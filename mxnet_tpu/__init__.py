@@ -1,0 +1,58 @@
+"""mxnet_tpu — a TPU-native framework with the capabilities of Apache MXNet 1.x.
+
+Top-level namespace mirrors the reference (``python/mxnet/__init__.py``):
+``mx.nd``, ``mx.sym``, ``mx.autograd``, ``mx.gluon``, ``mx.mod``, ``mx.kv``,
+``mx.io``, ``mx.optimizer``, ``mx.metric``, ``mx.init``, ``mx.context``.
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_devices, num_tpus
+from . import base
+from . import context
+from . import random
+from .random import seed
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import engine
+
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+
+from . import executor
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from .optimizer import Optimizer
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import monitor
+
+from . import io
+from . import recordio
+from . import image
+
+from . import kvstore
+from . import kvstore as kv
+
+from . import module
+from . import module as mod
+from .module import Module
+
+from . import gluon
+from . import model
+from .model import save_checkpoint, load_checkpoint
+
+from . import parallel
+from . import profiler
+from . import test_utils
+from . import visualization as viz
+from . import visualization
+from . import attribute
+from .attribute import AttrScope
+from . import name
+from .name import NameManager
+from . import util

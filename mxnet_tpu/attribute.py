@@ -1,0 +1,44 @@
+"""Attribute scoping (reference: python/mxnet/attribute.py AttrScope).
+
+``group2ctx``-style attributes attached here become pjit sharding/placement
+hints on the TPU build (symbol __ctx_group__ → mesh axis assignment).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope:
+    _tls = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attr = {k: str(v) for k, v in kwargs.items()}
+
+    def get(self, attr):
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        stack = AttrScope._stack()
+        merged = dict(stack[-1]._attr)
+        merged.update(self._attr)
+        self._attr = merged
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._stack().pop()
+
+    @staticmethod
+    def _stack():
+        if not hasattr(AttrScope._tls, "stack"):
+            AttrScope._tls.stack = [AttrScope()]
+        return AttrScope._tls.stack
+
+
+def current() -> AttrScope:
+    return AttrScope._stack()[-1]

@@ -1,0 +1,325 @@
+"""Tape-based autograd.
+
+Reference: ``src/imperative/imperative.cc`` (RecordOp :183, Backward :270) and
+``python/mxnet/autograd.py`` (record/pause :122,146, mark_variables :197,
+backward :243, grad :270, Function :363).
+
+TPU-native design: instead of per-op FGradient graph surgery, recording keeps
+a linear tape of (op, attrs, inputs, outputs).  ``backward`` replays the tape
+as a *pure function of the marked variables* and differentiates it with
+``jax.vjp`` — one XLA-traceable closure, so the whole backward pass compiles
+into a single fused program rather than the reference's node-by-node imperative
+execution (imperative.cc:346).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode", "is_recording", "is_training",
+    "mark_variables", "backward", "grad", "Function", "get_symbol",
+]
+
+_tls = threading.local()
+
+
+def _st():
+    if not hasattr(_tls, "recording"):
+        _tls.recording = False
+        _tls.training = False
+        _tls.tape = []
+        _tls.marked = {}  # id(handle) -> (weakref(var), weakref(grad), grad_req)
+    return _tls
+
+
+class _TapeEntry:
+    __slots__ = ("fn", "kwargs", "in_ids", "in_vals", "out_ids", "name",
+                 "_handle_refs")
+
+    def __init__(self, fn, kwargs, in_ids, in_vals, out_ids, name, handle_refs=()):
+        self.fn = fn
+        self.kwargs = kwargs
+        self.in_ids = in_ids
+        self.in_vals = in_vals  # captured buffers at record time
+        self.out_ids = out_ids
+        self.name = name
+        # strong refs keep input/output handles alive for the tape's lifetime
+        # so CPython cannot reuse their id() for unrelated arrays (the
+        # id-keyed env in _replay would silently mis-resolve otherwise)
+        self._handle_refs = handle_refs
+
+
+def _record_op(op, kwargs, inputs, outputs):
+    """Called by ndarray.invoke for every op executed under record()."""
+    st = _st()
+    st.tape.append(_TapeEntry(
+        op.fn, dict(kwargs),
+        [id(i) for i in inputs],
+        [i._data for i in inputs],
+        [id(o) for o in outputs],
+        op.name,
+        list(inputs) + list(outputs),
+    ))
+
+
+# ---------------------------------------------------------------------------
+# scopes
+# ---------------------------------------------------------------------------
+
+class _Scope:
+    def __init__(self, recording=None, training=None):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.recording, st.training = self._old
+
+
+def record(train_mode: bool = True) -> _Scope:
+    return _Scope(recording=True, training=train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(recording=False, training=train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(training=True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(training=False)
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(flag: bool) -> bool:
+    st = _st()
+    old = st.recording
+    st.recording = flag
+    return old
+
+
+def set_training(flag: bool) -> bool:
+    st = _st()
+    old = st.training
+    st.training = flag
+    return old
+
+
+# ---------------------------------------------------------------------------
+# variables + backward
+# ---------------------------------------------------------------------------
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference: MXAutogradMarkVariables)."""
+    st = _st()
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        st.marked[id(v)] = (weakref.ref(v), weakref.ref(g), req)
+
+
+def _replay(tape: List[_TapeEntry], var_ids: List[int], head_ids: List[int],
+            head_fallback: Dict[int, object]):
+    """Build the pure function replaying the tape over variable values."""
+
+    def f(var_vals):
+        env = dict(zip(var_ids, var_vals))
+        for entry in tape:
+            ins = [env.get(hid, val) for hid, val in zip(entry.in_ids, entry.in_vals)]
+            out = entry.fn(*ins, **entry.kwargs)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for oid, o in zip(entry.out_ids, outs):
+                env[oid] = o
+        return [env.get(h, head_fallback[h]) for h in head_ids]
+
+    return f
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. all marked variables and write them
+    into the variables' grad buffers (reference: Imperative::Backward)."""
+    st = _st()
+    heads = list(heads)
+    tape = st.tape
+    # live marked variables
+    var_entries = []
+    for hid, (vref, gref, req) in list(st.marked.items()):
+        v, g = vref(), gref()
+        if v is None or g is None:
+            del st.marked[hid]
+            continue
+        var_entries.append((hid, v, g, req))
+    if not var_entries:
+        raise RuntimeError("no variables marked for gradient (call attach_grad first)")
+
+    var_ids = [hid for hid, _, _, _ in var_entries]
+    var_vals = [v._data for _, v, _, _ in var_entries]
+    head_ids = [id(h) for h in heads]
+    head_fallback = {id(h): h._data for h in heads}
+
+    f = _replay(tape, var_ids, head_ids, head_fallback)
+    primals, vjp_fn = jax.vjp(f, var_vals)
+    if head_grads is None:
+        cts = [jnp.ones_like(p) for p in primals]
+    else:
+        cts = [jnp.ones_like(p) if hg is None else hg._data
+               for p, hg in zip(primals, head_grads)]
+    (grads,) = vjp_fn(cts)
+    for (hid, v, g, req), gv in zip(var_entries, grads):
+        if req == "null":
+            continue
+        if req == "add":
+            g._data = g._data + gv
+        else:
+            g._data = gv
+    if not retain_graph:
+        st.tape = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Functional-style gradient (reference: autograd.grad, python/mxnet/autograd.py:270).
+
+    Returns gradient NDArrays instead of writing into attached buffers.
+    ``create_graph=True`` re-records the gradient computation so higher-order
+    gradients work.
+    """
+    from .ndarray.ndarray import NDArray
+
+    st = _st()
+    heads = list(heads) if isinstance(heads, (list, tuple)) else [heads]
+    variables = list(variables) if isinstance(variables, (list, tuple)) else [variables]
+    tape = st.tape
+    var_ids = [id(v) for v in variables]
+    var_vals = [v._data for v in variables]
+    head_ids = [id(h) for h in heads]
+    head_fallback = {id(h): h._data for h in heads}
+
+    f = _replay(tape, var_ids, head_ids, head_fallback)
+    if create_graph:
+        # differentiate symbolically and keep the result on a fresh tape segment
+        def scalar_f(vals):
+            outs = f(vals)
+            return outs
+
+        primals, vjp_fn = jax.vjp(scalar_f, var_vals)
+        cts = [jnp.ones_like(p) if head_grads is None or head_grads[i] is None
+               else head_grads[i]._data for i, p in enumerate(primals)]
+        (grads,) = vjp_fn(cts)
+        outs = [NDArray(g) for g in grads]
+        # record a tape entry so a further backward can differentiate through
+        entry = _TapeEntry(
+            lambda *vals, **kw: tuple(jax.vjp(f, list(vals))[1](
+                [jnp.ones_like(p) for p in jax.eval_shape(f, list(vals))])[0]),
+            {}, var_ids, var_vals, [id(o) for o in outs], "_grad_of", list(outs))
+        if st.recording:
+            st.tape.append(entry)
+        if retain_graph is False:
+            st.tape = []
+        return outs
+    primals, vjp_fn = jax.vjp(f, var_vals)
+    cts = [jnp.ones_like(p) if head_grads is None or (isinstance(head_grads, list) and head_grads[i] is None)
+           else head_grads[i]._data for i, p in enumerate(primals)]
+    (grads,) = vjp_fn(cts)
+    if retain_graph is False or (retain_graph is None and not create_graph):
+        st.tape = []
+    return [NDArray(g) for g in grads]
+
+
+def get_symbol(x):
+    """Reference API parity: returns None (no NNVM symbol for eager arrays)."""
+    return None
+
+
+# ---------------------------------------------------------------------------
+# custom Function (reference: python/mxnet/autograd.py:363)
+# ---------------------------------------------------------------------------
+
+class Function:
+    """User-defined differentiable function.
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` over NDArrays; save state on ``self``.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        st = _st()
+        with pause():
+            outputs = self.forward(*inputs)
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+        if st.recording:
+            fn = _make_custom_vjp(self, len(inputs), len(outs))
+            st.tape.append(_TapeEntry(
+                fn, {}, [id(i) for i in inputs], [i._data for i in inputs],
+                [id(o) for o in outs], type(self).__name__,
+                list(inputs) + list(outs)))
+        return outputs if multi else outs[0]
+
+
+def _make_custom_vjp(func: Function, n_in: int, n_out: int):
+    from .ndarray.ndarray import NDArray
+
+    @jax.custom_vjp
+    def fn(*vals):
+        with pause():
+            outs = func.forward(*[NDArray(v) for v in vals])
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        return tuple(o._data for o in outs)
+
+    def fwd(*vals):
+        return fn(*vals), vals
+
+    def bwd(res, gs):
+        with pause():
+            grads = func.backward(*[NDArray(g) for g in gs])
+        grads = grads if isinstance(grads, (tuple, list)) else (grads,)
+        return tuple(g._data if isinstance(g, NDArray) else g for g in grads)
+
+    fn.defvjp(fwd, bwd)
+    if n_out == 1:
+        return lambda *vals, **kw: fn(*vals)[0]
+    return fn

@@ -1,0 +1,127 @@
+"""Shared small utilities: dtype mapping, shape checks, registry, env knobs.
+
+Replaces the dmlc-core substrate of the reference (logging/CHECK macros,
+``dmlc::GetEnv`` env-var access, ``dmlc::Registry`` — see SURVEY.md §2.2) with
+plain-Python equivalents.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Generic, Iterable, Optional, Tuple, TypeVar
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "getenv",
+    "Registry",
+    "np_dtype",
+    "canonical_dtype",
+    "check",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: ``dmlc::Error`` surfaced as MXNetError)."""
+
+
+def getenv(name: str, default):
+    """Typed env lookup (reference: ``dmlc::GetEnv`` — 45 MXNET_* knobs).
+
+    The same MXNET_* names are honored so reference users' job scripts keep
+    working; cast follows the type of ``default``.
+    """
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    """Name → object registry with alias support.
+
+    Stands in for dmlc::Registry which backs the reference's op/iter/metric/
+    optimizer/initializer registries.
+    """
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._map: Dict[str, T] = {}
+
+    def register(self, name: Optional[str] = None, *aliases: str) -> Callable[[T], T]:
+        def _reg(obj: T) -> T:
+            key = (name or getattr(obj, "__name__", None) or str(obj)).lower()
+            self._map[key] = obj
+            for a in aliases:
+                self._map[a.lower()] = obj
+            return obj
+
+        return _reg
+
+    def get(self, name: str) -> T:
+        key = name.lower()
+        if key not in self._map:
+            raise KeyError(
+                f"{self.kind} {name!r} is not registered; known: {sorted(self._map)}"
+            )
+        return self._map[key]
+
+    def find(self, name: str) -> Optional[T]:
+        return self._map.get(name.lower())
+
+    def __contains__(self, name: str) -> bool:
+        return name.lower() in self._map
+
+    def keys(self) -> Iterable[str]:
+        return self._map.keys()
+
+
+_DTYPE_ALIASES = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "bfloat16": "bfloat16",  # resolved lazily via ml_dtypes through jnp
+    "uint8": np.uint8,
+    "int8": np.int8,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+
+def np_dtype(dtype) -> np.dtype:
+    """Canonicalize a dtype spec (str | np.dtype | jnp dtype) to np.dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str) and dtype in _DTYPE_ALIASES:
+        dtype = _DTYPE_ALIASES[dtype]
+    if dtype == "bfloat16":
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(dtype)
+
+
+def canonical_dtype(dtype) -> str:
+    return np_dtype(dtype).name
+
+
+def check(cond: bool, msg: str = "check failed") -> None:
+    """CHECK macro analogue; raises MXNetError."""
+    if not cond:
+        raise MXNetError(msg)
+
+
+def tuple_shape(shape) -> Tuple[int, ...]:
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)

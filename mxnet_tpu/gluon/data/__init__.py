@@ -1,0 +1,9 @@
+"""gluon.data (reference: python/mxnet/gluon/data/)."""
+from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
+from .dataloader import DataLoader
+from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
+from . import vision
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
+           "DataLoader", "Sampler", "SequentialSampler", "RandomSampler",
+           "BatchSampler", "vision"]

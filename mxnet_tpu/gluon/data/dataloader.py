@@ -1,0 +1,90 @@
+"""DataLoader (reference: python/mxnet/gluon/data/dataloader.py:26-74 —
+multiprocessing workers + shared-memory NDArray IPC).
+
+TPU-native: worker parallelism uses a thread pool rather than fork —
+host-side decode releases the GIL in numpy/PIL, and device upload is a single
+async jax transfer per batch, so threads reach the same overlap the
+reference's process pool + CPUSharedStorageManager achieves without the shm
+plumbing (src/storage/cpu_shared_storage_manager.h).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    arr = _np.asarray(data)
+    if arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    return nd.array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None, thread_pool=True):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with explicit sampler")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError("batch_sampler is mutually exclusive with "
+                             "batch_size/shuffle/sampler/last_batch")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, int(num_workers))
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._pool = ThreadPoolExecutor(self._num_workers) if self._num_workers else None
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch_idx in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+            return
+        # pipelined: submit ahead, yield in order
+        pending = []
+        it = iter(self._batch_sampler)
+
+        def fetch(batch_idx):
+            return self._batchify_fn([self._dataset[i] for i in batch_idx])
+
+        try:
+            for _ in range(self._prefetch + 1):
+                pending.append(self._pool.submit(fetch, next(it)))
+        except StopIteration:
+            pass
+        while pending:
+            fut = pending.pop(0)
+            try:
+                pending.append(self._pool.submit(fetch, next(it)))
+            except StopIteration:
+                pass
+            yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,107 @@
+"""Vision transforms (reference: python/mxnet/gluon/data/vision/transforms.py)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .... import ndarray as nd
+from ....ndarray.ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom"]
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference: transforms.ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        if x.ndim == 3:
+            return F.transpose(x.astype("float32") / 255.0, axes=(2, 0, 1))
+        return F.transpose(x.astype("float32") / 255.0, axes=(0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = nd.array(_np.asarray(self._mean, dtype=_np.float32)
+                        .reshape(-1, 1, 1)) if not _np.isscalar(self._mean) else self._mean
+        std = nd.array(_np.asarray(self._std, dtype=_np.float32)
+                       .reshape(-1, 1, 1)) if not _np.isscalar(self._std) else self._std
+        return (x - mean) / std
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._interp = interpolation
+
+    def forward(self, x):
+        from .... import image as img_mod
+
+        arr = x.asnumpy() if isinstance(x, NDArray) else x
+        out = img_mod._resize_np(arr, self._size[1], self._size[0], self._interp)
+        return nd.array(out)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._interp = interpolation
+
+    def forward(self, x):
+        from .... import image as img_mod
+
+        arr = x.asnumpy() if isinstance(x, NDArray) else x
+        out, _ = img_mod.center_crop(arr, self._size, self._interp)
+        return nd.array(out)
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3), interpolation=1):
+        super().__init__()
+        self._args = (size if isinstance(size, (tuple, list)) else (size, size),
+                      scale, ratio, interpolation)
+
+    def forward(self, x):
+        from .... import image as img_mod
+
+        arr = x.asnumpy() if isinstance(x, NDArray) else x
+        out, _ = img_mod.random_size_crop(arr, *self._args)
+        return nd.array(out)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return x[:, ::-1] if x.ndim == 3 else x[:, :, ::-1]
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if _np.random.rand() < 0.5:
+            return x[::-1]
+        return x

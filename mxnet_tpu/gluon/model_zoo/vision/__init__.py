@@ -1,0 +1,21 @@
+"""gluon.model_zoo.vision (reference: python/mxnet/gluon/model_zoo/vision/
+— alexnet/densenet/inception/mobilenet/resnet/squeezenet/vgg)."""
+from .resnet import *  # noqa: F401,F403
+from .simple_nets import *  # noqa: F401,F403
+from .resnet import get_resnet
+from . import resnet, simple_nets
+
+_models = {}
+for _mod in (resnet, simple_nets):
+    for _name in _mod.__all__:
+        obj = getattr(_mod, _name)
+        if callable(obj) and _name[0].islower():
+            _models[_name] = obj
+
+
+def get_model(name, **kwargs):
+    """Factory by model name (reference: model_zoo/vision/__init__.py)."""
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(f"unknown model {name!r}; available: {sorted(_models)}")
+    return _models[name](**kwargs)

@@ -1,0 +1,307 @@
+"""Gluon Parameter / ParameterDict (reference: python/mxnet/gluon/parameter.py,
+918 LoC — Parameter with deferred init, grad_req, contexts; ParameterDict with
+prefix scoping and sharing).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as _np
+
+from .. import autograd, initializer as init_mod
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..initializer import InitDesc
+from ..ndarray import zeros as nd_zeros
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._stype = stype
+        self._data: Optional[NDArray] = None
+        self._grad: Optional[NDArray] = None
+        self._deferred_init = None
+        self._ctx_list: List[Context] = []
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._grad = None
+            else:
+                self._init_grad()
+
+    def _shape_known(self):
+        return self.shape is not None and all(s > 0 for s in self.shape)
+
+    def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if not self._shape_known():
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                f"cannot initialize {self.name}: unknown shape {self.shape}; "
+                "set allow_deferred_init or pass in_units/in_channels")
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        self._deferred_init = None
+        arr = nd_zeros(self.shape, ctx=ctx[0], dtype=self.dtype)
+        initializer = init or self.init or default_init
+        desc = InitDesc(self.name, {"__init__": ""})
+        initializer(desc, arr)
+        self._data = arr
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = NDArray(_zeros_like_data(self._data))
+        autograd.mark_variables([self._data], [self._grad],
+                                grad_reqs=[self._grad_req])
+
+    def _finish_deferred_init(self, shape):
+        if self._deferred_init is None:
+            raise DeferredInitializationError(self.name)
+        self.shape = tuple(shape)
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    def set_data(self, data):
+        if self._data is None:
+            if self._deferred_init is not None:
+                self._finish_deferred_init(data.shape)
+            else:
+                raise MXNetError(f"parameter {self.name} not initialized")
+        self._data._data = data._data.astype(self._data._data.dtype) \
+            if hasattr(data, "_data") else data
+        # preserve autograd marking: the handle identity is unchanged
+
+    def data(self, ctx=None) -> NDArray:
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} deferred; run a forward pass first")
+            raise MXNetError(f"parameter {self.name} has not been initialized")
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None) -> NDArray:
+        if self._grad is None:
+            raise MXNetError(f"parameter {self.name} has no gradient "
+                             f"(grad_req={self._grad_req})")
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        return list(self._ctx_list) or [current_context()]
+
+    def zero_grad(self):
+        if self._grad is not None:
+            import jax.numpy as jnp
+
+            self._grad._data = jnp.zeros_like(self._grad._data)
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._ctx_list = list(ctx)
+        if self._data is not None:
+            self._data._data = self._data.as_in_context(ctx[0])._data
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data._data = self._data.astype(dtype)._data
+            if self._grad is not None:
+                self._grad._data = self._grad.astype(dtype)._data
+
+    def var(self):
+        from .. import symbol as sym
+
+        return sym.var(self.name, shape=self.shape, dtype=self.dtype,
+                       lr_mult=self.lr_mult, wd_mult=self.wd_mult)
+
+
+def _zeros_like_data(arr: NDArray):
+    import jax.numpy as jnp
+
+    return jnp.zeros_like(arr._data)
+
+
+class Constant(Parameter):
+    """Non-learnable constant parameter (reference: gluon Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            from ..ndarray import array
+
+            value = array(_np.asarray(value))
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def _init_weight(s, _, arr):
+                arr._data = value._data
+
+            _init_default = _init_weight
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=_CInit(), differentiable=False)
+
+
+class ParameterDict:
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        s = "\n".join(repr(p) for p in self._params.values())
+        return f"ParameterDict(prefix={self._prefix!r})\n{s}"
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs) -> Parameter:
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if getattr(param, k, None) is None and v is not None:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None) -> Constant:
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(f"no constant named {name}")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(f"duplicate parameter {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        for p in self.values():
+            p.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from .. import ndarray as nd
+
+        arg_dict = {}
+        for param in self.values():
+            block = param.list_data()
+            weight = block[0]
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(f"prefix {strip_prefix!r} does not match {param.name}")
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from .. import ndarray as nd
+
+        arg_dict = nd.load(filename)
+        arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise MXNetError(f"parameter {name} missing in {filename}")
+        for name, value in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError(f"parameter {name} in file is not in this dict")
+                continue
+            p = self._params[name]
+            if p._data is None:
+                p.shape = value.shape
+                p.initialize(ctx=ctx)
+            p.set_data(value)

@@ -1,0 +1,105 @@
+"""Gluon fused RNN layers (reference: python/mxnet/gluon/rnn/rnn_layer.py, 526
+LoC).  Backed by the fused `RNN` op (lax.scan over MXU matmuls)."""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...ops.rnn import rnn_param_size
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        with self.name_scope():
+            shape = (rnn_param_size(mode, num_layers, input_size, hidden_size,
+                                    bidirectional),) if input_size else (0,)
+            self.parameters = self.params.get("parameters", shape=shape,
+                                              allow_deferred_init=True)
+
+    def _param_shape(self, param, args):
+        x = args[0]
+        input_size = x.shape[-1]
+        return (rnn_param_size(self._mode, self._num_layers, input_size,
+                               self._hidden_size, self._dir == 2),)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(nd.zeros(info["shape"]))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, parameters=None):
+        if self._layout == "NTC":
+            inputs = F.transpose(inputs, axes=(1, 0, 2))
+        batch_size = inputs.shape[1]
+        explicit_states = states is not None
+        if states is None:
+            states = self.begin_state(batch_size)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        args = [inputs, parameters] + list(states)
+        out = F.RNN(*args, state_size=self._hidden_size,
+                    num_layers=self._num_layers, mode=self._mode,
+                    bidirectional=self._dir == 2, p=self._dropout,
+                    state_outputs=explicit_states)
+        if explicit_states:
+            outputs, out_states = out[0], list(out[1:])
+        else:
+            outputs = out
+            out_states = None
+        if self._layout == "NTC":
+            outputs = F.transpose(outputs, axes=(1, 0, 2))
+        return (outputs, out_states) if explicit_states else outputs
+
+    def __repr__(self):
+        return (f"{self.__class__.__name__}({self._hidden_size}, "
+                f"layers={self._num_layers}, bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu", layout="TNC",
+                 dropout=0, bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "rnn_" + ("relu" if activation == "relu" else "tanh"),
+                         **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -1,0 +1,170 @@
+"""Gluon Trainer (reference: python/mxnet/gluon/trainer.py — kvstore wiring
+:158-212, step :254, allreduce_grads :282, update :300).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import kvstore as kvs_mod
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be dict/list of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(f"invalid parameter {param}")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = dict(optimizer_params or {})
+        self._scale = optimizer_params.get("rescale_grad", 1.0)
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_arg = kvstore
+        self._update_on_kvstore_arg = update_on_kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = False
+        self._params_to_init = list(self._params)
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and set(optimizer_params) - {"rescale_grad"}:
+                raise ValueError(
+                    "optimizer_params must be None when optimizer is an instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(
+                optimizer, param_dict=param_dict,
+                param_idx2name={i: p.name for i, p in enumerate(self._params)},
+                **optimizer_params)
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        if self._kvstore_arg is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            kv = self._kvstore_arg if isinstance(self._kvstore_arg, kvs_mod.KVStore) \
+                else (kvs_mod.create(self._kvstore_arg)
+                      if isinstance(self._kvstore_arg, str) else None)
+            self._kvstore = kv
+            update = self._update_on_kvstore_arg
+            if update is None:
+                update = kv is not None and "dist" in getattr(kv, "type", "")
+            self._update_on_kvstore = bool(update) and kv is not None
+            if kv is not None:
+                if self._compression_params:
+                    kv.set_gradient_compression(self._compression_params)
+                if self._update_on_kvstore:
+                    kv.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    def _init_params(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None:
+            for param in self._params_to_init:
+                if param._data is not None:
+                    idx = self._param2idx[param.name]
+                    self._kvstore.init(idx, param.data())
+            self._params_to_init = [p for p in self._params_to_init if p._data is None]
+        else:
+            self._params_to_init = [p for p in self._params_to_init if p._data is None]
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
+        if not self._kv_initialized:
+            self._init_params()
+        if self._kvstore is not None:
+            idx = self._param2idx[parameter.name]
+            self._kvstore.row_sparse_pull(idx, out=out, row_ids=row_id)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Rescale + allreduce + update (reference: trainer.py:254)."""
+        if not self._kv_initialized:
+            self._init_params()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_params()
+        if self._update_on_kvstore:
+            raise MXNetError("allreduce_grads() is invalid with update_on_kvstore")
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None or self._update_on_kvstore:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                self._kvstore.push(i, param.list_grad(), priority=-i)
+                self._kvstore.pull(i, param.list_grad(), priority=-i,
+                                   ignore_sparse=False)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_params()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if param._data is None:
+                if not ignore_stale_grad:
+                    raise MXNetError(f"parameter {param.name} not initialized")
+                continue
+            if self._update_on_kvstore:
+                self._kvstore.push(i, param.list_grad(), priority=-i)
+                self._kvstore.pull(i, param.list_data(), priority=-i)
+            else:
+                for updater, w, g in zip(self._updaters, param.list_data(),
+                                         param.list_grad()):
+                    updater(i, g, w)
+
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._updaters[0].optimizer
+            self._optimizer = self._updaters[0].optimizer

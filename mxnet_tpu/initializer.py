@@ -1,0 +1,278 @@
+"""Weight initializers (reference: python/mxnet/initializer.py — registry at
+:53, Xavier :444, MSRAPrelu :477, Orthogonal :547, Bilinear :613, LSTMBias).
+
+Same name-pattern dispatch as the reference: arrays whose names end in
+``bias``/``gamma``/``beta``/``moving_*`` get their special defaults; everything
+else goes through the configured weight initializer.
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Optional
+
+import numpy as _np
+
+from .base import Registry
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Initializer", "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
+           "Bilinear", "Zero", "One", "Constant", "LSTMBias", "Mixed", "InitDesc",
+           "register", "create"]
+
+_REG: Registry = Registry("initializer")
+register = _REG.register
+
+
+class InitDesc(str):
+    """Array name + attrs hint (reference: initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func
+        return self
+
+    def dumps(self) -> str:
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr: NDArray):
+        if not isinstance(desc, str):
+            raise TypeError("desc must be a string or InitDesc")
+        init = getattr(desc, "attrs", {}).get("__init__", "")
+        if init:
+            klass, kwargs = json.loads(init)
+            create(klass, **kwargs)._init_weight(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("moving_mean") or name.endswith("running_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("moving_var") or name.endswith("running_var"):
+            self._init_one(desc, arr)
+        elif name.endswith("moving_inv_var") or name.endswith("moving_avg"):
+            self._init_zero(desc, arr)
+        elif name.endswith("min") or name.endswith("max"):
+            self._init_zero(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # element initializers -------------------------------------------------------
+    def _set(self, arr: NDArray, value: _np.ndarray):
+        import jax.numpy as jnp
+
+        arr._data = jnp.asarray(value.astype(_np.asarray(arr._data).dtype) if
+                                hasattr(value, "astype") else value)
+
+    def _init_zero(self, desc, arr):
+        self._set(arr, _np.zeros(arr.shape, dtype=_np.float32))
+
+    def _init_one(self, desc, arr):
+        self._set(arr, _np.ones(arr.shape, dtype=_np.float32))
+
+    def _init_bias(self, desc, arr):
+        self._init_zero(desc, arr)
+
+    def _init_gamma(self, desc, arr):
+        self._init_one(desc, arr)
+
+    def _init_beta(self, desc, arr):
+        self._init_zero(desc, arr)
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+def _rng():
+    from . import random as _r
+    import numpy.random as npr
+
+    return _np.random
+
+
+@register("uniform")
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _np.random.uniform(-self.scale, self.scale, arr.shape))
+
+
+@register("normal")
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _np.random.normal(0, self.sigma, arr.shape))
+
+
+@register("zero", "zeros")
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_zero(desc, arr)
+
+
+@register("one", "ones")
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_one(desc, arr)
+
+
+@register("constant")
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _np.full(arr.shape, self.value, dtype=_np.float32))
+
+
+@register("orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        self._set(arr, (self.scale * q).reshape(arr.shape))
+
+
+@register("xavier")
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(f"Xavier requires ndim>=2, got {shape} for {desc}")
+        if len(shape) > 2:
+            hw_scale = float(_np.prod(shape[2:]))
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in, "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            self._set(arr, _np.random.uniform(-scale, scale, shape))
+        else:
+            self._set(arr, _np.random.normal(0, scale, shape))
+
+
+@register("msraprelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register("bilinear")
+class Bilinear(Initializer):
+    def _init_weight(self, desc, arr):
+        weight = _np.zeros(int(_np.prod(arr.shape)), dtype=_np.float32)
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+
+@register("lstmbias")
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = _np.zeros(arr.shape, dtype=_np.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        self._set(arr, b)
+
+    _init_bias = _init_weight
+    _init_default = _init_weight
+
+
+@register("fusedrnn")
+class FusedRNN(Initializer):
+    """Initialize a fused RNN parameter blob (reference: initializer.py FusedRNN)."""
+
+    def __init__(self, init=None, num_hidden=0, num_layers=1, mode="lstm",
+                 bidirectional=False, forget_bias=1.0):
+        super().__init__(num_hidden=num_hidden, num_layers=num_layers, mode=mode)
+        self._init = init or Xavier()
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _np.random.uniform(-0.07, 0.07, arr.shape))
+
+    _init_default = _init_weight
+
+
+class Mixed:
+    """Pattern-dispatched initializer list (reference: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, desc, arr):
+        for pat, init in self.map:
+            if pat.match(desc):
+                init(desc, arr)
+                return
+        raise ValueError(f"no initializer pattern matches {desc!r}")
+
+
+def create(name, **kwargs) -> Initializer:
+    if isinstance(name, Initializer):
+        return name
+    return _REG.get(name)(**kwargs)

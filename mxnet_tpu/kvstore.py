@@ -1,0 +1,249 @@
+"""KVStore: parameter synchronization.
+
+Reference: ``include/mxnet/kvstore.h:59-364`` + factory
+(``src/kvstore/kvstore.cc:40-77``) with types local / device / nccl /
+dist_sync / dist_async / dist_device_sync.
+
+TPU-native mapping (SURVEY.md §5.8):
+- ``local`` / ``device``  → single-process reduce over per-device buffers
+  (the reference's CommCPU/CommDevice trees collapse to one XLA reduction —
+  ICI/HBM bandwidth replaces PCIe tree topology planning).
+- ``tpu_sync`` (also answering to ``nccl``) → reduce/broadcast lower to
+  ``jax.lax.psum`` over the active device mesh when values are sharded
+  (see parallel/collectives.py); per-device lists reduce on-device otherwise.
+- ``dist_sync`` / ``dist_async`` / ``dist_device_sync`` → host-side TCP
+  parameter server (kvstore_dist.py) replacing ps-lite: scheduler + servers +
+  workers with BSP merge exactly matching kvstore_dist_server.h:346-358
+  semantics; rank/size/barrier surface the same API.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, getenv
+from .ndarray.ndarray import NDArray
+from .ndarray import sparse as _sparse
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreTPUSync", "create"]
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class KVStore:
+    """Abstract base mirroring the reference KVStore API."""
+
+    def __init__(self):
+        self._updater = None
+        self._str_updater = None
+        self._grad_compression = None
+
+    # -- data plane ---------------------------------------------------------------
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out=out, priority=priority)
+
+    # -- control plane ------------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        from .optimizer import Updater
+
+        self._updater = Updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        from .parallel.compression import GradientCompression
+
+        self._grad_compression = GradientCompression(**compression_params)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _barrier_before_exit(self):
+        pass
+
+
+class KVStoreLocal(KVStore):
+    """Single-process multi-device store (reference: src/kvstore/kvstore_local.h).
+
+    Semantics match the reference exactly:
+    - with an updater set: stored value is the weight; push reduces gradients
+      and applies the updater; pull broadcasts the weight.
+    - without an updater: push reduces and *replaces* the stored value; pull
+      returns it (the Module 'not update_on_kvstore' path, model.py:145-177).
+    """
+
+    def __init__(self, device_reduce: bool = False):
+        super().__init__()
+        self._type = "device" if device_reduce else "local"
+        self._store: Dict = {}
+
+    def init(self, key, value):
+        keys = _as_list(key)
+        values = _as_list(value)
+        if len(values) != len(keys):  # single key, multiple device values
+            values = [values]
+        for k, v in zip(keys, values):
+            v0 = v[0] if isinstance(v, (list, tuple)) else v
+            if isinstance(v0, _sparse.BaseSparseNDArray):
+                self._store[k] = v0
+            else:
+                self._store[k] = NDArray(v0._data)
+
+    def _reduce(self, vals: List[NDArray]):
+        if len(vals) == 1:
+            v = vals[0]
+            if isinstance(v, _sparse.RowSparseNDArray):
+                return v
+            return NDArray(v._data)
+        if any(isinstance(v, _sparse.RowSparseNDArray) for v in vals):
+            idx = jnp.concatenate([v.indices_ for v in vals])
+            values = jnp.concatenate([v.values_ for v in vals])
+            return _sparse.RowSparseNDArray(values, idx, vals[0].shape)
+        # one fused XLA reduction; inputs migrate to the first buffer's device
+        acc = vals[0]._data
+        for v in vals[1:]:
+            acc = acc + jax.device_put(v._data, list(acc.devices())[0])
+        return NDArray(acc)
+
+    def push(self, key, value, priority=0):
+        keys = _as_list(key)
+        values = _as_list(value)
+        if len(keys) == 1 and (not isinstance(value, (list, tuple))
+                               or not isinstance(value[0], (list, tuple))):
+            values = [values] if not isinstance(values[0], (list, tuple)) else values
+        for k, v in zip(keys, values):
+            vlist = _as_list(v)
+            merged = self._reduce(vlist)
+            if k not in self._store:
+                raise MXNetError(f"kvstore: key {k!r} not initialized")
+            if self._updater is not None:
+                weight = self._store[k]
+                self._updater(k, merged, weight)
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = _as_list(key)
+        outs = _as_list(out)
+        if len(keys) == 1 and not isinstance(out, (list, tuple)):
+            outs = [outs]
+        elif len(keys) == 1 and isinstance(out, (list, tuple)) \
+                and not isinstance(out[0], (list, tuple)):
+            outs = [outs]
+        for k, o in zip(keys, outs):
+            src = self._store.get(k)
+            if src is None:
+                raise MXNetError(f"kvstore: key {k!r} not initialized")
+            for dst in _as_list(o):
+                if isinstance(src, _sparse.BaseSparseNDArray):
+                    src.copyto(dst) if isinstance(dst, _sparse.BaseSparseNDArray) \
+                        else dst.__setattr__("_data", src._to_dense_jax())
+                else:
+                    dst._data = src._data
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only requested rows (reference: kvstore.h:209-223). On TPU this
+        is the sharded-embedding gather path."""
+        keys = _as_list(key)
+        outs = _as_list(out)
+        rids = _as_list(row_ids)
+        if len(keys) == 1:
+            outs = [outs] if not isinstance(out, (list, tuple)) or \
+                not isinstance(out[0], (list, tuple)) else outs
+            rids = [rids] if not isinstance(row_ids, (list, tuple)) else [row_ids] \
+                if isinstance(row_ids, NDArray) else rids
+        for k, o, r in zip(keys, outs, rids):
+            src = self._store.get(k)
+            for dst, rid in zip(_as_list(o), _as_list(r) * len(_as_list(o))):
+                retained = _sparse.retain(
+                    src if isinstance(src, _sparse.RowSparseNDArray)
+                    else _sparse.cast_storage(src, "row_sparse"), rid)
+                if isinstance(dst, _sparse.RowSparseNDArray):
+                    retained.copyto(dst)
+                else:
+                    dst._data = retained._to_dense_jax()
+
+
+class KVStoreTPUSync(KVStoreLocal):
+    """`tpu_sync`: collective-backed store.
+
+    Per-device value lists reduce in one XLA program; when the caller is inside
+    an SPMD region (shard_map over a Mesh), reduce/broadcast lower to psum over
+    ICI — see parallel/collectives.py `allreduce_grads`, which the Trainer and
+    Module use for the fused data-parallel step.  This class is the boundary
+    where the reference's NCCL semantics (kvstore_nccl.h:285,402) become XLA
+    collectives.
+    """
+
+    def __init__(self):
+        super().__init__(device_reduce=True)
+        self._type = "tpu_sync"
+
+    @property
+    def num_workers(self):
+        return int(os.environ.get("TPUMX_NUM_WORKERS", "1"))
+
+    @property
+    def rank(self):
+        return int(os.environ.get("TPUMX_RANK", "0"))
+
+
+def create(name: str = "local") -> KVStore:
+    """Factory (reference: src/kvstore/kvstore.cc:40-77 + python/mxnet/kvstore.py)."""
+    name = name.lower()
+    if name == "local" or name.startswith("local_"):
+        return KVStoreLocal()
+    if name == "device":
+        return KVStoreLocal(device_reduce=True)
+    if name in ("tpu_sync", "nccl"):
+        return KVStoreTPUSync()
+    if name.startswith("dist"):
+        from .kvstore_dist import KVStoreDist
+
+        return KVStoreDist(name)
+    raise MXNetError(f"unknown kvstore type {name!r}")

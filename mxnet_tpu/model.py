@@ -1,0 +1,124 @@
+"""Model helpers: checkpointing + kvstore wiring (reference: python/mxnet/model.py
+— _create_kvstore :77, _initialize_kvstore :116, _update_params_on_kvstore :145,
+save_checkpoint :384, load_checkpoint :414).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Dict, Optional
+
+from . import ndarray as nd
+from . import symbol as sym
+from .kvstore import KVStore, create as _create_kv
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+           "_create_kvstore", "_initialize_kvstore", "_update_params_on_kvstore",
+           "_update_params"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def _create_kvstore(kvstore, num_device: int, arg_params):
+    """Returns (kvstore, update_on_kvstore) — reference model.py:77."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = _create_kv(kvstore)
+            if kvstore == "local":
+                max_size = max(p.size for p in arg_params.values()) if arg_params else 0
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """Init each param on the store, broadcasting rank-0 weights — model.py:116."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        name = param_names[idx]
+        kvstore.init(name, arg_params[name])
+        if update_on_kvstore:
+            kvstore.pull(name, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
+    """Push grads / pull updated weights, priority-ordered so comm of layer i
+    overlaps compute of layer i+1 (reference model.py:145-156; on TPU the
+    overlap is realized by XLA latency-hiding over async dispatch)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None, param_names=None):
+    """Allreduce grads via kvstore then run the local updater per device
+    (reference model.py:157-177)."""
+    updates = [[] for _ in range(num_device)]
+    for i, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        index = i
+        if kvstore:
+            name = param_names[index]
+            kvstore.push(name, grad_list, priority=-index)
+            kvstore.pull(name, grad_list, priority=-index)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            w, g = p
+            updates[k].append((index * num_device + k, g, w))
+    for dev_updates in updates:
+        if dev_updates:
+            i, g, w = zip(*dev_updates)
+            updater(list(i), list(g), list(w))
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Write prefix-symbol.json + prefix-%04d.params (reference: model.py:384)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """Returns (symbol, arg_params, aux_params) — reference: model.py:414."""
+    import os
+
+    symbol = None
+    if os.path.exists(f"{prefix}-symbol.json"):
+        symbol = sym.load(f"{prefix}-symbol.json")
+    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy API shim (reference: model.py FeedForward). Use Module."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "FeedForward is deprecated in the reference; use mxnet_tpu.module.Module")

@@ -1,0 +1,49 @@
+"""Automatic op naming (reference: python/mxnet/name.py NameManager)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager:
+    _tls = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        hint = hint.lower()
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self):
+        stack = NameManager._stack()
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._stack().pop()
+
+    @staticmethod
+    def _stack():
+        if not hasattr(NameManager._tls, "stack"):
+            NameManager._tls.stack = [NameManager()]
+        return NameManager._tls.stack
+
+
+class Prefix(NameManager):
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return name if name else self._prefix + super().get(None, hint)
+
+
+def current() -> NameManager:
+    return NameManager._stack()[-1]

@@ -1,0 +1,70 @@
+"""`mx.nd.contrib` namespace (reference: python/mxnet/ndarray/contrib.py)."""
+from __future__ import annotations
+
+from ..ops.registry import get_op, OP_REGISTRY
+from .ndarray import NDArray, invoke
+import sys
+
+_mod = sys.modules[__name__]
+
+# expose all _contrib_* registered ops under their short names
+for _name, _op in list(OP_REGISTRY.items()):
+    if _name.startswith("_contrib_"):
+        short = _name[len("_contrib_"):]
+
+        def _make(op):
+            def f(*args, out=None, **kwargs):
+                inputs = [a for a in args if isinstance(a, NDArray)]
+                return invoke(op, inputs, kwargs, out=out)
+            return f
+
+        setattr(_mod, short, _make(_op))
+        setattr(_mod, _name, getattr(_mod, short))
+
+
+def foreach(body, data, init_states):
+    """Reference: control-flow op _foreach (src/operator/control_flow.cc:1256).
+    Imperative version: a Python loop (the symbolic/jit path uses lax.scan)."""
+    states = init_states if isinstance(init_states, list) else [init_states]
+    seq = data if isinstance(data, list) else [data]
+    T = seq[0].shape[0]
+    outs = None
+    for t in range(T):
+        xs = [s[t] for s in seq]
+        out, states = body(xs[0] if len(xs) == 1 else xs, states)
+        out_list = out if isinstance(out, list) else [out]
+        if outs is None:
+            outs = [[] for _ in out_list]
+        for acc, o in zip(outs, out_list):
+            acc.append(o)
+    import mxnet_tpu.ndarray as nd
+
+    stacked = [nd.stack(*acc, axis=0) for acc in outs]
+    return (stacked[0] if len(stacked) == 1 else stacked), states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Reference: _while_loop (control_flow.cc:1317). Imperative version."""
+    steps = 0
+    outs = None
+    lv = list(loop_vars)
+    while bool(cond(*lv).asscalar()) and (max_iterations is None or steps < max_iterations):
+        out, lv = func(*lv)
+        out_list = out if isinstance(out, list) else [out]
+        if outs is None:
+            outs = [[] for _ in out_list]
+        for acc, o in zip(outs, out_list):
+            acc.append(o)
+        steps += 1
+    import mxnet_tpu.ndarray as nd
+
+    if outs is None:
+        return [], lv
+    return [nd.stack(*acc, axis=0) for acc in outs], lv
+
+
+def cond(pred, then_func, else_func):
+    """Reference: _cond (control_flow.cc:1379). Imperative version."""
+    if bool(pred.asscalar()):
+        return then_func()
+    return else_func()

@@ -1,0 +1,28 @@
+"""`mx.nd.linalg` namespace (reference: src/operator/tensor/la_op.cc)."""
+from __future__ import annotations
+
+from ..ops.registry import get_op
+from .ndarray import invoke
+
+__all__ = ["gemm2", "potrf", "trsm", "syrk"]
+
+
+def gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, out=None):
+    return invoke(get_op("linalg_gemm2"), [a, b],
+                  {"transpose_a": transpose_a, "transpose_b": transpose_b,
+                   "alpha": alpha}, out=out)
+
+
+def potrf(a, out=None):
+    return invoke(get_op("linalg_potrf"), [a], {}, out=out)
+
+
+def trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0, out=None):
+    return invoke(get_op("linalg_trsm"), [a, b],
+                  {"transpose": transpose, "rightside": rightside,
+                   "lower": lower, "alpha": alpha}, out=out)
+
+
+def syrk(a, transpose=False, alpha=1.0, out=None):
+    return invoke(get_op("linalg_syrk"), [a], {"transpose": transpose, "alpha": alpha},
+                  out=out)

@@ -1,0 +1,544 @@
+"""NDArray: the imperative tensor.
+
+TPU-native analogue of the reference NDArray (``include/mxnet/ndarray.h:82``,
+``src/ndarray/ndarray.cc``).  Differences by design:
+
+- The reference pairs every NDArray with an engine variable and schedules
+  kernels through the ThreadedEngine.  Here the *JAX runtime already is* that
+  async engine: every op dispatch is non-blocking, ordering is defined by
+  data dependencies between immutable ``jax.Array`` values, and
+  ``wait_to_read`` maps to ``block_until_ready`` (reference
+  ``WaitToRead``/``WaitToWrite`` — ndarray.h:315,323).
+- Mutability is at the *handle* level: an NDArray is a mutable cell holding an
+  immutable device buffer; in-place ops rebind the cell.  This is exactly the
+  write-after-read hazard model the reference's engine vars solve, but solved
+  by construction (old readers keep the old buffer).
+"""
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Any, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype
+from ..context import Context, current_context
+from ..ops.registry import Op, get_op
+
+__all__ = ["NDArray", "invoke", "array", "from_jax", "waitall"]
+
+
+def _op_accepts_training(op: Op) -> bool:
+    cached = getattr(op, "_accepts_training", None)
+    if cached is None:
+        try:
+            cached = "_training" in inspect.signature(op.fn).parameters
+        except (TypeError, ValueError):
+            cached = False
+        op._accepts_training = cached
+    return cached
+
+
+class NDArray:
+    __slots__ = ("_data", "_grad", "_grad_req", "_stype", "__weakref__")
+
+    def __init__(self, data, stype: str = "default"):
+        self._data = data  # jax.Array | tracer
+        self._grad: Optional["NDArray"] = None
+        self._grad_req: Optional[str] = None
+        self._stype = stype
+
+    # -- basic properties ---------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        s = 1
+        for d in self._data.shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def context(self) -> Context:
+        try:
+            devs = self._data.devices()
+            dev = next(iter(devs))
+            if dev.platform == "cpu":
+                return Context("cpu", dev.id)
+            return Context("tpu", dev.id)
+        except Exception:
+            return current_context()
+
+    ctx = context
+
+    # -- host sync ---------------------------------------------------------------
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        """Block until the value is computed (reference: WaitToRead)."""
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+        return self
+
+    wait_to_write = wait_to_read
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    # -- conversion / movement ----------------------------------------------------
+    def astype(self, dtype, copy=True):
+        return invoke(get_op("cast"), [self], {"dtype": np_dtype(dtype).name})
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device), self._stype)
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other) -> "NDArray":
+        """Copy into another NDArray / Context (reference: CopyFromTo, ndarray.h:1016)."""
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device), self._stype)
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, next(iter(other._data.devices()))) \
+                if hasattr(other._data, "devices") else self._data
+            return other
+        raise TypeError(f"copyto: unsupported target {type(other)}")
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._data, self._stype)
+
+    def detach(self) -> "NDArray":
+        return NDArray(jax.lax.stop_gradient(self._data), self._stype)
+
+    def tostype(self, stype: str) -> "NDArray":
+        from . import sparse as _sp
+
+        return _sp.cast_storage(self, stype)
+
+    def as_nd_ndarray(self):
+        return self
+
+    # -- autograd -----------------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None) -> None:
+        """Allocate a gradient buffer and mark this array as a differentiation
+        root (reference: autograd.mark_variables — python/mxnet/autograd.py:197)."""
+        from .. import autograd
+
+        self._grad = NDArray(jnp.zeros_like(self._data))
+        self._grad_req = grad_req
+        autograd.mark_variables([self], [self._grad], grad_reqs=[grad_req])
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward([self], head_grads=[out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- shape manipulation sugar -------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if "shape" in kwargs:
+            shape = kwargs["shape"]
+        return invoke(get_op("reshape"), [self], {"shape": tuple(shape)})
+
+    def reshape_like(self, other):
+        return invoke(get_op("reshape_like"), [self, other], {})
+
+    def transpose(self, axes=None):
+        return invoke(get_op("transpose"), [self], {"axes": axes or ()})
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def flatten(self):
+        return invoke(get_op("flatten"), [self], {})
+
+    def expand_dims(self, axis):
+        return invoke(get_op("expand_dims"), [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return invoke(get_op("squeeze"), [self], {"axis": axis})
+
+    def broadcast_to(self, shape):
+        return invoke(get_op("broadcast_to"), [self], {"shape": tuple(shape)})
+
+    def broadcast_like(self, other):
+        return invoke(get_op("broadcast_like"), [self, other], {})
+
+    def flip(self, axis):
+        return invoke(get_op("flip"), [self], {"axis": axis})
+
+    def tile(self, reps):
+        return invoke(get_op("tile"), [self], {"reps": reps})
+
+    def repeat(self, repeats, axis=None):
+        return invoke(get_op("repeat"), [self], {"repeats": repeats, "axis": axis})
+
+    def swapaxes(self, dim1, dim2):
+        axes = list(range(self.ndim))
+        axes[dim1], axes[dim2] = axes[dim2], axes[dim1]
+        return self.transpose(axes)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke(get_op("split"), [self],
+                      {"num_outputs": num_outputs, "axis": axis,
+                       "squeeze_axis": squeeze_axis})
+
+    def slice(self, begin, end, step=None):
+        return invoke(get_op("slice"), [self],
+                      {"begin": begin, "end": end, "step": step})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke(get_op("slice_axis"), [self],
+                      {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        indices = _as_ndarray(indices)
+        return invoke(get_op("take"), [self, indices], {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kwargs):
+        return invoke(get_op("one_hot"), [self], dict(depth=depth, **kwargs))
+
+    # -- reductions ---------------------------------------------------------------
+    def sum(self, axis=None, keepdims=False):
+        return invoke(get_op("sum"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return invoke(get_op("mean"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return invoke(get_op("max"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return invoke(get_op("min"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke(get_op("prod"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke(get_op("norm"), [self], {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return invoke(get_op("argmax"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return invoke(get_op("argmin"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def abs(self):
+        return invoke(get_op("abs"), [self], {})
+
+    def sqrt(self):
+        return invoke(get_op("sqrt"), [self], {})
+
+    def square(self):
+        return invoke(get_op("square"), [self], {})
+
+    def exp(self):
+        return invoke(get_op("exp"), [self], {})
+
+    def log(self):
+        return invoke(get_op("log"), [self], {})
+
+    def sigmoid(self):
+        return invoke(get_op("sigmoid"), [self], {})
+
+    def tanh(self):
+        return invoke(get_op("tanh"), [self], {})
+
+    def relu(self):
+        return invoke(get_op("relu"), [self], {})
+
+    def softmax(self, axis=-1):
+        return invoke(get_op("softmax"), [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return invoke(get_op("log_softmax"), [self], {"axis": axis})
+
+    def clip(self, a_min=None, a_max=None):
+        return invoke(get_op("clip"), [self], {"a_min": a_min, "a_max": a_max})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return invoke(get_op("dot"), [self, _as_ndarray(other)],
+                      {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+    def zeros_like(self):
+        return invoke(get_op("zeros_like"), [self], {})
+
+    def ones_like(self):
+        return invoke(get_op("ones_like"), [self], {})
+
+    def sign(self):
+        return invoke(get_op("sign"), [self], {})
+
+    # -- arithmetic dunders -------------------------------------------------------
+    def _binary(self, opname, other, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(get_op("broadcast_" + opname), [a, b], {})
+        scalar = float(other) if not isinstance(other, bool) else float(other)
+        if reverse and opname in ("sub", "div", "power", "mod"):
+            return invoke(get_op(f"_r{opname}_scalar"), [self], {"scalar": scalar})
+        return invoke(get_op(f"_{opname}_scalar"), [self], {"scalar": scalar})
+
+    def __add__(self, other):
+        return self._binary("add", other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary("sub", other)
+
+    def __rsub__(self, other):
+        return self._binary("sub", other, reverse=True)
+
+    def __mul__(self, other):
+        return self._binary("mul", other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary("div", other)
+
+    def __rtruediv__(self, other):
+        return self._binary("div", other, reverse=True)
+
+    def __mod__(self, other):
+        return self._binary("mod", other)
+
+    def __rmod__(self, other):
+        return self._binary("mod", other, reverse=True)
+
+    def __pow__(self, other):
+        return self._binary("power", other)
+
+    def __rpow__(self, other):
+        return self._binary("power", other, reverse=True)
+
+    def __neg__(self):
+        return invoke(get_op("negative"), [self], {})
+
+    def __abs__(self):
+        return invoke(get_op("abs"), [self], {})
+
+    def __eq__(self, other):
+        return self._binary("equal", other) if other is not None else _full_like(self, 0.0)
+
+    def __ne__(self, other):
+        return self._binary("not_equal", other) if other is not None else _full_like(self, 1.0)
+
+    def __gt__(self, other):
+        return self._binary("greater", other)
+
+    def __ge__(self, other):
+        return self._binary("greater_equal", other)
+
+    def __lt__(self, other):
+        return self._binary("lesser", other)
+
+    def __le__(self, other):
+        return self._binary("lesser_equal", other)
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: rebind the handle (old readers keep the old immutable buffer)
+    def __iadd__(self, other):
+        out = self.__add__(other)
+        self._data = out._data
+        return self
+
+    def __isub__(self, other):
+        out = self.__sub__(other)
+        self._data = out._data
+        return self
+
+    def __imul__(self, other):
+        out = self.__mul__(other)
+        self._data = out._data
+        return self
+
+    def __itruediv__(self, other):
+        out = self.__truediv__(other)
+        self._data = out._data
+        return self
+
+    # -- indexing -----------------------------------------------------------------
+    def _convert_key(self, key):
+        if isinstance(key, NDArray):
+            return key._data.astype(jnp.int32) if jnp.issubdtype(key._data.dtype, jnp.floating) else key._data
+        if isinstance(key, tuple):
+            return tuple(self._convert_key(k) for k in key)
+        if isinstance(key, (list, np.ndarray)):
+            return jnp.asarray(key)
+        return key
+
+    def __getitem__(self, key):
+        jkey = self._convert_key(key)
+        return NDArray(self._data[jkey])
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            v = value._data
+        elif isinstance(value, (int, float)):
+            v = value
+        else:
+            v = jnp.asarray(value, dtype=self._data.dtype)
+        jkey = self._convert_key(key)
+        if jkey is Ellipsis or (isinstance(jkey, slice) and jkey == slice(None)):
+            if isinstance(v, (int, float)):
+                self._data = jnp.full_like(self._data, v)
+            else:
+                self._data = jnp.broadcast_to(v, self._data.shape).astype(self._data.dtype)
+        else:
+            self._data = self._data.at[jkey].set(v)
+
+    def __repr__(self):
+        try:
+            arr = self.asnumpy()
+            body = str(arr)
+        except Exception:
+            body = "<unrealized>"
+        return f"\n{body}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+
+def _full_like(x: NDArray, v: float) -> NDArray:
+    return NDArray(jnp.full_like(x._data, v))
+
+
+def _as_ndarray(x) -> NDArray:
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(jnp.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# central op dispatch — the analogue of Imperative::Invoke
+# (reference: src/imperative/imperative.cc:87)
+# ---------------------------------------------------------------------------
+
+def invoke(op: Op, inputs: Sequence[NDArray], attrs: dict, out=None):
+    """Dispatch an op eagerly and record it on the autograd tape if active.
+
+    The reference's per-call pipeline (SetShapeType → SetDependency →
+    PushFCompute, imperative_utils.h:199-499) collapses to: unwrap buffers,
+    call the jnp emitter (async dispatch), wrap outputs, append tape entry.
+    """
+    from .. import autograd
+
+    vals = [i._data for i in inputs]
+    kwargs = dict(attrs)
+    if op.rng:
+        from .. import random as _random
+
+        kwargs["rng_key"] = _random.next_key()
+    if _op_accepts_training(op):
+        kwargs.setdefault("_training", autograd.is_training())
+    try:
+        result = op.fn(*vals, **kwargs)
+    except MXNetError:
+        raise
+    except Exception as e:
+        raise MXNetError(f"operator {op.name} failed: {e}") from e
+
+    multi = isinstance(result, (tuple, list))
+    results = list(result) if multi else [result]
+    outputs = [NDArray(r) for r in results]
+
+    if autograd.is_recording():
+        autograd._record_op(op, kwargs, list(inputs), outputs)
+
+    if out is not None:
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        for dst, src in zip(outs, outputs):
+            dst._data = src._data
+        return out
+    if multi:
+        return tuple(outputs)
+    return outputs[0]
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def _device_for(ctx: Optional[Context]):
+    ctx = ctx or current_context()
+    return ctx.jax_device
+
+
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source, NDArray):
+        src = source._data
+    else:
+        src = np.asarray(source, dtype=np_dtype(dtype) if dtype is not None else None)
+        if dtype is None and src.dtype == np.float64:
+            src = src.astype(np.float32)
+    data = jax.device_put(src, _device_for(ctx))
+    if dtype is not None:
+        data = data.astype(np_dtype(dtype))
+    return NDArray(data)
+
+
+def from_jax(x) -> NDArray:
+    return NDArray(x)
+
+
+def waitall():
+    """Block until all outstanding computation completes
+    (reference: Engine::WaitForAll / mx.nd.waitall)."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass

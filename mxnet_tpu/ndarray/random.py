@@ -1,0 +1,67 @@
+"""`mx.nd.random` namespace (reference: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from ..ops.registry import get_op
+from .ndarray import NDArray, invoke
+
+__all__ = ["uniform", "normal", "gamma", "exponential", "poisson",
+           "negative_binomial", "randint", "multinomial", "shuffle", "randn"]
+
+
+def _shape(shape):
+    if shape is None:
+        return None
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return invoke(get_op("_random_uniform"), [],
+                  {"low": low, "high": high, "shape": _shape(shape) or (1,), "dtype": dtype},
+                  out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return invoke(get_op("_random_normal"), [],
+                  {"loc": loc, "scale": scale, "shape": _shape(shape) or (1,), "dtype": dtype},
+                  out=out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc=loc, scale=scale, shape=shape or (1,), dtype=dtype, ctx=ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return invoke(get_op("_random_gamma"), [],
+                  {"alpha": alpha, "beta": beta, "shape": _shape(shape) or (1,), "dtype": dtype},
+                  out=out)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return invoke(get_op("_random_exponential"), [],
+                  {"lam": 1.0 / scale, "shape": _shape(shape) or (1,), "dtype": dtype}, out=out)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return invoke(get_op("_random_poisson"), [],
+                  {"lam": lam, "shape": _shape(shape) or (1,), "dtype": dtype}, out=out)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    return invoke(get_op("_random_negative_binomial"), [],
+                  {"k": k, "p": p, "shape": _shape(shape) or (1,), "dtype": dtype}, out=out)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):
+    return invoke(get_op("_random_randint"), [],
+                  {"low": low, "high": high, "shape": _shape(shape) or (1,), "dtype": dtype},
+                  out=out)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", out=None):
+    return invoke(get_op("_sample_multinomial"), [data],
+                  {"shape": _shape(shape) or (), "get_prob": get_prob, "dtype": dtype},
+                  out=out)
+
+
+def shuffle(data, out=None):
+    return invoke(get_op("shuffle"), [data], {}, out=out)

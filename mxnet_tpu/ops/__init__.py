@@ -1,0 +1,9 @@
+"""Operator library: registry + op family modules (importing registers them)."""
+from .registry import Op, register, get_op, list_ops, OP_REGISTRY
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import rnn  # noqa: F401
+from . import contrib  # noqa: F401
+
+__all__ = ["Op", "register", "get_op", "list_ops", "OP_REGISTRY"]

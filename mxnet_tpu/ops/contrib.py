@@ -1,0 +1,224 @@
+"""Contrib ops: detection (NMS/IoU/ROI), misc (reference: src/operator/contrib/*).
+
+Detection primitives are written XLA-first: fixed-shape masked computations
+instead of the reference's dynamic-length CUDA kernels — scores are sorted with
+the TPU sort unit and suppression runs as a fori_loop over the top-k window.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _iou_matrix(boxes_a, boxes_b, fmt="corner"):
+    """IoU between (..., Na, 4) and (..., Nb, 4)."""
+    if fmt == "center":
+        ax, ay, aw, ah = jnp.split(boxes_a, 4, -1)
+        boxes_a = jnp.concatenate([ax - aw / 2, ay - ah / 2, ax + aw / 2, ay + ah / 2], -1)
+        bx, by, bw, bh = jnp.split(boxes_b, 4, -1)
+        boxes_b = jnp.concatenate([bx - bw / 2, by - bh / 2, bx + bw / 2, by + bh / 2], -1)
+    al, at, ar, ab = jnp.split(boxes_a, 4, -1)  # (..., Na, 1)
+    bl, bt, br, bb = [x.squeeze(-1) for x in jnp.split(boxes_b, 4, -1)]  # (..., Nb)
+    iw = jnp.maximum(0.0, jnp.minimum(ar, br[..., None, :]) - jnp.maximum(al, bl[..., None, :]))
+    ih = jnp.maximum(0.0, jnp.minimum(ab, bb[..., None, :]) - jnp.maximum(at, bt[..., None, :]))
+    inter = (iw * ih).squeeze(-2) if iw.shape[-2] == 1 else iw * ih
+    inter = iw * ih
+    area_a = ((ar - al) * (ab - at))
+    area_b = ((br - bl) * (bb - bt))[..., None, :]
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0).reshape(
+        boxes_a.shape[:-1] + (boxes_b.shape[-2],))
+
+
+@register("_contrib_box_iou", aliases=("box_iou",))
+def box_iou(lhs, rhs, format="corner"):
+    return _iou_matrix(lhs, rhs, fmt=format)
+
+
+@register("_contrib_box_nms", aliases=("box_nms",), differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Greedy NMS (reference: src/operator/contrib/bounding_box.cc).
+
+    Fixed-shape: keeps all N slots, suppressed entries get score/-1 class."""
+    batched = data.ndim == 3
+    x = data if batched else data[None]
+    B, N, F = x.shape
+
+    def one(img):
+        scores = img[:, score_index]
+        valid = scores > valid_thresh
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        sorted_img = img[order]
+        boxes = sorted_img[:, coord_start:coord_start + 4]
+        ious = _iou_matrix(boxes, boxes, fmt=in_format)
+        if id_index >= 0 and not force_suppress:
+            cls = sorted_img[:, id_index]
+            same = cls[:, None] == cls[None, :]
+            ious = jnp.where(same, ious, 0.0)
+        k = N if topk <= 0 else min(int(topk), N)
+
+        def body(i, keep):
+            sup = (ious[i] > overlap_thresh) & (jnp.arange(N) > i) & keep[i]
+            return jnp.where(sup, False, keep)
+
+        keep0 = valid[order]
+        if topk > 0:
+            keep0 = keep0 & (jnp.arange(N) < k)
+        keep = lax.fori_loop(0, k, body, keep0)
+        out = jnp.where(keep[:, None], sorted_img,
+                        jnp.full_like(sorted_img, -1.0))
+        return out
+
+    out = jax.vmap(one)(x)
+    return out if batched else out[0]
+
+
+@register("_contrib_box_encode")
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    m = matches.astype(jnp.int32)
+    ref = jnp.take_along_axis(refs, m[..., None].repeat(4, -1), axis=1)
+    ax, ay, aw, ah = jnp.split(anchors, 4, -1)
+    acx, acy = (ax + aw) / 2, (ay + ah) / 2  # corner → center-ish; caller supplies center fmt
+    gx, gy, gw, gh = jnp.split(ref, 4, -1)
+    t0 = ((gx - ax) / jnp.maximum(aw, 1e-6) - means[0]) / stds[0]
+    t1 = ((gy - ay) / jnp.maximum(ah, 1e-6) - means[1]) / stds[1]
+    t2 = (jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-6), 1e-6)) - means[2]) / stds[2]
+    t3 = (jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-6), 1e-6)) - means[3]) / stds[3]
+    out = jnp.concatenate([t0, t1, t2, t3], -1)
+    mask = (samples > 0.5)[..., None]
+    return jnp.where(mask, out, 0.0), mask.astype(out.dtype).repeat(4, -1)
+
+
+@register("ROIPooling")
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Reference: src/operator/roi_pooling.cc. data NCHW, rois (R,5)."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    N, C, H, W = data.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[b]  # (C, H, W)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def cell(py, px):
+            hstart = y1 + (py * rh) // ph
+            hend = y1 + -(-((py + 1) * rh) // ph)
+            wstart = x1 + (px * rw) // pw
+            wend = x1 + -(-((px + 1) * rw) // pw)
+            m = ((ys >= hstart) & (ys < jnp.maximum(hend, hstart + 1)))[:, None] & \
+                ((xs >= wstart) & (xs < jnp.maximum(wend, wstart + 1)))[None, :]
+            masked = jnp.where(m[None], img, -jnp.inf)
+            return jnp.max(masked, axis=(1, 2))
+
+        grid = jax.vmap(lambda py: jax.vmap(lambda px: cell(py, px))(jnp.arange(pw)))(jnp.arange(ph))
+        # grid: (ph, pw, C) → (C, ph, pw)
+        return jnp.transpose(grid, (2, 0, 1))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_ROIAlign")
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=2,
+              position_sensitive=False, aligned=False):
+    """ROIAlign with bilinear sampling (reference: src/operator/contrib/roi_align.cc)."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    N, C, H, W = data.shape
+    sr = max(int(sample_ratio), 1)
+    offset = 0.5 if aligned else 0.0
+
+    def bilinear(img, y, x):
+        y = jnp.clip(y, 0.0, H - 1.0)
+        x = jnp.clip(x, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        ly, lx = y - y0, x - x0
+        v = (img[:, y0, x0] * (1 - ly) * (1 - lx) + img[:, y1, x0] * ly * (1 - lx)
+             + img[:, y0, x1] * (1 - ly) * lx + img[:, y1, x1] * ly * lx)
+        return v
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        img = data[b]
+
+        def cell(py, px):
+            acc = 0.0
+            for iy in range(sr):
+                for ix in range(sr):
+                    y = y1 + py * bh + (iy + 0.5) * bh / sr
+                    x = x1 + px * bw + (ix + 0.5) * bw / sr
+                    acc = acc + bilinear(img, y, x)
+            return acc / (sr * sr)
+
+        grid = jax.vmap(lambda py: jax.vmap(lambda px: cell(py, px))(jnp.arange(pw)))(jnp.arange(ph))
+        return jnp.transpose(grid, (2, 0, 1))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_count_sketch")
+def count_sketch(data, h, s, out_dim=1, processing_batch_size=32):
+    N, D = data.shape
+    idx = h.astype(jnp.int32).reshape(-1)[:D]
+    sign = s.reshape(-1)[:D]
+    out = jnp.zeros((N, int(out_dim)), dtype=data.dtype)
+    return out.at[:, idx].add(data * sign)
+
+
+@register("_contrib_fft")
+def fft(data, compute_size=128):
+    c = jnp.fft.fft(data, axis=-1)
+    return jnp.stack([c.real, c.imag], axis=-1).reshape(*data.shape[:-1], -1).astype(data.dtype)
+
+
+@register("_contrib_ifft")
+def ifft(data, compute_size=128):
+    D = data.shape[-1] // 2
+    c = data.reshape(*data.shape[:-1], D, 2)
+    comp = c[..., 0] + 1j * c[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(data.dtype) * D
+
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    return a * data * data + b * data + c
+
+
+@register("_contrib_arange_like", differentiable=False)
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = data.size
+        return (start + step * jnp.arange(n)).reshape(data.shape).astype(data.dtype)
+    n = data.shape[int(axis)]
+    return (start + step * jnp.arange(n)).astype(data.dtype)
+
+
+@register("_contrib_index_copy")
+def index_copy(old, idx, new):
+    return old.at[idx.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_getnnz", differentiable=False)
+def getnnz(data, axis=None):
+    return jnp.sum(data != 0, axis=axis).astype(jnp.float32)

@@ -1,0 +1,571 @@
+"""Neural-net ops: FC, conv, pooling, norms, softmax family, activation, dropout.
+
+Covers the reference's ``src/operator/nn/*`` (SURVEY.md §2.1; conv/deconv/FC/
+pool/norm/softmax/activation/dropout — ~14k LoC CUDA) plus the cuDNN wrapper
+surface, as XLA emitters.  Convolutions lower through ``lax.conv_general_dilated``
+which XLA tiles onto the MXU; bf16 inputs accumulate in f32
+(``preferred_element_type``), the TPU-native analogue of the reference's
+fp16-with-fp32-master-weights path (``python/mxnet/optimizer.py:494``).
+
+Data layout: the public ops accept the reference's default NCHW ("NCHW" attr)
+but also "NHWC"; internally XLA's layout assignment owns the physical layout,
+so no manual transposes are inserted.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _acc(x):
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (src/operator/nn/fully_connected.cc)
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected")
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    out = jnp.dot(x, weight.T, preferred_element_type=_acc(x))
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (src/operator/nn/convolution.cc, deconvolution.cc)
+# ---------------------------------------------------------------------------
+
+def _conv_dnums(ndim, layout):
+    if ndim == 3:  # NCW
+        return ("NCH", "OIH", "NCH") if layout in (None, "NCW") else ("NHC", "HIO", "NHC")
+    if ndim == 4:
+        if layout in (None, "NCHW"):
+            return ("NCHW", "OIHW", "NCHW")
+        return ("NHWC", "HWIO", "NHWC")
+    if layout in (None, "NCDHW"):
+        return ("NCDHW", "OIDHW", "NCDHW")
+    return ("NDHWC", "DHWIO", "NDHWC")
+
+
+@register("Convolution")
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                num_filter=0, num_group=1, no_bias=False, layout=None,
+                cudnn_tune=None, cudnn_off=False, workspace=1024):
+    """NNVM Convolution (reference: src/operator/nn/convolution.cc).
+
+    cudnn_* / workspace attrs accepted and ignored (XLA owns algorithm choice).
+    """
+    nd = data.ndim - 2
+    k = len(kernel) if kernel else nd
+    stride = _pair(stride, k) if stride else (1,) * k
+    dilate = _pair(dilate, k) if dilate else (1,) * k
+    pad = _pair(pad, k) if pad else (0,) * k
+    dnums = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                       _conv_dnums(data.ndim, layout))
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dnums,
+        feature_group_count=int(num_group),
+        preferred_element_type=_acc(data),
+    )
+    if out.dtype != data.dtype:
+        out = out.astype(data.dtype)
+    if not no_bias and bias is not None:
+        if layout in (None, "NCHW", "NCW", "NCDHW"):
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+        else:
+            out = out + bias
+    return out
+
+
+@register("Deconvolution")
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                  adj=(), num_filter=0, num_group=1, no_bias=False, layout=None,
+                  target_shape=None, cudnn_tune=None, cudnn_off=False, workspace=1024):
+    """Transposed convolution (reference: src/operator/nn/deconvolution.cc)."""
+    nd = data.ndim - 2
+    k = len(kernel) if kernel else nd
+    stride = _pair(stride, k) if stride else (1,) * k
+    dilate = _pair(dilate, k) if dilate else (1,) * k
+    pad = _pair(pad, k) if pad else (0,) * k
+    adj = _pair(adj, k) if adj else (0,) * k
+    # weight layout for Deconvolution in the reference is (in, out/group, *k)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dnums(data.ndim, layout))
+    # conv_transpose via gradient-of-conv: lhs_dilation implements the stride.
+    kernel_dims = [weight.shape[i] for i in range(2, 2 + k)]
+    padding = []
+    for i in range(k):
+        eff_k = (kernel_dims[i] - 1) * dilate[i] + 1
+        lo = eff_k - 1 - pad[i]
+        hi = eff_k - 1 - pad[i] + adj[i]
+        padding.append((lo, hi))
+    # flip spatial dims and swap in/out channels to express transpose as conv
+    wt = jnp.flip(weight, axis=tuple(range(2, 2 + k)))
+    wt = jnp.swapaxes(wt, 0, 1)  # (out/group? , in, *k) — reference stores (in, out/g, *k)
+    # regroup for grouped deconv
+    if num_group > 1:
+        ci = data.shape[1]
+        wt = wt.reshape(num_group, wt.shape[0], ci // num_group, *kernel_dims)
+        wt = wt.reshape(num_group * wt.shape[1], ci // num_group, *kernel_dims)
+    out = lax.conv_general_dilated(
+        data, wt,
+        window_strides=(1,) * k,
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=int(num_group),
+        preferred_element_type=_acc(data),
+    )
+    if out.dtype != data.dtype:
+        out = out.astype(data.dtype)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (src/operator/nn/pooling.cc)
+# ---------------------------------------------------------------------------
+
+@register("Pooling")
+def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=(),
+            pooling_convention="valid", cudnn_off=False, count_include_pad=True,
+            layout=None):
+    nd = data.ndim - 2
+    spatial = tuple(range(2, 2 + nd))
+    if global_pool:
+        if pool_type == "max":
+            return jnp.max(data, axis=spatial, keepdims=True)
+        return jnp.mean(data, axis=spatial, keepdims=True)
+    k = _pair(kernel, nd)
+    s = _pair(stride, nd) if stride else k
+    p = _pair(pad, nd) if pad else (0,) * nd
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    if pooling_convention == "full":
+        # ceil-mode: pad high side enough that ceil division is honored
+        pads = [(0, 0), (0, 0)]
+        for i in range(nd):
+            in_sz = data.shape[2 + i] + 2 * p[i]
+            out_sz = -(-(in_sz - k[i]) // s[i]) + 1  # ceil
+            needed = (out_sz - 1) * s[i] + k[i] - in_sz
+            pads.append((p[i], p[i] + max(0, needed)))
+    else:
+        pads = [(0, 0), (0, 0)] + [(p[i], p[i]) for i in range(nd)]
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = 1.0
+            for kk in k:
+                denom *= kk
+            return summed / jnp.asarray(denom, summed.dtype)
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return summed / counts
+    raise ValueError(f"unsupported pool_type {pool_type}")
+
+
+@register("_contrib_AdaptiveAvgPooling2D")
+def adaptive_avg_pooling(data, output_size=(1, 1)):
+    os = _pair(output_size, 2)
+    n, c, h, w = data.shape
+    if h % os[0] == 0 and w % os[1] == 0:
+        x = data.reshape(n, c, os[0], h // os[0], os[1], w // os[1])
+        return x.mean(axis=(3, 5))
+    # general: interpolate bin edges via mean over gathered windows
+    out = jax.image.resize(data, (n, c, os[0], os[1]), method="linear")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Normalization (batch_norm.cc, layer_norm.cc, instance_norm, l2, lrn)
+# ---------------------------------------------------------------------------
+
+@register("BatchNorm", num_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+               fix_gamma=True, use_global_stats=False, output_mean_var=False,
+               axis=1, cudnn_off=False, _training=True):
+    """Reference: src/operator/nn/batch_norm.cc.
+
+    Pure-functional: running-stat update is returned to the caller by the
+    stateful frontends (NDArray/Gluon) rather than mutated here — see
+    ndarray/__init__.py `_STATEFUL_BN` handling.
+    """
+    ax = int(axis)
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if _training and not use_global_stats:
+        mean = jnp.mean(data.astype(jnp.float32), axis=red)
+        var = jnp.var(data.astype(jnp.float32), axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(shape).astype(data.dtype)) * (g * inv).reshape(shape).astype(data.dtype) \
+        + beta.reshape(shape).astype(data.dtype)
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+@register("LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    ax = int(axis)
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("LRN")
+def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
+    sq = jnp.square(data)
+    half = int(nsize) // 2
+    padded = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    win = lax.reduce_window(padded, 0.0, lax.add, (1, int(nsize), 1, 1), (1, 1, 1, 1),
+                            [(0, 0)] * 4)
+    norm = jnp.power(knorm + alpha * win, beta)
+    return data / norm
+
+
+# ---------------------------------------------------------------------------
+# Softmax family (softmax.cc, softmax_output.cc)
+# ---------------------------------------------------------------------------
+
+@register("softmax")
+def softmax(data, axis=-1, temperature=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    x = data if temperature in (None, 1.0) else data / temperature
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@register("softmin")
+def softmin(data, axis=-1, temperature=None):
+    return softmax(-data, axis=axis, temperature=temperature)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+@register("SoftmaxOutput", aliases=("Softmax",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Fused softmax + CE-gradient head (reference: src/operator/nn/softmax_output.cc).
+
+    Forward emits softmax probabilities; the custom backward (grad = p - onehot)
+    is expressed via a custom_vjp so autograd matches the reference exactly,
+    including ignore_label masking and normalization modes.
+    """
+    return _softmax_output_vjp(data, label, float(grad_scale), float(ignore_label),
+                               bool(multi_output), bool(use_ignore),
+                               str(normalization), float(smooth_alpha))
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _softmax_output_vjp(data, label, grad_scale, ignore_label, multi_output,
+                        use_ignore, normalization, smooth_alpha):
+    return _softmax_fwd_only(data, multi_output)
+
+
+def _softmax_fwd_only(data, multi_output):
+    if multi_output and data.ndim > 2:
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _so_fwd(data, label, grad_scale, ignore_label, multi_output, use_ignore,
+            normalization, smooth_alpha):
+    out = _softmax_fwd_only(data, multi_output)
+    return out, (out, label)
+
+
+def _so_bwd(grad_scale, ignore_label, multi_output, use_ignore, normalization,
+            smooth_alpha, res, g):
+    out, label = res
+    if multi_output and out.ndim > 2:
+        nclass = out.shape[1]
+        lab = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, nclass, axis=1, dtype=out.dtype)
+    else:
+        nclass = out.shape[-1]
+        lab = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, nclass, dtype=out.dtype)
+        if onehot.ndim < out.ndim:
+            onehot = onehot.reshape(out.shape)
+    if smooth_alpha:
+        onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / nclass
+    grad = out - onehot
+    if use_ignore:
+        if multi_output and out.ndim > 2:
+            mask = (label != ignore_label).astype(out.dtype)
+            mask = jnp.expand_dims(mask, 1)
+        else:
+            mask = (label != ignore_label).astype(out.dtype)
+            mask = mask.reshape(mask.shape + (1,) * (grad.ndim - mask.ndim))
+        grad = grad * mask
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / out.shape[0]
+    elif normalization == "valid" and use_ignore:
+        valid = jnp.maximum(jnp.sum(label != ignore_label), 1).astype(out.dtype)
+        grad = grad / valid
+    grad = grad * scale
+    return (grad.astype(out.dtype), jnp.zeros_like(label))
+
+
+_softmax_output_vjp.defvjp(_so_fwd, _so_bwd)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+# ---------------------------------------------------------------------------
+# Activation / LeakyReLU / Dropout
+# ---------------------------------------------------------------------------
+
+@register("Activation")
+def activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * (jnp.exp(data) - 1))
+    if act_type == "selu":
+        a, l = 1.6732632423543772, 1.0507009873554805
+        return l * jnp.where(data >= 0, data, a * (jnp.exp(data) - 1))
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, mid * data)
+    if act_type == "gelu":
+        return jax.nn.gelu(data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register("Dropout", rng=True)
+def dropout(data, rng_key=None, p=0.5, mode="training", axes=(), _training=True):
+    """Reference: src/operator/nn/dropout.cc. rng_key injected by the frontend
+    from the global PRNG stream (mxnet_tpu.random)."""
+    if not _training and mode != "always":
+        return data
+    if p <= 0.0:
+        return data
+    keep = 1.0 - p
+    shape = list(data.shape)
+    for ax in (axes or ()):
+        shape[ax] = 1
+    mask = jax.random.bernoulli(rng_key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+# ---------------------------------------------------------------------------
+# Upsampling / resize
+# ---------------------------------------------------------------------------
+
+@register("UpSampling")
+def upsampling(data, *weights, scale=2, sample_type="nearest", num_filter=0,
+               multi_input_mode="concat", num_args=1, workspace=512):
+    s = int(scale)
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(data, s, axis=2), s, axis=3)
+    n, c, h, w = data.shape
+    return jax.image.resize(data, (n, c, h * s, w * s), method="linear")
+
+
+@register("_contrib_BilinearResize2D")
+def bilinear_resize(data, height=1, width=1, scale_height=None, scale_width=None):
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height, width = int(h * scale_height), int(w * scale_width)
+    return jax.image.resize(data, (n, c, int(height), int(width)), method="linear")
+
+
+# ---------------------------------------------------------------------------
+# misc heads
+# ---------------------------------------------------------------------------
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, grad_scale=1.0):
+    return _regression_vjp(data, label, float(grad_scale), "linear")
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, grad_scale=1.0):
+    return _regression_vjp(data, label, float(grad_scale), "mae")
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label, grad_scale=1.0):
+    return _regression_vjp(data, label, float(grad_scale), "logistic")
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _regression_vjp(data, label, grad_scale, kind):
+    if kind == "logistic":
+        return jax.nn.sigmoid(data)
+    return data
+
+
+def _reg_fwd(data, label, grad_scale, kind):
+    out = _regression_vjp(data, label, grad_scale, kind)
+    return out, (out, label)
+
+
+def _reg_bwd(grad_scale, kind, res, g):
+    out, label = res
+    lab = label.reshape(out.shape)
+    if kind == "mae":
+        grad = jnp.sign(out - lab)
+    else:
+        grad = out - lab
+    return (grad * grad_scale, jnp.zeros_like(label))
+
+
+_regression_vjp.defvjp(_reg_fwd, _reg_bwd)
+
+
+@register("MakeLoss")
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    return data
+
+
+@register("CTCLoss", aliases=("ctc_loss",))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False, blank_label="first"):
+    """CTC loss (reference: src/operator/contrib/ctc_loss.cc) via the standard
+    alpha-recursion in log space with lax.scan over time."""
+    # data: (T, N, C) as in the reference
+    T, N, C = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    blank = 0 if blank_label == "first" else C - 1
+    L = label.shape[1]
+    lab = label.astype(jnp.int32)
+    if blank_label != "first":
+        pass  # labels already 0-based
+    # extended labels with blanks: length 2L+1
+    ext = jnp.full((N, 2 * L + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        lab_len = jnp.sum(lab != 0, axis=1).astype(jnp.int32) if blank == 0 else \
+            jnp.sum(lab != -1, axis=1).astype(jnp.int32)
+    ext_len = 2 * lab_len + 1
+    S = 2 * L + 1
+    neg_inf = -1e30
+    # init alpha
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0])
+
+    same_as_prev2 = jnp.pad(ext[:, 2:] == ext[:, :-2], ((0, 0), (2, 0)),
+                            constant_values=True)
+
+    def step(alpha, logp_t):
+        a = alpha
+        a1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=neg_inf)
+        a2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=neg_inf)
+        a2 = jnp.where(same_as_prev2, neg_inf, a2)
+        m = jnp.maximum(jnp.maximum(a, a1), a2)
+        m_safe = jnp.where(m == neg_inf, 0.0, m)
+        merged = m_safe + jnp.log(
+            jnp.exp(a - m_safe) + jnp.exp(a1 - m_safe) + jnp.exp(a2 - m_safe) + 1e-37
+        )
+        merged = jnp.where(m == neg_inf, neg_inf, merged)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        out = merged + emit
+        return out, None
+
+    if use_data_lengths and data_lengths is not None:
+        dl = data_lengths.astype(jnp.int32)
+
+        def step_masked(carry, inp):
+            alpha, t = carry
+            new_alpha, _ = step(alpha, inp)
+            new_alpha = jnp.where((t < dl)[:, None], new_alpha, alpha)
+            return (new_alpha, t + 1), None
+
+        (alphaT, _), _ = lax.scan(step_masked, (alpha0, jnp.asarray(1)), logp[1:])
+    else:
+        alphaT, _ = lax.scan(step, alpha0, logp[1:])
+    idx_last = jnp.clip(ext_len - 1, 0, S - 1)
+    idx_prev = jnp.clip(ext_len - 2, 0, S - 1)
+    aL = jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0]
+    aP = jnp.take_along_axis(alphaT, idx_prev[:, None], axis=1)[:, 0]
+    m = jnp.maximum(aL, aP)
+    m_safe = jnp.where(m == neg_inf, 0.0, m)
+    ll = m_safe + jnp.log(jnp.exp(aL - m_safe) + jnp.exp(aP - m_safe) + 1e-37)
+    return -ll

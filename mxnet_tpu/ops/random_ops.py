@@ -1,0 +1,90 @@
+"""Random sampling ops (reference: src/operator/random/* — sample_op.cc,
+multisample_op.cc, shuffle, multinomial).
+
+Each op takes an explicit threefry key as its trailing positional arg
+(appended by the frontend from the global stream in mxnet_tpu/random.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import np_dtype
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+@register("_random_uniform", rng=True, differentiable=False, aliases=("uniform",))
+def random_uniform(rng_key=None, low=0.0, high=1.0, shape=None, dtype="float32"):
+    return jax.random.uniform(rng_key, _shape(shape), dtype=np_dtype(dtype),
+                              minval=low, maxval=high)
+
+
+@register("_random_normal", rng=True, differentiable=False, aliases=("normal", "_sample_normal"))
+def random_normal(rng_key=None, loc=0.0, scale=1.0, shape=None, dtype="float32"):
+    return loc + scale * jax.random.normal(rng_key, _shape(shape), dtype=np_dtype(dtype))
+
+
+@register("_random_gamma", rng=True, differentiable=False, aliases=("gamma_sample",))
+def random_gamma(rng_key=None, alpha=1.0, beta=1.0, shape=None, dtype="float32"):
+    return beta * jax.random.gamma(rng_key, alpha, _shape(shape), dtype=np_dtype(dtype))
+
+
+@register("_random_exponential", rng=True, differentiable=False)
+def random_exponential(rng_key=None, lam=1.0, shape=None, dtype="float32"):
+    return jax.random.exponential(rng_key, _shape(shape), dtype=np_dtype(dtype)) / lam
+
+
+@register("_random_poisson", rng=True, differentiable=False)
+def random_poisson(rng_key=None, lam=1.0, shape=None, dtype="float32"):
+    return jax.random.poisson(rng_key, lam, _shape(shape)).astype(np_dtype(dtype))
+
+
+@register("_random_negative_binomial", rng=True, differentiable=False)
+def random_negative_binomial(rng_key=None, k=1, p=0.5, shape=None, dtype="float32"):
+    g = jax.random.gamma(rng_key, k, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(jax.random.fold_in(rng_key, 1), g).astype(np_dtype(dtype))
+
+
+@register("_random_randint", rng=True, differentiable=False)
+def random_randint(rng_key=None, low=0, high=1, shape=None, dtype="int32"):
+    return jax.random.randint(rng_key, _shape(shape), int(low), int(high),
+                              dtype=np_dtype(dtype))
+
+
+@register("_sample_multinomial", rng=True, differentiable=False, aliases=("multinomial",))
+def sample_multinomial(data, rng_key=None, shape=None, get_prob=False, dtype="int32"):
+    n = _shape(shape)
+    num = 1
+    for s in n:
+        num *= s
+    num = max(num, 1)
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        out = jax.random.categorical(rng_key, logits, shape=(num,))
+        out = out.reshape(n) if n else out.reshape(())
+    else:
+        out = jax.random.categorical(rng_key, logits[:, None, :].repeat(num, 1), axis=-1)
+        out = out.reshape((data.shape[0],) + n)
+    return out.astype(np_dtype(dtype))
+
+
+@register("shuffle", rng=True, differentiable=False, aliases=("_shuffle",))
+def shuffle(data, rng_key=None):
+    return jax.random.permutation(rng_key, data, axis=0)
+
+
+@register("_sample_unique_zipfian", rng=True, differentiable=False)
+def sample_unique_zipfian(rng_key=None, range_max=1, shape=None):
+    # log-uniform proposal like the reference's candidate sampler
+    n = _shape(shape)
+    u = jax.random.uniform(rng_key, n)
+    out = jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int64) - 1
+    return jnp.clip(out, 0, range_max - 1).astype(jnp.float32)

@@ -1,0 +1,93 @@
+"""Operator registry.
+
+TPU-native analogue of the reference's NNVM op registry
+(``include/mxnet/op_attr_types.h:213-287`` — FCompute / FInferShape /
+FGradient / FStatefulCompute).  Each op here is a *pure JAX function*
+``fn(*arrays, **attrs) -> array | tuple``:
+
+- FCompute      → the function body itself (jnp / lax / pallas), traced by XLA.
+- FInferShape   → ``jax.eval_shape`` over the same function (no duplicate logic).
+- FGradient     → ``jax.vjp`` over the same function (no per-op grad code).
+- storage-type  → dense-by-default; sparse frontends wrap dense kernels
+                  (see ndarray/sparse.py).
+
+This collapses three of the reference's per-op code paths into one definition,
+which is the main structural win of building on a tracing compiler.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+__all__ = ["Op", "register", "get_op", "list_ops", "OP_REGISTRY"]
+
+
+class Op:
+    """A registered operator.
+
+    Attributes
+    ----------
+    name:          canonical snake_case name (matches the reference op name
+                   where one exists, e.g. ``broadcast_add``, ``FullyConnected``
+                   is exposed under its alias).
+    fn:            pure function over jax arrays.
+    num_outputs:   static int, or a callable(attrs_dict) -> int.
+    differentiable: if False, autograd records it as a constant producer
+                   (e.g. ``argmax``, random samplers).
+    rng:           op consumes a PRNG key appended as the last positional arg
+                   by the frontend (random ops, dropout).
+    """
+
+    __slots__ = ("name", "fn", "num_outputs", "differentiable", "rng", "aliases",
+                 "doc", "_accepts_training")
+
+    def __init__(self, name, fn, num_outputs=1, differentiable=True, rng=False,
+                 aliases=(), doc=None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.rng = rng
+        self.aliases = tuple(aliases)
+        self.doc = doc or fn.__doc__
+
+    def n_outputs(self, attrs: Dict) -> int:
+        if callable(self.num_outputs):
+            return self.num_outputs(attrs)
+        return self.num_outputs
+
+    def __repr__(self):
+        return f"<Op {self.name}>"
+
+
+OP_REGISTRY: Dict[str, Op] = {}
+
+
+def register(name: Optional[str] = None, num_outputs=1, differentiable=True,
+             rng=False, aliases=()):
+    """Decorator registering a pure jax function as a framework op."""
+
+    def _reg(fn: Callable) -> Callable:
+        opname = name or fn.__name__
+        op = Op(opname, fn, num_outputs=num_outputs, differentiable=differentiable,
+                rng=rng, aliases=aliases)
+        if opname in OP_REGISTRY:
+            raise ValueError(f"op {opname!r} already registered")
+        OP_REGISTRY[opname] = op
+        for a in aliases:
+            OP_REGISTRY[a] = op
+        fn.op = op
+        return fn
+
+    return _reg
+
+
+def get_op(name: str) -> Op:
+    try:
+        return OP_REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"operator {name!r} not registered") from None
+
+
+def list_ops():
+    return sorted(set(op.name for op in OP_REGISTRY.values()))

@@ -1,0 +1,634 @@
+"""Tensor op families: elementwise, broadcast, reduce, matrix, index, init.
+
+Covers the reference's ``src/operator/tensor/*`` families (SURVEY.md §2.1,
+~29k LoC of CUDA/C++) as jnp/lax emitters.  Naming follows the reference's
+public op names (``python/mxnet/ndarray/register.py`` autogen surface) so that
+user code written against mx.nd/mx.sym carries over.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary (reference: src/operator/tensor/elemwise_unary_op_basic.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "negative": jnp.negative,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.fix,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "reciprocal": jnp.reciprocal,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": lambda x: jax.scipy.special.gammaln(x),
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _name, _f in _UNARY.items():
+    register(_name, differentiable=_name not in ("logical_not",))(
+        (lambda f: lambda x: f(x))(_f)
+    )
+
+
+@register("identity", aliases=("_copy", "stop_gradient_identity"))
+def identity(x):
+    return x
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def block_grad(x):
+    return lax.stop_gradient(x)
+
+
+@register("cast", aliases=("Cast",))
+def cast(x, dtype="float32"):
+    from ..base import np_dtype
+
+    return x.astype(np_dtype(dtype))
+
+
+@register("clip")
+def clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary + broadcast (elemwise_binary_op*.cc, broadcast ops)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "equal": lambda a, b: (a == b),
+    "not_equal": lambda a, b: (a != b),
+    "greater": lambda a, b: (a > b),
+    "greater_equal": lambda a, b: (a >= b),
+    "lesser": lambda a, b: (a < b),
+    "lesser_equal": lambda a, b: (a <= b),
+    "logical_and": lambda a, b: ((a != 0) & (b != 0)),
+    "logical_or": lambda a, b: ((a != 0) | (b != 0)),
+    "logical_xor": lambda a, b: ((a != 0) ^ (b != 0)),
+}
+
+_CMP = {"equal", "not_equal", "greater", "greater_equal", "lesser", "lesser_equal",
+        "logical_and", "logical_or", "logical_xor"}
+
+
+def _binary_impl(f, cmp):
+    def impl(a, b):
+        r = f(a, b)
+        if cmp:
+            r = r.astype(a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32)
+        return r
+
+    return impl
+
+
+for _name, _f in _BINARY.items():
+    impl = _binary_impl(_f, _name in _CMP)
+    # elemwise_* requires same shape in the reference; broadcast_* broadcasts.
+    # XLA broadcasts natively so one emitter serves both names.
+    register("elemwise_" + _name, differentiable=_name not in _CMP,
+             aliases=("broadcast_" + _name, "_" + _name))(impl)
+
+# scalar variants (reference: *_scalar ops)
+for _name, _f in _BINARY.items():
+    impl = (lambda f, cmp: lambda x, scalar=0.0: _binary_impl(f, cmp)(x, jnp.asarray(scalar, dtype=x.dtype)))(_f, _name in _CMP)
+    register("_" + _name + "_scalar", differentiable=_name not in _CMP)(impl)
+
+
+@register("_rsub_scalar")
+def _rsub_scalar(x, scalar=0.0):
+    return jnp.asarray(scalar, dtype=x.dtype) - x
+
+
+@register("_rdiv_scalar")
+def _rdiv_scalar(x, scalar=0.0):
+    return jnp.asarray(scalar, dtype=x.dtype) / x
+
+
+@register("_rpower_scalar")
+def _rpower_scalar(x, scalar=0.0):
+    return jnp.power(jnp.asarray(scalar, dtype=x.dtype), x)
+
+
+@register("_rmod_scalar")
+def _rmod_scalar(x, scalar=0.0):
+    return jnp.mod(jnp.asarray(scalar, dtype=x.dtype), x)
+
+
+@register("add_n", aliases=("ElementWiseSum", "_grad_add_n"))
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("where")
+def where(cond, x, y):
+    return jnp.where(cond != 0, x, y)
+
+
+# ---------------------------------------------------------------------------
+# reductions (broadcast_reduce_op*.cc)
+# ---------------------------------------------------------------------------
+
+def _reduce(fn):
+    def impl(x, axis=None, keepdims=False, exclude=False):
+        ax = _axis_arg(axis)
+        if exclude and ax is not None:
+            if isinstance(ax, int):
+                ax = (ax,)
+            ax = tuple(i for i in range(x.ndim) if i not in ax)
+        return fn(x, axis=ax, keepdims=bool(keepdims))
+
+    return impl
+
+
+register("sum", aliases=("sum_axis",))(_reduce(jnp.sum))
+register("mean")(_reduce(jnp.mean))
+register("prod")(_reduce(jnp.prod))
+register("max", aliases=("max_axis",))(_reduce(jnp.max))
+register("min", aliases=("min_axis",))(_reduce(jnp.min))
+register("nansum")(_reduce(jnp.nansum))
+register("nanprod")(_reduce(jnp.nanprod))
+
+
+@register("norm")
+def norm(x, ord=2, axis=None, keepdims=False):
+    ax = _axis_arg(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=bool(keepdims)))
+
+
+@register("argmax", differentiable=False)
+def argmax(x, axis=None, keepdims=False):
+    ax = _axis_arg(axis)
+    r = jnp.argmax(x, axis=ax)
+    if keepdims and ax is not None:
+        r = jnp.expand_dims(r, ax)
+    return r.astype(jnp.float32)
+
+
+@register("argmin", differentiable=False)
+def argmin(x, axis=None, keepdims=False):
+    ax = _axis_arg(axis)
+    r = jnp.argmin(x, axis=ax)
+    if keepdims and ax is not None:
+        r = jnp.expand_dims(r, ax)
+    return r.astype(jnp.float32)
+
+
+@register("argsort", differentiable=False)
+def argsort(x, axis=-1, is_ascend=True):
+    r = jnp.argsort(x, axis=_axis_arg(axis))
+    if not is_ascend:
+        r = jnp.flip(r, axis=_axis_arg(axis) if axis is not None else 0)
+    return r.astype(jnp.float32)
+
+
+@register("sort")
+def sort(x, axis=-1, is_ascend=True):
+    r = jnp.sort(x, axis=_axis_arg(axis))
+    if not is_ascend:
+        r = jnp.flip(r, axis=_axis_arg(axis) if axis is not None else 0)
+    return r
+
+
+@register("topk", differentiable=False, num_outputs=lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1)
+def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Reference: src/operator/tensor/ordered_op. lax.top_k rides the TPU sort unit."""
+    ax = int(axis) if axis is not None else -1
+    xm = jnp.moveaxis(x, ax, -1)
+    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax).astype(jnp.float32)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# matrix ops (matrix_op.cc: reshape/transpose/slice/…; dot.cc)
+# ---------------------------------------------------------------------------
+
+@register("reshape", aliases=("Reshape",))
+def reshape(x, shape=None, reverse=False):
+    """Supports the reference's special codes 0 (keep), -1 (infer), -2 (copy rest),
+    -3 (merge two), -4 (split) — src/operator/tensor/matrix_op.cc docstring."""
+    shape = tuple(int(s) for s in shape)
+    if not any(s in (0, -2, -3, -4) for s in shape):
+        return jnp.reshape(x, shape)
+    src = list(x.shape)[::-1] if reverse else list(x.shape)
+    out = []
+    i = 0
+    it = iter(range(len(shape)))
+    src_i = 0
+    shape = list(shape)
+    j = 0
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(src[src_i]); src_i += 1
+        elif s == -1:
+            out.append(-1); src_i += 1
+        elif s == -2:
+            out.extend(src[src_i:]); src_i = len(src)
+        elif s == -3:
+            out.append(src[src_i] * src[src_i + 1]); src_i += 2
+        elif s == -4:
+            a, b = shape[j + 1], shape[j + 2]
+            if a == -1:
+                a = src[src_i] // b
+            if b == -1:
+                b = src[src_i] // a
+            out.extend([a, b]); src_i += 1; j += 2
+        else:
+            out.append(s); src_i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(x, tuple(out))
+
+
+@register("reshape_like")
+def reshape_like(x, y):
+    return jnp.reshape(x, y.shape)
+
+
+@register("flatten", aliases=("Flatten",))
+def flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose")
+def transpose(x, axes=None):
+    if axes is None or len(axes) == 0:
+        return jnp.transpose(x)
+    return jnp.transpose(x, tuple(int(a) for a in axes))
+
+
+@register("expand_dims")
+def expand_dims(x, axis=0):
+    return jnp.expand_dims(x, int(axis))
+
+
+@register("squeeze")
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, _axis_arg(axis))
+
+
+@register("slice", aliases=("crop",))
+def slice_op(x, begin=None, end=None, step=None):
+    slices = []
+    begin = begin or ()
+    end = end or ()
+    step = step or ()
+    for i in range(len(begin)):
+        b = begin[i]
+        e = end[i] if i < len(end) else None
+        s = step[i] if i < len(step) and step[i] is not None and step[i] != 0 else 1
+        slices.append(slice(b, e, s))
+    return x[tuple(slices)]
+
+
+@register("slice_axis")
+def slice_axis(x, axis=0, begin=0, end=None):
+    sl = [slice(None)] * x.ndim
+    sl[int(axis)] = slice(begin, end)
+    return x[tuple(sl)]
+
+
+@register("slice_like")
+def slice_like(x, like, axes=()):
+    axes = tuple(axes) if axes else tuple(range(min(x.ndim, like.ndim)))
+    sl = [slice(None)] * x.ndim
+    for a in axes:
+        sl[a] = slice(0, like.shape[a])
+    return x[tuple(sl)]
+
+
+@register("concat", aliases=("Concat",))
+def concat(*args, dim=1):
+    return jnp.concatenate(args, axis=int(dim))
+
+
+@register("stack")
+def stack(*args, axis=0):
+    return jnp.stack(args, axis=int(axis))
+
+
+@register("split", aliases=("SliceChannel",),
+          num_outputs=lambda attrs: int(attrs.get("num_outputs", 1)))
+def split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, int(num_outputs), axis=int(axis))
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=int(axis)) for p in parts]
+    return tuple(parts)
+
+
+@register("tile")
+def tile(x, reps=()):
+    return jnp.tile(x, tuple(int(r) for r in reps))
+
+
+@register("repeat")
+def repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, int(repeats), axis=_axis_arg(axis))
+
+
+@register("pad", aliases=("Pad",))
+def pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    pw = list(pad_width)
+    pairs = [(int(pw[i]), int(pw[i + 1])) for i in range(0, len(pw), 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pairs, mode="constant", constant_values=constant_value)
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+@register("flip", aliases=("reverse",))
+def flip(x, axis=0):
+    return jnp.flip(x, _axis_arg(axis))
+
+
+@register("roll")
+def roll(x, shift=0, axis=None):
+    return jnp.roll(x, shift, axis=_axis_arg(axis))
+
+
+@register("broadcast_to")
+def broadcast_to(x, shape=()):
+    target = tuple(int(s) if int(s) != 0 else x.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, target)
+
+
+@register("broadcast_like")
+def broadcast_like(x, like):
+    return jnp.broadcast_to(x, like.shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(x, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    target = list(x.shape)
+    for a, s in zip(axis, size):
+        target[a] = s
+    return jnp.broadcast_to(x, tuple(target))
+
+
+@register("dot")
+def dot(a, b, transpose_a=False, transpose_b=False):
+    """Reference: src/operator/tensor/dot.cc. Maps straight onto the MXU via
+    lax.dot_general; accumulate in f32 when inputs are bf16."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    return jnp.dot(a, b, preferred_element_type=_acc_type(a))
+
+
+def _acc_type(a):
+    if a.dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return None
+
+
+@register("batch_dot")
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b, preferred_element_type=_acc_type(a))
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    r = batch_dot(a, b, transpose_a, transpose_b)
+    return r if alpha == 1.0 else alpha * r
+
+
+@register("diag")
+def diag(x, k=0):
+    if x.ndim == 1:
+        return jnp.diag(x, k=int(k))
+    return jnp.diagonal(x, offset=int(k), axis1=-2, axis2=-1)
+
+
+@register("L2Normalization")
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        n = jnp.sqrt(jnp.sum(jnp.square(x.reshape(x.shape[0], -1)), axis=1) + eps)
+        return x / n.reshape((-1,) + (1,) * (x.ndim - 1))
+    if mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+        return x / n
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=tuple(range(2, x.ndim)), keepdims=True) + eps)
+    return x / n
+
+
+# ---------------------------------------------------------------------------
+# indexing (indexing_op.cc: take/gather/scatter/embedding/one_hot)
+# ---------------------------------------------------------------------------
+
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    jmode = "clip" if mode in ("clip", "raise") else "wrap"
+    return jnp.take(a, idx, axis=int(axis), mode=jmode)
+
+
+@register("pick")
+def pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[int(axis)] - 1)
+    r = jnp.take_along_axis(x, jnp.expand_dims(idx, int(axis)), axis=int(axis))
+    if not keepdims:
+        r = jnp.squeeze(r, int(axis))
+    return r
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=()):
+    out = jnp.zeros(tuple(int(s) for s in shape), dtype=data.dtype)
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return out.at[idx].add(data)
+
+
+@register("one_hot", differentiable=False)
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import np_dtype
+
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), int(depth))
+    return (oh * (on_value - off_value) + off_value).astype(np_dtype(dtype))
+
+
+@register("Embedding")
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    """Reference: src/operator/tensor/indexing_op.cc Embedding. On TPU this is a
+    gather that XLA lowers efficiently; sparse_grad maps to the same dense
+    gather (grads become scatter-adds under vjp)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("SequenceMask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    T = data.shape[int(axis)]
+    steps = jnp.arange(T)
+    if int(axis) == 0:
+        mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:
+        mask = steps[None, :] < sequence_length[:, None].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, -1, axis=int(axis))
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    if int(axis) == 0:
+        return jnp.take_along_axis(
+            data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0
+        ).squeeze(0)
+    return jnp.take_along_axis(
+        data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1
+    ).squeeze(1)
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, int(axis))
+    T = data.shape[0]
+    steps = jnp.arange(T)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    rev_idx = jnp.where(steps < L, L - 1 - steps, steps)
+    return jnp.take_along_axis(data, rev_idx.reshape(rev_idx.shape + (1,) * (data.ndim - 2)), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# init ops (init_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+# ---------------------------------------------------------------------------
+# misc (histogram, ravel, linalg basics)
+# ---------------------------------------------------------------------------
+
+@register("linalg_potrf")
+def linalg_potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_trsm")
+def linalg_trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    import jax.scipy.linalg as jsl
+
+    if rightside:
+        x = jsl.solve_triangular(a.swapaxes(-1, -2), b.swapaxes(-1, -2),
+                                 lower=not lower, trans=1 if transpose else 0)
+        x = x.swapaxes(-1, -2)
+    else:
+        x = jsl.solve_triangular(a, b, lower=lower, trans=1 if transpose else 0)
+    return alpha * x
+
+
+@register("linalg_syrk")
+def linalg_syrk(a, transpose=False, alpha=1.0):
+    at = a.swapaxes(-1, -2)
+    r = jnp.matmul(at, a) if transpose else jnp.matmul(a, at)
+    return alpha * r
+
+
+@register("smooth_l1")
+def smooth_l1(x, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * jnp.square(x),
+                     jnp.abs(x) - 0.5 / s2)

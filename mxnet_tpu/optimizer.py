@@ -1,0 +1,573 @@
+"""Optimizers (reference: python/mxnet/optimizer.py — registry :112, SGD with
+fp16 master weights :494, LBSGD :672, Updater :1498).
+
+All 16 registered reference optimizers are provided.  Updates are jnp
+expressions over the parameter/grad/state buffers; under the Module/Trainer
+fused path they are jitted together with the step.  Multi-precision mirrors
+the reference: bf16/fp16 params keep an f32 master copy in the state.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Dict, Optional
+
+import numpy as _np
+import jax.numpy as jnp
+
+from .base import Registry
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "Signum", "FTML", "LBSGD", "DCASGD", "NAG", "SGLD",
+           "Adam", "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Adamax", "Nadam",
+           "Test", "register", "create", "Updater", "get_updater"]
+
+_REG: Registry = Registry("optimizer")
+
+
+def register(klass):
+    _REG.register(klass.__name__)(klass)
+    return klass
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.get(name)(**kwargs)
+
+
+class Optimizer:
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self.multi_precision = multi_precision
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.lr_mult: Dict[str, float] = {}
+        self.wd_mult: Dict[str, float] = {}
+        if sym is not None:
+            attrs = sym.attr_dict()
+            for name, a in attrs.items():
+                if "__lr_mult__" in a:
+                    self.lr_mult[name] = float(a["__lr_mult__"])
+                if "__wd_mult__" in a:
+                    self.wd_mult[name] = float(a["__wd_mult__"])
+
+    # -- bookkeeping --------------------------------------------------------------
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        return lr * self.lr_mult.get(name, 1.0)
+
+    def _get_wd(self, index):
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        return self.wd * self.wd_mult.get(name, 1.0)
+
+    def _preprocess_grad(self, grad):
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def _needs_master(self, weight):
+        return self.multi_precision and weight.dtype in (_np.float16, jnp.bfloat16)
+
+    # -- API ----------------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self._needs_master(weight):
+            master = NDArray(weight._data.astype(jnp.float32))
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self._needs_master(weight):
+            master, inner = state
+            g32 = NDArray(grad._data.astype(jnp.float32))
+            self.update(index, master, g32, inner)
+            weight._data = master._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + lazy sparse updates (reference: optimizer.py:494)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        if state is None:
+            weight._data = weight._data - lr * g
+        else:
+            mom = self.momentum * state._data - lr * g
+            state._data = mom
+            weight._data = weight._data + mom
+
+
+@register
+class Signum(Optimizer):
+    """signSGD with momentum (reference: optimizer.py Signum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        if state is not None:
+            m = self.momentum * state._data - (1 - self.momentum) * (g + wd * weight._data)
+            state._data = m
+            weight._data = (1 - lr * self.wd_lh) * weight._data + lr * jnp.sign(m)
+        else:
+            weight._data = (1 - lr * (wd + self.wd_lh)) * weight._data - lr * jnp.sign(g)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros_like(weight._data)
+        return (NDArray(z), NDArray(z), NDArray(z))  # d, v, z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        d, v, z = state
+        v_t = self.beta2 * v._data + (1 - self.beta2) * g * g
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v_t / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d._data
+        z_t = self.beta1 * z._data + (1 - self.beta1) * g - sigma * weight._data
+        weight._data = -z_t / d_t
+        d._data, v._data, z._data = d_t, v_t, z_t
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD with LARS-style layer-wise adaptive rate
+    (reference: optimizer.py:672)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.lbmult = 1.0
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def _get_lbmult(self, nup):
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        if nup >= nwup or nwup == 0:
+            return self.batch_scale
+        if self.warmup_strategy == "linear":
+            return 1.0 + (self.batch_scale - 1) * nup / nwup
+        if self.warmup_strategy == "power2":
+            return 1.0 + (self.batch_scale - 1) * (nup * nup) / (nwup * nwup)
+        if self.warmup_strategy == "sqrt":
+            return 1.0 + (self.batch_scale - 1) * math.sqrt(nup / nwup)
+        return 1.0
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        if self.warmup_strategy == "lars":
+            w_norm = float(jnp.linalg.norm(weight._data.astype(jnp.float32).reshape(-1)))
+            g_norm = float(jnp.linalg.norm(g.astype(jnp.float32).reshape(-1)))
+            if w_norm > 0 and g_norm > 0:
+                lr = lr * (w_norm / (g_norm + wd * w_norm))
+        else:
+            lr = lr * self._get_lbmult(self.num_update - self.init_updates)
+        mom = self.momentum * state._data - lr * (g + wd * weight._data)
+        state._data = mom
+        weight._data = weight._data + mom
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = NDArray(jnp.zeros_like(weight._data)) if self.momentum != 0 else None
+        prev = NDArray(weight._data)
+        return (mom, prev)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        mom, prev = state
+        comp = g + self.lamda * g * g * (weight._data - prev._data)
+        if mom is not None:
+            m = self.momentum * mom._data - lr * (comp + wd * weight._data)
+            mom._data = m
+            delta = m
+        else:
+            delta = -lr * (comp + wd * weight._data)
+        prev._data = weight._data
+        weight._data = weight._data + delta
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference: optimizer.py NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        if state is None:
+            weight._data = weight._data - lr * g
+        else:
+            m = self.momentum * state._data + g
+            state._data = m
+            weight._data = weight._data - lr * (g + self.momentum * m)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        from . import random as _random
+        import jax
+
+        noise = jax.random.normal(_random.next_key(), weight.shape,
+                                  dtype=weight._data.dtype) * math.sqrt(lr)
+        weight._data = weight._data - lr / 2 * g + noise
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        z = jnp.zeros_like(weight._data)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        lr = lr * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        m, v = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        weight._data = weight._data - lr * m._data / (jnp.sqrt(v._data) + self.epsilon)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        state._data = state._data + g * g
+        weight._data = weight._data - lr * g / jnp.sqrt(state._data + self.float_stable_eps)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8,
+                 centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2, self.epsilon = gamma1, gamma2, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = jnp.zeros_like(weight._data)
+        if self.centered:
+            return (NDArray(z), NDArray(z), NDArray(z))  # n, g, delta
+        return NDArray(z)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        if self.centered:
+            n, mg, delta = state
+            n._data = (1 - self.gamma1) * g * g + self.gamma1 * n._data
+            mg._data = (1 - self.gamma1) * g + self.gamma1 * mg._data
+            delta._data = self.gamma2 * delta._data - lr * g / jnp.sqrt(
+                n._data - mg._data * mg._data + self.epsilon)
+            weight._data = weight._data + delta._data
+        else:
+            n = state
+            n._data = (1 - self.gamma1) * g * g + self.gamma1 * n._data
+            weight._data = weight._data - lr * g / jnp.sqrt(n._data + self.epsilon)
+        if self.clip_weights:
+            weight._data = jnp.clip(weight._data, -self.clip_weights, self.clip_weights)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros_like(weight._data)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        acc_g, acc_delta = state
+        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * g * g
+        delta = jnp.sqrt(acc_delta._data + self.epsilon) / jnp.sqrt(acc_g._data + self.epsilon) * g
+        acc_delta._data = self.rho * acc_delta._data + (1 - self.rho) * delta * delta
+        weight._data = weight._data - delta
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        z = jnp.zeros_like(weight._data)
+        return (NDArray(z), NDArray(z))  # z, n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        z, n = state
+        sigma = (jnp.sqrt(n._data + g * g) - jnp.sqrt(n._data)) / lr
+        z._data = z._data + g - sigma * weight._data
+        n._data = n._data + g * g
+        weight._data = (jnp.sign(z._data) * self.lamda1 - z._data) / (
+            (self.beta + jnp.sqrt(n._data)) / lr + wd) * (jnp.abs(z._data) > self.lamda1)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        z = jnp.zeros_like(weight._data)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        lr /= (1.0 - self.beta1 ** t)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        m, u = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        weight._data = weight._data - lr * m._data / (u._data + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        z = jnp.zeros_like(weight._data)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * g * g
+        g_prime = g / (1 - self.m_schedule)
+        m_prime = m._data / (1 - m_schedule_next)
+        v_prime = v._data / (1 - self.beta2 ** t)
+        m_bar = (1 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._data = weight._data - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer doing plain SGD (reference: optimizer.py Test)."""
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        weight._data = weight._data - self.rescale_grad * grad._data
+
+
+ccSGD = SGD  # reference alias
+
+
+class Updater:
+    """Applies an optimizer to (index, grad, weight) triples, creating state
+    lazily (reference: optimizer.py:1498 get_updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict = {}
+        self.states_synced: Dict = {}
+
+    def __call__(self, index, grad, weight):
+        if isinstance(index, (list, tuple)):
+            for i, g, w in zip(index, grad, weight):
+                self._update(i, g, w)
+        else:
+            self._update(index, grad, weight)
+
+    def _update(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        def pack(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(pack(x) for x in s)
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            return s
+
+        packed = {k: pack(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((packed, self.optimizer))
+        return pickle.dumps(packed)
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2 and isinstance(data[1], Optimizer):
+            data, self.optimizer = data
+
+        def unpack(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(unpack(x) for x in s)
+            if isinstance(s, _np.ndarray):
+                from .ndarray import array
+
+                return array(s)
+            return s
+
+        self.states = {k: unpack(v) for k, v in data.items()}
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -1,0 +1,29 @@
+"""Parallelism & distribution (SURVEY.md §2.3, §5.7, §5.8).
+
+This package holds the TPU-native replacements for the reference's
+distribution machinery, plus the pod-scale capabilities the 2018 reference
+lacks (sequence/context parallelism, ring attention, tensor/pipeline
+parallelism):
+
+- mesh.py          device mesh management (ICI topology → jax.sharding.Mesh)
+- collectives.py   allreduce/broadcast/reduce_scatter over mesh axes
+                   (replaces Comm/CommDevice/NCCL — src/kvstore/comm.h)
+- data_parallel.py fused SPMD data-parallel train step (replaces
+                   DataParallelExecutorGroup — module/executor_group.py:143)
+- ring_attention.py blockwise ring attention over the sequence axis
+- sequence_parallel.py all-to-all (DeepSpeed-Ulysses style) sequence sharding
+- pipeline.py      pipeline parallelism via shard_map + ppermute microbatching
+- compression.py   2-bit gradient compression w/ error feedback
+                   (src/kvstore/gradient_compression.*)
+"""
+from .mesh import MeshConfig, get_mesh, make_mesh, local_mesh
+from . import collectives
+from . import compression
+from .data_parallel import DataParallelTrainer
+from .ring_attention import ring_attention
+from .sequence_parallel import ulysses_attention
+from . import pipeline
+
+__all__ = ["MeshConfig", "get_mesh", "make_mesh", "local_mesh", "collectives",
+           "compression", "DataParallelTrainer", "ring_attention",
+           "ulysses_attention", "pipeline"]

@@ -1,0 +1,161 @@
+"""Fused SPMD data-parallel training.
+
+Replaces the reference's DataParallelExecutorGroup + kvstore push/pull loop
+(executor_group.py:143, model.py:145-177): instead of slicing the batch across
+per-device executors and reducing grads key-by-key, the *whole* train step —
+forward, backward, allreduce, optimizer — is one jitted XLA program over a
+device mesh.  The batch is sharded on the `dp` axis; parameters are replicated
+(or sharded on `tp` for tensor parallelism); XLA inserts ICI allreduces where
+the gradient of a replicated parameter meets sharded activations.  This is the
+path that must hit the ≥1,200 img/s/chip north star (BASELINE.md).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .. import autograd
+from ..gluon.block import Block
+from ..ndarray.ndarray import NDArray
+from .mesh import get_mesh
+
+__all__ = ["DataParallelTrainer", "block_apply_fn"]
+
+
+def block_apply_fn(block: Block, is_train: bool = True):
+    """Extract a pure fn(params_dict, x, rng) -> out from a Gluon block."""
+    from .. import random as _random
+
+    pd = block.collect_params()
+    names = list(pd.keys())
+
+    def apply_fn(params: Dict[str, jnp.ndarray], x, rng=None):
+        saved = []
+        for name in names:
+            p = pd[name]
+            saved.append(p._data._data)
+            p._data._data = params[name]
+        saved_key = _random.swap_key(rng if rng is not None else jax.random.PRNGKey(0))
+        try:
+            with autograd.pause(train_mode=is_train):
+                out = block(NDArray(x))
+        finally:
+            _random.swap_key(saved_key)
+            for name, s in zip(names, saved):
+                pd[name]._data._data = s
+        return out._data if isinstance(out, NDArray) else tuple(o._data for o in out)
+
+    try:
+        init_params = {n: pd[n].data()._data for n in names}
+    except Exception as e:
+        raise RuntimeError(
+            "block has uninitialized (deferred-shape) parameters; run one "
+            "forward pass or construct layers with in_units/in_channels before "
+            "creating a DataParallelTrainer") from e
+    return apply_fn, init_params
+
+
+class DataParallelTrainer:
+    """One-program-per-step data-parallel trainer.
+
+    loss_fn(pred, y) -> scalar-per-sample array.  Optimizer: SGD w/ momentum
+    + optional weight decay, fused into the step (extend via `update_fn`).
+    """
+
+    def __init__(self, block: Block, loss_fn: Callable, lr: float = 0.1,
+                 momentum: float = 0.9, weight_decay: float = 0.0,
+                 mesh: Optional[Mesh] = None, dp_axis: str = "dp",
+                 compute_dtype=None, update_fn: Optional[Callable] = None,
+                 donate: bool = True):
+        self._mesh = mesh or get_mesh()
+        self._axis = dp_axis
+        self._block = block
+        self._loss_fn = loss_fn
+        self._lr = lr
+        self._momentum = momentum
+        self._wd = weight_decay
+        self._compute_dtype = compute_dtype
+        self._update_fn = update_fn
+        self._apply_fn, self.params = block_apply_fn(block, is_train=True)
+        self.momenta = {k: jnp.zeros_like(v) for k, v in self.params.items()}
+        self._step_fn = None
+        self._donate = donate
+        if self._mesh is not None:
+            self._place_params()
+
+    def _place_params(self):
+        repl = NamedSharding(self._mesh, PartitionSpec())
+        self.params = {k: jax.device_put(v, repl) for k, v in self.params.items()}
+        self.momenta = {k: jax.device_put(v, repl) for k, v in self.momenta.items()}
+
+    def _build_step(self):
+        apply_fn = self._apply_fn
+        loss_fn = self._loss_fn
+        lr, mom, wd = self._lr, self._momentum, self._wd
+        cdt = self._compute_dtype
+        update_fn = self._update_fn
+
+        def step(params, momenta, x, y, rng):
+            def loss_of(p):
+                pc = p if cdt is None else jax.tree_util.tree_map(
+                    lambda a: a.astype(cdt), p)
+                xin = x if cdt is None else x.astype(cdt)
+                pred = apply_fn(pc, xin, rng)
+                return jnp.mean(loss_fn(pred, y).astype(jnp.float32))
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            if update_fn is not None:
+                new_params, new_momenta = update_fn(params, momenta, grads)
+            else:
+                new_momenta = jax.tree_util.tree_map(
+                    lambda m, g: mom * m + g, momenta, grads)
+                new_params = jax.tree_util.tree_map(
+                    lambda p, m: p * (1.0 - lr * wd) - lr * m.astype(p.dtype),
+                    params, new_momenta)
+            return loss, new_params, new_momenta
+
+        if self._mesh is None:
+            return jax.jit(step, donate_argnums=(0, 1) if self._donate else ())
+        repl = NamedSharding(self._mesh, PartitionSpec())
+        shard = NamedSharding(self._mesh, PartitionSpec(self._axis))
+        return jax.jit(
+            step,
+            in_shardings=({k: repl for k in self.params},
+                          {k: repl for k in self.momenta}, shard, shard, repl),
+            out_shardings=(repl, {k: repl for k in self.params},
+                           {k: repl for k in self.momenta}),
+            donate_argnums=(0, 1) if self._donate else (),
+        )
+
+    def step(self, x, y, rng=None):
+        """Run one fused train step; returns scalar loss (async)."""
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        if isinstance(x, NDArray):
+            x = x._data
+        if isinstance(y, NDArray):
+            y = y._data
+        from .. import random as _random
+
+        _random.ensure_key()
+        if rng is None:
+            rng = _random.next_key()
+        if self._mesh is not None:
+            shard = NamedSharding(self._mesh, PartitionSpec(self._axis))
+            x = jax.device_put(x, shard)
+            y = jax.device_put(y, shard)
+        loss, self.params, self.momenta = self._step_fn(
+            self.params, self.momenta, x, y, rng)
+        return loss
+
+    def write_back(self):
+        """Copy trained params back into the Gluon block's buffers (re-placed
+        on a single device so the eager frontend can keep using them)."""
+        pd = self._block.collect_params()
+        for name, v in self.params.items():
+            pd[name]._data._data = jax.device_put(_np.asarray(v))

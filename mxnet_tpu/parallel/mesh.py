@@ -1,0 +1,78 @@
+"""Device mesh management.
+
+Replaces the reference's device-topology machinery (PCIe/NVLink spanning-tree
+planning, src/kvstore/gpu_topology.h:1054) with ICI mesh construction: on TPU
+the interconnect *is* a mesh, so topology-aware reduction = XLA collectives
+over named mesh axes.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as _np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["MeshConfig", "make_mesh", "get_mesh", "local_mesh", "sharding_for"]
+
+_current_mesh: Optional[Mesh] = None
+
+
+@dataclass
+class MeshConfig:
+    """Named mesh axes; standard names: dp (data), tp (tensor/model),
+    pp (pipeline), sp (sequence), ep (expert)."""
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def size(self):
+        s = 1
+        for v in self.axes.values():
+            s *= v
+        return s
+
+
+def make_mesh(axes: Dict[str, int] = None, devices=None, **axis_kwargs) -> Mesh:
+    """Build a jax Mesh over the available devices.
+
+    make_mesh({'dp': 4, 'tp': 2}) or make_mesh(dp=4, tp=2).
+    """
+    axes = dict(axes or {})
+    axes.update(axis_kwargs)
+    if devices is None:
+        devices = jax.devices()
+    size = 1
+    for v in axes.values():
+        size *= v
+    if size > len(devices):
+        raise ValueError(f"mesh wants {size} devices, only {len(devices)} present")
+    names = tuple(axes.keys())
+    shape = tuple(axes.values())
+    dev_array = _np.asarray(devices[:size]).reshape(shape)
+    mesh = Mesh(dev_array, names)
+    set_mesh(mesh)
+    return mesh
+
+
+def local_mesh(axis_name: str = "dp") -> Mesh:
+    """One-axis mesh over every local device."""
+    devs = jax.devices()
+    mesh = Mesh(_np.asarray(devs), (axis_name,))
+    set_mesh(mesh)
+    return mesh
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def sharding_for(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))

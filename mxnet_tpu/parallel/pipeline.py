@@ -1,0 +1,84 @@
+"""Pipeline parallelism via shard_map + ppermute microbatching.
+
+The reference has only inter-layer model parallelism with cross-device copies
+(`group2ctx` + _CrossDeviceCopy nodes, SURVEY.md §2.3); this provides true
+GPipe-style pipelining: stages live on the `pp` mesh axis, microbatches flow
+stage-to-stage over ICI with a steady-state bubble of (S-1)/(M+S-1).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+
+from .mesh import get_mesh
+
+__all__ = ["pipeline_apply", "pipeline_apply_sharded"]
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
+                   axis_name: str = "pp"):
+    """Run INSIDE shard_map.
+
+    stage_fn(params, x) -> y             one pipeline stage (same shape in/out)
+    stage_params                         this device's stage params (leading
+                                         stage dim already split by shard_map)
+    x_microbatches: (M, ...) microbatches; only stage 0's input is used.
+
+    Returns (M, ...) outputs valid on the LAST stage (others zeros).
+    """
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    T = M + n - 1
+    state = jnp.zeros_like(x_microbatches[0])
+    outputs = jnp.zeros_like(x_microbatches)
+
+    def tick(t, carry):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (if still available)
+        mb_idx = jnp.clip(t, 0, M - 1)
+        inject = jnp.where(t < M, 1.0, 0.0).astype(state.dtype)
+        state = jnp.where(rank == 0,
+                          x_microbatches[mb_idx] * inject, state)
+        # every stage computes
+        y = stage_fn(stage_params, state)
+        # last stage commits its finished microbatch: microbatch t-(n-1)
+        out_idx = jnp.clip(t - (n - 1), 0, M - 1)
+        commit = jnp.logical_and(t >= n - 1, rank == n - 1)
+        outputs = lax.cond(
+            commit,
+            lambda o: o.at[out_idx].set(y),
+            lambda o: o,
+            outputs)
+        # shift activations one stage down the ring
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, outputs)
+
+    state, outputs = lax.fori_loop(0, T, tick, (state, outputs))
+    return outputs
+
+
+def pipeline_apply_sharded(stage_fn: Callable, stacked_params, x_microbatches,
+                           mesh: Optional[Mesh] = None, axis_name: str = "pp"):
+    """Host entry: stacked_params has a leading stage dimension of size
+    mesh.shape[axis_name]; x_microbatches (M, B, ...) is replicated."""
+    mesh = mesh or get_mesh()
+    pspec = jax.tree_util.tree_map(lambda _: PartitionSpec(axis_name), stacked_params)
+
+    def inner(params, x):
+        # shard_map splits the stage dim; drop it inside
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        out = pipeline_apply(stage_fn, params, x, axis_name)
+        # outputs are zeros except on the last stage → psum replicates them
+        return lax.psum(out, axis_name)
+
+    fn = jax.shard_map(inner, mesh=mesh,
+                       in_specs=(pspec, PartitionSpec()),
+                       out_specs=PartitionSpec())
+    return fn(stacked_params, x_microbatches)

@@ -1,0 +1,49 @@
+"""All-to-all (Ulysses-style) sequence parallelism.
+
+Second long-context strategy (besides ring attention): activations are
+sequence-sharded between attention calls; inside attention, an all_to_all
+re-shards from sequence → heads so each device computes full-sequence
+attention for a head subset, then all_to_all back.  ICI all_to_all is cheap
+on TPU; this trades ring latency for two transposes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+
+from .mesh import get_mesh
+from .ring_attention import local_attention
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
+    """Call INSIDE shard_map; q,k,v: (B, Tlocal, H, D) sequence-sharded.
+
+    all_to_all: (B, T/n, H, D) → (B, T, H/n, D); local full attention; inverse.
+    """
+    def seq2head(x):
+        # split heads across the axis, gather sequence
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def head2seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+    out = local_attention(qh, kh, vh, causal=causal)
+    return head2seq(out)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Optional[Mesh] = None,
+                              axis_name: str = "sp", causal: bool = False):
+    mesh = mesh or get_mesh()
+    spec = PartitionSpec(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

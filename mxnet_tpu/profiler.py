@@ -1,0 +1,189 @@
+"""Profiler (reference: src/profiler/* + python/mxnet/profiler.py — chrome
+trace emission, aggregate summaries; SURVEY.md §5.1).
+
+TPU-native: host-side events are recorded in chrome://tracing format exactly
+like the reference; device-side, `profiler_start/stop` also drives the JAX/XLA
+TPU profiler (jax.profiler) whose traces carry the MXU/HBM detail, replacing
+CUDA kernel events.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = ["set_config", "set_state", "profiler_set_config", "profiler_set_state",
+           "start", "stop", "pause", "resume", "dump", "dumps", "Task", "Frame",
+           "Event", "Counter", "Marker", "Domain", "scope"]
+
+_lock = threading.Lock()
+_events: List[dict] = []
+_state = {"running": False, "filename": "profile.json", "aggregate": False,
+          "jax_trace_dir": None, "t0": None}
+_counters: Dict[str, float] = {}
+
+
+def set_config(filename="profile.json", profile_all=False, profile_symbolic=False,
+               profile_imperative=False, profile_memory=False, profile_api=False,
+               aggregate_stats=False, continuous_dump=False, **kwargs):
+    """Reference: MXSetProcessProfilerConfig."""
+    _state["filename"] = filename
+    _state["aggregate"] = aggregate_stats
+
+
+profiler_set_config = set_config
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+profiler_set_state = set_state
+
+
+def start(profile_process="worker"):
+    _state["running"] = True
+    _state["t0"] = time.perf_counter()
+    trace_dir = os.environ.get("TPUMX_JAX_TRACE_DIR")
+    if trace_dir:
+        import jax
+
+        _state["jax_trace_dir"] = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop(profile_process="worker"):
+    _state["running"] = False
+    if _state.get("jax_trace_dir"):
+        import jax
+
+        jax.profiler.stop_trace()
+        _state["jax_trace_dir"] = None
+
+
+def pause(profile_process="worker"):
+    _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    _state["running"] = True
+
+
+def _emit(ph, name, cat, ts=None, dur=None, args=None):
+    if not _state["running"]:
+        return
+    ev = {"ph": ph, "name": name, "cat": cat, "pid": os.getpid(),
+          "tid": threading.get_ident(),
+          "ts": (ts if ts is not None else time.perf_counter() * 1e6)}
+    if dur is not None:
+        ev["dur"] = dur
+    if args:
+        ev["args"] = args
+    with _lock:
+        _events.append(ev)
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate summary string (reference: MXAggregateProfileStatsPrint)."""
+    agg = defaultdict(lambda: [0, 0.0])
+    with _lock:
+        for ev in _events:
+            if ev["ph"] == "X":
+                agg[ev["name"]][0] += 1
+                agg[ev["name"]][1] += ev.get("dur", 0.0)
+    lines = [f"{'Name':<40}{'Count':>10}{'Total(us)':>15}"]
+    for name, (cnt, total) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+        lines.append(f"{name:<40}{cnt:>10}{total:>15.1f}")
+    if reset:
+        with _lock:
+            _events.clear()
+    return "\n".join(lines)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON (reference: MXDumpProfile)."""
+    with _lock:
+        data = {"traceEvents": list(_events), "displayTimeUnit": "ms"}
+    with open(_state["filename"], "w") as f:
+        json.dump(data, f)
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return f"Domain({self.name})"
+
+
+class Task:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter() * 1e6
+
+    def stop(self):
+        if self._t0 is not None:
+            _emit("X", self.name, self.domain.name, ts=self._t0,
+                  dur=time.perf_counter() * 1e6 - self._t0)
+
+
+Frame = Task
+
+
+class Event(Task):
+    pass
+
+
+class Counter:
+    def __init__(self, domain, name, value=0):
+        self.domain = domain
+        self.name = name
+        self._value = value
+
+    def set_value(self, value):
+        self._value = value
+        _emit("C", self.name, self.domain.name, args={self.name: value})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    __iadd__ = lambda self, d: (self.increment(d), self)[1]
+    __isub__ = lambda self, d: (self.decrement(d), self)[1]
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        _emit("i", self.name, self.domain.name, args={"scope": scope})
+
+
+class scope:
+    """Context manager timing a region as one trace slice."""
+
+    def __init__(self, name, cat="python"):
+        self._name = name
+        self._cat = cat
+
+    def __enter__(self):
+        self._t0 = time.perf_counter() * 1e6
+        return self
+
+    def __exit__(self, *exc):
+        _emit("X", self._name, self._cat, ts=self._t0,
+              dur=time.perf_counter() * 1e6 - self._t0)

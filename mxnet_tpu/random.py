@@ -1,0 +1,58 @@
+"""Global PRNG stream.
+
+Reference: per-device seeded generators (``src/common/random_generator.h`` —
+CPU mt19937 / GPU Philox) behind ``mx.random.seed``.  TPU-native version: one
+global threefry key split per consuming op, so every random op remains a pure
+function of an explicit key (jit/vmap/shard-safe), while the user-facing API
+stays stateful like the reference.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "fold_in"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get_key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state.key
+
+
+def ensure_key() -> None:
+    """Materialize the stream key eagerly, OUTSIDE any trace.
+
+    Must be called before code that may first-touch the stream while being
+    traced (jit/eval_shape) — otherwise the lazily-created default key would
+    be a tracer and leak into global state after the trace ends.
+    """
+    _get_key()
+
+
+def seed(seed_state: int, ctx=None) -> None:
+    """Seed the global stream (reference: ``mx.random.seed`` in python/mxnet/random.py)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split one subkey off the global stream."""
+    key = _get_key()
+    _state.key, sub = jax.random.split(key)
+    return sub
+
+
+def fold_in(data: int):
+    return jax.random.fold_in(_get_key(), data)
+
+
+def swap_key(new_key):
+    """Swap the global stream key (used by traced CachedOps to thread a traced
+    key through jit so dropout masks differ per call); returns the old key."""
+    old = _get_key()
+    _state.key = new_key
+    return old

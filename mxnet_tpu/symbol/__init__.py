@@ -1,0 +1,27 @@
+"""`mx.sym` namespace (reference: python/mxnet/symbol/)."""
+from __future__ import annotations
+
+import sys
+
+from ..ops.registry import OP_REGISTRY
+from .symbol import (Symbol, Variable, var, Group, load, load_json, zeros, ones,
+                     _make_sym_wrapper)
+from . import graph  # noqa: F401
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+_mod = sys.modules[__name__]
+for _name in list(OP_REGISTRY):
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_sym_wrapper(_name))
+
+# contrib sub-namespace
+class _Contrib:
+    pass
+
+
+contrib = _Contrib()
+for _name in list(OP_REGISTRY):
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):], getattr(_mod, _name))
+        setattr(contrib, _name, getattr(_mod, _name))

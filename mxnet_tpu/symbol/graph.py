@@ -1,0 +1,160 @@
+"""Symbol graph core: nodes, traversal, tracing to a pure JAX function.
+
+Reference: NNVM ``Graph/Node/Symbol`` (``src/executor/graph_executor.h:33-35``)
+and the pass pipeline (Gradient / InferShape / PlanMemory — SURVEY.md §3.1).
+
+TPU-native position: the graph here is only a *frontend* expression DAG.  All
+of NNVM's passes collapse into XLA:
+
+- InferShape/InferType  → ``jax.eval_shape`` over the traced function
+- Gradient              → ``jax.grad``/``jax.vjp`` of the traced function
+- PlanMemory/inplace    → XLA buffer assignment + donated arguments
+- PlaceDevice/group2ctx → pjit shardings from ``__ctx_group__`` attrs
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..ops.registry import Op
+
+# per-op parameter/aux input declarations for auto-created variables
+# (reference: each op's ListArguments/ListAuxiliaryStates)
+OP_EXTRA_INPUTS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
+    # opname: ((learnable param inputs after data...), (aux inputs))
+    "FullyConnected": (("weight", "bias"), ()),
+    "Convolution": (("weight", "bias"), ()),
+    "Deconvolution": (("weight", "bias"), ()),
+    "BatchNorm": (("gamma", "beta"), ("moving_mean", "moving_var")),
+    "LayerNorm": (("gamma", "beta"), ()),
+    "InstanceNorm": (("gamma", "beta"), ()),
+    "Embedding": (("weight",), ()),
+    "RNN": (("parameters", "state", "state_cell"), ()),
+    "LeakyReLU": (("gamma",), ()),
+}
+
+# ops whose extra-input list depends on attrs
+def _active_extra_inputs(opname: str, attrs: dict) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    params, aux = OP_EXTRA_INPUTS.get(opname, ((), ()))
+    if opname in ("FullyConnected", "Convolution", "Deconvolution") and attrs.get("no_bias"):
+        params = tuple(p for p in params if p != "bias")
+    if opname == "LeakyReLU" and attrs.get("act_type", "leaky") != "prelu":
+        params = ()
+    if opname == "RNN":
+        if attrs.get("mode") != "lstm":
+            params = ("parameters", "state")
+    return params, aux
+
+
+class Node:
+    """One graph node: a variable or an op application."""
+
+    __slots__ = ("kind", "name", "op", "attrs", "inputs", "attr_dict", "_uid")
+
+    _next_uid = [0]
+
+    def __init__(self, kind: str, name: str, op: Optional[Op] = None,
+                 attrs: Optional[dict] = None, inputs: Optional[List["SymbolEntry"]] = None,
+                 attr_dict: Optional[dict] = None):
+        self.kind = kind  # 'var' | 'op'
+        self.name = name
+        self.op = op
+        self.attrs = attrs or {}
+        self.inputs = inputs or []
+        self.attr_dict = attr_dict or {}
+        self._uid = Node._next_uid[0]
+        Node._next_uid[0] += 1
+
+    def num_outputs(self) -> int:
+        if self.kind == "var":
+            return 1
+        return self.op.n_outputs(self.attrs)
+
+
+class SymbolEntry:
+    """(node, output_index) pair — an edge source in the DAG."""
+
+    __slots__ = ("node", "index")
+
+    def __init__(self, node: Node, index: int = 0):
+        self.node = node
+        self.index = index
+
+
+def topo_order(entries: Sequence[SymbolEntry]) -> List[Node]:
+    """Post-order DFS over the DAG, deduplicated (reference: nnvm DFSVisit)."""
+    seen = set()
+    order: List[Node] = []
+
+    def visit(node: Node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for e in node.inputs:
+            visit(e.node)
+        order.append(node)
+
+    for e in entries:
+        visit(e.node)
+    return order
+
+
+def input_nodes(entries: Sequence[SymbolEntry], include_aux=True) -> List[Node]:
+    """All variable nodes in traversal order."""
+    out = []
+    for n in topo_order(entries):
+        if n.kind == "var":
+            if not include_aux and n.attr_dict.get("__is_aux__"):
+                continue
+            out.append(n)
+    return out
+
+
+def trace(entries: Sequence[SymbolEntry], env: Dict[str, object], is_train: bool,
+          rng_key=None, collect_aux: Optional[dict] = None):
+    """Evaluate the DAG over jax values.
+
+    env: variable name -> jax value.  Random ops fold the node uid into
+    rng_key.  When collect_aux is a dict and is_train, BatchNorm nodes place
+    their (batch_mean, batch_var) under their aux variable names so the
+    executor can update running stats functionally.
+    """
+    import inspect
+
+    from ..ndarray.ndarray import _op_accepts_training
+
+    values: Dict[int, tuple] = {}
+
+    for node in topo_order(entries):
+        if node.kind == "var":
+            if node.name not in env:
+                raise ValueError(f"unbound variable {node.name!r}")
+            values[id(node)] = (env[node.name],)
+            continue
+        ins = [values[id(e.node)][e.index] for e in node.inputs]
+        kwargs = dict(node.attrs)
+        op = node.op
+        if op.rng:
+            if rng_key is None:
+                rng_key = jax.random.PRNGKey(0)
+            kwargs["rng_key"] = jax.random.fold_in(rng_key, node._uid)
+        if _op_accepts_training(op):
+            kwargs["_training"] = is_train
+        if op.name == "BatchNorm" and collect_aux is not None and is_train \
+                and not kwargs.get("use_global_stats"):
+            kwargs["output_mean_var"] = True
+            out = op.fn(*ins, **kwargs)
+            y, mean, var = out
+            aux_names = [e.node.name for e in node.inputs[-2:]]
+            momentum = float(kwargs.get("momentum", 0.9))
+            old_mean = ins[-2]
+            old_var = ins[-1]
+            collect_aux[aux_names[0]] = momentum * old_mean + (1 - momentum) * mean
+            collect_aux[aux_names[1]] = momentum * old_var + (1 - momentum) * var
+            values[id(node)] = (y,)
+            continue
+        out = op.fn(*ins, **kwargs)
+        values[id(node)] = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+    return [values[id(e.node)][e.index] for e in entries]

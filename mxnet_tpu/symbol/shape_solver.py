@@ -1,0 +1,143 @@
+"""Shape inference for symbol graphs.
+
+Reference: NNVM bidirectional ``InferShape`` pass
+(``src/executor/infer_graph_attr_pass.cc``).  TPU-native version: output
+shapes come from ``jax.eval_shape`` over each op's emitter (no duplicated
+shape logic), and *parameter* shapes (weight/bias/gamma/...) are solved
+forward from data shapes + op attrs via per-op rules — the only place shape
+knowledge is written twice, and only for the seven param-taking op families.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as _np
+import jax
+
+from ..base import MXNetError
+from .graph import Node, SymbolEntry, _active_extra_inputs, input_nodes, topo_order
+
+_KEY_STRUCT = jax.ShapeDtypeStruct((2,), _np.uint32)
+
+
+def _param_shape_rule(op_name: str, slot: str, attrs: dict,
+                      in_shapes: List[Tuple[int, ...]]) -> Tuple[int, ...]:
+    """Shape of a learnable/aux input given the data input shapes."""
+    data = in_shapes[0]
+    if op_name == "FullyConnected":
+        nh = int(attrs["num_hidden"])
+        flat = 1
+        if attrs.get("flatten", True):
+            for d in data[1:]:
+                flat *= d
+        else:
+            flat = data[-1]
+        return (nh, flat) if slot == "weight" else (nh,)
+    if op_name == "Convolution":
+        nf = int(attrs["num_filter"])
+        kernel = tuple(int(k) for k in attrs["kernel"])
+        ng = int(attrs.get("num_group", 1))
+        cin = data[1]
+        if slot == "weight":
+            return (nf, cin // ng) + kernel
+        return (nf,)
+    if op_name == "Deconvolution":
+        nf = int(attrs["num_filter"])
+        kernel = tuple(int(k) for k in attrs["kernel"])
+        ng = int(attrs.get("num_group", 1))
+        cin = data[1]
+        if slot == "weight":
+            # reference layout: (in_channels, num_filter/num_group, *kernel)
+            return (cin, nf // ng) + kernel
+        return (nf,)
+    if op_name in ("BatchNorm", "InstanceNorm"):
+        ax = int(attrs.get("axis", 1))
+        return (data[ax],)
+    if op_name == "LayerNorm":
+        ax = int(attrs.get("axis", -1))
+        return (data[ax],)
+    if op_name == "Embedding":
+        return (int(attrs["input_dim"]), int(attrs["output_dim"]))
+    if op_name == "LeakyReLU":
+        return (data[1],)
+    if op_name == "RNN":
+        from ..ops.rnn import rnn_param_size
+
+        H = int(attrs["state_size"])
+        L = int(attrs["num_layers"])
+        bi = bool(attrs.get("bidirectional", False))
+        dirs = 2 if bi else 1
+        T, N, I = data
+        if slot == "parameters":
+            return (rnn_param_size(attrs.get("mode", "lstm"), L, I, H, bi),)
+        return (L * dirs, N, H)
+    raise MXNetError(f"no shape rule for {op_name}.{slot}")
+
+
+def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]]):
+    """Returns (arg_shapes, out_shapes, aux_shapes) in listing order."""
+    from ..ndarray.ndarray import _op_accepts_training
+
+    entries = symbol._entries
+    shapes: Dict[int, Tuple] = {}  # id(node) -> tuple of output shapes
+    var_shape: Dict[str, Tuple[int, ...]] = dict(known)
+
+    for node in topo_order(entries):
+        if node.kind == "var":
+            if node.name in var_shape:
+                shapes[id(node)] = (tuple(var_shape[node.name]),)
+            elif node.attr_dict.get("__shape__"):
+                sh = tuple(eval(node.attr_dict["__shape__"]))  # noqa: S307 — own format
+                var_shape[node.name] = sh
+                shapes[id(node)] = (sh,)
+            # else: deferred — a consuming op's param rule will fill it
+            continue
+        op = node.op
+        params, aux = _active_extra_inputs(op.name, node.attrs)
+        extra = list(params) + list(aux)
+        n_data = len(node.inputs) - len(extra)
+        in_shapes: List[Tuple[int, ...]] = []
+        # data inputs must be known
+        for e in node.inputs[:n_data]:
+            if id(e.node) not in shapes:
+                raise MXNetError(
+                    f"infer_shape: input {e.node.name!r} of op {node.name!r} has unknown shape")
+            in_shapes.append(shapes[id(e.node)][e.index])
+        # solve param/aux shapes
+        for slot, e in zip(extra, node.inputs[n_data:]):
+            if id(e.node) in shapes:
+                in_shapes.append(shapes[id(e.node)][e.index])
+                continue
+            sh = _param_shape_rule(op.name, slot, node.attrs, in_shapes)
+            var_shape[e.node.name] = sh
+            shapes[id(e.node)] = (sh,)
+            in_shapes.append(sh)
+        # abstract-eval the op for output shapes
+        kwargs = dict(node.attrs)
+        if op.rng:
+            kwargs["rng_key"] = _KEY_STRUCT
+        if _op_accepts_training(op):
+            kwargs["_training"] = False
+        structs = [jax.ShapeDtypeStruct(s, _np.float32) for s in in_shapes]
+        try:
+            if op.rng:
+                key = kwargs.pop("rng_key")
+                out = jax.eval_shape(lambda *a: op.fn(*a, rng_key=jax.random.PRNGKey(0), **kwargs), *structs)
+            else:
+                out = jax.eval_shape(lambda *a: op.fn(*a, **kwargs), *structs)
+        except Exception as e:
+            raise MXNetError(f"infer_shape failed at op {node.name!r} ({op.name}): {e}") from e
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        shapes[id(node)] = tuple(tuple(o.shape) for o in outs)
+
+    arg_shapes = []
+    for n in input_nodes(entries):
+        if n.attr_dict.get("__is_aux__"):
+            continue
+        if n.name not in var_shape:
+            raise MXNetError(f"infer_shape: could not determine shape of {n.name!r}")
+        arg_shapes.append(tuple(var_shape[n.name]))
+    aux_shapes = [tuple(var_shape[n.name]) for n in input_nodes(entries)
+                  if n.attr_dict.get("__is_aux__")]
+    out_shapes = [shapes[id(e.node)][e.index] for e in entries]
+    return arg_shapes, out_shapes, aux_shapes

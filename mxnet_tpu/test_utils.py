@@ -1,0 +1,193 @@
+"""Test utilities (reference: python/mxnet/test_utils.py — assert_almost_equal,
+numeric_grad :470, rand_ndarray/rand_sparse_ndarray :53, default_context).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import ndarray as nd
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_shape_2d", "rand_shape_3d",
+           "rand_ndarray", "rand_sparse_ndarray", "numeric_grad",
+           "check_numeric_gradient", "check_consistency", "simple_forward"]
+
+_default_ctx = None
+
+
+def default_context() -> Context:
+    return _default_ctx or current_context()
+
+
+def set_default_context(ctx: Context):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def same(a, b):
+    return _np.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return _np.allclose(_to_np(a), _to_np(b), rtol=rtol, atol=atol,
+                        equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b"),
+                        equal_nan=False):
+    a, b = _to_np(a), _to_np(b)
+    if not _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        err = _np.abs(a - b)
+        rel = err / (_np.abs(b) + atol)
+        raise AssertionError(
+            f"{names[0]} != {names[1]} (max abs {err.max():.3e}, "
+            f"max rel {rel.max():.3e}, rtol={rtol}, atol={atol})")
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1),
+            _np.random.randint(1, dim2 + 1))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None):
+    if stype == "default":
+        return nd.array(_np.random.uniform(-1, 1, shape), dtype=dtype)
+    return rand_sparse_ndarray(shape, stype, density=density, dtype=dtype)[0]
+
+
+def rand_sparse_ndarray(shape, stype, density=0.5, dtype=None):
+    """Random sparse array + its dense numpy twin (reference: test_utils.py:53)."""
+    from .ndarray import sparse as _sp
+
+    density = 0.5 if density is None else density
+    dense = _np.random.uniform(-1, 1, shape)
+    mask = _np.random.rand(*shape) < density
+    if stype == "row_sparse":
+        row_mask = _np.random.rand(shape[0]) < density
+        dense = dense * row_mask.reshape((-1,) + (1,) * (len(shape) - 1))
+        arr = _sp.row_sparse_array(dense.astype(dtype or _np.float32))
+    elif stype == "csr":
+        dense = dense * mask
+        arr = _sp.csr_matrix(dense.astype(dtype or _np.float32))
+    else:
+        raise ValueError(stype)
+    return arr, dense.astype(dtype or _np.float32)
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Finite-difference gradient via central differences
+    (reference: test_utils.py numeric_grad)."""
+    grads = {}
+    for name, arr in location.items():
+        base = arr.asnumpy().astype(_np.float64)
+        g = _np.zeros_like(base)
+        flat = base.reshape(-1)
+        gflat = g.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            executor.arg_dict[name]._data = nd.array(base).astype("float32")._data
+            out_p = executor.forward(is_train=use_forward_train)[0].asnumpy().sum()
+            flat[i] = orig - eps
+            executor.arg_dict[name]._data = nd.array(base).astype("float32")._data
+            out_m = executor.forward(is_train=use_forward_train)[0].asnumpy().sum()
+            flat[i] = orig
+            gflat[i] = (out_p - out_m) / (2 * eps)
+        executor.arg_dict[name]._data = nd.array(base).astype("float32")._data
+        grads[name] = g
+    return grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None, ctx=None):
+    """Compare autodiff grads against finite differences
+    (reference: test_utils.py check_numeric_gradient)."""
+    import jax
+    import jax.numpy as jnp
+
+    ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    location = {k: (v if isinstance(v, NDArray) else nd.array(v))
+                for k, v in location.items()}
+    grad_nodes = grad_nodes or arg_names
+    ex = sym.bind(ctx=ctx, args=location,
+                  args_grad={n: nd.zeros(location[n].shape) for n in grad_nodes},
+                  grad_req={n: ("write" if n in grad_nodes else "null")
+                            for n in arg_names},
+                  aux_states=aux_states)
+    ex.forward(is_train=True)
+    ex.backward()
+    analytic = {n: ex.grad_dict[n].asnumpy() for n in grad_nodes}
+
+    # numeric: perturb each grad node
+    def f(vals):
+        env = {k: v._data for k, v in location.items()}
+        env.update(vals)
+        from .symbol.graph import trace
+
+        outs = trace(sym._entries, env, True, jax.random.PRNGKey(0), {})
+        return sum(jnp.sum(o) for o in outs)
+
+    for n in grad_nodes:
+        base = location[n].asnumpy().astype(_np.float64)
+        g = _np.zeros_like(base).reshape(-1)
+        flat = base.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps
+            fp = float(f({n: jnp.asarray(base.astype(_np.float32))}))
+            flat[i] = orig - numeric_eps
+            fm = float(f({n: jnp.asarray(base.astype(_np.float32))}))
+            flat[i] = orig
+            g[i] = (fp - fm) / (2 * numeric_eps)
+        numeric = g.reshape(base.shape)
+        assert_almost_equal(analytic[n], numeric, rtol=rtol,
+                            atol=atol if atol is not None else 1e-2,
+                            names=(f"analytic[{n}]", f"numeric[{n}]"))
+
+
+def check_consistency(sym, ctx_list=None, scale=1.0, grad_req="write",
+                      arg_params=None, tol=None):
+    """Cross-backend consistency: run the same graph on cpu and tpu contexts
+    (the reference's GPU-vs-CPU oracle, tests/python/gpu/test_operator_gpu.py)."""
+    from .context import num_tpus, tpu
+
+    if ctx_list is None:
+        ctx_list = [cpu(0)] + ([tpu(0)] if num_tpus() else [])
+    arg_shapes, _, _ = sym.infer_shape()
+    arg_names = sym.list_arguments()
+    location = {n: nd.array(_np.random.uniform(-scale, scale, s))
+                for n, s in zip(arg_names, arg_shapes)}
+    outputs = []
+    for ctx in ctx_list:
+        args = {k: v.as_in_context(ctx) for k, v in location.items()}
+        ex = sym.bind(ctx=ctx, args=args, grad_req="null")
+        outputs.append([o.asnumpy() for o in ex.forward(is_train=False)])
+    for other in outputs[1:]:
+        for a, b in zip(outputs[0], other):
+            assert_almost_equal(a, b, rtol=1e-3, atol=1e-4)
+    return outputs
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    ctx = ctx or default_context()
+    args = {k: (v if isinstance(v, NDArray) else nd.array(v))
+            for k, v in inputs.items()}
+    ex = sym.bind(ctx=ctx, args=args, grad_req="null")
+    outputs = ex.forward(is_train=is_train)
+    return outputs[0] if len(outputs) == 1 else outputs

@@ -1,0 +1,29 @@
+"""Misc utilities (reference: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["makedirs", "get_gpu_count", "use_np_shape", "is_np_shape"]
+
+
+def makedirs(d):
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def get_gpu_count():
+    from .context import num_tpus
+
+    return num_tpus()
+
+
+def use_np_shape(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+
+    return wrapper
+
+
+def is_np_shape():
+    return False

@@ -1,0 +1,55 @@
+"""Network visualization (reference: python/mxnet/visualization.py —
+print_summary, plot_network)."""
+from __future__ import annotations
+
+from .symbol.graph import topo_order
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a layer-by-layer summary table (reference: visualization.py)."""
+    shape_info = {}
+    if shape is not None:
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
+        internals = symbol.get_internals()
+    nodes = topo_order(symbol._entries)
+    header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    positions = [int(line_length * p) for p in positions]
+
+    def print_row(fields):
+        line = ""
+        for f, pos in zip(fields, positions):
+            line = (line + str(f))[:pos - 1].ljust(pos)
+        print(line)
+
+    print("_" * line_length)
+    print_row(header)
+    print("=" * line_length)
+    total = 0
+    for n in nodes:
+        if n.kind == "var":
+            continue
+        prev = ",".join(e.node.name for e in n.inputs if e.node.kind != "var")
+        print_row([f"{n.name} ({n.op.name})", "", "", prev])
+    print("=" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Emit a graphviz dot source string (graphviz binary optional)."""
+    lines = ["digraph plot {"]
+    nodes = topo_order(symbol._entries)
+    nid = {id(n): i for i, n in enumerate(nodes)}
+    for n in nodes:
+        if n.kind == "var" and hide_weights and n.name != "data":
+            continue
+        shape_attr = "ellipse" if n.kind == "var" else "box"
+        lines.append(f'  n{nid[id(n)]} [label="{n.name}", shape={shape_attr}];')
+    for n in nodes:
+        for e in n.inputs:
+            if e.node.kind == "var" and hide_weights and e.node.name != "data":
+                continue
+            lines.append(f"  n{nid[id(e.node)]} -> n{nid[id(n)]};")
+    lines.append("}")
+    return "\n".join(lines)

@@ -1,0 +1,35 @@
+"""Test configuration: force a hermetic CPU backend with 8 virtual devices.
+
+The axon TPU plugin registers from sitecustomize at interpreter start and its
+client init dials the TPU tunnel (slow, exclusive) even when tests only need
+CPU.  sitecustomize imports jax early, locking ``jax_platforms`` from the
+environment — so overriding the *config* (not just the env var) is required.
+Backends initialize lazily, so doing this at conftest import (before any test
+touches jax) keeps the whole session on 8 virtual CPU devices, which is how
+the multi-chip sharding tests run without real chips (SURVEY.md §4's
+"distributed without a real cluster" analogue).
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip() \
+    if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "") \
+    else os.environ["XLA_FLAGS"]
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as _np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    """Reproducible seeds per test (reference: tests/python/unittest/common.py
+    @with_seed)."""
+    _np.random.seed(0)
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,137 @@
+"""Autograd tests (model: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2, 3])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * 2).sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 4 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array(np.random.rand(4))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * y).sum()
+    z.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * np.exp(2 * x.asnumpy()), rtol=1e-4)
+
+
+def test_multiple_variables():
+    a = nd.array([2.0])
+    b = nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), [4.0])
+    assert np.allclose(b.grad.asnumpy(), [2.0])
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(out_grad=nd.array([10.0, 1.0]))
+    assert np.allclose(x.grad.asnumpy(), [30.0, 3.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_pause_and_predict_mode():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        with autograd.pause():
+            z = x * 5  # not recorded
+        y = x * 2
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), [2.0])
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # z = const(4) * x → dz/dx = 4
+    assert np.allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_grad_function():
+    x = nd.array([3.0])
+    with autograd.record():
+        y = x * x
+    (gx,) = autograd.grad([y], [x])
+    assert np.allclose(gx.asnumpy(), [6.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array(np.random.rand(4))
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x).sum()
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert np.allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-4)
+
+
+def test_through_nonlinear_graph():
+    x = nd.array(np.random.rand(3, 4))
+    w = nd.array(np.random.rand(5, 4))
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        h = nd.FullyConnected(x, w, num_hidden=5, no_bias=True)
+        out = nd.relu(h).sum()
+    out.backward()
+    mask = (x.asnumpy() @ w.asnumpy().T) > 0
+    expect_w = (mask.T.astype(np.float32) @ x.asnumpy())
+    assert np.allclose(w.grad.asnumpy(), expect_w, atol=1e-4)
+
+
+def test_training_flag_affects_dropout():
+    x = nd.ones((50, 50))
+    with autograd.record(train_mode=True):
+        y_train = nd.Dropout(x, p=0.5)
+    with autograd.record(train_mode=False):
+        y_pred = nd.Dropout(x, p=0.5)
+    assert (y_train.asnumpy() == 0).any()
+    assert not (y_pred.asnumpy() == 0).any()

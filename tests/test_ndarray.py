@@ -1,0 +1,178 @@
+"""NDArray tests (model: tests/python/unittest/test_ndarray.py in the reference)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert np.all(a.asnumpy() == 0)
+    b = nd.ones((2,), dtype="int32")
+    assert b.dtype == np.int32
+    c = nd.full((2, 2), 7.0)
+    assert np.all(c.asnumpy() == 7)
+    d = nd.arange(0, 10, 2)
+    assert np.allclose(d.asnumpy(), np.arange(0, 10, 2))
+
+
+def test_arithmetic():
+    a = nd.array(np.array([[1.0, 2], [3, 4]]))
+    b = nd.array(np.array([[5.0, 6], [7, 8]]))
+    assert np.allclose((a + b).asnumpy(), [[6, 8], [10, 12]])
+    assert np.allclose((a - b).asnumpy(), [[-4, -4], [-4, -4]])
+    assert np.allclose((a * b).asnumpy(), [[5, 12], [21, 32]])
+    assert np.allclose((b / a).asnumpy(), [[5, 3], [7 / 3, 2]])
+    assert np.allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    assert np.allclose((2 - a).asnumpy(), [[1, 0], [-1, -2]])
+    assert np.allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    assert np.allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    b = a  # alias
+    a += 1
+    assert np.all(a.asnumpy() == 2)
+    assert np.all(b.asnumpy() == 2)  # handle semantics
+    a *= 3
+    assert np.all(a.asnumpy() == 6)
+
+
+def test_comparison():
+    a = nd.array([1.0, 2, 3])
+    b = nd.array([2.0, 2, 2])
+    assert np.allclose((a == b).asnumpy(), [0, 1, 0])
+    assert np.allclose((a > b).asnumpy(), [0, 0, 1])
+    assert np.allclose((a <= b).asnumpy(), [1, 1, 0])
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert np.allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    assert np.allclose(a[1:3, 1].asnumpy(), [5, 9])
+    assert float(a[2, 3].asscalar()) == 11
+    a[0] = 1.0
+    assert np.all(a[0].asnumpy() == 1)
+    a[1:3] = nd.zeros((2, 4))
+    assert np.all(a[1:3].asnumpy() == 0)
+
+
+def test_setitem_full():
+    a = nd.zeros((2, 3))
+    a[:] = 5.0
+    assert np.all(a.asnumpy() == 5)
+    a[:] = nd.ones((2, 3))
+    assert np.all(a.asnumpy() == 1)
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape(6, 4).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)  # 0 = keep dim
+    assert a.T.shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+
+
+def test_reduce():
+    a = nd.array(np.arange(6).reshape(2, 3).astype(np.float32))
+    assert float(a.sum()) == 15
+    assert np.allclose(a.sum(axis=0).asnumpy(), [3, 5, 7])
+    assert np.allclose(a.mean(axis=1).asnumpy(), [1, 4])
+    assert float(a.max()) == 5
+    assert float(a.min()) == 0
+    assert np.allclose(a.argmax(axis=1).asnumpy(), [2, 2])
+    assert a.sum(axis=1, keepdims=True).shape == (2, 1)
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4))
+    b = nd.array(np.random.rand(4, 5))
+    c = nd.dot(a, b)
+    assert np.allclose(c.asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+    d = nd.dot(a, b.T.copy(), transpose_b=True)
+    assert d.shape == (3, 4) or d.shape == (3, 5)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2 and np.all(parts[0].asnumpy() == 1)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_take_one_hot():
+    w = nd.array(np.arange(12).reshape(4, 3).astype(np.float32))
+    idx = nd.array([0, 2])
+    out = nd.take(w, idx)
+    assert np.allclose(out.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    oh = nd.one_hot(nd.array([1, 0]), depth=3)
+    assert np.allclose(oh.asnumpy(), [[0, 1, 0], [1, 0, 0]])
+
+
+def test_astype():
+    a = nd.ones((2, 2))
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.astype("bfloat16")
+    assert c.dtype.itemsize == 2
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs.bin")
+    a = nd.array(np.random.rand(3, 4))
+    b = nd.array(np.random.rand(5))
+    nd.save(fname, {"a": a, "b": b})
+    loaded = nd.load(fname)
+    assert np.allclose(loaded["a"].asnumpy(), a.asnumpy())
+    assert np.allclose(loaded["b"].asnumpy(), b.asnumpy())
+    nd.save(fname, [a, b])
+    lst = nd.load(fname)
+    assert len(lst) == 2 and np.allclose(lst[1].asnumpy(), b.asnumpy())
+
+
+def test_wait_to_read_and_waitall():
+    a = nd.ones((64, 64))
+    for _ in range(5):
+        a = a * 1.00001
+    a.wait_to_read()
+    nd.waitall()
+    assert a.shape == (64, 64)
+
+
+def test_norm_clip():
+    a = nd.array([[3.0, 4.0]])
+    assert abs(float(a.norm()) - 5.0) < 1e-5
+    c = a.clip(0, 3.5)
+    assert np.allclose(c.asnumpy(), [[3.0, 3.5]])
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1, 2], [0, 5, 4]])
+    idx = nd.topk(a, k=2)
+    assert idx.shape == (2, 2)
+    vals = nd.topk(a, k=2, ret_typ="value")
+    assert np.allclose(vals.asnumpy(), [[3, 2], [5, 4]])
+    s = nd.sort(a, axis=1)
+    assert np.allclose(s.asnumpy(), [[1, 2, 3], [0, 4, 5]])
+
+
+def test_context_movement():
+    a = nd.ones((2, 2), ctx=mx.cpu())
+    assert a.context.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b.context == mx.cpu(0)
+    c = nd.zeros((2, 2))
+    a.copyto(c)
+    assert np.all(c.asnumpy() == 1)

@@ -1,0 +1,239 @@
+"""Operator tests (model: tests/python/unittest/test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_unary_math():
+    x = np.random.rand(3, 4).astype(np.float32) + 0.1
+    a = nd.array(x)
+    assert np.allclose(nd.exp(a).asnumpy(), np.exp(x), rtol=1e-5)
+    assert np.allclose(nd.log(a).asnumpy(), np.log(x), rtol=1e-5)
+    assert np.allclose(nd.sqrt(a).asnumpy(), np.sqrt(x), rtol=1e-5)
+    assert np.allclose(nd.rsqrt(a).asnumpy(), 1 / np.sqrt(x), rtol=1e-4)
+    assert np.allclose(nd.square(a).asnumpy(), x * x, rtol=1e-6)
+    assert np.allclose(nd.tanh(a).asnumpy(), np.tanh(x), rtol=1e-5)
+    assert np.allclose(nd.sigmoid(a).asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert np.allclose(nd.relu(nd.array(x - 0.5)).asnumpy(), np.maximum(x - 0.5, 0))
+
+
+def test_fully_connected():
+    x = np.random.rand(4, 10).astype(np.float32)
+    w = np.random.rand(5, 10).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=5)
+    assert np.allclose(out.asnumpy(), x @ w.T + b, atol=1e-4)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w), num_hidden=5, no_bias=True)
+    assert np.allclose(out2.asnumpy(), x @ w.T, atol=1e-4)
+
+
+def test_convolution_vs_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+    b = np.random.rand(4).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b), kernel=(3, 3),
+                         stride=(2, 2), pad=(1, 1), num_filter=4)
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                     torch.tensor(b), stride=2, padding=1).numpy()
+    assert np.allclose(out.asnumpy(), ref, atol=1e-4)
+
+
+def test_grouped_and_depthwise_conv():
+    torch = pytest.importorskip("torch")
+    x = np.random.rand(1, 4, 6, 6).astype(np.float32)
+    w = np.random.rand(4, 1, 3, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3), num_filter=4,
+                         num_group=4, no_bias=True, pad=(1, 1))
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                     padding=1, groups=4).numpy()
+    assert np.allclose(out.asnumpy(), ref, atol=1e-4)
+
+
+def test_deconvolution_shape():
+    torch = pytest.importorskip("torch")
+    x = np.random.rand(1, 3, 5, 5).astype(np.float32)
+    w = np.random.rand(3, 2, 4, 4).astype(np.float32)  # (in, out, kH, kW)
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(4, 4), stride=(2, 2),
+                           pad=(1, 1), num_filter=2, no_bias=True)
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+    assert out.shape == ref.shape
+    assert np.allclose(out.asnumpy(), ref, atol=1e-4)
+
+
+def test_pooling():
+    torch = pytest.importorskip("torch")
+    x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    ref = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2).numpy()
+    assert np.allclose(out.asnumpy(), ref, atol=1e-6)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    ref = torch.nn.functional.avg_pool2d(torch.tensor(x), 2, 2).numpy()
+    assert np.allclose(out.asnumpy(), ref, atol=1e-6)
+    outg = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg")
+    assert np.allclose(outg.asnumpy(), x.mean(axis=(2, 3), keepdims=True), atol=1e-6)
+
+
+def test_batchnorm_inference():
+    x = np.random.rand(4, 3, 5, 5).astype(np.float32)
+    gamma = np.random.rand(3).astype(np.float32)
+    beta = np.random.rand(3).astype(np.float32)
+    mean = np.random.rand(3).astype(np.float32)
+    var = np.random.rand(3).astype(np.float32) + 0.5
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mean), nd.array(var), eps=1e-5, fix_gamma=False,
+                       use_global_stats=True)
+    ref = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-5) \
+        * gamma[None, :, None, None] + beta[None, :, None, None]
+    assert np.allclose(out.asnumpy(), ref, atol=1e-4)
+
+
+def test_softmax_and_logsoftmax():
+    x = np.random.rand(4, 10).astype(np.float32)
+    out = nd.softmax(nd.array(x))
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    ref = e / e.sum(axis=1, keepdims=True)
+    assert np.allclose(out.asnumpy(), ref, atol=1e-6)
+    assert np.allclose(nd.log_softmax(nd.array(x)).asnumpy(), np.log(ref), atol=1e-5)
+
+
+def test_softmax_output_backward_semantics():
+    """grad = softmax(x) - onehot(label), the reference's fused CE head."""
+    x = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = nd.array(np.array([1, 0, 3, 2], dtype=np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    p = out.asnumpy()
+    onehot = np.eye(5, dtype=np.float32)[[1, 0, 3, 2]]
+    assert np.allclose(x.grad.asnumpy(), p - onehot, atol=1e-5)
+
+
+def test_activation_leakyrelu():
+    x = np.array([[-1.0, 0.5]], dtype=np.float32)
+    assert np.allclose(nd.Activation(nd.array(x), act_type="relu").asnumpy(),
+                       [[0, 0.5]])
+    assert np.allclose(nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1)
+                       .asnumpy(), [[-0.1, 0.5]], atol=1e-6)
+    elu = nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0).asnumpy()
+    assert np.allclose(elu, [[np.exp(-1) - 1, 0.5]], atol=1e-5)
+
+
+def test_dropout_training_and_inference():
+    x = nd.ones((100, 100))
+    with mx.autograd.record(train_mode=True):
+        out = nd.Dropout(x, p=0.5)
+    kept = (out.asnumpy() != 0).mean()
+    assert 0.3 < kept < 0.7
+    assert np.allclose(out.asnumpy()[out.asnumpy() != 0], 2.0)
+    out_inf = nd.Dropout(x, p=0.5)  # not training
+    assert np.allclose(out_inf.asnumpy(), 1.0)
+
+
+def test_embedding():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = nd.array([1, 3, 1])
+    out = nd.Embedding(idx, nd.array(w), input_dim=10, output_dim=4)
+    assert np.allclose(out.asnumpy(), w[[1, 3, 1]])
+
+
+def test_broadcast_ops():
+    a = np.random.rand(3, 1).astype(np.float32)
+    b = np.random.rand(1, 4).astype(np.float32)
+    assert np.allclose(nd.broadcast_add(nd.array(a), nd.array(b)).asnumpy(), a + b)
+    assert np.allclose(nd.broadcast_mul(nd.array(a), nd.array(b)).asnumpy(), a * b)
+    assert np.allclose(nd.broadcast_maximum(nd.array(a), nd.array(b)).asnumpy(),
+                       np.maximum(a, b))
+
+
+def test_slice_ops():
+    x = nd.array(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    out = nd.slice(x, begin=(0, 1), end=(2, 3))
+    assert out.shape == (2, 2, 4)
+    out = nd.slice_axis(x, axis=2, begin=1, end=3)
+    assert out.shape == (2, 3, 2)
+    like = nd.zeros((2, 2, 2))
+    out = nd.slice_like(x, like)
+    assert out.shape == (2, 2, 2)
+
+
+def test_where_pick():
+    cond = nd.array([[1.0, 0], [0, 1]])
+    a = nd.ones((2, 2))
+    b = nd.zeros((2, 2))
+    out = nd.where(cond, a, b)
+    assert np.allclose(out.asnumpy(), [[1, 0], [0, 1]])
+    x = nd.array([[1.0, 2, 3], [4, 5, 6]])
+    idx = nd.array([0, 2])
+    assert np.allclose(nd.pick(x, idx, axis=1).asnumpy(), [1, 6])
+
+
+def test_sequence_ops():
+    x = nd.array(np.arange(12).reshape(3, 2, 2).astype(np.float32))  # (T,N,...)
+    seqlen = nd.array([2.0, 3.0])
+    masked = nd.SequenceMask(x, seqlen, use_sequence_length=True, value=-1.0)
+    m = masked.asnumpy()
+    assert np.all(m[2, 0] == -1)
+    assert np.all(m[2, 1] == x.asnumpy()[2, 1])
+    last = nd.SequenceLast(x, seqlen, use_sequence_length=True)
+    assert np.allclose(last.asnumpy()[0], x.asnumpy()[1, 0])
+    assert np.allclose(last.asnumpy()[1], x.asnumpy()[2, 1])
+
+
+def test_rnn_op_forward():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    T, N, I, H = 5, 2, 3, 4
+    x = nd.array(np.random.rand(T, N, I).astype(np.float32))
+    psize = rnn_param_size("lstm", 1, I, H)
+    params = nd.array(np.random.uniform(-0.1, 0.1, (psize,)).astype(np.float32))
+    h0 = nd.zeros((1, N, H))
+    c0 = nd.zeros((1, N, H))
+    out = nd.RNN(x, params, h0, c0, state_size=H, num_layers=1, mode="lstm",
+                 state_outputs=True)
+    y, hT, cT = out
+    assert y.shape == (T, N, H)
+    assert hT.shape == (1, N, H)
+    assert np.allclose(y.asnumpy()[-1], hT.asnumpy()[0], atol=1e-5)
+
+
+def test_ctc_loss_simple():
+    T, N, C = 4, 1, 3
+    logits = np.zeros((T, N, C), dtype=np.float32)
+    label = nd.array(np.array([[1, 2]], dtype=np.float32))
+    loss = nd.CTCLoss(nd.array(logits), label)
+    assert loss.shape == (1,)
+    assert float(loss.asnumpy()[0]) > 0
+
+
+def test_box_iou_nms():
+    boxes = nd.array(np.array([[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3]],
+                              dtype=np.float32))
+    iou = mx.nd.contrib.box_iou(boxes, boxes)
+    assert np.allclose(np.diag(iou.asnumpy()), 1.0, atol=1e-5)
+    assert iou.asnumpy()[0, 2] == 0.0
+    dets = nd.array(np.array([
+        [0, 0.9, 0, 0, 1, 1],
+        [0, 0.8, 0.05, 0.05, 1.05, 1.05],
+        [0, 0.7, 2, 2, 3, 3]], dtype=np.float32))
+    out = mx.nd.contrib.box_nms(dets, overlap_thresh=0.5, coord_start=2,
+                                score_index=1, id_index=0)
+    o = out.asnumpy()
+    # second box suppressed
+    assert (o[:, 1] > 0).sum() == 2
+
+
+def test_grad_of_matmul():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        c = nd.dot(a, b).sum()
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), b.asnumpy().sum(axis=1)[None, :].repeat(3, 0),
+                       atol=1e-4)

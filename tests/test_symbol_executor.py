@@ -1,0 +1,159 @@
+"""Symbol + Executor tests (model: tests/python/unittest/test_symbol.py,
+test_executor.py, test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(fc2, label, name="softmax")
+
+
+def test_list_arguments():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 20),
+                                                         softmax_label=(8,))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 20)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (10, 16)
+    assert out_shapes == [(8, 10)]
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1), name="c1")
+    bn = sym.BatchNorm(conv, name="bn1")
+    pool = sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(pool.list_arguments(), arg_shapes))
+    assert d["c1_weight"] == (8, 3, 3, 3)
+    assert d["bn1_gamma"] == (8,)
+    assert out_shapes == [(2, 8, 4, 4)]
+    assert len(aux_shapes) == 2  # moving_mean, moving_var
+
+
+def test_aux_states_bn():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, name="bn")
+    assert net.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    assert "bn_moving_mean" not in net.list_arguments()
+
+
+def test_symbol_arithmetic_and_compose():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a * 2 + b
+    ex = c.bind(ctx=mx.cpu(), args={"a": nd.array([1.0]), "b": nd.array([3.0])})
+    out = ex.forward()
+    assert np.allclose(out[0].asnumpy(), [5.0])
+
+
+def test_executor_forward_backward():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 12), softmax_label=(4,))
+    ex.arg_dict["data"][:] = nd.array(np.random.rand(4, 12))
+    ex.arg_dict["softmax_label"][:] = nd.array(np.array([0., 1, 2, 3]))
+    ex.arg_dict["fc1_weight"][:] = nd.array(np.random.rand(16, 12) * 0.1)
+    ex.arg_dict["fc2_weight"][:] = nd.array(np.random.rand(10, 16) * 0.1)
+    out = ex.forward(is_train=True)
+    assert out[0].shape == (4, 10)
+    assert np.allclose(out[0].asnumpy().sum(axis=1), 1.0, atol=1e-5)
+    ex.backward()
+    assert float(ex.grad_dict["fc1_weight"].abs().sum()) > 0
+    # label/data have grad_req null by default in simple_bind write map
+    assert ex.grad_dict.get("data") is not None  # simple_bind created it
+
+
+def test_executor_grad_req_add():
+    x = sym.Variable("x")
+    y = x * 3.0
+    gx = nd.zeros((2,))
+    ex = y.bind(ctx=mx.cpu(), args={"x": nd.array([1.0, 2.0])},
+                args_grad={"x": gx}, grad_req="add")
+    for _ in range(2):
+        ex.forward(is_train=True)
+        ex.backward()
+    assert np.allclose(gx.asnumpy(), [6.0, 6.0])
+
+
+def test_symbol_save_load(tmp_path):
+    net = _mlp()
+    f = str(tmp_path / "sym.json")
+    net.save(f)
+    net2 = sym.load(f)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # bind loaded symbol and run
+    ex = net2.simple_bind(ctx=mx.cpu(), data=(2, 6), softmax_label=(2,))
+    out = ex.forward()
+    assert out[0].shape == (2, 10)
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    fc1 = internals["fc1_output"]
+    ex = fc1.simple_bind(ctx=mx.cpu(), data=(2, 6))
+    out = ex.forward()
+    assert out[0].shape == (2, 16)
+
+
+def test_group():
+    a = sym.Variable("a")
+    s1 = a * 2
+    s2 = a + 1
+    g = sym.Group([s1, s2])
+    assert len(g.list_outputs()) == 2
+    ex = g.bind(ctx=mx.cpu(), args={"a": nd.array([1.0])})
+    outs = ex.forward()
+    assert np.allclose(outs[0].asnumpy(), [2.0])
+    assert np.allclose(outs[1].asnumpy(), [2.0])
+
+
+def test_bn_aux_update_in_training():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, name="bn", momentum=0.5, fix_gamma=False)
+    ex = net.simple_bind(ctx=mx.cpu(), data=(8, 3))
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    x = np.random.rand(8, 3).astype(np.float32) * 4
+    before = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True, data=nd.array(x))
+    after = ex.aux_dict["bn_moving_mean"].asnumpy()
+    expected = 0.5 * before + 0.5 * x.mean(axis=0)
+    assert np.allclose(after, expected, atol=1e-4)
+
+
+def test_monitor_callback():
+    seen = []
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 6), softmax_label=(2,))
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.forward()
+    assert seen == ["softmax_output"]
+
+
+def test_shape_solver_rnn():
+    data = sym.Variable("data")
+    net = sym.RNN(data, state_size=8, num_layers=2, mode="lstm", name="rnn")
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(10, 4, 6))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["rnn_state"] == (2, 4, 8)
+    assert out_shapes == [(10, 4, 8)]
