@@ -1,0 +1,76 @@
+"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+
+Matches the reference's headline number (`train_imagenet.py` throughput,
+BASELINE.md: V100 fp32 298.51 img/s at bs=32; driver north star 1,200
+img/s/chip on v4-32).  The whole train step — forward, backward, SGD+momentum
+update — is one jitted XLA program with donated param buffers; bf16 compute
+with f32 master weights (the TPU analogue of the reference's multi-precision
+fp16 path, python/mxnet/optimizer.py:494).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR = 1200.0  # img/s/chip (BASELINE.json)
+
+
+def main():
+    batch_size = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.parallel.data_parallel import block_apply_fn
+
+    net = gluon.model_zoo.vision.resnet50_v1(classes=1000)
+    net.initialize()
+    net(nd.array(np.zeros((1, 3, 224, 224), np.float32)))  # materialize shapes
+    apply_fn, params = block_apply_fn(net, is_train=True)
+    momenta = {k: jnp.zeros_like(v) for k, v in params.items()}
+
+    def step(params, momenta, x, y, rng):
+        def loss_of(p):
+            pc = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), p)
+            logits = apply_fn(pc, x.astype(jnp.bfloat16), rng).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        momenta = jax.tree_util.tree_map(lambda m, g: 0.9 * m + g.astype(m.dtype),
+                                         momenta, grads)
+        params = jax.tree_util.tree_map(lambda p, m: p - 0.1 * m, params, momenta)
+        return loss, params, momenta
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    rng0 = jax.random.PRNGKey(0)
+    x = jnp.asarray(np.random.rand(batch_size, 3, 224, 224).astype(np.float32))
+    y = jnp.asarray(np.random.randint(0, 1000, (batch_size,)).astype(np.int32))
+
+    # compile + warmup
+    loss, params, momenta = jstep(params, momenta, x, y, rng0)
+    float(loss)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss, params, momenta = jstep(params, momenta, x, y,
+                                      jax.random.fold_in(rng0, i))
+    float(loss)  # sync
+    dt = time.perf_counter() - t0
+    img_per_sec = batch_size * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / NORTH_STAR, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

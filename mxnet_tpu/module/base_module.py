@@ -180,6 +180,7 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        train_data.reset()  # defensive: support reused/exhausted iterators
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()

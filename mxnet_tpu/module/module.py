@@ -106,9 +106,14 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return [(n, o.shape) for n, o in
-                zip(self._output_names, self._exec.outputs)] if self._exec.outputs \
-            else [(n, None) for n in self._output_names]
+        if self._exec.outputs:
+            return [(n, o.shape) for n, o in
+                    zip(self._output_names, self._exec.outputs)]
+        _, out_shapes, _ = self._symbol.infer_shape(**self._shape_kwargs())
+        return list(zip(self._output_names, out_shapes))
+
+    def _shape_kwargs(self):
+        return dict(self._data_shapes + self._label_shapes)
 
     # -- binding ------------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -132,7 +137,7 @@ class Module(BaseModule):
 
         self._data_shapes = _norm(data_shapes)
         self._label_shapes = _norm(label_shapes)
-        shape_kwargs = dict(self._data_shapes + self._label_shapes)
+        shape_kwargs = self._shape_kwargs()
 
         req = {}
         for n in self._symbol.list_arguments():

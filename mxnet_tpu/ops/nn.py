@@ -3,8 +3,11 @@
 Covers the reference's ``src/operator/nn/*`` (SURVEY.md §2.1; conv/deconv/FC/
 pool/norm/softmax/activation/dropout — ~14k LoC CUDA) plus the cuDNN wrapper
 surface, as XLA emitters.  Convolutions lower through ``lax.conv_general_dilated``
-which XLA tiles onto the MXU; bf16 inputs accumulate in f32
-(``preferred_element_type``), the TPU-native analogue of the reference's
+which XLA tiles onto the MXU.  Mixed precision: matmuls request f32
+accumulation via ``preferred_element_type``; convs rely on the MXU's implicit
+f32 accumulation for bf16 (jax's conv transpose rule rejects an explicit
+``preferred_element_type``), and fp16 convs are computed in f32 and cast back
+— together the TPU-native analogue of the reference's
 fp16-with-fp32-master-weights path (``python/mxnet/optimizer.py:494``).
 
 Data layout: the public ops accept the reference's default NCHW ("NCHW" attr)
@@ -77,6 +80,11 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=()
     pad = _pair(pad, k) if pad else (0,) * k
     dnums = lax.conv_dimension_numbers(data.shape, weight.shape,
                                        _conv_dnums(data.ndim, layout))
+    # fp16 has no implicit f32 accumulation guarantee: compute in f32
+    # (bf16 accumulates in f32 on the MXU by construction)
+    in_dtype = data.dtype
+    if in_dtype == jnp.float16:
+        data, weight = data.astype(jnp.float32), weight.astype(jnp.float32)
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -84,10 +92,11 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=()
         rhs_dilation=dilate,
         dimension_numbers=dnums,
         feature_group_count=int(num_group),
-        preferred_element_type=_acc(data),
+        # no preferred_element_type: jax's conv transpose rule can't upcast
+        # cotangents
     )
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
+    if in_dtype == jnp.float16:
+        out = out.astype(in_dtype)
     if not no_bias and bias is not None:
         if layout in (None, "NCHW", "NCW", "NCDHW"):
             out = out + bias.reshape((1, -1) + (1,) * nd)
@@ -134,10 +143,7 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=int(num_group),
-        preferred_element_type=_acc(data),
     )
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out

@@ -37,6 +37,11 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
     T = M + n - 1
     state = jnp.zeros_like(x_microbatches[0])
     outputs = jnp.zeros_like(x_microbatches)
+    # mark carries as device-varying over the pp axis up front: the loop body
+    # makes them varying (rank-dependent writes), and lax.fori_loop requires
+    # carry types to be invariant across iterations
+    state = lax.pcast(state, (axis_name,), to="varying")
+    outputs = lax.pcast(outputs, (axis_name,), to="varying")
 
     def tick(t, carry):
         state, outputs = carry
@@ -50,11 +55,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
         # last stage commits its finished microbatch: microbatch t-(n-1)
         out_idx = jnp.clip(t - (n - 1), 0, M - 1)
         commit = jnp.logical_and(t >= n - 1, rank == n - 1)
-        outputs = lax.cond(
-            commit,
-            lambda o: o.at[out_idx].set(y),
-            lambda o: o,
-            outputs)
+        outputs = outputs.at[out_idx].set(
+            jnp.where(commit, y, outputs[out_idx]))
         # shift activations one stage down the ring
         perm = [(j, (j + 1) % n) for j in range(n)]
         state = lax.ppermute(y, axis_name, perm)

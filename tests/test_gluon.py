@@ -1,0 +1,236 @@
+"""Gluon tests (model: tests/python/unittest/test_gluon*.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_forward():
+    layer = nn.Dense(5, in_units=3)
+    layer.initialize()
+    x = nd.array(np.random.rand(2, 3))
+    out = layer(x)
+    assert out.shape == (2, 5)
+    w = layer.weight.data().asnumpy()
+    b = layer.bias.data().asnumpy()
+    assert np.allclose(out.asnumpy(), x.asnumpy() @ w.T + b, atol=1e-5)
+
+
+def test_deferred_init():
+    layer = nn.Dense(4)
+    layer.initialize()
+    out = layer(nd.array(np.random.rand(2, 7)))
+    assert layer.weight.shape == (4, 7)
+    assert out.shape == (2, 4)
+
+
+def test_sequential_and_children():
+    net = nn.Sequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    assert len(net) == 2
+    out = net(nd.array(np.random.rand(3, 5)))
+    assert out.shape == (3, 2)
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = nd.array(np.random.rand(4, 10))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    jit1 = net(x).asnumpy()
+    jit2 = net(x).asnumpy()
+    assert np.allclose(eager, jit1, atol=1e-5)
+    assert np.allclose(jit1, jit2, atol=1e-6)
+
+
+def test_hybridized_gradients_match_eager():
+    def run(hybridize):
+        np.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(6, activation="tanh", in_units=4), nn.Dense(3, in_units=6))
+        net.initialize()
+        if hybridize:
+            net.hybridize()
+        x = nd.array(np.random.RandomState(3).rand(5, 4))
+        with autograd.record():
+            out = net(x).sum()
+        out.backward()
+        return {name: p.grad().asnumpy()
+                for name, p in net.collect_params().items()
+                if p.grad_req != "null"}
+
+    g_eager = run(False)
+    g_jit = run(True)
+    for (k1, v1), (k2, v2) in zip(sorted(g_eager.items()), sorted(g_jit.items())):
+        assert np.allclose(v1, v2, atol=1e-4), (k1, k2)
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Flatten(),
+            nn.Dense(4))
+    net.initialize()
+    out = net(nd.array(np.random.rand(2, 3, 8, 8)))
+    assert out.shape == (2, 4)
+
+
+def test_batchnorm_train_vs_eval():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = nd.array((np.random.rand(16, 3, 4, 4) * 5 + 2).astype(np.float32))
+    with autograd.record():
+        y_train = net(x)
+    # training output ~ normalized per-batch
+    m = y_train.asnumpy().mean(axis=(0, 2, 3))
+    assert np.allclose(m, 0, atol=1e-2)
+    # running stats moved toward batch stats
+    rm = net.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0)
+    y_eval = net(x)
+    assert not np.allclose(y_eval.asnumpy(), y_train.asnumpy(), atol=1e-3)
+
+
+def test_trainer_step_sgd():
+    net = nn.Dense(1, in_units=1, use_bias=False)
+    net.initialize(mx.init.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = nd.array([[2.0]])
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    # w=1, x=2 → y=2, loss=y², dL/dw = 2*y*x = 8; w' = 1 - 0.1*8 = 0.2
+    assert np.allclose(net.weight.data().asnumpy(), [[0.2]], atol=1e-5)
+
+
+def test_losses():
+    pred = nd.array(np.random.rand(4, 5))
+    label = nd.array(np.array([1.0, 0, 3, 2]))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    p = np.exp(pred.asnumpy() - pred.asnumpy().max(1, keepdims=True))
+    p = p / p.sum(1, keepdims=True)
+    expect = -np.log(p[np.arange(4), label.asnumpy().astype(int)])
+    assert np.allclose(l.asnumpy(), expect, atol=1e-4)
+
+    l2 = gluon.loss.L2Loss()(pred, nd.zeros((4, 5)))
+    assert np.allclose(l2.asnumpy(), (pred.asnumpy() ** 2).mean(axis=1) / 2, atol=1e-5)
+
+    l1 = gluon.loss.L1Loss()(pred, nd.zeros((4, 5)))
+    assert np.allclose(l1.asnumpy(), np.abs(pred.asnumpy()).mean(axis=1), atol=1e-5)
+
+    bce = gluon.loss.SigmoidBCELoss()(pred, nd.ones((4, 5)))
+    x = pred.asnumpy()
+    expect = (np.maximum(x, 0) - x * 1 + np.log1p(np.exp(-np.abs(x)))).mean(axis=1)
+    assert np.allclose(bce.asnumpy(), expect, atol=1e-4)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    f = str(tmp_path / "p.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.load_parameters(f)
+    x = nd.array(np.random.rand(2, 4))
+    assert np.allclose(net(x).asnumpy(), net2(x).asnumpy(), atol=1e-6)
+
+
+def test_lstm_layer():
+    layer = gluon.rnn.LSTM(hidden_size=8, num_layers=2)
+    layer.initialize()
+    x = nd.array(np.random.rand(5, 3, 4))  # (T, N, C)
+    out = layer(x)
+    assert out.shape == (5, 3, 8)
+    states = layer.begin_state(batch_size=3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 8)
+    assert new_states[0].shape == (2, 3, 8)
+    assert new_states[1].shape == (2, 3, 8)
+
+
+def test_gru_bidirectional():
+    layer = gluon.rnn.GRU(hidden_size=6, num_layers=1, bidirectional=True)
+    layer.initialize()
+    x = nd.array(np.random.rand(4, 2, 5))
+    out = layer(x)
+    assert out.shape == (4, 2, 12)
+
+
+def test_lstm_cell_unroll():
+    cell = gluon.rnn.LSTMCell(hidden_size=8, input_size=4)
+    cell.initialize()
+    x = nd.array(np.random.rand(3, 6, 4))  # (N, T, C)
+    outputs, states = cell.unroll(6, x, layout="NTC")
+    assert outputs.shape == (3, 6, 8)
+    assert states[0].shape == (3, 8)
+
+
+def test_rnn_cell_gradient_flows():
+    cell = gluon.rnn.RNNCell(hidden_size=4, input_size=3)
+    cell.initialize()
+    x = nd.array(np.random.rand(2, 5, 3))
+    with autograd.record():
+        outputs, _ = cell.unroll(5, x, layout="NTC")
+        loss = outputs.sum()
+    loss.backward()
+    g = cell.i2h_weight.grad().asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_model_zoo_smoke():
+    for name in ("resnet18_v1", "resnet18_v2", "mobilenet0_25", "squeezenet1_1"):
+        net = gluon.model_zoo.vision.get_model(name, classes=10)
+        net.initialize()
+        out = net(nd.array(np.random.rand(1, 3, 32, 32)))
+        assert out.shape == (1, 10), name
+
+
+def test_dataloader():
+    X = np.random.rand(20, 3).astype(np.float32)
+    Y = np.arange(20).astype(np.float32)
+    ds = gluon.data.ArrayDataset(X, Y)
+    loader = gluon.data.DataLoader(ds, batch_size=6, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (6, 3)
+    assert np.allclose(yb.asnumpy(), [0, 1, 2, 3, 4, 5])
+    loader2 = gluon.data.DataLoader(ds, batch_size=6, shuffle=False,
+                                    last_batch="discard", num_workers=2)
+    assert len(list(loader2)) == 3
+
+
+def test_vision_dataset_transform():
+    ds = gluon.data.vision.MNIST(train=False)
+    assert len(ds) > 0
+    img, label = ds[0]
+    assert img.shape == (28, 28, 1)
+    tf = gluon.data.vision.transforms.ToTensor()
+    out = tf(img)
+    assert out.shape == (1, 28, 28)
+    assert float(out.max()) <= 1.0
+
+
+def test_clip_global_norm():
+    arrays = [nd.array([3.0]), nd.array([4.0])]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert abs(norm - 5.0) < 1e-5
+    total = np.sqrt(sum(float((a * a).sum()) for a in arrays))
+    assert total <= 1.01
+
+
+def test_split_and_load():
+    data = nd.array(np.arange(12).reshape(6, 2))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(), mx.cpu()])
+    assert len(parts) == 2
+    assert parts[0].shape == (3, 2)

@@ -1,0 +1,246 @@
+"""Metric, IO, RecordIO, KVStore tests (model: test_metric.py, test_io.py,
+test_kvstore.py in the reference)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1.0, 0, 0])
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6
+
+
+def test_topk():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]])
+    label = nd.array([2.0, 2.0])
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_f1_mcc():
+    pred = nd.array([[0.8, 0.2], [0.3, 0.7], [0.1, 0.9], [0.6, 0.4]])
+    label = nd.array([0.0, 1, 1, 1])
+    f1 = mx.metric.F1()
+    f1.update([label], [pred])
+    assert 0 < f1.get()[1] <= 1
+    mcc = mx.metric.MCC()
+    mcc.update([label], [pred])
+    assert -1 <= mcc.get()[1] <= 1
+
+
+def test_mse_mae_rmse():
+    pred = nd.array([[1.0], [2.0]])
+    label = nd.array([[0.0], [0.0]])
+    for name, expect in (("mse", 2.5), ("mae", 1.5)):
+        m = mx.metric.create(name)
+        m.update([label], [pred])
+        assert abs(m.get()[1] - expect) < 1e-6
+
+
+def test_composite_and_custom():
+    comp = mx.metric.create(["acc", "ce"])
+    pred = nd.array([[0.9, 0.1]])
+    label = nd.array([0.0])
+    comp.update([label], [pred])
+    names, vals = comp.get()
+    assert len(names) == 2
+    custom = mx.metric.np(lambda l, p: float((l == p.argmax(-1)).mean()))
+    custom.update([label], [pred])
+    assert custom.get()[1] == 1.0
+
+
+def test_perplexity_pooled():
+    m = mx.metric.Perplexity(ignore_label=None)
+    p = np.full((2, 4), 0.25, dtype=np.float32)
+    m.update([nd.array([0.0, 1])], [nd.array(p)])
+    m.update([nd.array([2.0, 3])], [nd.array(p)])
+    assert abs(m.get()[1] - 4.0) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# io
+# ---------------------------------------------------------------------------
+
+def test_ndarray_iter_pad_and_discard():
+    X = np.arange(20).reshape(10, 2).astype(np.float32)
+    it = mx.io.NDArrayIter(X, np.arange(10), batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it2 = mx.io.NDArrayIter(X, np.arange(10), batch_size=4,
+                            last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_ndarray_iter_provide():
+    it = mx.io.NDArrayIter(np.zeros((8, 3, 4, 4), np.float32),
+                           np.zeros(8), batch_size=2)
+    d = it.provide_data[0]
+    assert d.name == "data" and d.shape == (2, 3, 4, 4)
+    assert it.provide_label[0].name == "softmax_label"
+
+
+def test_resize_iter():
+    it = mx.io.NDArrayIter(np.zeros((8, 2), np.float32), np.zeros(8), batch_size=2)
+    r = mx.io.ResizeIter(it, 7)
+    assert len(list(r)) == 7
+
+
+def test_prefetching_iter():
+    it = mx.io.NDArrayIter(np.arange(16).reshape(8, 2).astype(np.float32),
+                           np.arange(8), batch_size=2)
+    p = mx.io.PrefetchingIter(it)
+    batches = list(p)
+    assert len(batches) == 4
+
+
+def test_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "test.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        rec.write(f"record-{i}".encode())
+    rec.close()
+    rec = recordio.MXRecordIO(path, "r")
+    out = []
+    while True:
+        buf = rec.read()
+        if buf is None:
+            break
+        out.append(buf.decode())
+    assert out == [f"record-{i}" for i in range(5)]
+
+
+def test_indexed_recordio_and_pack(tmp_path):
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "idx.rec")
+    idx_path = str(tmp_path / "idx.rec.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(4):
+        header = recordio.IRHeader(0, float(i), i, 0)
+        img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        rec.write_idx(i, recordio.pack_img(header, img))
+    rec.close()
+    rec = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert rec.keys == [0, 1, 2, 3]
+    header, img = recordio.unpack_img(rec.read_idx(2))
+    assert header.label == 2.0
+    assert img.shape == (8, 8, 3)
+
+
+def test_mnist_iter_synthetic():
+    it = mx.io.MNISTIter(image=None, batch_size=50, flat=True)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (50, 784)
+    assert batch.label[0].shape == (50,)
+
+
+# ---------------------------------------------------------------------------
+# kvstore
+# ---------------------------------------------------------------------------
+
+def test_kvstore_push_pull():
+    kv = mx.kv.create("local")
+    kv.init("a", nd.ones((3,)))
+    out = nd.zeros((3,))
+    kv.pull("a", out=out)
+    assert np.allclose(out.asnumpy(), 1)
+    kv.push("a", nd.full((3,), 5.0))
+    kv.pull("a", out=out)
+    assert np.allclose(out.asnumpy(), 5)
+
+
+def test_kvstore_multi_device_reduce():
+    kv = mx.kv.create("tpu_sync")
+    kv.init("w", nd.zeros((4,)))
+    vals = [nd.ones((4,)) * (i + 1) for i in range(4)]
+    kv.push("w", vals)
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 10.0)
+
+
+def test_kvstore_updater():
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0))
+    kv.init(0, nd.ones((2,)))
+    kv.push(0, nd.ones((2,)))  # grad=1 → w = 1 - 0.1 = 0.9
+    out = nd.zeros((2,))
+    kv.pull(0, out=out)
+    assert np.allclose(out.asnumpy(), 0.9, atol=1e-6)
+
+
+def test_kvstore_list_keys():
+    kv = mx.kv.create("local")
+    kv.init(["x", "y"], [nd.ones((2,)), nd.zeros((2,))])
+    outs = [nd.zeros((2,)), nd.zeros((2,))]
+    kv.pull(["x", "y"], out=outs)
+    assert np.allclose(outs[0].asnumpy(), 1)
+    assert np.allclose(outs[1].asnumpy(), 0)
+
+
+def test_kvstore_row_sparse_pull():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    kv = mx.kv.create("local")
+    w = np.arange(12).reshape(4, 3).astype(np.float32)
+    kv.init("emb", nd.array(w))
+    out = nd.zeros((4, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 3]))
+    expect = np.zeros_like(w)
+    expect[[1, 3]] = w[[1, 3]]
+    assert np.allclose(out.asnumpy(), expect)
+
+
+# ---------------------------------------------------------------------------
+# sparse ndarray
+# ---------------------------------------------------------------------------
+
+def test_row_sparse_basics():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    dense = np.zeros((5, 3), np.float32)
+    dense[1] = 1
+    dense[3] = 2
+    rsp = sp.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    assert np.allclose(rsp.asnumpy(), dense)
+    back = rsp.tostype("default")
+    assert np.allclose(back.asnumpy(), dense)
+
+
+def test_csr_basics():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    dense = np.array([[1, 0, 2], [0, 0, 3]], np.float32)
+    csr = sp.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert np.allclose(csr.asnumpy(), dense)
+    assert csr.data.shape == (3,)
+    d = sp.dot(csr, nd.array(np.ones((3, 2), np.float32)))
+    assert np.allclose(d.asnumpy(), dense @ np.ones((3, 2)))
+
+
+def test_cast_storage_roundtrip():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    x = nd.array(np.diag([1.0, 2, 3]))
+    csr = x.tostype("csr")
+    rsp = x.tostype("row_sparse")
+    assert np.allclose(csr.asnumpy(), x.asnumpy())
+    assert np.allclose(rsp.asnumpy(), x.asnumpy())
+    assert np.allclose(csr.tostype("default").asnumpy(), x.asnumpy())

@@ -1,0 +1,168 @@
+"""Module API tests (model: tests/python/unittest/test_module.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _mlp_sym(nh=32, classes=10):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=nh, name="fc1"),
+                       act_type="relu")
+    out = sym.FullyConnected(h, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(out, label, name="softmax")
+
+
+def _toy_iter(n=256, dim=16, classes=4, batch=32, seed=0):
+    """Cleanly separable toy data: class decided by a shifted feature block."""
+    r = np.random.RandomState(seed)
+    Y = r.randint(0, classes, n).astype(np.float32)
+    X = r.rand(n, dim).astype(np.float32) * 0.3
+    for c in range(classes):
+        X[Y == c, c] += 1.0
+    return mx.io.NDArrayIter(X, Y, batch_size=batch, shuffle=True)
+
+
+def test_module_fit_and_score():
+    train = _toy_iter()
+    mod = mx.mod.Module(_mlp_sym(classes=4), context=mx.cpu())
+    mod.fit(train, num_epoch=8, optimizer_params=(("learning_rate", 0.5),))
+    acc = dict(mod.score(train, "acc"))["accuracy"]
+    assert acc > 0.9
+
+
+def test_module_predict():
+    train = _toy_iter()
+    mod = mx.mod.Module(_mlp_sym(classes=4), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer_params=(("learning_rate", 0.1),))
+    out = mod.predict(train)
+    assert out.shape == (256, 4)
+    assert np.allclose(out.asnumpy().sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_module_get_set_params():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 16))], label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    arg, aux = mod.get_params()
+    assert "fc1_weight" in arg
+    arg2 = {k: nd.zeros(v.shape) for k, v in arg.items()}
+    mod.set_params(arg2, aux)
+    new_arg, _ = mod.get_params()
+    assert np.allclose(new_arg["fc1_weight"].asnumpy(), 0)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "model")
+    train = _toy_iter()
+    mod = mx.mod.Module(_mlp_sym(classes=4), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer_params=(("learning_rate", 0.2),))
+    mod.save_checkpoint(prefix, 2)
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (32, 16))],
+              label_shapes=[("softmax_label", (32,))], for_training=False)
+    train.reset()
+    batch = next(iter(train))
+    mod.forward(batch, is_train=False)
+    out1 = mod.get_outputs()[0].asnumpy()
+    mod2.forward(batch, is_train=False)
+    out2 = mod2.get_outputs()[0].asnumpy()
+    assert np.allclose(out1, out2, atol=1e-5)
+
+
+def test_module_optimizer_state_roundtrip(tmp_path):
+    train = _toy_iter()
+    mod = mx.mod.Module(_mlp_sym(classes=4), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data, label_shapes=train.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)))
+    batch = next(iter(train))
+    mod.forward_backward(batch)
+    mod.update()
+    f = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(f)
+    mod.load_optimizer_states(f)
+
+
+def test_module_input_grads():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 16))],
+             label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    from mxnet_tpu.io import DataBatch
+
+    batch = DataBatch([nd.array(np.random.rand(4, 16))],
+                      [nd.array(np.array([0.0, 1, 2, 3]))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0]
+    assert g.shape == (4, 16)
+    assert float(g.abs().sum()) > 0
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        # params must be shape-invariant across buckets: pool over time first
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        pooled = sym.mean(data, axis=1)
+        fc = sym.FullyConnected(pooled, num_hidden=8, name="fc")
+        out = sym.SoftmaxOutput(fc, label, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10, 3))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params=(("learning_rate", 0.1),))
+    from mxnet_tpu.io import DataBatch
+
+    for key in (10, 6, 10):
+        batch = DataBatch([nd.array(np.random.rand(4, key, 3))],
+                          [nd.array(np.array([0.0, 1, 2, 3]))],
+                          bucket_key=key)
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets) == {10, 6}
+
+
+def test_sequential_module():
+    net1 = sym.Activation(sym.FullyConnected(sym.Variable("data"), num_hidden=8,
+                                             name="fc1"), act_type="relu")
+    net2 = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc2"),
+        sym.Variable("softmax_label"), name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(net1, label_names=[], context=mx.cpu()))
+    seq.add(mx.mod.Module(net2, context=mx.cpu()),
+            take_labels=True, auto_wiring=True)
+    seq.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    seq.init_params()
+    seq.init_optimizer(optimizer_params=(("learning_rate", 0.1),))
+    from mxnet_tpu.io import DataBatch
+
+    batch = DataBatch([nd.array(np.random.rand(4, 6))],
+                      [nd.array(np.array([0.0, 1, 2, 3]))])
+    seq.forward(batch, is_train=True)
+    out = seq.get_outputs()[0]
+    assert out.shape == (4, 4)
+    seq.backward()
+    seq.update()
+
+
+def test_module_batch_size_change():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 16))], label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    from mxnet_tpu.io import DataBatch
+
+    batch = DataBatch([nd.array(np.random.rand(3, 16))],
+                      [nd.array(np.zeros(3))])
+    mod.forward(batch, is_train=False)  # triggers rebind to bs=3
+    assert mod.get_outputs()[0].shape == (3, 10)

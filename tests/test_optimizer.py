@@ -1,0 +1,131 @@
+"""Optimizer tests (model: tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, optimizer as opt
+
+
+ALL_OPTS = ["sgd", "signum", "ftml", "lbsgd", "dcasgd", "nag", "sgld", "adam",
+            "adagrad", "rmsprop", "adadelta", "ftrl", "adamax", "nadam"]
+
+
+@pytest.mark.parametrize("name", ALL_OPTS)
+def test_optimizer_decreases_quadratic(name):
+    """Every optimizer should reduce f(w) = ||w||² from a random start."""
+    o = opt.create(name, learning_rate=0.05, rescale_grad=1.0)
+    w = nd.array(np.random.RandomState(0).rand(8) + 1.0)
+    state = o.create_state(0, w)
+    f0 = float((w * w).sum())
+    for _ in range(60):
+        grad = 2 * w
+        o.update(0, w, grad, state)
+    f1 = float((w * w).sum())
+    assert f1 < f0, f"{name}: {f0} -> {f1}"
+
+
+def test_sgd_momentum_math():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, rescale_grad=1.0, wd=0.0)
+    w = nd.array([1.0])
+    state = o.create_state(0, w)
+    o.update(0, w, nd.array([1.0]), state)
+    # mom = 0.9*0 - 0.1*1 = -0.1 ; w = 1 - 0.1 = 0.9
+    assert np.allclose(w.asnumpy(), [0.9], atol=1e-6)
+    o.update(0, w, nd.array([1.0]), state)
+    # mom = 0.9*(-0.1) - 0.1 = -0.19 ; w = 0.9 - 0.19 = 0.71
+    assert np.allclose(w.asnumpy(), [0.71], atol=1e-6)
+
+
+def test_adam_first_step():
+    o = opt.Adam(learning_rate=0.001, rescale_grad=1.0, wd=0.0)
+    w = nd.array([1.0])
+    state = o.create_state(0, w)
+    o.update(0, w, nd.array([0.5]), state)
+    # first adam step ≈ lr * sign(g)
+    assert abs(float(w.asnumpy()[0]) - (1.0 - 0.001)) < 1e-4
+
+
+def test_rescale_and_clip():
+    o = opt.SGD(learning_rate=1.0, rescale_grad=0.5, clip_gradient=0.1)
+    w = nd.array([0.0])
+    o.update(0, w, nd.array([10.0]), None)
+    # g = clip(10*0.5, 0.1) = 0.1 → w = -0.1
+    assert np.allclose(w.asnumpy(), [-0.1], atol=1e-6)
+
+
+def test_lr_scheduler_in_optimizer():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched, rescale_grad=1.0)
+    w = nd.array([10.0])
+    lrs = []
+    for i in range(6):
+        lrs.append(o._get_lr(0))
+        o.update(0, w, nd.array([0.0]), None)
+    assert lrs[0] == 1.0
+    assert lrs[-1] < 1.0
+
+
+def test_lr_mult_from_symbol():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("myw", lr_mult=0.0)
+    out = mx.sym.FullyConnected(data, weight=w, num_hidden=2, no_bias=True,
+                                name="fc")
+    o = opt.create("sgd", learning_rate=0.5, sym=out,
+                   param_idx2name={0: "myw"})
+    weight = nd.array(np.ones((2, 3)))
+    o.update(0, weight, nd.array(np.ones((2, 3))), o.create_state(0, weight))
+    assert np.allclose(weight.asnumpy(), 1.0)  # lr_mult 0 → frozen
+
+
+def test_multi_precision():
+    import jax.numpy as jnp
+
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True,
+                rescale_grad=1.0)
+    w = nd.array(np.ones(4), dtype="bfloat16")
+    state = o.create_state_multi_precision(0, w)
+    master, _ = state
+    assert master.dtype == np.float32
+    o.update_multi_precision(0, w, nd.array(np.full(4, 0.001), dtype="bfloat16"),
+                             state)
+    # master tracks tiny updates that bf16 alone would lose
+    assert master.asnumpy()[0] < 1.0
+
+
+def test_updater_serialization():
+    o = opt.Adam(learning_rate=0.01)
+    u = opt.get_updater(o)
+    w = nd.array(np.random.rand(4))
+    u(0, nd.array(np.random.rand(4)), w)
+    states = u.get_states()
+    u2 = opt.get_updater(opt.Adam(learning_rate=0.01))
+    u2.set_states(states)
+    assert 0 in u2.states
+
+
+def test_updater_list_call():
+    o = opt.SGD(learning_rate=0.1)
+    u = opt.get_updater(o)
+    ws = [nd.array([1.0]), nd.array([2.0])]
+    gs = [nd.array([1.0]), nd.array([1.0])]
+    u([0, 1], gs, ws)
+    assert np.allclose(ws[0].asnumpy(), [0.9])
+    assert np.allclose(ws[1].asnumpy(), [1.9])
+
+
+def test_schedulers():
+    s = mx.lr_scheduler.MultiFactorScheduler([3, 6], factor=0.1, base_lr=1.0)
+    vals = [s(i) for i in range(1, 9)]
+    assert vals[0] == 1.0
+    assert abs(vals[-1] - 0.01) < 1e-9
+    p = mx.lr_scheduler.PolyScheduler(max_update=10, base_lr=1.0, pwr=2)
+    assert p(0) == 1.0
+    assert p(10) == 0.0
+    c = mx.lr_scheduler.CosineScheduler(max_update=10, base_lr=1.0)
+    assert abs(c(10)) < 1e-9
+    w = mx.lr_scheduler.WarmupScheduler(
+        mx.lr_scheduler.FactorScheduler(step=100, base_lr=1.0),
+        warmup_steps=10)
+    assert w(0) == 0.0
+    assert w(5) == 0.5
+    assert w(20) == 1.0

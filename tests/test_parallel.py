@@ -1,0 +1,152 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (SURVEY.md §2.3/§5.7:
+the capabilities the reference lacks must be first-class here)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.ndarray.ndarray import NDArray
+from mxnet_tpu.parallel import make_mesh, DataParallelTrainer
+from mxnet_tpu.parallel.ring_attention import (local_attention,
+                                               ring_attention_sharded)
+from mxnet_tpu.parallel.sequence_parallel import ulysses_attention_sharded
+from mxnet_tpu.parallel.pipeline import pipeline_apply_sharded
+from mxnet_tpu.parallel.compression import GradientCompression
+
+
+def _qkv(B=2, T=32, H=4, D=8, seed=0):
+    r = np.random.RandomState(seed)
+    return (r.rand(B, T, H, D).astype(np.float32),
+            r.rand(B, T, H, D).astype(np.float32),
+            r.rand(B, T, H, D).astype(np.float32))
+
+
+def test_ring_attention_matches_local():
+    mesh = make_mesh(sp=8)
+    q, k, v = _qkv()
+    out = ring_attention_sharded(q, k, v, mesh=mesh)
+    ref = local_attention(q, k, v)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ring_attention_causal():
+    mesh = make_mesh(sp=8)
+    q, k, v = _qkv(T=64)
+    out = ring_attention_sharded(q, k, v, mesh=mesh, causal=True)
+    ref = local_attention(q, k, v, causal=True)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ulysses_attention_matches_local():
+    # head count must be divisible by axis size
+    mesh = make_mesh(sp=4)
+    q, k, v = _qkv(T=32, H=8)
+    out = ulysses_attention_sharded(q, k, v, mesh=mesh)
+    ref = local_attention(q, k, v)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_pipeline_matches_sequential():
+    mesh = make_mesh(pp=4)
+    S, F, M = 4, 8, 8
+    r = np.random.RandomState(0)
+    stage_w = jnp.asarray(r.randn(S, F, F).astype(np.float32) * 0.3)
+    micro = jnp.asarray(r.rand(M, 3, F).astype(np.float32))
+
+    def stage_fn(w, x):
+        return jnp.tanh(jnp.dot(x, w))
+
+    out = pipeline_apply_sharded(stage_fn, stage_w, micro, mesh=mesh)
+    # sequential oracle
+    ref = micro
+    for s in range(S):
+        ref = jnp.tanh(jnp.dot(ref, stage_w[s]))
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_data_parallel_trainer_converges():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(10))
+    net.initialize()
+    net(nd.array(np.random.rand(8, 20).astype(np.float32)))
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh(dp=8)
+    tr = DataParallelTrainer(net, lambda p, y: lf(NDArray(p), NDArray(y))._data,
+                             lr=0.5, mesh=mesh)
+    r = np.random.RandomState(0)
+    Y = r.randint(0, 10, 256).astype(np.float32)
+    X = r.rand(256, 20).astype(np.float32) * 0.3
+    for c in range(10):
+        X[Y == c, c] += 1.0
+    first = float(tr.step(X, Y))
+    for _ in range(30):
+        last = float(tr.step(X, Y))
+    assert last < first * 0.5
+    tr.write_back()
+    pred = net(nd.array(X)).argmax(axis=1).asnumpy()
+    assert (pred == Y).mean() > 0.8
+
+
+def test_dp_matches_single_device():
+    """Data-parallel gradient == single-device gradient on the same batch."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu", in_units=12),
+            gluon.nn.Dense(4, in_units=16))
+    net.initialize()
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn = lambda p, y: lf(NDArray(p), NDArray(y))._data
+    r = np.random.RandomState(1)
+    X = r.rand(64, 12).astype(np.float32)
+    Y = r.randint(0, 4, (64,)).astype(np.float32)
+
+    tr1 = DataParallelTrainer(net, loss_fn, lr=0.1, momentum=0.0, mesh=None,
+                              donate=False)
+    mesh = make_mesh(dp=8)
+    tr8 = DataParallelTrainer(net, loss_fn, lr=0.1, momentum=0.0, mesh=mesh,
+                              donate=False)
+    l1 = float(tr1.step(X, Y))
+    l8 = float(tr8.step(X, Y))
+    assert abs(l1 - l8) < 1e-4
+    for k in tr1.params:
+        assert np.allclose(np.asarray(tr1.params[k]), np.asarray(tr8.params[k]),
+                           atol=1e-4), k
+
+
+def test_gradient_compression_roundtrip():
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    r = np.random.RandomState(0)
+    # error feedback converges when |g| stays below the quantization threshold
+    g = jnp.asarray((r.randn(37) * 0.15).astype(np.float32))
+    packed, residual = gc.quantize(g, None)
+    deq = gc.dequantize(packed, (37,))
+    # every dequantized value in {-0.5, 0, +0.5}
+    assert set(np.unique(np.asarray(deq))).issubset({-0.5, 0.0, 0.5})
+    # error feedback: deq + residual == original
+    assert np.allclose(np.asarray(deq) + np.asarray(residual), np.asarray(g),
+                       atol=1e-6)
+    # accumulating residual over steps converges to the true gradient sum
+    total = jnp.zeros_like(g)
+    res = None
+    for _ in range(50):
+        packed, res = gc.quantize(g, res)
+        total = total + gc.dequantize(packed, (37,))
+    assert np.allclose(np.asarray(total) / 50, np.asarray(g), atol=0.02)
+
+
+def test_collectives_allreduce_tree():
+    from mxnet_tpu.parallel.collectives import allreduce_tree
+
+    vals = [jnp.ones((4,)) * i for i in range(8)]
+    mesh = make_mesh(dp=8)
+    out = allreduce_tree(vals, mesh=mesh, axis="dp")
+    for o in out:
+        assert np.allclose(np.asarray(o), 28.0)
+
+
+def test_graft_entry_dryrun():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
