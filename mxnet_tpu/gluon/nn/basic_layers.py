@@ -291,8 +291,9 @@ class HybridLambda(HybridBlock):
         self._func = function
 
     def hybrid_forward(self, F, *args):
-        fn = self._func or getattr(F, self._func_name)
-        return fn(*args)
+        if self._func is not None:
+            return self._func(F, *args)
+        return getattr(F, self._func_name)(*args)
 
 
 class HybridConcatenate(HybridBlock):

@@ -86,6 +86,8 @@ class Parameter:
         self._deferred_init = None
         arr = nd_zeros(self.shape, ctx=ctx[0], dtype=self.dtype)
         initializer = init or self.init or default_init
+        if isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
         desc = InitDesc(self.name, {"__init__": ""})
         initializer(desc, arr)
         self._data = arr

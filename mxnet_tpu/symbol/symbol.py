@@ -503,10 +503,11 @@ def _make_sym_wrapper(opname):
                 v = Variable(f"{node_name}_{slot}")
                 v._entries[0].node.attr_dict["__is_aux__"] = "1"
                 inputs.append(v)
-            elif slot in params:
-                inputs.append(Variable(f"{node_name}_{slot}"))
             else:
-                raise MXNetError(f"{op.name}: missing required input {slot!r}")
+                # NNVM compose auto-creates variables for every missing input
+                # slot — learnable params AND data slots like SoftmaxOutput's
+                # label (which becomes `<name>_label`, what Module binds to)
+                inputs.append(Variable(f"{node_name}_{slot}"))
         return _apply_op(op, inputs, kwargs, node_name)
 
     wrapper.__name__ = opname
