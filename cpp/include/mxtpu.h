@@ -1,0 +1,112 @@
+/*
+ * mxtpu native runtime — C ABI.
+ *
+ * TPU-native re-provision of the reference's host-side native subsystems
+ * (capability parity, new design):
+ *  - dependency engine: read/write-variable scheduling with worker pools,
+ *    sync ("naive") mode, and exception propagation through variables
+ *    (reference: include/mxnet/engine.h:98-297, src/engine/threaded_engine.cc).
+ *    On TPU the device-side parallelism belongs to XLA; this engine orders
+ *    host work: IO, prefetch, checkpoint writes, custom host callbacks.
+ *  - RecordIO reader/writer + background prefetch pipeline
+ *    (reference: src/io/iter_image_recordio_2.cc, iter_prefetcher.h).
+ *  - pooled host allocator with stats
+ *    (reference: src/storage/pooled_storage_manager.h).
+ *
+ * All functions return 0 on success and nonzero on failure unless noted.
+ */
+#ifndef MXTPU_H_
+#define MXTPU_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ------------------------------------------------------------------ engine */
+
+/* Op callback: ctx is the opaque id passed at push; return 0 on success,
+ * nonzero on failure. Failures are propagated: every write-var of a failed op
+ * becomes poisoned with the op's ctx id, and waits on it report that id so
+ * the caller can map back to the original (e.g. Python) exception. */
+typedef int (*mxtpu_fn_t)(void *ctx);
+
+int mxtpu_engine_create(int num_workers, void **out_handle);
+void mxtpu_engine_destroy(void *handle);
+
+/* New engine variable; never returns 0. */
+uint64_t mxtpu_engine_new_var(void *handle);
+
+/* Push an op reading `reads[0..n_reads)` and writing `writes[0..n_writes)`.
+ * A var may appear in at most one of the two lists. Higher priority runs
+ * first among ready ops. If `sync` is nonzero the call blocks until the op
+ * (and its dependencies) completed — the NaiveEngine mode. */
+int mxtpu_engine_push(void *handle, mxtpu_fn_t fn, void *ctx,
+                      const uint64_t *reads, int n_reads,
+                      const uint64_t *writes, int n_writes,
+                      int priority, int sync);
+
+/* Block until all previously pushed ops touching `var` completed.
+ * Returns 0 and sets *failed_ctx = 0 on success; returns 1 and sets
+ * *failed_ctx to the poisoning op's ctx if the var carries an exception. */
+int mxtpu_engine_wait_var(void *handle, uint64_t var, uint64_t *failed_ctx);
+
+/* Block until the engine is idle. Reports the first failure seen, as above. */
+int mxtpu_engine_wait_all(void *handle, uint64_t *failed_ctx);
+
+/* Schedule var deletion after all its pending ops complete. */
+void mxtpu_engine_delete_var(void *handle, uint64_t var);
+
+/* Ops pushed but not yet completed. */
+int mxtpu_engine_num_pending(void *handle);
+
+/* -------------------------------------------------------------- recordio */
+
+/* Sequential reader with a background prefetch thread filling a bounded
+ * queue of record batches. Sharded reads for data parallelism: the reader
+ * yields records whose ordinal % num_shards == shard_index
+ * (reference: dmlc InputSplit partitioning). */
+int mxtpu_rec_open(const char *path, int batch_records, int queue_depth,
+                   int shard_index, int num_shards, void **out_handle);
+void mxtpu_rec_close(void *handle);
+
+/* Pops the next prefetched batch. Returns 0 with *out_batch != NULL on
+ * success; 0 with *out_batch == NULL at end of epoch; nonzero on read error
+ * (mxtpu_last_error() has the message). */
+int mxtpu_rec_next_batch(void *handle, void **out_batch, int *out_count);
+void mxtpu_rec_get(void *batch, int i, const uint8_t **data, uint64_t *len);
+void mxtpu_rec_free_batch(void *batch);
+
+/* Restart from file start (new epoch). Drops queued batches. */
+int mxtpu_rec_reset(void *handle);
+
+/* One-shot sequential count of records in a file (no handle needed). */
+int64_t mxtpu_rec_count(const char *path);
+
+/* Writer (append framing + padding; same wire format as the reader). */
+int mxtpu_rec_writer_open(const char *path, void **out_handle);
+int mxtpu_rec_write(void *handle, const uint8_t *data, uint64_t len);
+int64_t mxtpu_rec_writer_tell(void *handle);
+void mxtpu_rec_writer_close(void *handle);
+
+/* --------------------------------------------------------------- storage */
+
+void *mxtpu_pool_alloc(size_t size);
+void mxtpu_pool_free(void *ptr, size_t size);
+/* stats: [0] bytes currently allocated from OS, [1] bytes served from pool,
+ * [2] live allocations, [3] pooled free bytes */
+void mxtpu_pool_stats(uint64_t out[4]);
+void mxtpu_pool_clear(void);
+
+/* ----------------------------------------------------------------- misc */
+
+const char *mxtpu_last_error(void);
+const char *mxtpu_version(void);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* MXTPU_H_ */

@@ -1,0 +1,16 @@
+#include "common.h"
+
+#include "../include/mxtpu.h"
+
+namespace {
+thread_local std::string g_last_error;
+}
+
+namespace mxtpu {
+void SetError(const std::string &msg) { g_last_error = msg; }
+}  // namespace mxtpu
+
+extern "C" {
+const char *mxtpu_last_error(void) { return g_last_error.c_str(); }
+const char *mxtpu_version(void) { return "mxtpu-native 0.1"; }
+}

@@ -1,0 +1,12 @@
+#ifndef MXTPU_COMMON_H_
+#define MXTPU_COMMON_H_
+
+#include <string>
+
+namespace mxtpu {
+// Thread-local last-error slot shared by all subsystems; read back through
+// mxtpu_last_error() (the dmlc-core LOG/CHECK analogue is the caller's job).
+void SetError(const std::string &msg);
+}  // namespace mxtpu
+
+#endif  // MXTPU_COMMON_H_

@@ -1,0 +1,374 @@
+/*
+ * Threaded dependency engine.
+ *
+ * Capability parity with the reference scheduler (include/mxnet/engine.h:98,
+ * src/engine/threaded_engine.{h,cc}): ops are pushed with read-vars and
+ * write-vars; an op runs once every var has granted it access; per-var
+ * ordering is push order, with consecutive reads running concurrently and
+ * writes exclusive. Failures poison the op's write-vars and surface at
+ * WaitForVar/WaitForAll (reference: threaded_engine.h:179,450-465).
+ *
+ * New design, not a port: grant bookkeeping lives in a per-var queue guarded
+ * by a per-var mutex; ready ops go to a two-level (priority/normal) queue
+ * drained by a fixed worker pool; sync pushes (NaiveEngine mode,
+ * src/engine/engine.cc:32-58) run inline after their dependencies drain.
+ */
+#include "../include/mxtpu.h"
+
+#include "common.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Opr;
+
+struct Var {
+  std::mutex mu;
+  // Ops waiting for this var, in push order. true = write.
+  std::deque<std::pair<Opr *, bool>> pending;
+  int running_reads = 0;
+  bool running_write = false;
+  bool to_delete = false;
+  // ctx id of the op whose failure poisoned this var (0 = clean).
+  std::atomic<uint64_t> failed_ctx{0};
+};
+
+struct Opr {
+  mxtpu_fn_t fn = nullptr;
+  void *ctx = nullptr;
+  std::vector<std::shared_ptr<Var>> reads, writes;
+  std::atomic<int> wait{0};
+  int priority = 0;
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) {
+    if (num_workers < 1) num_workers = 1;
+    for (int i = 0; i < num_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  ~Engine() {
+    {
+      uint64_t ignored;
+      WaitAll(&ignored);
+      std::lock_guard<std::mutex> lk(ready_mu_);
+      stop_ = true;
+    }
+    ready_cv_.notify_all();
+    for (auto &t : workers_) t.join();
+  }
+
+  uint64_t NewVar() {
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    uint64_t id = next_var_++;
+    vars_.emplace(id, std::make_shared<Var>());
+    return id;
+  }
+
+  std::shared_ptr<Var> GetVar(uint64_t id) {
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    auto it = vars_.find(id);
+    return it == vars_.end() ? nullptr : it->second;
+  }
+
+  void Push(mxtpu_fn_t fn, void *ctx, const uint64_t *reads, int n_reads,
+            const uint64_t *writes, int n_writes, int priority, bool sync) {
+    Opr *op = new Opr;
+    op->fn = fn;
+    op->ctx = ctx;
+    op->priority = priority;
+    for (int i = 0; i < n_reads; ++i)
+      if (auto v = GetVar(reads[i])) op->reads.push_back(std::move(v));
+    for (int i = 0; i < n_writes; ++i)
+      if (auto v = GetVar(writes[i])) op->writes.push_back(std::move(v));
+
+    pending_.fetch_add(1, std::memory_order_relaxed);
+    // +1 sentinel grant held by this thread so the op cannot fire while
+    // grants are still being requested var by var.
+    op->wait.store(static_cast<int>(op->reads.size() + op->writes.size()) + 1,
+                   std::memory_order_relaxed);
+    for (auto &v : op->reads) RequestAccess(v.get(), op, /*is_write=*/false);
+    for (auto &v : op->writes) RequestAccess(v.get(), op, /*is_write=*/true);
+    Grant(op);  // release sentinel
+
+    if (sync) {
+      // NaiveEngine semantics: the pushed op (and everything it depends on)
+      // has completed before Push returns.
+      WaitIdleOf(op);
+    }
+  }
+
+  bool WaitVar(uint64_t var_id, uint64_t *failed_ctx) {
+    auto v = GetVar(var_id);
+    *failed_ctx = 0;
+    if (!v) return false;
+    // clear-on-report: the exception surfaces at exactly one wait
+    // (reference rethrow semantics, threaded_engine.cc WaitForVar).
+    // A signal op taking WRITE access: per-var ordering then guarantees it
+    // runs only after every previously pushed read AND write completed
+    // (reference pushes WaitForVar as a mutable dep, threaded_engine.cc:367).
+    struct Signal {
+      std::mutex mu;
+      std::condition_variable cv;
+      bool done = false;
+    } sig;
+    Opr *op = new Opr;
+    op->ctx = &sig;
+    op->fn = [](void *c) {
+      auto *s = static_cast<Signal *>(c);
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->done = true;
+      s->cv.notify_all();
+      return 0;
+    };
+    // writes slot so grant/release stay symmetric; the signal fn cannot fail,
+    // so it never poisons the var
+    op->writes.push_back(v);
+    pending_.fetch_add(1, std::memory_order_relaxed);
+    op->wait.store(2, std::memory_order_relaxed);
+    RequestAccess(v.get(), op, /*is_write=*/true);
+    Grant(op);
+    {
+      std::unique_lock<std::mutex> lk(sig.mu);
+      sig.cv.wait(lk, [&] { return sig.done; });
+    }
+    uint64_t f = v->failed_ctx.exchange(0, std::memory_order_acq_rel);
+    if (f) {
+      uint64_t expected = f;  // same failure shouldn't re-report at WaitAll
+      first_failed_.compare_exchange_strong(expected, 0,
+                                            std::memory_order_acq_rel);
+      *failed_ctx = f;
+      return true;
+    }
+    return false;
+  }
+
+  bool WaitAll(uint64_t *failed_ctx) {
+    std::unique_lock<std::mutex> lk(idle_mu_);
+    idle_cv_.wait(lk, [&] { return pending_.load() == 0; });
+    uint64_t f = first_failed_.exchange(0, std::memory_order_acq_rel);
+    *failed_ctx = f;
+    return f != 0;
+  }
+
+  void DeleteVar(uint64_t var_id) {
+    auto v = GetVar(var_id);
+    if (!v) return;
+    struct Cap {
+      Engine *eng;
+      uint64_t id;
+    };
+    Cap *cap = new Cap{this, var_id};
+    Opr *op = new Opr;
+    op->ctx = cap;
+    op->fn = [](void *c) {
+      Cap *cp = static_cast<Cap *>(c);
+      {
+        std::lock_guard<std::mutex> lk(cp->eng->vars_mu_);
+        cp->eng->vars_.erase(cp->id);
+      }
+      delete cp;
+      return 0;
+    };
+    op->writes.push_back(v);
+    pending_.fetch_add(1, std::memory_order_relaxed);
+    op->wait.store(2, std::memory_order_relaxed);
+    RequestAccess(v.get(), op, true);
+    Grant(op);
+  }
+
+  int NumPending() { return pending_.load(std::memory_order_relaxed); }
+
+  std::mutex vars_mu_;
+  std::unordered_map<uint64_t, std::shared_ptr<Var>> vars_;
+
+ private:
+  // Ask `v` for access; grants immediately if compatible, else queues.
+  void RequestAccess(Var *v, Opr *op, bool is_write) {
+    bool granted = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (is_write) {
+        granted = !v->running_write && v->running_reads == 0 &&
+                  v->pending.empty();
+        if (granted) v->running_write = true;
+      } else {
+        granted = !v->running_write && v->pending.empty();
+        if (granted) ++v->running_reads;
+      }
+      if (!granted) v->pending.emplace_back(op, is_write);
+    }
+    if (granted) Grant(op);
+  }
+
+  void Grant(Opr *op) {
+    if (op->wait.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      std::lock_guard<std::mutex> lk(ready_mu_);
+      if (op->priority > 0)
+        ready_hi_.push_back(op);
+      else
+        ready_.push_back(op);
+      ready_cv_.notify_one();
+    }
+  }
+
+  // Release access and grant queued successors (called after op ran).
+  void ReleaseAccess(Var *v, bool was_write, uint64_t fail_id) {
+    std::vector<Opr *> to_grant;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (fail_id && was_write)
+        v->failed_ctx.store(fail_id, std::memory_order_release);
+      if (was_write)
+        v->running_write = false;
+      else
+        --v->running_reads;
+      if (v->running_write || v->running_reads > 0) return;
+      // Head-of-line grant: a write alone, or a maximal run of reads.
+      while (!v->pending.empty()) {
+        auto [next, next_write] = v->pending.front();
+        if (next_write) {
+          if (v->running_reads == 0) {
+            v->pending.pop_front();
+            v->running_write = true;
+            to_grant.push_back(next);
+          }
+          break;
+        }
+        v->pending.pop_front();
+        ++v->running_reads;
+        to_grant.push_back(next);
+      }
+    }
+    for (Opr *o : to_grant) Grant(o);
+  }
+
+  void Execute(Opr *op) {
+    int rc = 0;
+    if (op->fn) rc = op->fn(op->ctx);
+    uint64_t fail_id = 0;
+    if (rc != 0) {
+      fail_id = reinterpret_cast<uint64_t>(op->ctx);
+      if (fail_id == 0) fail_id = ~uint64_t(0);
+      uint64_t expected = 0;
+      first_failed_.compare_exchange_strong(expected, fail_id,
+                                            std::memory_order_acq_rel);
+    }
+    // Failed reads don't poison their sources; failed writes poison outputs.
+    for (auto &v : op->reads) ReleaseAccess(v.get(), false, 0);
+    for (auto &v : op->writes) ReleaseAccess(v.get(), true, fail_id);
+    delete op;
+    if (pending_.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      std::lock_guard<std::mutex> lk(idle_mu_);
+      idle_cv_.notify_all();
+    }
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr *op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(ready_mu_);
+        ready_cv_.wait(lk, [&] {
+          return stop_ || !ready_hi_.empty() || !ready_.empty();
+        });
+        if (stop_ && ready_hi_.empty() && ready_.empty()) return;
+        if (!ready_hi_.empty()) {
+          op = ready_hi_.front();
+          ready_hi_.pop_front();
+        } else {
+          op = ready_.front();
+          ready_.pop_front();
+        }
+      }
+      Execute(op);
+    }
+  }
+
+  void WaitIdleOf(Opr * /*op*/) {
+    // Sync push: per-var ordering means "engine idle" is a sound (stronger)
+    // stand-in for "this op done" and keeps naive mode fully serial, matching
+    // the reference NaiveEngine.
+    uint64_t ignored;
+    WaitAll(&ignored);
+  }
+
+  std::vector<std::thread> workers_;
+  std::mutex ready_mu_;
+  std::condition_variable ready_cv_;
+  std::deque<Opr *> ready_hi_, ready_;
+  bool stop_ = false;
+
+  std::atomic<uint64_t> next_var_{1};
+  std::atomic<int> pending_{0};
+  std::atomic<uint64_t> first_failed_{0};
+  std::mutex idle_mu_;
+  std::condition_variable idle_cv_;
+};
+
+}  // namespace
+
+extern "C" {
+
+int mxtpu_engine_create(int num_workers, void **out_handle) {
+  try {
+    *out_handle = new Engine(num_workers);
+    return 0;
+  } catch (const std::exception &e) {
+    mxtpu::SetError(e.what());
+    return 1;
+  }
+}
+
+void mxtpu_engine_destroy(void *handle) {
+  delete static_cast<Engine *>(handle);
+}
+
+uint64_t mxtpu_engine_new_var(void *handle) {
+  return static_cast<Engine *>(handle)->NewVar();
+}
+
+int mxtpu_engine_push(void *handle, mxtpu_fn_t fn, void *ctx,
+                      const uint64_t *reads, int n_reads,
+                      const uint64_t *writes, int n_writes, int priority,
+                      int sync) {
+  try {
+    static_cast<Engine *>(handle)->Push(fn, ctx, reads, n_reads, writes,
+                                        n_writes, priority, sync != 0);
+    return 0;
+  } catch (const std::exception &e) {
+    mxtpu::SetError(e.what());
+    return 1;
+  }
+}
+
+int mxtpu_engine_wait_var(void *handle, uint64_t var, uint64_t *failed_ctx) {
+  return static_cast<Engine *>(handle)->WaitVar(var, failed_ctx) ? 1 : 0;
+}
+
+int mxtpu_engine_wait_all(void *handle, uint64_t *failed_ctx) {
+  return static_cast<Engine *>(handle)->WaitAll(failed_ctx) ? 1 : 0;
+}
+
+void mxtpu_engine_delete_var(void *handle, uint64_t var) {
+  static_cast<Engine *>(handle)->DeleteVar(var);
+}
+
+int mxtpu_engine_num_pending(void *handle) {
+  return static_cast<Engine *>(handle)->NumPending();
+}
+
+}  // extern "C"

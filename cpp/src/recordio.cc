@@ -1,0 +1,280 @@
+/*
+ * RecordIO reader/writer + background prefetch pipeline.
+ *
+ * Wire format parity with the reference (src/io record framing; python
+ * recordio.py): records framed by magic 0xced7230a and a length word whose
+ * low 29 bits are the payload length, padded to 4-byte boundaries. Files
+ * written by either side read back in the other.
+ *
+ * New design, not a port: one reader thread per open file fills a bounded
+ * queue of record *batches* (vector of byte strings), double-buffering decode
+ * against IO the way the reference's PrefetcherIter does
+ * (src/io/iter_prefetcher.h:47) with chunked reads like
+ * ImageRecordIOParser2 (src/io/iter_image_recordio_2.cc:175-206). Sharding
+ * for data parallelism assigns record ordinals round-robin
+ * (ordinal % num_shards == shard_index).
+ */
+#include "../include/mxtpu.h"
+
+#include "common.h"
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Batch {
+  std::vector<std::string> records;
+};
+
+class RecReader {
+ public:
+  RecReader(std::string path, int batch_records, int queue_depth,
+            int shard_index, int num_shards)
+      : path_(std::move(path)),
+        batch_records_(batch_records < 1 ? 1 : batch_records),
+        queue_depth_(queue_depth < 1 ? 1 : queue_depth),
+        shard_index_(shard_index),
+        num_shards_(num_shards < 1 ? 1 : num_shards) {
+    Start();
+  }
+
+  ~RecReader() { Stop(); }
+
+  // Returns: 1 = batch, 0 = end of epoch, -1 = error.
+  int NextBatch(Batch **out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_pop_.wait(lk, [&] { return !queue_.empty() || done_; });
+    if (!queue_.empty()) {
+      *out = queue_.front().release();
+      queue_.pop_front();
+      cv_push_.notify_one();
+      return 1;
+    }
+    if (!error_.empty()) {
+      mxtpu::SetError(error_);
+      return -1;
+    }
+    *out = nullptr;
+    return 0;
+  }
+
+  int Reset() {
+    Stop();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      queue_.clear();
+      done_ = false;
+      error_.clear();
+    }
+    Start();
+    return 0;
+  }
+
+ private:
+  void Start() {
+    thread_ = std::thread([this] { ReadLoop(); });
+  }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_push_.notify_all();
+    if (thread_.joinable()) thread_.join();
+    stop_ = false;
+  }
+
+  void ReadLoop() {
+    FILE *f = std::fopen(path_.c_str(), "rb");
+    if (!f) {
+      Finish("cannot open " + path_);
+      return;
+    }
+    auto batch = std::make_unique<Batch>();
+    int64_t ordinal = 0;
+    for (;;) {
+      uint32_t header[2];
+      size_t n = std::fread(header, 1, sizeof(header), f);
+      if (n == 0) break;  // clean EOF
+      if (n < sizeof(header) || header[0] != kMagic) {
+        Finish(path_ + ": corrupt record header");
+        std::fclose(f);
+        return;
+      }
+      uint32_t len = header[1] & kLenMask;
+      uint32_t padded = (len + 3u) & ~3u;
+      bool mine = (ordinal % num_shards_) == shard_index_;
+      ++ordinal;
+      if (mine) {
+        std::string rec(len, '\0');
+        if (std::fread(&rec[0], 1, len, f) != len) {
+          Finish(path_ + ": truncated record");
+          std::fclose(f);
+          return;
+        }
+        if (padded != len) std::fseek(f, padded - len, SEEK_CUR);
+        batch->records.push_back(std::move(rec));
+        if (static_cast<int>(batch->records.size()) >= batch_records_) {
+          if (!Emit(std::move(batch))) {
+            std::fclose(f);
+            return;  // stop requested
+          }
+          batch = std::make_unique<Batch>();
+        }
+      } else {
+        std::fseek(f, padded, SEEK_CUR);
+      }
+    }
+    std::fclose(f);
+    if (!batch->records.empty()) Emit(std::move(batch));
+    Finish("");
+  }
+
+  bool Emit(std::unique_ptr<Batch> b) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_push_.wait(lk, [&] {
+      return stop_ || static_cast<int>(queue_.size()) < queue_depth_;
+    });
+    if (stop_) return false;
+    queue_.push_back(std::move(b));
+    cv_pop_.notify_one();
+    return true;
+  }
+
+  void Finish(std::string err) {
+    std::lock_guard<std::mutex> lk(mu_);
+    error_ = std::move(err);
+    done_ = true;
+    cv_pop_.notify_all();
+  }
+
+  std::string path_;
+  int batch_records_, queue_depth_, shard_index_, num_shards_;
+  std::thread thread_;
+  std::mutex mu_;
+  std::condition_variable cv_push_, cv_pop_;
+  std::deque<std::unique_ptr<Batch>> queue_;
+  bool done_ = false, stop_ = false;
+  std::string error_;
+};
+
+class RecWriter {
+ public:
+  explicit RecWriter(const std::string &path)
+      : f_(std::fopen(path.c_str(), "wb")) {}
+  ~RecWriter() {
+    if (f_) std::fclose(f_);
+  }
+  bool ok() const { return f_ != nullptr; }
+
+  int Write(const uint8_t *data, uint64_t len) {
+    if (len > kLenMask) return 1;  // multipart framing unsupported; reject
+    uint32_t header[2] = {kMagic, static_cast<uint32_t>(len & kLenMask)};
+    if (std::fwrite(header, 1, sizeof(header), f_) != sizeof(header)) return 1;
+    if (len && std::fwrite(data, 1, len, f_) != len) return 1;
+    uint32_t pad = (4u - (len & 3u)) & 3u;
+    static const char zeros[4] = {0, 0, 0, 0};
+    if (pad && std::fwrite(zeros, 1, pad, f_) != pad) return 1;
+    return 0;
+  }
+
+  int64_t Tell() { return std::ftell(f_); }
+
+  FILE *f_;
+};
+
+}  // namespace
+
+extern "C" {
+
+int mxtpu_rec_open(const char *path, int batch_records, int queue_depth,
+                   int shard_index, int num_shards, void **out_handle) {
+  try {
+    *out_handle =
+        new RecReader(path, batch_records, queue_depth, shard_index, num_shards);
+    return 0;
+  } catch (const std::exception &e) {
+    mxtpu::SetError(e.what());
+    return 1;
+  }
+}
+
+void mxtpu_rec_close(void *handle) { delete static_cast<RecReader *>(handle); }
+
+int mxtpu_rec_next_batch(void *handle, void **out_batch, int *out_count) {
+  Batch *b = nullptr;
+  int rc = static_cast<RecReader *>(handle)->NextBatch(&b);
+  if (rc < 0) return 1;
+  *out_batch = b;
+  *out_count = b ? static_cast<int>(b->records.size()) : 0;
+  return 0;
+}
+
+void mxtpu_rec_get(void *batch, int i, const uint8_t **data, uint64_t *len) {
+  auto &rec = static_cast<Batch *>(batch)->records[i];
+  *data = reinterpret_cast<const uint8_t *>(rec.data());
+  *len = rec.size();
+}
+
+void mxtpu_rec_free_batch(void *batch) { delete static_cast<Batch *>(batch); }
+
+int mxtpu_rec_reset(void *handle) {
+  return static_cast<RecReader *>(handle)->Reset();
+}
+
+int64_t mxtpu_rec_count(const char *path) {
+  FILE *f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t count = 0;
+  for (;;) {
+    uint32_t header[2];
+    size_t n = std::fread(header, 1, sizeof(header), f);
+    if (n == 0) break;
+    if (n < sizeof(header) || header[0] != kMagic) {
+      std::fclose(f);
+      return -1;
+    }
+    uint32_t padded = ((header[1] & kLenMask) + 3u) & ~3u;
+    std::fseek(f, padded, SEEK_CUR);
+    ++count;
+  }
+  std::fclose(f);
+  return count;
+}
+
+int mxtpu_rec_writer_open(const char *path, void **out_handle) {
+  auto *w = new RecWriter(path);
+  if (!w->ok()) {
+    mxtpu::SetError(std::string("cannot open for write: ") + path);
+    delete w;
+    return 1;
+  }
+  *out_handle = w;
+  return 0;
+}
+
+int mxtpu_rec_write(void *handle, const uint8_t *data, uint64_t len) {
+  return static_cast<RecWriter *>(handle)->Write(data, len);
+}
+
+int64_t mxtpu_rec_writer_tell(void *handle) {
+  return static_cast<RecWriter *>(handle)->Tell();
+}
+
+void mxtpu_rec_writer_close(void *handle) {
+  delete static_cast<RecWriter *>(handle);
+}
+
+}  // extern "C"

@@ -1,0 +1,103 @@
+/*
+ * Pooled host allocator with stats.
+ *
+ * Capability parity with the reference's pooled storage manager
+ * (src/storage/pooled_storage_manager.h:52-104): freed buffers are kept in
+ * size-bucketed free lists and reused for later allocations of the same
+ * rounded size. On TPU, device HBM belongs to the XLA runtime; this pool
+ * serves host-side IO/prefetch/staging buffers, where the reference used its
+ * CPU and pinned-memory managers (src/storage/storage.cc:53-129).
+ *
+ * Rounding policy: next power of two above 4 KiB, exact below — the analogue
+ * of the reference's rounded-bucket manager (storage.cc:128).
+ */
+#include "../include/mxtpu.h"
+
+#include <cstdlib>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Pool {
+  std::mutex mu;
+  std::unordered_map<size_t, std::vector<void *>> free_lists;
+  uint64_t os_bytes = 0;      // bytes obtained from the OS and not returned
+  uint64_t reused_bytes = 0;  // bytes served from the pool
+  uint64_t live = 0;          // live allocations
+  uint64_t pooled_bytes = 0;  // bytes sitting in free lists
+};
+
+Pool &pool() {
+  static Pool p;
+  return p;
+}
+
+size_t RoundSize(size_t size) {
+  if (size <= 4096) return size;
+  size_t r = 4096;
+  while (r < size) r <<= 1;
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *mxtpu_pool_alloc(size_t size) {
+  size_t bucket = RoundSize(size);
+  Pool &p = pool();
+  {
+    std::lock_guard<std::mutex> lk(p.mu);
+    auto it = p.free_lists.find(bucket);
+    if (it != p.free_lists.end() && !it->second.empty()) {
+      void *ptr = it->second.back();
+      it->second.pop_back();
+      p.reused_bytes += bucket;
+      p.pooled_bytes -= bucket;
+      ++p.live;
+      return ptr;
+    }
+  }
+  void *ptr = std::malloc(bucket);
+  if (!ptr) return nullptr;
+  std::lock_guard<std::mutex> lk(p.mu);
+  p.os_bytes += bucket;
+  ++p.live;
+  return ptr;
+}
+
+void mxtpu_pool_free(void *ptr, size_t size) {
+  if (!ptr) return;
+  size_t bucket = RoundSize(size);
+  Pool &p = pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  p.free_lists[bucket].push_back(ptr);
+  p.pooled_bytes += bucket;
+  --p.live;
+}
+
+void mxtpu_pool_stats(uint64_t out[4]) {
+  Pool &p = pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  out[0] = p.os_bytes;
+  out[1] = p.reused_bytes;
+  out[2] = p.live;
+  out[3] = p.pooled_bytes;
+}
+
+void mxtpu_pool_clear(void) {
+  Pool &p = pool();
+  std::lock_guard<std::mutex> lk(p.mu);
+  for (auto &kv : p.free_lists) {
+    for (void *ptr : kv.second) {
+      std::free(ptr);
+      p.os_bytes -= kv.first;
+      p.pooled_bytes -= kv.first;
+    }
+    kv.second.clear();
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,312 @@
+"""ctypes binding to the native runtime (cpp/ → libmxtpu.so).
+
+The reference reaches its native core through a C API loaded from libmxnet.so
+(python/mxnet/base.py _load_lib); same shape here, minus the codegen: the
+native surface is small (engine, recordio, pool) because XLA owns the compute
+path. If the library is missing it is built on demand with `make` (toolchain
+is baked into the image); if that fails, callers fall back to pure Python —
+`lib()` returns None and every consumer must handle it.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["lib", "last_error", "NativeEngine", "RecordReader", "RecordWriter",
+           "rec_count", "pool_stats"]
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_CPP_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "cpp")
+_LIB_PATH = os.path.join(_CPP_DIR, "build", "libmxtpu.so")
+
+MXTPU_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+
+
+def _declare(lib):
+    u64 = ctypes.c_uint64
+    p = ctypes.c_void_p
+    lib.mxtpu_engine_create.argtypes = [ctypes.c_int, ctypes.POINTER(p)]
+    lib.mxtpu_engine_destroy.argtypes = [p]
+    lib.mxtpu_engine_new_var.argtypes = [p]
+    lib.mxtpu_engine_new_var.restype = u64
+    lib.mxtpu_engine_push.argtypes = [p, MXTPU_FN, p, ctypes.POINTER(u64),
+                                      ctypes.c_int, ctypes.POINTER(u64),
+                                      ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.mxtpu_engine_wait_var.argtypes = [p, u64, ctypes.POINTER(u64)]
+    lib.mxtpu_engine_wait_all.argtypes = [p, ctypes.POINTER(u64)]
+    lib.mxtpu_engine_delete_var.argtypes = [p, u64]
+    lib.mxtpu_engine_num_pending.argtypes = [p]
+    lib.mxtpu_rec_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                                   ctypes.c_int, ctypes.c_int, ctypes.POINTER(p)]
+    lib.mxtpu_rec_close.argtypes = [p]
+    lib.mxtpu_rec_next_batch.argtypes = [p, ctypes.POINTER(p),
+                                         ctypes.POINTER(ctypes.c_int)]
+    lib.mxtpu_rec_get.argtypes = [p, ctypes.c_int,
+                                  ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                                  ctypes.POINTER(u64)]
+    lib.mxtpu_rec_free_batch.argtypes = [p]
+    lib.mxtpu_rec_reset.argtypes = [p]
+    lib.mxtpu_rec_count.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_rec_count.restype = ctypes.c_int64
+    lib.mxtpu_rec_writer_open.argtypes = [ctypes.c_char_p, ctypes.POINTER(p)]
+    lib.mxtpu_rec_write.argtypes = [p, ctypes.c_char_p, u64]
+    lib.mxtpu_rec_writer_tell.argtypes = [p]
+    lib.mxtpu_rec_writer_tell.restype = ctypes.c_int64
+    lib.mxtpu_rec_writer_close.argtypes = [p]
+    lib.mxtpu_pool_alloc.argtypes = [ctypes.c_size_t]
+    lib.mxtpu_pool_alloc.restype = p
+    lib.mxtpu_pool_free.argtypes = [p, ctypes.c_size_t]
+    lib.mxtpu_pool_stats.argtypes = [ctypes.POINTER(u64)]
+    lib.mxtpu_last_error.restype = ctypes.c_char_p
+    lib.mxtpu_version.restype = ctypes.c_char_p
+    return lib
+
+
+def lib():
+    """The loaded native library, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MXTPU_NO_NATIVE"):
+            return None
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(["make", "-C", _CPP_DIR], check=True,
+                               capture_output=True, timeout=300)
+            except Exception:
+                return None
+        try:
+            _lib = _declare(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def last_error() -> str:
+    l = lib()
+    return l.mxtpu_last_error().decode() if l else ""
+
+
+class NativeEngine:
+    """Dependency engine over the native scheduler.
+
+    Python callables are pushed with read/write variable ids; exceptions
+    raised inside a callable poison the op's write-vars and re-raise at
+    wait_var/wait_all, matching the reference's engine exception semantics
+    (src/engine/threaded_engine.h:179,450-465; tests test_exc_handling.py).
+    """
+
+    def __init__(self, num_workers: int = 4):
+        l = lib()
+        if l is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = l
+        handle = ctypes.c_void_p()
+        if l.mxtpu_engine_create(num_workers, ctypes.byref(handle)):
+            raise RuntimeError(last_error())
+        self._h = handle
+        self._next_id = 1
+        self._callbacks = {}   # id -> (CFUNCTYPE ref, fn)
+        self._errors = {}      # id -> exception, kept until consumed by a wait
+        self._inflight = 0     # pushes registered but not yet handed to C
+        self._cb_lock = threading.Lock()
+
+    def new_var(self) -> int:
+        return int(self._lib.mxtpu_engine_new_var(self._h))
+
+    def push(self, fn, read_vars=(), write_vars=(), priority=0, sync=False):
+        with self._cb_lock:
+            op_id = self._next_id
+            self._next_id += 1
+
+        def trampoline(_ctx, _op_id=op_id, _fn=fn):
+            try:
+                _fn()
+                return 0
+            except BaseException as e:  # noqa: BLE001 — crossing C boundary
+                with self._cb_lock:
+                    self._errors[_op_id] = e
+                return 1
+
+        cfn = MXTPU_FN(trampoline)
+        with self._cb_lock:
+            self._callbacks[op_id] = (cfn, fn)
+            self._inflight += 1
+
+        try:
+            reads = (ctypes.c_uint64 * len(read_vars))(*read_vars)
+            writes = (ctypes.c_uint64 * len(write_vars))(*write_vars)
+            rc = self._lib.mxtpu_engine_push(
+                self._h, cfn, ctypes.c_void_p(op_id), reads, len(read_vars),
+                writes, len(write_vars), priority, 1 if sync else 0)
+        finally:
+            with self._cb_lock:
+                self._inflight -= 1
+        if rc:
+            raise RuntimeError(last_error())
+        if sync:
+            self._raise_if(op_id)
+        return op_id
+
+    def _raise_if(self, failed_id: int):
+        with self._cb_lock:
+            exc = self._errors.pop(failed_id, None)
+        if exc is not None:
+            raise exc
+
+    def wait_var(self, var: int):
+        failed = ctypes.c_uint64()
+        if self._lib.mxtpu_engine_wait_var(self._h, var, ctypes.byref(failed)):
+            self._raise_if(int(failed.value))
+            raise RuntimeError(f"engine op {failed.value} failed")
+        self._gc_callbacks()
+
+    def wait_all(self):
+        failed = ctypes.c_uint64()
+        if self._lib.mxtpu_engine_wait_all(self._h, ctypes.byref(failed)):
+            self._gc_callbacks()
+            self._raise_if(int(failed.value))
+            raise RuntimeError(f"engine op {failed.value} failed")
+        self._gc_callbacks()
+
+    def _gc_callbacks(self):
+        # Once the engine drained AND no push is mid-registration, completed
+        # trampolines are unreachable from C — safe to drop refs. Stored
+        # exceptions stay until the wait that surfaces them consumes them.
+        with self._cb_lock:
+            if self._inflight == 0 and \
+                    self._lib.mxtpu_engine_num_pending(self._h) == 0:
+                self._callbacks.clear()
+
+    def delete_var(self, var: int):
+        self._lib.mxtpu_engine_delete_var(self._h, var)
+
+    def num_pending(self) -> int:
+        return int(self._lib.mxtpu_engine_num_pending(self._h))
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self.wait_all()
+            self._lib.mxtpu_engine_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RecordReader:
+    """Prefetching sharded RecordIO reader (native). Iterates bytes records."""
+
+    def __init__(self, path, batch_records=64, queue_depth=4, shard_index=0,
+                 num_shards=1):
+        l = lib()
+        if l is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = l
+        handle = ctypes.c_void_p()
+        if l.mxtpu_rec_open(path.encode(), batch_records, queue_depth,
+                            shard_index, num_shards, ctypes.byref(handle)):
+            raise IOError(last_error())
+        self._h = handle
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        buf = getattr(self, "_pending", None)
+        if not buf:
+            batch = ctypes.c_void_p()
+            count = ctypes.c_int()
+            if self._lib.mxtpu_rec_next_batch(self._h, ctypes.byref(batch),
+                                              ctypes.byref(count)):
+                raise IOError(last_error())
+            if not batch.value:
+                raise StopIteration
+            records = []
+            data = ctypes.POINTER(ctypes.c_uint8)()
+            length = ctypes.c_uint64()
+            for i in range(count.value):
+                self._lib.mxtpu_rec_get(batch, i, ctypes.byref(data),
+                                        ctypes.byref(length))
+                records.append(ctypes.string_at(data, length.value))
+            self._lib.mxtpu_rec_free_batch(batch)
+            records.reverse()
+            self._pending = buf = records
+        return buf.pop()
+
+    def reset(self):
+        self._pending = None
+        self._lib.mxtpu_rec_reset(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.mxtpu_rec_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RecordWriter:
+    """Native sequential RecordIO writer."""
+
+    def __init__(self, path):
+        l = lib()
+        if l is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = l
+        handle = ctypes.c_void_p()
+        if l.mxtpu_rec_writer_open(path.encode(), ctypes.byref(handle)):
+            raise IOError(last_error())
+        self._h = handle
+
+    def write(self, buf: bytes):
+        if self._lib.mxtpu_rec_write(self._h, buf, len(buf)):
+            raise IOError("record write failed")
+
+    def tell(self) -> int:
+        return int(self._lib.mxtpu_rec_writer_tell(self._h))
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.mxtpu_rec_writer_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def rec_count(path: str) -> int:
+    l = lib()
+    if l is None:
+        raise RuntimeError("native runtime unavailable")
+    return int(l.mxtpu_rec_count(path.encode()))
+
+
+def pool_stats():
+    l = lib()
+    if l is None:
+        return None
+    out = (ctypes.c_uint64 * 4)()
+    l.mxtpu_pool_stats(out)
+    return {"os_bytes": out[0], "reused_bytes": out[1], "live": out[2],
+            "pooled_bytes": out[3]}

@@ -17,9 +17,10 @@ the reference exposes, preserved here so user code and tests carry over:
   makes every invoke block_until_ready, which serializes exactly like the
   reference and surfaces async exceptions at the faulting op.
 
-A C++ dependency engine for host-side IO/prefetch pipelines lives in
-``cpp/`` (see engine_ext) and is used by the data pipeline, not the compute
-path.
+The C++ dependency engine for host-side IO/prefetch/checkpoint work lives in
+``cpp/src/engine.cc`` (bound via ``mxnet_tpu._native.NativeEngine``) and is
+exposed here through ``new_var``/``push``/``wait_for_var`` — it orders host
+tasks, not the XLA compute path.
 """
 from __future__ import annotations
 
@@ -29,7 +30,8 @@ import threading
 
 from .base import getenv
 
-__all__ = ["set_bulk_size", "bulk", "is_naive", "wait_all", "push", "NaiveEngine"]
+__all__ = ["set_bulk_size", "bulk", "is_naive", "wait_all", "push",
+           "new_var", "wait_for_var", "host_engine", "NaiveEngine"]
 
 _state = threading.local()
 _ENGINE_TYPE = getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
@@ -65,20 +67,65 @@ def bulk(size: int):
         set_bulk_size(old)
 
 
-def push(fn, *args, **kwargs):
-    """Execute a host task; synchronous under NaiveEngine, else fire-and-go.
+_host_engine = None
+_host_engine_lock = threading.Lock()
 
-    This is the host-callback integration point the reference's CustomOperator
-    thread pool provides (src/operator/custom/custom-inl.h:50-148).
+
+def host_engine():
+    """The process-wide native dependency engine ordering host-side work
+    (IO, prefetch, checkpoint writes, custom host callbacks) — the retained
+    half of the reference's ThreadedEngine (SURVEY.md §7). None when the
+    native library is unavailable."""
+    global _host_engine
+    if _host_engine is None:
+        with _host_engine_lock:
+            if _host_engine is None:
+                from . import _native
+
+                if _native.lib() is not None:
+                    nthreads = int(getenv("MXNET_CPU_WORKER_NTHREADS", "4"))
+                    _host_engine = _native.NativeEngine(num_workers=nthreads)
+    return _host_engine
+
+
+def new_var():
+    """Engine variable for dependency-ordered host tasks
+    (reference: Engine::NewVariable)."""
+    eng = host_engine()
+    return eng.new_var() if eng is not None else None
+
+
+def push(fn, *args, read_vars=(), write_vars=(), priority=0, **kwargs):
+    """Schedule a host task; synchronous under NaiveEngine, else async on the
+    native dependency engine when vars are given (reference:
+    Engine::PushAsync, include/mxnet/engine.h:166). Without vars the task runs
+    inline — the host-callback integration point the reference's
+    CustomOperator thread pool provides (src/operator/custom/custom-inl.h:50).
     """
+    eng = host_engine() if (read_vars or write_vars) else None
+    if eng is not None:
+        return eng.push(lambda: fn(*args, **kwargs), read_vars=read_vars,
+                        write_vars=write_vars, priority=priority,
+                        sync=is_naive())
     result = fn(*args, **kwargs)
     if is_naive():
         wait_all()
     return result
 
 
+def wait_for_var(var) -> None:
+    """Reference: Engine::WaitForVar — blocks until all ops touching `var`
+    completed; rethrows any exception the failing op raised."""
+    eng = host_engine()
+    if eng is not None and var is not None:
+        eng.wait_var(var)
+
+
 def wait_all() -> None:
-    """Reference: Engine::WaitForAll."""
+    """Reference: Engine::WaitForAll — host engine first, then device."""
+    eng = _host_engine
+    if eng is not None:
+        eng.wait_all()
     import jax
 
     try:

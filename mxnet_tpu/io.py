@@ -394,6 +394,60 @@ def ImageRecordIter(**kwargs):
     return ImageRecordIterImpl(**kwargs)
 
 
+class RecordIOIter:
+    """Streaming iterator over raw RecordIO records with native background
+    prefetch and round-robin sharding for data parallelism (reference:
+    PrefetcherIter over chunked reads, src/io/iter_prefetcher.h:47,
+    src/io/iter_image_recordio_2.cc:175-206; sharding per dmlc InputSplit).
+
+    Uses the C++ prefetch pipeline (cpp/src/recordio.cc) when available and
+    falls back to the pure-Python `MXRecordIO` reader otherwise. Yields
+    `bytes` payloads; pair with `recordio.unpack`/`unpack_img` to decode.
+    """
+
+    def __init__(self, path, batch_records=64, queue_depth=4, part_index=0,
+                 num_parts=1):
+        from . import _native
+
+        self._path = path
+        self._native = _native.lib() is not None
+        if self._native:
+            self._reader = _native.RecordReader(
+                path, batch_records=batch_records, queue_depth=queue_depth,
+                shard_index=part_index, num_shards=num_parts)
+        else:
+            from .recordio import MXRecordIO
+
+            self._reader = MXRecordIO(path, "r")
+            self._part_index, self._num_parts = part_index, num_parts
+            self._ordinal = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        if self._native:
+            return next(self._reader)
+        while True:
+            buf = self._reader.read()
+            if buf is None:
+                raise StopIteration
+            mine = (self._ordinal % self._num_parts) == self._part_index
+            self._ordinal += 1
+            if mine:
+                return buf
+
+    def reset(self):
+        self._reader.reset()
+        if not self._native:
+            self._ordinal = 0
+
+    def close(self):
+        close = getattr(self._reader, "close", None)
+        if close:
+            close()
+
+
 class LibSVMIter(DataIter):
     """LibSVM sparse reader (reference: src/io/iter_libsvm.cc)."""
 

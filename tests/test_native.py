@@ -1,0 +1,251 @@
+"""Native runtime tests: dependency engine ordering/exceptions, RecordIO
+roundtrip + sharded prefetch (reference test models:
+tests/cpp/engine/threaded_engine_test.cc, tests/python/unittest/
+test_exc_handling.py, test_recordio in test_io.py)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import _native
+from mxnet_tpu import recordio
+
+pytestmark = pytest.mark.skipif(_native.lib() is None,
+                                reason="native runtime unavailable")
+
+
+def test_engine_serializes_writes():
+    eng = _native.NativeEngine(num_workers=4)
+    v = eng.new_var()
+    out = []
+    for i in range(50):
+        eng.push(lambda i=i: out.append(i), write_vars=[v])
+    eng.wait_var(v)
+    assert out == list(range(50))
+    eng.close()
+
+
+def test_engine_reads_run_concurrently():
+    eng = _native.NativeEngine(num_workers=4)
+    v = eng.new_var()
+    barrier = threading.Barrier(3, timeout=5)
+
+    def read_task():
+        barrier.wait()  # deadlocks unless 3 reads run at once
+
+    for _ in range(3):
+        eng.push(read_task, read_vars=[v])
+    eng.wait_all()
+    eng.close()
+
+
+def test_engine_read_write_ordering():
+    # writes before reads before writes, per push order on one var
+    eng = _native.NativeEngine(num_workers=4)
+    v = eng.new_var()
+    log = []
+    eng.push(lambda: log.append("w1"), write_vars=[v])
+    eng.push(lambda: (time.sleep(0.01), log.append("r"))[1], read_vars=[v])
+    eng.push(lambda: log.append("r"), read_vars=[v])
+    eng.push(lambda: log.append("w2"), write_vars=[v])
+    eng.wait_var(v)
+    assert log[0] == "w1" and log[-1] == "w2" and log.count("r") == 2
+    eng.close()
+
+
+def test_engine_cross_var_parallelism():
+    eng = _native.NativeEngine(num_workers=2)
+    v1, v2 = eng.new_var(), eng.new_var()
+    barrier = threading.Barrier(2, timeout=5)
+    eng.push(barrier.wait, write_vars=[v1])
+    eng.push(barrier.wait, write_vars=[v2])  # independent → parallel
+    eng.wait_all()
+    eng.close()
+
+
+def test_engine_exception_propagates_to_wait_var():
+    # reference: test_exc_handling.py — async failure surfaces at wait
+    eng = _native.NativeEngine(num_workers=2)
+    v = eng.new_var()
+
+    def boom():
+        raise ValueError("async failure")
+
+    eng.push(boom, write_vars=[v])
+    with pytest.raises(ValueError, match="async failure"):
+        eng.wait_var(v)
+    eng2 = _native.NativeEngine(num_workers=2)
+    w = eng2.new_var()
+    eng2.push(boom, write_vars=[w])
+    with pytest.raises(ValueError):
+        eng2.wait_all()
+    eng.close()
+    eng2.close()
+
+
+def test_engine_failed_read_does_not_poison_source():
+    eng = _native.NativeEngine(num_workers=2)
+    v = eng.new_var()
+    eng.push(lambda: None, write_vars=[v])
+
+    def boom():
+        raise RuntimeError("reader died")
+
+    eng.push(boom, read_vars=[v])
+    try:
+        eng.wait_all()
+    except RuntimeError:
+        pass
+    eng.wait_var(v)  # var itself is clean
+    eng.close()
+
+
+def test_engine_sync_mode():
+    # NaiveEngine semantics: push returns after execution
+    eng = _native.NativeEngine(num_workers=2)
+    v = eng.new_var()
+    out = []
+    eng.push(lambda: out.append(1), write_vars=[v], sync=True)
+    assert out == [1]
+    with pytest.raises(KeyError):
+        eng.push(lambda: {}["missing"], write_vars=[v], sync=True)
+    eng.close()
+
+
+def test_engine_priority_runs_first():
+    eng = _native.NativeEngine(num_workers=1)
+    gate = threading.Event()
+    order = []
+    # occupy the single worker so both queued ops are pending together
+    eng.push(lambda: gate.wait(5))
+    eng.push(lambda: order.append("normal"))
+    eng.push(lambda: order.append("hi"), priority=10)
+    gate.set()
+    eng.wait_all()
+    assert order == ["hi", "normal"]
+    eng.close()
+
+
+def test_engine_delete_var():
+    eng = _native.NativeEngine(num_workers=2)
+    v = eng.new_var()
+    out = []
+    eng.push(lambda: out.append(1), write_vars=[v])
+    eng.delete_var(v)
+    eng.wait_all()
+    assert out == [1]
+    eng.close()
+
+
+# ---------------------------------------------------------------- recordio
+
+
+def test_native_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "data.rec")
+    payloads = [os.urandom(np.random.randint(1, 200)) for _ in range(100)]
+    w = _native.RecordWriter(path)
+    for buf in payloads:
+        w.write(buf)
+    w.close()
+    assert _native.rec_count(path) == 100
+    got = list(_native.RecordReader(path, batch_records=7))
+    assert got == payloads
+
+
+def test_native_recordio_interop_with_python(tmp_path):
+    # wire-format parity: python writer ↔ native reader and vice versa
+    path = str(tmp_path / "py.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    payloads = [bytes([i]) * (i + 1) for i in range(20)]
+    for buf in payloads:
+        rec.write(buf)
+    rec.close()
+    assert list(_native.RecordReader(path)) == payloads
+
+    path2 = str(tmp_path / "native.rec")
+    w = _native.RecordWriter(path2)
+    for buf in payloads:
+        w.write(buf)
+    w.close()
+    rec = recordio.MXRecordIO(path2, "r")
+    got = []
+    while True:
+        buf = rec.read()
+        if buf is None:
+            break
+        got.append(buf)
+    assert got == payloads
+
+
+def test_native_recordio_sharding(tmp_path):
+    path = str(tmp_path / "shard.rec")
+    w = _native.RecordWriter(path)
+    for i in range(10):
+        w.write(str(i).encode())
+    w.close()
+    shard0 = list(_native.RecordReader(path, shard_index=0, num_shards=2))
+    shard1 = list(_native.RecordReader(path, shard_index=1, num_shards=2))
+    assert shard0 == [b"0", b"2", b"4", b"6", b"8"]
+    assert shard1 == [b"1", b"3", b"5", b"7", b"9"]
+
+
+def test_native_recordio_reset(tmp_path):
+    path = str(tmp_path / "r.rec")
+    w = _native.RecordWriter(path)
+    for i in range(5):
+        w.write(b"x%d" % i)
+    w.close()
+    r = _native.RecordReader(path, batch_records=2)
+    assert len(list(r)) == 5
+    r.reset()
+    assert len(list(r)) == 5
+    r.close()
+
+
+def test_native_recordio_corrupt_file(tmp_path):
+    path = str(tmp_path / "bad.rec")
+    with open(path, "wb") as f:
+        f.write(b"not a recordio file at all")
+    with pytest.raises(IOError):
+        list(_native.RecordReader(path))
+
+
+def test_engine_facade_dependency_push():
+    # mxnet_tpu.engine routes var-carrying pushes to the native engine
+    from mxnet_tpu import engine
+
+    v = engine.new_var()
+    assert v is not None
+    out = []
+    for i in range(10):
+        engine.push(lambda i=i: out.append(i), write_vars=[v])
+    engine.wait_for_var(v)
+    assert out == list(range(10))
+
+
+def test_recordio_iter_native_and_fallback(tmp_path):
+    from mxnet_tpu import io
+
+    path = str(tmp_path / "s.rec")
+    w = _native.RecordWriter(path)
+    for i in range(6):
+        w.write(b"r%d" % i)
+    w.close()
+    it = io.RecordIOIter(path, part_index=0, num_parts=3)
+    assert list(it) == [b"r0", b"r3"]
+    it.reset()
+    assert list(it) == [b"r0", b"r3"]
+    it.close()
+
+
+def test_pool_stats_reuse():
+    lib = _native.lib()
+    before = _native.pool_stats()
+    p1 = lib.mxtpu_pool_alloc(10000)
+    lib.mxtpu_pool_free(p1, 10000)
+    p2 = lib.mxtpu_pool_alloc(10000)  # same bucket → reused
+    lib.mxtpu_pool_free(p2, 10000)
+    after = _native.pool_stats()
+    assert after["reused_bytes"] > before["reused_bytes"]
