@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Inference throughput benchmark over the model zoo (reference:
+example/image-classification/benchmark_score.py — scores symbols at several
+batch sizes and prints images/sec)."""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.parallel.data_parallel import block_apply_fn
+
+
+def score(model_name, batch_size, image_shape=(3, 224, 224), steps=20,
+          dtype="float32"):
+    net = gluon.model_zoo.vision.get_model(model_name, classes=1000)
+    net.initialize()
+    net(mx.nd.array(np.zeros((1,) + image_shape, np.float32)))
+    apply_fn, params = block_apply_fn(net, is_train=False)
+    cdt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    def fwd(params, x):
+        p = jax.tree_util.tree_map(lambda a: a.astype(cdt), params)
+        return apply_fn(p, x.astype(cdt)).astype(jnp.float32)
+
+    jfwd = jax.jit(fwd)
+    x = jnp.asarray(np.random.rand(batch_size, *image_shape)
+                    .astype(np.float32))
+    jfwd(params, x).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = jfwd(params, x)
+    out.block_until_ready()
+    return batch_size * steps / (time.perf_counter() - t0)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--networks", type=str,
+                        default="resnet50_v1,mobilenet1_0")
+    parser.add_argument("--batch-sizes", type=str, default="1,16,32")
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--dtype", type=str, default="float32")
+    parser.add_argument("--steps", type=int, default=20)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    for net in args.networks.split(","):
+        for bs in (int(b) for b in args.batch_sizes.split(",")):
+            ips = score(net, bs, shape, steps=args.steps, dtype=args.dtype)
+            logging.info("network: %s, batch=%d, dtype=%s: %.1f images/sec",
+                         net, bs, args.dtype, ips)

@@ -1,0 +1,108 @@
+"""Shared training harness for the image-classification examples
+(reference: example/image-classification/common/fit.py — arg groups for
+network/data/optimizer/kvstore, checkpointing, lr schedule, Speedometer)."""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+
+import mxnet_tpu as mx
+
+
+def add_fit_args(parser: argparse.ArgumentParser):
+    train = parser.add_argument_group("Training")
+    train.add_argument("--network", type=str, default="mlp")
+    train.add_argument("--num-layers", type=int, default=None)
+    train.add_argument("--gpus", type=str, default=None,
+                       help="unused on TPU; kept for CLI parity")
+    train.add_argument("--kv-store", type=str, default="local")
+    train.add_argument("--num-epochs", type=int, default=10)
+    train.add_argument("--lr", type=float, default=0.05)
+    train.add_argument("--lr-factor", type=float, default=0.1)
+    train.add_argument("--lr-step-epochs", type=str, default=None)
+    train.add_argument("--optimizer", type=str, default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=1e-4)
+    train.add_argument("--batch-size", type=int, default=128)
+    train.add_argument("--disp-batches", type=int, default=20)
+    train.add_argument("--model-prefix", type=str, default=None)
+    train.add_argument("--load-epoch", type=int, default=None)
+    train.add_argument("--top-k", type=int, default=0)
+    train.add_argument("--dtype", type=str, default="float32",
+                       choices=("float32", "bfloat16"))
+    train.add_argument("--num-examples", type=int, default=6000)
+    return train
+
+
+def _lr_scheduler(args, epoch_size):
+    if not args.lr_step_epochs:
+        return args.lr, None
+    begin = args.load_epoch or 0
+    step_epochs = [int(x) for x in args.lr_step_epochs.split(",")]
+    lr = args.lr
+    for s in step_epochs:
+        if begin >= s:
+            lr *= args.lr_factor
+    steps = [epoch_size * (x - begin) for x in step_epochs
+             if x - begin > 0]
+    if not steps:
+        return lr, None
+    return lr, mx.lr_scheduler.MultiFactorScheduler(step=steps,
+                                                    factor=args.lr_factor)
+
+
+def fit(args, network, data_loader, **kwargs):
+    """Bind network on a Module and run the fit loop (reference: common/fit.py
+    fit)."""
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    kv = mx.kv.create(args.kv_store)
+    train, val = data_loader(args, kv)
+
+    epoch_size = max(args.num_examples // args.batch_size, 1)
+    lr, lr_sched = _lr_scheduler(args, epoch_size)
+
+    checkpoint = None
+    if args.model_prefix:
+        checkpoint = mx.callback.do_checkpoint(
+            args.model_prefix if kv.rank == 0
+            else f"{args.model_prefix}-{kv.rank}")
+
+    arg_params = aux_params = None
+    if args.model_prefix and args.load_epoch is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+
+    mod = mx.mod.Module(network, label_names=["softmax_label"])
+    optimizer_params = {"learning_rate": lr, "wd": args.wd}
+    if args.optimizer in ("sgd", "nag", "signum"):
+        optimizer_params["momentum"] = args.mom
+    if lr_sched is not None:
+        optimizer_params["lr_scheduler"] = lr_sched
+
+    eval_metrics = ["accuracy"]
+    if args.top_k > 0:
+        eval_metrics.append(mx.metric.create("top_k_accuracy",
+                                             top_k=args.top_k))
+
+    t0 = time.time()
+    mod.fit(train,
+            eval_data=val,
+            eval_metric=eval_metrics,
+            kvstore=kv,
+            optimizer=args.optimizer,
+            optimizer_params=optimizer_params,
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2),
+            arg_params=arg_params,
+            aux_params=aux_params,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       args.disp_batches),
+            epoch_end_callback=checkpoint,
+            begin_epoch=args.load_epoch or 0,
+            num_epoch=args.num_epochs,
+            **kwargs)
+    logging.info("total fit time: %.1fs", time.time() - t0)
+    return mod

@@ -1,0 +1,113 @@
+"""ResNet symbol builder (reference: example/image-classification/symbols/
+resnet.py — pre-activation v2 residual units, thumbnail stem for cifar)."""
+import mxnet_tpu as mx
+
+
+def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True):
+    if bottle_neck:
+        bn1 = mx.sym.BatchNorm(data=data, fix_gamma=False, eps=2e-5,
+                               momentum=0.9, name=name + "_bn1")
+        act1 = mx.sym.Activation(data=bn1, act_type="relu")
+        conv1 = mx.sym.Convolution(data=act1, num_filter=num_filter // 4,
+                                   kernel=(1, 1), stride=(1, 1), pad=(0, 0),
+                                   no_bias=True, name=name + "_conv1")
+        bn2 = mx.sym.BatchNorm(data=conv1, fix_gamma=False, eps=2e-5,
+                               momentum=0.9, name=name + "_bn2")
+        act2 = mx.sym.Activation(data=bn2, act_type="relu")
+        conv2 = mx.sym.Convolution(data=act2, num_filter=num_filter // 4,
+                                   kernel=(3, 3), stride=stride, pad=(1, 1),
+                                   no_bias=True, name=name + "_conv2")
+        bn3 = mx.sym.BatchNorm(data=conv2, fix_gamma=False, eps=2e-5,
+                               momentum=0.9, name=name + "_bn3")
+        act3 = mx.sym.Activation(data=bn3, act_type="relu")
+        conv3 = mx.sym.Convolution(data=act3, num_filter=num_filter,
+                                   kernel=(1, 1), stride=(1, 1), pad=(0, 0),
+                                   no_bias=True, name=name + "_conv3")
+        shortcut = data if dim_match else mx.sym.Convolution(
+            data=act1, num_filter=num_filter, kernel=(1, 1), stride=stride,
+            no_bias=True, name=name + "_sc")
+        return conv3 + shortcut
+    bn1 = mx.sym.BatchNorm(data=data, fix_gamma=False, eps=2e-5, momentum=0.9,
+                           name=name + "_bn1")
+    act1 = mx.sym.Activation(data=bn1, act_type="relu")
+    conv1 = mx.sym.Convolution(data=act1, num_filter=num_filter,
+                               kernel=(3, 3), stride=stride, pad=(1, 1),
+                               no_bias=True, name=name + "_conv1")
+    bn2 = mx.sym.BatchNorm(data=conv1, fix_gamma=False, eps=2e-5, momentum=0.9,
+                           name=name + "_bn2")
+    act2 = mx.sym.Activation(data=bn2, act_type="relu")
+    conv2 = mx.sym.Convolution(data=act2, num_filter=num_filter,
+                               kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                               no_bias=True, name=name + "_conv2")
+    shortcut = data if dim_match else mx.sym.Convolution(
+        data=act1, num_filter=num_filter, kernel=(1, 1), stride=stride,
+        no_bias=True, name=name + "_sc")
+    return conv2 + shortcut
+
+
+def resnet(units, num_stages, filter_list, num_classes, image_shape,
+           bottle_neck=True):
+    data = mx.sym.Variable("data")
+    data = mx.sym.BatchNorm(data=data, fix_gamma=True, eps=2e-5, momentum=0.9,
+                            name="bn_data")
+    height = image_shape[1]
+    if height <= 32:  # cifar thumbnail stem
+        body = mx.sym.Convolution(data=data, num_filter=filter_list[0],
+                                  kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                                  no_bias=True, name="conv0")
+    else:  # imagenet stem
+        body = mx.sym.Convolution(data=data, num_filter=filter_list[0],
+                                  kernel=(7, 7), stride=(2, 2), pad=(3, 3),
+                                  no_bias=True, name="conv0")
+        body = mx.sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
+                                momentum=0.9, name="bn0")
+        body = mx.sym.Activation(data=body, act_type="relu")
+        body = mx.sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
+                              pad=(1, 1), pool_type="max")
+    for i in range(num_stages):
+        stride = (1, 1) if i == 0 else (2, 2)
+        body = residual_unit(body, filter_list[i + 1], stride, False,
+                             name=f"stage{i+1}_unit1", bottle_neck=bottle_neck)
+        for j in range(units[i] - 1):
+            body = residual_unit(body, filter_list[i + 1], (1, 1), True,
+                                 name=f"stage{i+1}_unit{j+2}",
+                                 bottle_neck=bottle_neck)
+    bn1 = mx.sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5, momentum=0.9,
+                           name="bn1")
+    relu1 = mx.sym.Activation(data=bn1, act_type="relu")
+    pool1 = mx.sym.Pooling(data=relu1, global_pool=True, kernel=(7, 7),
+                           pool_type="avg", name="pool1")
+    flat = mx.sym.Flatten(data=pool1)
+    fc1 = mx.sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
+    return mx.sym.SoftmaxOutput(data=fc1, name="softmax")
+
+
+def get_symbol(num_classes, num_layers=50, image_shape="3,224,224", **kwargs):
+    image_shape = [int(x) for x in image_shape.split(",")] \
+        if isinstance(image_shape, str) else list(image_shape)
+    height = image_shape[1]
+    if height <= 32:
+        num_stages = 3
+        if (num_layers - 2) % 9 == 0 and num_layers >= 164:
+            per_unit = [(num_layers - 2) // 9]
+            filter_list = [16, 64, 128, 256]
+            bottle_neck = True
+        elif (num_layers - 2) % 6 == 0 and num_layers < 164:
+            per_unit = [(num_layers - 2) // 6]
+            filter_list = [16, 16, 32, 64]
+            bottle_neck = False
+        else:
+            raise ValueError(f"no cifar resnet spec for {num_layers} layers")
+        units = per_unit * num_stages
+    else:
+        num_stages = 4
+        specs = {18: ([2, 2, 2, 2], False), 34: ([3, 4, 6, 3], False),
+                 50: ([3, 4, 6, 3], True), 101: ([3, 4, 23, 3], True),
+                 152: ([3, 8, 36, 3], True)}
+        if num_layers not in specs:
+            raise ValueError(f"no imagenet resnet spec for {num_layers} layers")
+        units, bottle_neck = specs[num_layers]
+        filter_list = [64, 256, 512, 1024, 2048] if bottle_neck \
+            else [64, 64, 128, 256, 512]
+    return resnet(units, num_stages, filter_list, num_classes, image_shape,
+                  bottle_neck)

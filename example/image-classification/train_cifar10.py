@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Train ResNet on CIFAR-10 (reference: example/image-classification/
+train_cifar10.py). Reads RecordIO files when present; generates a synthetic
+deterministic set otherwise (no-egress CI use)."""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from common import fit
+
+
+def get_cifar_iter(args, kv):
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    rec = os.path.join(args.data_dir, "cifar10_train.rec")
+    if os.path.exists(rec):
+        train = mx.io.ImageRecordIter(
+            path_imgrec=rec, data_shape=shape, batch_size=args.batch_size,
+            shuffle=True, rand_crop=True, rand_mirror=True,
+            num_parts=kv.num_workers, part_index=kv.rank)
+        val = mx.io.ImageRecordIter(
+            path_imgrec=os.path.join(args.data_dir, "cifar10_val.rec"),
+            data_shape=shape, batch_size=args.batch_size,
+            num_parts=kv.num_workers, part_index=kv.rank)
+        return train, val
+    rng = np.random.RandomState(7)
+    n = args.num_examples
+    X = rng.rand(n, *shape).astype(np.float32)
+    y = rng.randint(0, args.num_classes, (n,)).astype(np.float32)
+    # make labels learnable: tie the class to a channel-mean threshold
+    y = (X.reshape(n, -1).mean(axis=1) * args.num_classes).astype(np.float32) \
+        % args.num_classes
+    y = np.floor(y)
+    train = mx.io.NDArrayIter(X[:int(n * 0.9)], y[:int(n * 0.9)],
+                              args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(X[int(n * 0.9):], y[int(n * 0.9):],
+                            args.batch_size, label_name="softmax_label")
+    return train, val
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--image-shape", type=str, default="3,28,28")
+    parser.add_argument("--data-dir", type=str, default="data/cifar10")
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="resnet", num_layers=8, num_epochs=5,
+                        batch_size=128, lr=0.05, num_examples=2560)
+    args = parser.parse_args()
+
+    from symbols import resnet as net_mod
+    sym = net_mod.get_symbol(num_classes=args.num_classes,
+                             num_layers=args.num_layers,
+                             image_shape=args.image_shape)
+    fit.fit(args, sym, get_cifar_iter)

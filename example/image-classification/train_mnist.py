@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Train on MNIST (reference: example/image-classification/train_mnist.py).
+Falls back to a deterministic synthetic set when idx files are absent."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from common import fit
+
+
+def get_mnist_iter(args, kv):
+    data_dir = getattr(args, "data_dir", "data/mnist")
+    train = mx.io.MNISTIter(
+        image=os.path.join(data_dir, "train-images-idx3-ubyte"),
+        label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+        batch_size=args.batch_size, shuffle=True,
+        flat=(args.network == "mlp"),
+        num_parts=kv.num_workers, part_index=kv.rank)
+    val = mx.io.MNISTIter(
+        image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+        label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+        batch_size=args.batch_size, shuffle=False,
+        flat=(args.network == "mlp"),
+        num_parts=kv.num_workers, part_index=kv.rank)
+    return train, val
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--data-dir", type=str, default="data/mnist")
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_epochs=10, batch_size=64,
+                        lr=0.05, lr_step_epochs="10", num_examples=6000)
+    args = parser.parse_args()
+
+    if args.network == "mlp":
+        from symbols import mlp as net_mod
+    else:
+        from symbols import lenet as net_mod
+    sym = net_mod.get_symbol(num_classes=args.num_classes)
+    fit.fit(args, sym, get_mnist_iter)

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Word-level language model with bucketing (reference: example/rnn/word_lm +
+example/rnn/bucketing/lstm_bucketing.py — BucketSentenceIter +
+BucketingModule + stacked LSTM cells; each bucket length compiles to one
+static-shape XLA program cached by the module).
+
+Reads PTB-format text when present; generates a synthetic deterministic
+corpus otherwise (no-egress CI use)."""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = [line.split() for line in f]
+    if vocab is None:
+        vocab = {}
+    sentences = []
+    for words in lines:
+        ids = []
+        for w in words:
+            if w not in vocab:
+                vocab[w] = len(vocab) + start_label
+            ids.append(vocab[w])
+        if ids:
+            sentences.append(ids)
+    return sentences, vocab
+
+
+def synthetic_corpus(num_sentences=1200, vocab_size=200, seed=0):
+    """Markov-chain corpus: next-token structure an LM can actually learn."""
+    rs = np.random.RandomState(seed)
+    trans = rs.randint(1, vocab_size, size=(vocab_size, 3))
+    sentences = []
+    for _ in range(num_sentences):
+        length = rs.randint(5, 25)
+        tok = rs.randint(1, vocab_size)
+        sent = [tok]
+        for _ in range(length - 1):
+            tok = int(trans[tok, rs.randint(3)])
+            sent.append(tok)
+        sentences.append(sent)
+    return sentences, vocab_size
+
+
+def train(args):
+    buckets = [int(b) for b in args.buckets.split(",")]
+    if args.train_data and os.path.exists(args.train_data):
+        train_sent, vocab = tokenize_text(args.train_data, start_label=1)
+        val_sent, _ = tokenize_text(args.valid_data, vocab=vocab) \
+            if args.valid_data and os.path.exists(args.valid_data) \
+            else (train_sent[-50:], None)
+        vocab_size = len(vocab) + 1
+    else:
+        sents, vocab_size = synthetic_corpus()
+        split = int(len(sents) * 0.8)
+        train_sent, val_sent = sents[:split], sents[split:]
+
+    train_iter = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                           buckets=buckets, invalid_label=0)
+    val_iter = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                         buckets=buckets, invalid_label=0)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix=f"lstm_l{i}_"))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, states = stack.unroll(seq_len, inputs=embed,
+                                       merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab_size,
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen,
+        default_bucket_key=train_iter.default_bucket_key)
+
+    model.fit(
+        train_data=train_iter,
+        eval_data=val_iter,
+        eval_metric=mx.metric.Perplexity(ignore_label=0),
+        optimizer=args.optimizer,
+        optimizer_params={"learning_rate": args.lr},
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches))
+    return model
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="word-level LM")
+    parser.add_argument("--train-data", type=str, default=None)
+    parser.add_argument("--valid-data", type=str, default=None)
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--num-embed", type=int, default=64)
+    parser.add_argument("--buckets", type=str, default="8,16,24")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--optimizer", type=str, default="adam")
+    parser.add_argument("--disp-batches", type=int, default=20)
+    logging.basicConfig(level=logging.INFO, format="%(asctime)-15s %(message)s")
+    train(parser.parse_args())

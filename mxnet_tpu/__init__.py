@@ -43,6 +43,7 @@ from . import module as mod
 from .module import Module
 
 from . import gluon
+from . import rnn
 from . import model
 from .model import save_checkpoint, load_checkpoint
 

@@ -97,6 +97,15 @@ def rnn_param_size(mode, num_layers, input_size, state_size, bidirectional=False
     return total
 
 
+@register("_state_zeros")
+def _state_zeros(data, num_hidden=0, dtype="float32"):
+    """zeros((batch_of(data), num_hidden)) — forward-inference analogue of the
+    reference's unknown-batch begin_state shape=(0, H)
+    (python/mxnet/rnn/rnn_cell.py begin_state): the batch dim is derived from
+    the step input inside the graph, so `jax.eval_shape` solves it forward."""
+    return jnp.zeros((data.shape[0], int(num_hidden)), jnp.dtype(dtype))
+
+
 @register("RNN", rng=True, num_outputs=lambda attrs: (
     1 if not attrs.get("state_outputs") else (3 if attrs.get("mode") == "lstm" else 2)))
 def rnn(data, parameters, state, state_cell=None, rng_key=None, state_size=0,

@@ -326,6 +326,12 @@ def flatten(x):
     return jnp.reshape(x, (x.shape[0], -1))
 
 
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(x, dim1=0, dim2=1):
+    """Reference: src/operator/swapaxis.cc `SwapAxis`."""
+    return jnp.swapaxes(x, int(dim1), int(dim2))
+
+
 @register("transpose")
 def transpose(x, axes=None):
     if axes is None or len(axes) == 0:

@@ -1,0 +1,42 @@
+"""RNN checkpoint helpers (reference: python/mxnet/rnn/rnn.py).
+
+Fused cells store weights as one packed blob; these helpers unpack to
+per-gate arrays on save and re-pack on load so checkpoints are portable
+between fused and unfused cells (reference: save_rnn_checkpoint docstring).
+"""
+from __future__ import annotations
+
+from .. import model as _model
+
+__all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint", "do_rnn_checkpoint"]
+
+
+def _cells_of(cells):
+    return cells if isinstance(cells, (list, tuple)) else [cells]
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
+    """save_checkpoint with cell weights unpacked to per-gate arrays."""
+    for cell in _cells_of(cells):
+        arg_params = cell.unpack_weights(arg_params)
+    _model.save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """load_checkpoint re-packing per-gate arrays into cell weight blobs."""
+    sym, arg, aux = _model.load_checkpoint(prefix, epoch)
+    for cell in _cells_of(cells):
+        arg = cell.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback saving unpacked checkpoints
+    (reference: do_rnn_checkpoint; cf. callback.do_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback

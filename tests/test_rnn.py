@@ -1,0 +1,149 @@
+"""Legacy symbolic RNN package (reference: tests/python/unittest/test_rnn.py
+model — cell composition, unroll shapes, fused-vs-unfused parity,
+BucketSentenceIter batching)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _unroll_and_run(cell, T=4, N=2, I=6, merge=True, layout="NTC"):
+    data = mx.sym.Variable("data")
+    outputs, states = cell.unroll(T, inputs=data, layout=layout,
+                                  merge_outputs=merge)
+    if not merge:
+        outputs = mx.sym.Group(outputs) if isinstance(outputs, list) else outputs
+    shape = (N, T, I) if layout == "NTC" else (T, N, I)
+    exe = outputs.simple_bind(data=shape)
+    exe.forward(is_train=False, data=mx.nd.array(
+        np.random.RandomState(0).rand(*shape).astype(np.float32)))
+    return exe.outputs
+
+
+def test_rnn_cell_unroll_shapes():
+    out = _unroll_and_run(mx.rnn.RNNCell(num_hidden=8, prefix="r_"))
+    assert out[0].shape == (2, 4, 8)
+
+
+def test_lstm_cell_unroll_shapes():
+    out = _unroll_and_run(mx.rnn.LSTMCell(num_hidden=8, prefix="l_"))
+    assert out[0].shape == (2, 4, 8)
+
+
+def test_gru_cell_unroll_shapes():
+    out = _unroll_and_run(mx.rnn.GRUCell(num_hidden=8, prefix="g_"))
+    assert out[0].shape == (2, 4, 8)
+
+
+def test_unroll_unmerged_outputs():
+    outs = _unroll_and_run(mx.rnn.LSTMCell(num_hidden=5, prefix="l_"),
+                           merge=False)
+    assert len(outs) == 4
+    assert all(o.shape == (2, 5) for o in outs)
+
+
+def test_sequential_stack():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(num_hidden=8, prefix="l0_"))
+    stack.add(mx.rnn.DropoutCell(0.0))
+    stack.add(mx.rnn.LSTMCell(num_hidden=4, prefix="l1_"))
+    out = _unroll_and_run(stack)
+    assert out[0].shape == (2, 4, 4)
+
+
+def test_bidirectional_cell():
+    cell = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(num_hidden=3, prefix="fw_"),
+        mx.rnn.LSTMCell(num_hidden=3, prefix="bw_"))
+    out = _unroll_and_run(cell)
+    assert out[0].shape == (2, 4, 6)  # concat of both directions
+
+
+def test_residual_cell():
+    cell = mx.rnn.ResidualCell(mx.rnn.RNNCell(num_hidden=6, prefix="rc_"))
+    out = _unroll_and_run(cell, I=6)
+    assert out[0].shape == (2, 4, 6)
+
+
+def test_zoneout_cell_shapes():
+    cell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(num_hidden=6, prefix="z_"),
+                              zoneout_outputs=0.2, zoneout_states=0.2)
+    out = _unroll_and_run(cell, I=6)
+    assert out[0].shape == (2, 4, 6)
+
+
+def test_fused_cell_runs_and_matches_unfused_shapes():
+    fused = mx.rnn.FusedRNNCell(num_hidden=8, num_layers=2, mode="lstm",
+                                prefix="f_")
+    out = _unroll_and_run(fused)
+    assert out[0].shape == (2, 4, 8)
+    stack = fused.unfuse()
+    out2 = _unroll_and_run(stack)
+    assert out2[0].shape == (2, 4, 8)
+
+
+def test_fused_bidirectional():
+    fused = mx.rnn.FusedRNNCell(num_hidden=4, num_layers=1, mode="gru",
+                                bidirectional=True, prefix="fb_")
+    out = _unroll_and_run(fused)
+    assert out[0].shape == (2, 4, 8)
+
+
+def test_pack_unpack_weights_roundtrip():
+    cell = mx.rnn.LSTMCell(num_hidden=4, prefix="p_")
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(2, inputs=data, merge_outputs=True)
+    exe = outputs.simple_bind(data=(1, 2, 3))
+    args = {k: v for k, v in zip(outputs.list_arguments(), exe.arg_arrays)
+            if k != "data"}
+    unpacked = cell.unpack_weights(args)
+    assert f"p_i2h_i_weight" in unpacked and "p_i2h_weight" not in unpacked
+    packed = cell.pack_weights(unpacked)
+    np.testing.assert_allclose(packed["p_i2h_weight"].asnumpy(),
+                               args["p_i2h_weight"].asnumpy())
+
+
+def test_explicit_begin_state():
+    cell = mx.rnn.LSTMCell(num_hidden=4, prefix="b_")
+    begin = cell.begin_state(func=mx.sym.zeros, batch_size=2)
+    assert len(begin) == 2
+    outputs, states = cell.unroll(3, inputs=mx.sym.Variable("data"),
+                                  begin_state=begin, merge_outputs=True)
+    exe = outputs.simple_bind(data=(2, 3, 5))
+    exe.forward(is_train=False,
+                data=mx.nd.array(np.zeros((2, 3, 5), np.float32)))
+    assert exe.outputs[0].shape == (2, 3, 4)
+
+
+def test_bucket_sentence_iter():
+    rs = np.random.RandomState(0)
+    sentences = [list(rs.randint(1, 50, size=rs.randint(2, 12)))
+                 for _ in range(200)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                   buckets=[4, 8, 12], invalid_label=-1)
+    seen = 0
+    for batch in it:
+        assert batch.bucket_key in (4, 8, 12)
+        assert batch.data[0].shape == (8, batch.bucket_key)
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        np.testing.assert_allclose(l[:, :-1], d[:, 1:])  # shifted labels
+        seen += 1
+    assert seen > 0
+    it.reset()
+    assert len(list(it)) == seen
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    cell = mx.rnn.LSTMCell(num_hidden=4, prefix="ck_")
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(2, inputs=data, merge_outputs=True)
+    exe = outputs.simple_bind(data=(1, 2, 3))
+    args = {k: v for k, v in zip(outputs.list_arguments(), exe.arg_arrays)
+            if k != "data"}
+    prefix = str(tmp_path / "model")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 3, outputs, args, {})
+    sym, arg, aux = mx.rnn.load_rnn_checkpoint(cell, prefix, 3)
+    assert set(arg) == set(args)
+    np.testing.assert_allclose(arg["ck_i2h_weight"].asnumpy(),
+                               args["ck_i2h_weight"].asnumpy())
