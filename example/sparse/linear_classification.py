@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Sparse linear classification (reference: example/sparse/
+linear_classification.py — LibSVM data, csr weighted sum, row_sparse weight
+pulled per-batch from kvstore).
+
+TPU note: sparse features become dense XLA-side via the cast-storage
+fallback (SURVEY.md §7 hard parts); the row-id-sharded pull survives as
+`kv.row_sparse_pull`."""
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def synthetic_libsvm(path, num_examples=2000, num_features=100, seed=0):
+    """LibSVM file with a learnable linear rule."""
+    rs = np.random.RandomState(seed)
+    w_true = rs.randn(num_features)
+    with open(path, "w") as f:
+        for _ in range(num_examples):
+            nnz = rs.randint(5, 20)
+            idx = np.sort(rs.choice(num_features, nnz, replace=False))
+            val = rs.randn(nnz)
+            label = 1 if float(val @ w_true[idx]) > 0 else 0
+            feats = " ".join(f"{i}:{v:.4f}" for i, v in zip(idx, val))
+            f.write(f"{label} {feats}\n")
+
+
+def linear_model(num_features):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    # generic dot has no param-shape rule, so declare the weight shape
+    weight = mx.sym.Variable("weight", stype="row_sparse",
+                             shape=(num_features, 2))
+    bias = mx.sym.Variable("bias", shape=(2,))
+    dot = mx.sym.sparse_dot(data, weight) if hasattr(mx.sym, "sparse_dot") \
+        else mx.sym.dot(data, weight)
+    pred = mx.sym.broadcast_add(dot, bias)
+    return mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+
+
+def main(args):
+    if args.data and os.path.exists(args.data):
+        path = args.data
+        num_features = args.num_features
+    else:
+        path = os.path.join(tempfile.gettempdir(), "synthetic.libsvm")
+        num_features = args.num_features
+        synthetic_libsvm(path, num_features=num_features)
+
+    train_iter = mx.io.LibSVMIter(data_libsvm=path,
+                                  data_shape=(num_features,),
+                                  batch_size=args.batch_size,
+                                  label_name="softmax_label")
+    sym = linear_model(num_features)
+    mod = mx.mod.Module(sym, label_names=["softmax_label"])
+    mod.fit(train_iter,
+            num_epoch=args.epochs,
+            optimizer=args.optimizer,
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Normal(0.01),
+            eval_metric="accuracy",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    metric = mx.metric.Accuracy()
+    train_iter.reset()
+    mod.score(train_iter, metric)
+    logging.info("final train accuracy: %.3f", metric.get()[1])
+    return metric.get()[1]
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="sparse linear classifier")
+    parser.add_argument("--data", type=str, default=None)
+    parser.add_argument("--num-features", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--optimizer", type=str, default="sgd")
+    logging.basicConfig(level=logging.INFO, format="%(asctime)-15s %(message)s")
+    main(parser.parse_args())

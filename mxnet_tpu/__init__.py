@@ -49,6 +49,11 @@ from .model import save_checkpoint, load_checkpoint
 
 from . import parallel
 from . import profiler
+from . import contrib
+from . import executor_manager
+from . import kvstore_server
+from . import log
+from . import rtc
 from . import test_utils
 from . import visualization as viz
 from . import visualization

@@ -160,9 +160,110 @@ def _replay(tape: List[_TapeEntry], var_ids: List[int], head_ids: List[int],
     return f
 
 
+# Structural cache for compiled backward programs. Re-running the same
+# model step records a tape with identical *structure* (ops, static attrs,
+# dataflow pattern, shapes) but fresh buffers; keying one jitted program per
+# structure makes step 2+ pure cache hits — the analogue of the reference
+# CachedOp's cached backward graph (src/imperative/cached_op.cc:1047), minus
+# the explicit hybridize call.
+_BWD_CACHE: Dict[tuple, "jax.stages.Wrapped"] = {}
+_BWD_CACHE_MAX = 512
+
+
+def _hashable_attr(v):
+    """Hashable stand-in for an op attr — used ONLY in cache keys, never
+    passed back to the op."""
+    if isinstance(v, (list, tuple)):
+        return ("__seq__",) + tuple(_hashable_attr(x) for x in v)
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+def _canonical_program(tape, var_ids, head_ids, head_fallback):
+    """Canonicalize the tape into (structure_key, pure_fn, const_vals, dyn_kw).
+
+    pure_fn(var_vals, const_vals, dyn_kw) -> head values. Buffers captured by
+    the tape become *arguments* (not closure constants) so one jitted program
+    serves every step with this structure.
+    """
+    id_map: Dict[int, int] = {}
+
+    def cid(i):
+        return id_map.setdefault(i, len(id_map))
+
+    var_cids = [cid(i) for i in var_ids]
+    known = set(var_ids)
+    const_vals: List = []
+    dyn_kw: List = []
+    steps = []   # (fn, in_binds, static_kw, dyn_kw_names, out_cids)
+    key_parts = [tuple(var_cids)]
+    for e in tape:
+        in_binds = []
+        for hid, val in zip(e.in_ids, e.in_vals):
+            if hid in known:
+                in_binds.append((0, cid(hid)))
+            else:
+                in_binds.append((1, len(const_vals)))
+                const_vals.append(val)
+        static_kw = {}   # ORIGINAL values, replayed verbatim
+        key_kw = {}      # hashable stand-ins, cache key only
+        dyn_names = []
+        for k in sorted(e.kwargs):
+            v = e.kwargs[k]
+            if hasattr(v, "dtype") and hasattr(v, "shape"):
+                dyn_names.append(k)
+                dyn_kw.append(v)
+            else:
+                static_kw[k] = v
+                key_kw[k] = _hashable_attr(v)
+        out_cids = tuple(cid(o) for o in e.out_ids)
+        known.update(e.out_ids)
+        in_binds = tuple(in_binds)
+        steps.append((e.fn, in_binds, static_kw, tuple(dyn_names), out_cids))
+        key_parts.append((e.fn, in_binds, tuple(sorted(key_kw.items())),
+                          tuple(dyn_names), out_cids))
+    head_binds = []
+    for i, h in enumerate(head_ids):
+        if h in known:
+            head_binds.append((0, id_map[h]))
+        else:
+            head_binds.append((1, len(const_vals)))
+            const_vals.append(head_fallback[h])
+    key_parts.append(tuple(head_binds))
+
+    def pure_fn(var_vals, consts, dyn):
+        env: Dict[int, object] = dict(zip(var_cids, var_vals))
+        di = 0
+        for fn, in_binds, static_kw, dyn_names, out_cids in steps:
+            ins = [env[i] if kind == 0 else consts[i] for kind, i in in_binds]
+            kw = dict(static_kw)
+            for name in dyn_names:
+                kw[name] = dyn[di]
+                di += 1
+            out = fn(*ins, **kw)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for oc, o in zip(out_cids, outs):
+                env[oc] = o
+        return [env[i] if kind == 0 else consts[i] for kind, i in head_binds]
+
+    return tuple(key_parts), pure_fn, const_vals, dyn_kw
+
+
+def _sig(vals):
+    return tuple((tuple(v.shape), str(getattr(v, "dtype", type(v))))
+                 for v in vals)
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Compute gradients of heads w.r.t. all marked variables and write them
-    into the variables' grad buffers (reference: Imperative::Backward)."""
+    into the variables' grad buffers (reference: Imperative::Backward).
+
+    The whole backward pass runs as ONE jitted XLA program, cached on the
+    tape's structure — repeated steps of the same model skip tracing and
+    compilation entirely."""
     st = _st()
     heads = list(heads)
     tape = st.tape
@@ -182,14 +283,49 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     head_ids = [id(h) for h in heads]
     head_fallback = {id(h): h._data for h in heads}
 
-    f = _replay(tape, var_ids, head_ids, head_fallback)
-    primals, vjp_fn = jax.vjp(f, var_vals)
-    if head_grads is None:
-        cts = [jnp.ones_like(p) for p in primals]
+    hg_vals = None
+    hg_pattern = None
+    if head_grads is not None:
+        hg_pattern = tuple(hg is not None for hg in head_grads)
+        hg_vals = [hg._data for hg in head_grads if hg is not None]
+
+    if any(getattr(e.fn, "_mxtpu_custom", False) for e in tape):
+        # custom autograd.Function entries may use concrete values
+        # (asnumpy, python branching) in backward, and their per-call
+        # closures would defeat the structural cache — run the eager
+        # vjp path for those graphs
+        f = _replay(tape, var_ids, head_ids, head_fallback)
+        primals, vjp_fn = jax.vjp(f, var_vals)
+        if hg_pattern is None:
+            cts = [jnp.ones_like(p) for p in primals]
+        else:
+            it = iter(hg_vals)
+            cts = [next(it) if has else jnp.ones_like(p)
+                   for p, has in zip(primals, hg_pattern)]
+        (grads,) = vjp_fn(cts)
     else:
-        cts = [jnp.ones_like(p) if hg is None else hg._data
-               for p, hg in zip(primals, head_grads)]
-    (grads,) = vjp_fn(cts)
+        key, pure_fn, const_vals, dyn_kw = _canonical_program(
+            tape, var_ids, head_ids, head_fallback)
+        full_key = (key, _sig(var_vals), _sig(const_vals), _sig(dyn_kw),
+                    hg_pattern, _sig(hg_vals or []))
+
+        bwd = _BWD_CACHE.get(full_key)
+        if bwd is None:
+            def bwd_fn(var_vals, consts, dyn, hg):
+                primals, vjp_fn = jax.vjp(
+                    lambda vv: pure_fn(vv, consts, dyn), var_vals)
+                if hg_pattern is None:
+                    cts = [jnp.ones_like(p) for p in primals]
+                else:
+                    it = iter(hg)
+                    cts = [next(it) if has else jnp.ones_like(p)
+                           for p, has in zip(primals, hg_pattern)]
+                return vjp_fn(cts)[0]
+
+            while len(_BWD_CACHE) >= _BWD_CACHE_MAX:
+                _BWD_CACHE.pop(next(iter(_BWD_CACHE)))  # evict oldest
+            bwd = _BWD_CACHE[full_key] = jax.jit(bwd_fn)
+        grads = bwd(var_vals, const_vals, dyn_kw, hg_vals or [])
     for (hid, v, g, req), gv in zip(var_entries, grads):
         if req == "null":
             continue
@@ -233,9 +369,11 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         (grads,) = vjp_fn(cts)
         outs = [NDArray(g) for g in grads]
         # record a tape entry so a further backward can differentiate through
+        _grad_of = lambda *vals, **kw: tuple(jax.vjp(f, list(vals))[1](  # noqa: E731
+            [jnp.ones_like(p) for p in jax.eval_shape(f, list(vals))])[0])
+        _grad_of._mxtpu_custom = True  # per-call closure; skip backward jit cache
         entry = _TapeEntry(
-            lambda *vals, **kw: tuple(jax.vjp(f, list(vals))[1](
-                [jnp.ones_like(p) for p in jax.eval_shape(f, list(vals))])[0]),
+            _grad_of,
             {}, var_ids, var_vals, [id(o) for o in outs], "_grad_of", list(outs))
         if st.recording:
             st.tape.append(entry)
@@ -303,17 +441,27 @@ class Function:
 def _make_custom_vjp(func: Function, n_in: int, n_out: int):
     from .ndarray.ndarray import NDArray
 
-    @jax.custom_vjp
-    def fn(*vals):
+    def _run_forward(vals):
         with pause():
             outs = func.forward(*[NDArray(v) for v in vals])
         outs = outs if isinstance(outs, (tuple, list)) else (outs,)
         return tuple(o._data for o in outs)
 
-    def fwd(*vals):
-        return fn(*vals), vals
+    @jax.custom_vjp
+    def fn(*vals):
+        return _run_forward(vals)
 
-    def bwd(res, gs):
+    def fwd(*vals):
+        outs = _run_forward(vals)
+        # saved_tensors must travel through custom_vjp residuals: fwd and
+        # bwd are traced separately (e.g. inside the jitted backward
+        # program), so state stashed on `self` would leak tracers
+        saved = tuple(s._data if isinstance(s, NDArray) else s
+                      for s in (func._saved or ()))
+        return outs, saved
+
+    def bwd(saved, gs):
+        func._saved = tuple(NDArray(s) for s in saved)
         with pause():
             grads = func.backward(*[NDArray(g) for g in gs])
         grads = grads if isinstance(grads, (tuple, list)) else (grads,)
@@ -321,5 +469,8 @@ def _make_custom_vjp(func: Function, n_in: int, n_out: int):
 
     fn.defvjp(fwd, bwd)
     if n_out == 1:
-        return lambda *vals, **kw: fn(*vals)[0]
+        wrapper = lambda *vals, **kw: fn(*vals)[0]  # noqa: E731
+        wrapper._mxtpu_custom = True  # backward() skips jit for these tapes
+        return wrapper
+    fn._mxtpu_custom = True
     return fn

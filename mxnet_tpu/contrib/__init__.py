@@ -1,0 +1,10 @@
+"""Contrib namespace (reference: python/mxnet/contrib/ — quantization,
+text embeddings, tensorboard, onnx, contrib autograd/io/ndarray/symbol)."""
+from . import quantization  # noqa: F401
+from . import text  # noqa: F401
+from . import tensorboard  # noqa: F401
+from . import autograd  # noqa: F401
+from . import io  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import symbol  # noqa: F401
+from . import onnx  # noqa: F401

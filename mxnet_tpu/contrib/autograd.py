@@ -1,0 +1,19 @@
+"""Contrib autograd aliases (reference: python/mxnet/contrib/autograd.py —
+the pre-1.0 experimental API kept for script compat)."""
+from __future__ import annotations
+
+from ..autograd import (  # noqa: F401
+    record as train_section,
+    pause as test_section,
+    mark_variables,
+    backward,
+    grad,
+)
+
+__all__ = ["train_section", "test_section", "mark_variables", "backward",
+           "grad", "compute_gradient"]
+
+
+def compute_gradient(outputs):
+    """Reference: contrib/autograd.compute_gradient."""
+    backward(outputs)

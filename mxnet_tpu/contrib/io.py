@@ -1,0 +1,49 @@
+"""Contrib IO (reference: python/mxnet/contrib/io.py —
+DataLoaderIter wrapping a gluon DataLoader as a DataIter)."""
+from __future__ import annotations
+
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a gluon DataLoader into the Module DataIter interface."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size=getattr(loader, "_batch_size", 0) or
+                         getattr(loader, "batch_size", 0))
+        self._loader = loader
+        self._iter = iter(loader)
+        self._data_name = data_name
+        self._label_name = label_name
+        self._first = None
+
+    def _peek(self):
+        if self._first is None:
+            self._first = next(self._iter)
+        return self._first
+
+    @property
+    def provide_data(self):
+        data = self._peek()[0]
+        return [DataDesc(self._data_name, data.shape)]
+
+    @property
+    def provide_label(self):
+        batch = self._peek()
+        if len(batch) < 2:
+            return []
+        return [DataDesc(self._label_name, batch[1].shape)]
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._first = None
+
+    def next(self):
+        if self._first is not None:
+            batch, self._first = self._first, None
+        else:
+            batch = next(self._iter)
+        data, label = batch[0], (batch[1] if len(batch) > 1 else None)
+        return DataBatch([data], [label] if label is not None else [], pad=0)

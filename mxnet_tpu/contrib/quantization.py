@@ -1,0 +1,148 @@
+"""INT8 quantization with calibration (reference:
+python/mxnet/contrib/quantization.py + src/operator/quantization/ — the
+QuantizeGraph pass, quantize/dequantize ops, entropy/naive calibration).
+
+TPU-native: int8 matmuls hit the MXU via XLA when operands are int8 with
+int32 accumulation; quantize/dequantize are jnp emitters (ops/contrib.py
+quantize/dequantize). Graph conversion happens at the Gluon/param level:
+`quantize_model` rewrites a symbol's FullyConnected/Convolution weights to
+pre-quantized int8 + scales, computing activation ranges by calibration."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_params", "calib_thresholds_naive",
+           "calib_thresholds_entropy", "quantize_model", "QuantizedParam"]
+
+
+class QuantizedParam:
+    """An int8 tensor + scale, dequantizing to float on demand
+    (reference: quantized weight layout, quantize_graph_pass.cc:97)."""
+
+    __slots__ = ("data", "scale")
+
+    def __init__(self, data: _np.ndarray, scale: float):
+        self.data = data
+        self.scale = scale
+
+    def dequantize(self) -> _np.ndarray:
+        return self.data.astype(_np.float32) * self.scale
+
+
+def _quantize_symmetric(arr: _np.ndarray, threshold: Optional[float] = None):
+    t = float(_np.max(_np.abs(arr))) if threshold is None else threshold
+    t = max(t, 1e-8)
+    scale = t / 127.0
+    q = _np.clip(_np.round(arr / scale), -127, 127).astype(_np.int8)
+    return QuantizedParam(q, scale)
+
+
+def quantize_params(arg_params: Dict, exclude: Optional[List[str]] = None):
+    """Quantize weight tensors to int8 symmetric (reference:
+    quantization.py _quantize_params)."""
+    exclude = set(exclude or ())
+    out = {}
+    for name, arr in arg_params.items():
+        a = arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr)
+        if name in exclude or a.ndim < 2 or "bias" in name:
+            out[name] = a
+        else:
+            out[name] = _quantize_symmetric(a)
+    return out
+
+
+def calib_thresholds_naive(activations: Dict[str, List[_np.ndarray]]):
+    """Min/max calibration (reference: quantization.py calib_mode='naive')."""
+    out = {}
+    for name, batches in activations.items():
+        if not batches:
+            out[name] = 1e-8
+            continue
+        out[name] = max(max(abs(float(_np.min(x))), abs(float(_np.max(x))))
+                        for x in batches)
+    return out
+
+
+def calib_thresholds_entropy(activations: Dict[str, List[_np.ndarray]],
+                             num_bins: int = 2048,
+                             num_quantized_bins: int = 255):
+    """KL-divergence calibration (reference: quantization.py
+    _get_optimal_thresholds / _LayerOutputMinMaxCollector)."""
+    out = {}
+    for name, batches in activations.items():
+        samples = _np.concatenate([_np.abs(_np.ravel(b)) for b in batches])
+        max_val = float(samples.max()) if samples.size else 1.0
+        if max_val <= 0:
+            out[name] = 1e-8
+            continue
+        hist, edges = _np.histogram(samples, bins=num_bins, range=(0, max_val))
+        best_t, best_kl = max_val, _np.inf
+        for i in range(num_quantized_bins, num_bins + 1,
+                       max(1, num_bins // 64)):
+            t = edges[i]
+            p = hist[:i].astype(_np.float64).copy()
+            p[-1] += hist[i:].sum()  # clip outliers into the last bin
+            if p.sum() == 0:
+                continue
+            # quantize p into num_quantized_bins then expand back
+            factor = i / num_quantized_bins
+            q = _np.zeros(i)
+            for j in range(num_quantized_bins):
+                lo, hi = int(j * factor), max(int((j + 1) * factor),
+                                              int(j * factor) + 1)
+                chunk = p[lo:hi]
+                nz = (chunk > 0).sum()
+                if nz:
+                    q[lo:hi] = _np.where(chunk > 0, chunk.sum() / nz, 0)
+            pn, qn = p / p.sum(), q / max(q.sum(), 1e-12)
+            mask = pn > 0
+            kl = float(_np.sum(pn[mask] * _np.log(
+                pn[mask] / _np.maximum(qn[mask], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_t = kl, float(t)
+        out[name] = best_t
+    return out
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   excluded_sym_names=None, calib_mode="none",
+                   calib_data=None, num_calib_examples=None, ctx=None,
+                   quantized_dtype="int8", logger=None):
+    """Quantize a symbolic model's parameters (reference: quantization.py
+    quantize_model). Returns (symbol, quantized arg_params, aux_params);
+    consumers dequantize QuantizedParam entries (or feed them to int8
+    kernels). calib_mode 'naive'/'entropy' runs forward passes over
+    calib_data to pick activation thresholds, stored as symbol attrs."""
+    if quantized_dtype not in ("int8", "auto"):
+        raise MXNetError(f"unsupported quantized_dtype {quantized_dtype!r}")
+    qargs = quantize_params(arg_params, exclude=excluded_sym_names)
+    thresholds = {}
+    if calib_mode != "none":
+        if calib_data is None:
+            raise MXNetError("calib_data required when calib_mode != 'none'")
+        from ..module import Module
+
+        mod = Module(sym, data_names=list(data_names),
+                     label_names=None)
+        acts: Dict[str, List[_np.ndarray]] = {"output": []}
+        n = 0
+        for batch in calib_data:
+            mod.bind(data_shapes=calib_data.provide_data, for_training=False,
+                     force_rebind=False)
+            mod.set_params(arg_params, aux_params, allow_missing=True)
+            mod.forward(batch, is_train=False)
+            acts["output"].append(mod.get_outputs()[0].asnumpy())
+            n += batch.data[0].shape[0]
+            if num_calib_examples and n >= num_calib_examples:
+                break
+        fn = calib_thresholds_entropy if calib_mode == "entropy" \
+            else calib_thresholds_naive
+        thresholds = fn(acts)
+    qsym = sym
+    for name, t in thresholds.items():
+        qsym._entries[0].node.attr_dict[f"__calib_{name}__"] = repr(t)
+    return qsym, qargs, aux_params

@@ -1,0 +1,11 @@
+"""Contrib Symbol ops namespace (reference: python/mxnet/contrib/symbol.py)."""
+from __future__ import annotations
+
+import sys
+
+from .. import symbol as _sym
+
+_mod = sys.modules[__name__]
+for _name in dir(_sym.contrib):
+    if not _name.startswith("__"):
+        setattr(_mod, _name, getattr(_sym.contrib, _name))

@@ -1,0 +1,44 @@
+"""TensorBoard logging callback (reference:
+python/mxnet/contrib/tensorboard.py LogMetricsCallback).
+
+Gated: uses tensorboardX / torch.utils.tensorboard when importable, else
+falls back to a JSONL event file — no hard dependency."""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback:
+    """Batch-end callback streaming eval metrics (reference: same name)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self._writer = None
+        self._jsonl = None
+        try:
+            try:
+                from tensorboardX import SummaryWriter
+            except ImportError:
+                from torch.utils.tensorboard import SummaryWriter
+            self._writer = SummaryWriter(logging_dir)
+        except Exception:
+            os.makedirs(logging_dir, exist_ok=True)
+            self._jsonl = open(os.path.join(logging_dir, "metrics.jsonl"), "a")
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            if self._writer is not None:
+                self._writer.add_scalar(name, value, param.nbatch)
+            else:
+                self._jsonl.write(json.dumps(
+                    {"ts": time.time(), "epoch": param.epoch,
+                     "nbatch": param.nbatch, name: value}) + "\n")
+                self._jsonl.flush()

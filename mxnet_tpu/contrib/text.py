@@ -1,0 +1,182 @@
+"""Text utilities: vocabulary + pretrained-style embeddings (reference:
+python/mxnet/contrib/text/ — vocab.py Vocabulary, embedding.py
+TokenEmbedding/CustomEmbedding/register).
+
+No-egress note: the reference downloads GloVe/fastText archives; here
+embeddings load from local files (same .txt/.vec format) via
+CustomEmbedding, and the registry is preserved for API parity."""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray import array as nd_array
+
+__all__ = ["Vocabulary", "CustomEmbedding", "register", "create",
+           "get_pretrained_file_names"]
+
+_EMBED_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls):
+    """Reference: embedding.register — registry of embedding types."""
+    _EMBED_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    name = embedding_name.lower()
+    if name not in _EMBED_REGISTRY:
+        raise MXNetError(
+            f"unknown embedding {embedding_name!r}; registered: "
+            f"{sorted(_EMBED_REGISTRY)} (pretrained archives require local "
+            "files on TPU builds — use CustomEmbedding)")
+    return _EMBED_REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Reference API; TPU builds have no downloader, so the answer is the
+    registered custom types."""
+    return {name: [] for name in _EMBED_REGISTRY}
+
+
+class Vocabulary:
+    """Token vocabulary with frequency cutoff and reserved tokens
+    (reference: contrib/text/vocab.py)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        self._unknown_token = unknown_token
+        reserved_tokens = list(reserved_tokens or [])
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for token, freq in pairs:
+                if freq < min_freq or token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        tokens = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in tokens]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        indices = [indices] if single else indices
+        toks = []
+        for i in indices:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError(f"index {i} out of vocabulary range")
+            toks.append(self._idx_to_token[i])
+        return toks[0] if single else toks
+
+
+@register
+class CustomEmbedding:
+    """Token embedding loaded from a local whitespace text file of
+    `token v1 v2 ...` lines (reference: embedding.CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path=None, elem_delim=" ",
+                 encoding="utf8", vocabulary=None, **kwargs):
+        self._token_to_idx = {"<unk>": 0}
+        self._idx_to_token = ["<unk>"]
+        vectors = [None]  # placeholder for <unk>
+        dim = None
+        if pretrained_file_path is not None:
+            with open(pretrained_file_path, encoding=encoding) as f:
+                for line in f:
+                    parts = line.rstrip().split(elem_delim)
+                    if len(parts) < 2:
+                        continue
+                    token, vec = parts[0], [float(x) for x in parts[1:]]
+                    if dim is None:
+                        dim = len(vec)
+                    elif len(vec) != dim:
+                        continue
+                    if token in self._token_to_idx:
+                        continue
+                    self._token_to_idx[token] = len(self._idx_to_token)
+                    self._idx_to_token.append(token)
+                    vectors.append(vec)
+        dim = dim or 1
+        vectors[0] = [0.0] * dim
+        table = _np.asarray(vectors, dtype=_np.float32)
+        if vocabulary is not None:
+            rows = _np.zeros((len(vocabulary), dim), dtype=_np.float32)
+            for token, i in vocabulary.token_to_idx.items():
+                j = self._token_to_idx.get(token)
+                if j is not None:
+                    rows[i] = table[j]
+            self._token_to_idx = dict(vocabulary.token_to_idx)
+            self._idx_to_token = list(vocabulary.idx_to_token)
+            table = rows
+        self._idx_to_vec = nd_array(table)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return self._idx_to_vec.shape[1]
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        tokens = [tokens] if single else tokens
+        idx = []
+        for t in tokens:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            idx.append(0 if i is None else i)
+        vecs = self._idx_to_vec.asnumpy()[idx]
+        return nd_array(vecs[0] if single else vecs)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        tokens = [tokens] if isinstance(tokens, str) else tokens
+        arr = _np.array(self._idx_to_vec.asnumpy())  # writable copy
+        new = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else _np.asarray(new_vectors)
+        new = new.reshape(len(tokens), -1)
+        for t, v in zip(tokens, new):
+            if t not in self._token_to_idx:
+                raise MXNetError(f"token {t!r} unknown")
+            arr[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd_array(arr)

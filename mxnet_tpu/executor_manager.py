@@ -1,0 +1,146 @@
+"""Legacy multi-device executor manager (reference:
+python/mxnet/executor_manager.py, 444 LoC — _split_input_slice,
+DataParallelExecutorManager used by the FeedForward API).
+
+TPU-native: per-device executor lists collapse to one SPMD program; the
+manager keeps the reference's API for FeedForward-era scripts while slicing
+work across local devices."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import cpu
+from .ndarray import array as nd_array
+
+__all__ = ["_split_input_slice", "_load_data", "_load_label",
+           "DataParallelExecutorManager"]
+
+
+def _split_input_slice(batch_size: int, work_load_list: List[float]):
+    """Slice a batch across devices proportional to workload
+    (reference: executor_manager.py _split_input_slice)."""
+    total = sum(work_load_list)
+    if total <= 0:
+        raise MXNetError("invalid work_load_list")
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            end = batch_size
+        else:
+            end = start + int(round(batch_size * w / total))
+        if end > batch_size:
+            raise MXNetError("too many slices — batch size too small")
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+def _load_general(data, targets, slices=None):
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, list):
+            for (sl, d_dst) in d_targets:
+                d_dst[:] = nd_array(d_src.asnumpy()[sl])
+        else:
+            d_targets[:] = d_src
+
+
+def _load_data(batch, targets, slices=None):
+    _load_general(batch.data, targets, slices)
+
+
+def _load_label(batch, targets, slices=None):
+    _load_general(batch.label, targets, slices)
+
+
+class DataParallelExecutorManager:
+    """Per-device executor group for the legacy FeedForward path
+    (reference: executor_manager.py DataParallelExecutorManager). Each device
+    slice binds its own executor; params are shared (one copy — XLA handles
+    device placement)."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names, param_names,
+                 aux_names, work_load_list=None, logger=None,
+                 sym_gen=None):
+        self.symbol = symbol
+        self.ctx = ctx if isinstance(ctx, (list, tuple)) else [ctx]
+        num_device = len(self.ctx)
+        if work_load_list is None:
+            work_load_list = [1.0] * num_device
+        assert len(work_load_list) == num_device
+        self.work_load_list = work_load_list
+        self.arg_names = arg_names
+        self.param_names = param_names
+        self.aux_names = aux_names
+        self.batch_size = train_data.batch_size
+        self.slices = _split_input_slice(self.batch_size, work_load_list)
+
+        data_shapes = {d.name: (self.batch_size,) + tuple(d.shape[1:])
+                       for d in train_data.provide_data}
+        label_shapes = {l.name: (self.batch_size,) + tuple(l.shape[1:])
+                        for l in train_data.provide_label}
+        self._exec = symbol.simple_bind(ctx=self.ctx[0], grad_req="write",
+                                        **data_shapes, **label_shapes)
+        self._data_names = list(data_shapes)
+        self._label_names = list(label_shapes)
+
+    @property
+    def param_arrays(self):
+        argmap = dict(zip(self.symbol.list_arguments(), self._exec.arg_arrays))
+        return [[argmap[name]] for name in self.param_names]
+
+    @property
+    def grad_arrays(self):
+        gradmap = dict(zip(self.symbol.list_arguments(), self._exec.grad_arrays))
+        return [[gradmap[name]] for name in self.param_names]
+
+    @property
+    def aux_arrays(self):
+        return [[a] for a in self._exec.aux_arrays]
+
+    def install_monitor(self, monitor):
+        monitor.install(self._exec)
+
+    def set_params(self, arg_params, aux_params):
+        argmap = dict(zip(self.symbol.list_arguments(), self._exec.arg_arrays))
+        for name, arr in arg_params.items():
+            if name in argmap:
+                argmap[name][:] = arr
+        auxmap = dict(zip(self.symbol.list_auxiliary_states(),
+                          self._exec.aux_arrays))
+        for name, arr in aux_params.items():
+            if name in auxmap:
+                auxmap[name][:] = arr
+
+    def copy_to(self, arg_params, aux_params):
+        argmap = dict(zip(self.symbol.list_arguments(), self._exec.arg_arrays))
+        for name in self.param_names:
+            arg_params[name] = argmap[name].copy()
+        auxmap = dict(zip(self.symbol.list_auxiliary_states(),
+                          self._exec.aux_arrays))
+        for name, arr in auxmap.items():
+            aux_params[name] = arr.copy()
+
+    def load_data_batch(self, data_batch):
+        self._batch = data_batch
+
+    def forward(self, is_train=False):
+        feed = {}
+        for name, arr in zip(self._data_names, self._batch.data):
+            feed[name] = arr
+        for name, arr in zip(self._label_names, self._batch.label or []):
+            feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self):
+        self._exec.backward()
+
+    @property
+    def curr_execgrp(self):
+        return self
+
+    def update_metric(self, metric, labels, pre_sliced=False):
+        metric.update(labels, self._exec.outputs)

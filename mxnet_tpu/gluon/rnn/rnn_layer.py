@@ -24,7 +24,11 @@ class _RNNLayer(HybridBlock):
         with self.name_scope():
             shape = (rnn_param_size(mode, num_layers, input_size, hidden_size,
                                     bidirectional),) if input_size else (0,)
+            # param-level init: the fused blob is 1-D, so shape-sensitive
+            # global initializers (Xavier/MSRA) must not reach it — the
+            # reference routes fused blobs to init.FusedRNN the same way
             self.parameters = self.params.get("parameters", shape=shape,
+                                              init="uniform",
                                               allow_deferred_init=True)
 
     def _param_shape(self, param, args):

@@ -452,7 +452,7 @@ class LibSVMIter(DataIter):
     """LibSVM sparse reader (reference: src/io/iter_libsvm.cc)."""
 
     def __init__(self, data_libsvm, data_shape, label_shape=(1,), batch_size=1,
-                 **kwargs):
+                 data_name="data", label_name="label", **kwargs):
         super().__init__(batch_size)
         feats = []
         labels = []
@@ -469,7 +469,8 @@ class LibSVMIter(DataIter):
                     row[int(k)] = float(v)
                 feats.append(row)
         self._inner = NDArrayIter(_np.stack(feats), _np.asarray(labels),
-                                  batch_size=batch_size, label_name="label")
+                                  batch_size=batch_size, data_name=data_name,
+                                  label_name=label_name)
         self.provide_data = self._inner.provide_data
         self.provide_label = self._inner.provide_label
 

@@ -1,0 +1,426 @@
+"""Distributed KVStore: TCP parameter server over DCN.
+
+Reference: ps-lite worker/server (``src/kvstore/kvstore_dist.h``,
+``kvstore_dist_server.h``) — workers ZPush/ZPull values by key; in BSP sync
+mode the server merges exactly ``num_workers`` pushes per key per round
+before replying to pulls (``kvstore_dist_server.h:346-358``); async applies
+the updater immediately per push; rank 0 of the job may run the optimizer
+server-side (``kvstore_dist.h:130`` RunServer, ``python/mxnet/kvstore_server.py``).
+
+TPU-native position (SURVEY.md §5.8): *gradient* traffic inside a pod slice
+belongs to XLA collectives over ICI (``tpu_sync``); this PS exists for the
+reference's cross-pod/DCN tier — parameter init broadcast, barriers,
+rank/size bookkeeping, heartbeat liveness (num_dead_node), sharded
+row_sparse pulls — and for full API/test parity with the reference's
+``dist_sync`` / ``dist_async`` / ``dist_device_sync`` modes, runnable as
+plain multi-process jobs via ``tools/launch.py`` exactly like the
+reference's nightly dist tests (``tests/nightly/dist_sync_kvstore.py``).
+
+Bootstrap env (set by tools/launch.py): ``MXTPU_COORDINATOR`` (host:port of
+rank 0's server), ``MXTPU_NUM_PROCS``, ``MXTPU_PROC_ID``.
+
+Wire protocol: 4-byte little-endian length + pickled (cmd, *args) tuples,
+one request/response per round-trip, a persistent socket per worker.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from .base import MXNetError
+from .kvstore import KVStore, _as_list
+from .ndarray import array as nd_array
+from .ndarray.ndarray import NDArray
+
+__all__ = ["KVStoreDist", "KVStoreDistServer"]
+
+
+# ------------------------------------------------------------------ wire
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket):
+    header = _recv_exact(sock, 4)
+    (length,) = struct.unpack("<I", header)
+    return pickle.loads(_recv_exact(sock, length))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+# ------------------------------------------------------------------ server
+
+
+class _KeyState:
+    __slots__ = ("value", "pending_sum", "pending_ranks", "version")
+
+    def __init__(self, value):
+        self.value = value           # numpy array (the stored weight)
+        self.pending_sum = None      # merge buffer for the current round
+        self.pending_ranks = set()   # ranks merged into the current round
+        self.version = 0             # bumps once per completed BSP round
+
+
+class KVStoreDistServer:
+    """The server half (reference: kvstore_dist_server.h).
+
+    BSP (`sync_mode=True`): pushes accumulate into a merge buffer; when
+    exactly num_workers pushes arrived the round commits — updater applied
+    (or plain replace) and version bumps; pulls for version v block until
+    the commit (the reference parks pull responses the same way, :346-358).
+    Async: every push applies immediately.
+    """
+
+    def __init__(self, host="0.0.0.0", port=0, num_workers=1):
+        self._keys: Dict[str, _KeyState] = {}
+        self._lock = threading.Condition()
+        self._num_workers = num_workers
+        self._updater = None
+        self._sync_mode = False
+        self._barrier_count = {}
+        self._heartbeats: Dict[int, float] = {}
+        self._stop = False
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._threads: List[threading.Thread] = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # -- command handlers ---------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                reply = self._handle(msg)
+                _send_msg(conn, reply)
+                if msg[0] == "shutdown":
+                    return
+        except (ConnectionError, EOFError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def _handle(self, msg):
+        cmd = msg[0]
+        if cmd == "init":
+            _, key, value = msg
+            with self._lock:
+                if key not in self._keys:  # first init wins (rank-0 broadcast)
+                    self._keys[key] = _KeyState(value)
+                self._lock.notify_all()
+            return ("ok",)
+        if cmd == "push":
+            _, key, rank, value = msg
+            return self._push(key, rank, value)
+        if cmd == "pull":
+            _, key, min_version = msg
+            return self._pull(key, min_version)
+        if cmd == "row_sparse_pull":
+            _, key, row_ids, min_version = msg
+            rep = self._pull(key, min_version)
+            if rep[0] != "ok":
+                return rep
+            return ("ok", rep[1][_np.asarray(row_ids, dtype=_np.int64)],
+                    rep[2])
+        if cmd == "barrier":
+            _, barrier_id = msg
+            with self._lock:
+                self._barrier_count[barrier_id] = \
+                    self._barrier_count.get(barrier_id, 0) + 1
+                self._lock.notify_all()
+                deadline = time.time() + 600
+                while self._barrier_count[barrier_id] % self._num_workers != 0:
+                    if not self._lock.wait(timeout=min(1.0, deadline - time.time())):
+                        if time.time() > deadline:
+                            return ("error", "barrier timeout")
+            return ("ok",)
+        if cmd == "set_sync":
+            self._sync_mode = bool(msg[1])
+            return ("ok",)
+        if cmd == "set_optimizer":
+            from .optimizer import Updater, Optimizer
+
+            opt = pickle.loads(msg[1])
+            self._updater = Updater(opt) if isinstance(opt, Optimizer) else opt
+            return ("ok",)
+        if cmd == "heartbeat":
+            _, rank = msg
+            with self._lock:
+                self._heartbeats[rank] = time.time()
+            return ("ok",)
+        if cmd == "num_dead_node":
+            _, timeout_s = msg
+            now = time.time()
+            with self._lock:
+                dead = sum(1 for r in range(self._num_workers)
+                           if now - self._heartbeats.get(r, 0) > timeout_s)
+            return ("ok", dead)
+        if cmd == "shutdown":
+            with self._lock:
+                self._barrier_count["__shutdown__"] = \
+                    self._barrier_count.get("__shutdown__", 0) + 1
+                if self._barrier_count["__shutdown__"] >= self._num_workers:
+                    self._stop = True
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+            return ("ok",)
+        return ("error", f"unknown command {cmd!r}")
+
+    def _push(self, key, rank, value):
+        with self._lock:
+            st = self._keys.get(key)
+            if st is None:
+                return ("error", f"push to uninitialized key {key!r}")
+            if not self._sync_mode:
+                # async: apply immediately (kvstore_dist_server.h async branch)
+                self._apply(st, key, value)
+                self._lock.notify_all()
+                return ("ok",)
+            # BSP: one contribution per rank per round — a fast worker's
+            # next-round push parks until the current round commits
+            # (the reference parks on per-timestamp merge buffers)
+            deadline = time.time() + 600
+            while rank in st.pending_ranks:
+                if not self._lock.wait(timeout=min(1.0, deadline - time.time())):
+                    if time.time() > deadline:
+                        return ("error", f"push timeout on {key!r}")
+                st = self._keys.get(key)
+            if st.pending_sum is None:
+                st.pending_sum = value.copy()
+            else:
+                st.pending_sum += value
+            st.pending_ranks.add(rank)
+            if len(st.pending_ranks) == self._num_workers:
+                self._apply(st, key, st.pending_sum)
+                st.pending_sum = None
+                st.pending_ranks = set()
+                st.version += 1
+                self._lock.notify_all()
+            return ("ok",)
+
+    def _apply(self, st: _KeyState, key, merged):
+        if self._updater is not None:
+            w = nd_array(st.value)
+            self._updater(key, nd_array(merged), w)
+            st.value = w.asnumpy()
+        else:
+            st.value = _np.asarray(merged)
+
+    def _pull(self, key, min_version):
+        with self._lock:
+            deadline = time.time() + 600
+            while True:
+                st = self._keys.get(key)
+                if st is not None and (min_version is None
+                                       or st.version >= min_version):
+                    return ("ok", st.value, st.version)
+                if not self._lock.wait(timeout=min(1.0, deadline - time.time())):
+                    if time.time() > deadline:
+                        return ("error", f"pull timeout on {key!r}")
+
+    def join(self):
+        self._accept_thread.join()
+
+
+# ------------------------------------------------------------------ client
+
+
+class KVStoreDist(KVStore):
+    """The worker half (reference: kvstore_dist.h KVStoreDist)."""
+
+    def __init__(self, name="dist_sync"):
+        super().__init__()
+        self._type = name
+        self._sync = "async" not in name
+        self._rank = int(os.environ.get("MXTPU_PROC_ID",
+                                        os.environ.get("TPUMX_RANK", "0")))
+        self._num = int(os.environ.get("MXTPU_NUM_PROCS",
+                                       os.environ.get("TPUMX_NUM_WORKERS", "1")))
+        coord = os.environ.get("MXTPU_COORDINATOR", "127.0.0.1:9027")
+        host, port = coord.rsplit(":", 1)
+        self._server: Optional[KVStoreDistServer] = None
+        if self._rank == 0:
+            # rank 0 hosts the server tier in-process (the reference runs
+            # separate server processes; one SPMD job needs no extra tier)
+            self._server = KVStoreDistServer(host="0.0.0.0", port=int(port),
+                                             num_workers=self._num)
+        self._sock = self._connect(host if self._rank else "127.0.0.1",
+                                   int(port))
+        self._sock_lock = threading.Lock()
+        self._pull_version: Dict[str, int] = {}
+        self._barrier_seq = 0
+        self._request("set_sync", self._sync)
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True)
+        self._hb_thread.start()
+
+    # -- plumbing -----------------------------------------------------------------
+
+    def _connect(self, host, port, timeout=60):
+        deadline = time.time() + timeout
+        while True:
+            try:
+                sock = socket.create_connection((host, port), timeout=10)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return sock
+            except OSError:
+                if time.time() > deadline:
+                    raise MXNetError(
+                        f"cannot reach kvstore server at {host}:{port}")
+                time.sleep(0.1)
+
+    def _request(self, *msg):
+        with self._sock_lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply[0] != "ok":
+            raise MXNetError(f"kvstore server error: {reply[1:]}")
+        return reply
+
+    def _heartbeat_loop(self):
+        sock = None
+        try:
+            host, port = self._sock.getpeername()
+            sock = self._connect(host, port)
+            while True:  # first beat immediately, then every second
+                _send_msg(sock, ("heartbeat", self._rank))
+                _recv_msg(sock)
+                if self._hb_stop.wait(1.0):
+                    break
+        except (OSError, ConnectionError, MXNetError):
+            pass
+        finally:
+            if sock is not None:
+                sock.close()
+
+    # -- KVStore API --------------------------------------------------------------
+
+    def init(self, key, value):
+        keys = _as_list(key)
+        values = _as_list(value)
+        for k, v in zip(keys, values):
+            self._request("init", str(k), v.asnumpy())
+            self._pull_version[str(k)] = 0
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        keys = _as_list(key)
+        values = _as_list(value)
+        for k, vs in zip(keys, values):
+            vs = _as_list(vs)
+            local = vs[0].asnumpy()
+            for v in vs[1:]:  # reduce device list locally first
+                local = local + v.asnumpy()
+            self._request("push", str(k), self._rank, local)
+            if self._sync:
+                self._pull_version[str(k)] = \
+                    self._pull_version.get(str(k), 0) + 1
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = _as_list(key)
+        outs = _as_list(out)
+        results = []
+        for k, o in zip(keys, outs):
+            min_version = self._pull_version.get(str(k)) if self._sync else None
+            rep = self._request("pull", str(k), min_version)
+            arr = rep[1]
+            for dst in _as_list(o):
+                dst[:] = nd_array(arr)
+            results.append(o)
+        return out
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        keys = _as_list(key)
+        outs = _as_list(out)
+        ids = _as_list(row_ids)
+        for k, o, rid in zip(keys, outs, ids):
+            min_version = self._pull_version.get(str(k)) if self._sync else None
+            rid_np = rid.asnumpy().astype(_np.int64)
+            rep = self._request("row_sparse_pull", str(k), rid_np, min_version)
+            for dst in _as_list(o):
+                # local-kvstore semantics: full-shape out, requested rows
+                # filled, others zero (kvstore.h:209-223)
+                full = _np.zeros(dst.shape, dtype=rep[1].dtype)
+                full[rid_np] = rep[1]
+                dst[:] = nd_array(full)
+        return out
+
+    def set_optimizer(self, optimizer):
+        if self._rank == 0:
+            self._request("set_optimizer", pickle.dumps(optimizer))
+        self.barrier()
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num
+
+    def barrier(self):
+        self._barrier_seq += 1
+        self._request("barrier", f"b{self._barrier_seq}")
+
+    def num_dead_node(self, node_id=0, timeout=60):
+        """Reference: KVStore::get_num_dead_node via ps-lite heartbeats
+        (include/mxnet/kvstore.h:353)."""
+        return int(self._request("num_dead_node", float(timeout))[1])
+
+    def _barrier_before_exit(self):
+        self.close()
+
+    def close(self):
+        if self._hb_stop.is_set():
+            return
+        self._hb_stop.set()
+        try:
+            self._request("shutdown")
+        except (MXNetError, ConnectionError, OSError):
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

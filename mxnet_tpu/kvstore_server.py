@@ -1,0 +1,41 @@
+"""Standalone KVStore server bootstrap (reference:
+python/mxnet/kvstore_server.py — server processes enter a blocking loop
+executing optimizer commands sent by workers).
+
+TPU-native: rank 0's KVStoreDist hosts the server tier in-process
+(kvstore_dist.py), so a separate server role is only needed when running a
+dedicated parameter-server host across DCN. `_init_kvstore_server_module`
+keeps the reference's entry point: if MXTPU_ROLE=server, start a server and
+block."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """Blocking server runner (reference: kvstore_server.py KVStoreServer)."""
+
+    def __init__(self, kvstore=None):
+        from .kvstore_dist import KVStoreDistServer
+
+        coord = os.environ.get("MXTPU_COORDINATOR", "127.0.0.1:9027")
+        port = int(coord.rsplit(":", 1)[1])
+        num = int(os.environ.get("MXTPU_NUM_PROCS",
+                                 os.environ.get("DMLC_NUM_WORKER", "1")))
+        self._server = KVStoreDistServer(host="0.0.0.0", port=port,
+                                         num_workers=num)
+
+    def run(self):
+        """Blocks until all workers sent shutdown."""
+        self._server.join()
+
+
+def _init_kvstore_server_module():
+    """Reference entry point: called at import when DMLC_ROLE=server."""
+    role = os.environ.get("MXTPU_ROLE", os.environ.get("DMLC_ROLE", ""))
+    if role == "server":
+        server = KVStoreServer()
+        server.run()
+        raise SystemExit(0)

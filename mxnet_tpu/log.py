@@ -1,0 +1,57 @@
+"""Colored logging helpers (reference: python/mxnet/log.py — get_logger with
+color formatter and level helpers)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING", "ERROR",
+           "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+PY3 = True
+
+_COLORS = {"WARNING": "\x1b[33m", "INFO": "\x1b[32m", "DEBUG": "\x1b[34m",
+           "CRITICAL": "\x1b[35m", "ERROR": "\x1b[31m"}
+_RESET = "\x1b[0m"
+
+
+class _Formatter(logging.Formatter):
+    def __init__(self, colored=True):
+        self.colored = colored
+        super().__init__(datefmt="%m%d %H:%M:%S")
+
+    def format(self, record):
+        fmt = "%(asctime)s %(name)s:%(lineno)d: %(message)s"
+        if self.colored and record.levelname in _COLORS:
+            head = (_COLORS[record.levelname] + "%(levelname).1s " + _RESET)
+        else:
+            head = "%(levelname).1s "
+        self._style._fmt = head + fmt
+        return super().format(record)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Reference: log.getLogger — logger with colored stderr or file handler."""
+    logger = logging.getLogger(name)
+    if name is not None and not getattr(logger, "_init_done", False):
+        logger._init_done = True
+        if filename:
+            mode = filemode if filemode else "a"
+            hdlr = logging.FileHandler(filename, mode)
+            hdlr.setFormatter(_Formatter(colored=False))
+        else:
+            hdlr = logging.StreamHandler(sys.stderr)
+            hdlr.setFormatter(_Formatter(
+                colored=getattr(sys.stderr, "isatty", lambda: False)()))
+        logger.addHandler(hdlr)
+        logger.setLevel(level)
+    return logger
+
+
+getLogger = get_logger

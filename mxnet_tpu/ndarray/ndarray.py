@@ -468,12 +468,53 @@ def _as_ndarray(x) -> NDArray:
 # (reference: src/imperative/imperative.cc:87)
 # ---------------------------------------------------------------------------
 
+# Per-op jit dispatch cache: one compiled program per (op, static attrs,
+# input signature). The eager analogue of the reference engine's cached oprs
+# + bulking (threaded_engine.h:469-507) and the build plan's "imperative mode
+# via op-by-op compile cache" (SURVEY.md §7 step 3). Ops whose emitters
+# contain control-flow primitives (lax.scan RNN, while-loops) would otherwise
+# re-trace their bodies on every eager call.
+_INVOKE_JIT_CACHE: dict = {}
+_INVOKE_JIT_MAX = 4096
+
+
+def _jitted_op(op: Op, kwargs: dict):
+    """Split attrs into static/dynamic and return (jitted_fn, dyn_vals)."""
+    from ..autograd import _hashable_attr
+
+    key_kw = []      # hashable stand-ins, cache key only
+    dyn_names = []
+    dyn_vals = []
+    for k in sorted(kwargs):
+        v = kwargs[k]
+        if hasattr(v, "dtype") and hasattr(v, "shape"):
+            dyn_names.append(k)
+            dyn_vals.append(v)
+        else:
+            key_kw.append((k, _hashable_attr(v)))
+    key = (op, tuple(key_kw), tuple(dyn_names))
+    fn = _INVOKE_JIT_CACHE.get(key)
+    if fn is None:
+        skw = dict(kwargs)  # ORIGINAL values; key mangling never reaches ops
+        for name in dyn_names:
+            del skw[name]
+
+        def call(vals, dyn):
+            return op.fn(*vals, **skw, **dict(zip(dyn_names, dyn)))
+
+        while len(_INVOKE_JIT_CACHE) >= _INVOKE_JIT_MAX:
+            _INVOKE_JIT_CACHE.pop(next(iter(_INVOKE_JIT_CACHE)))
+        fn = _INVOKE_JIT_CACHE[key] = jax.jit(call)
+    return fn, dyn_vals
+
+
 def invoke(op: Op, inputs: Sequence[NDArray], attrs: dict, out=None):
     """Dispatch an op eagerly and record it on the autograd tape if active.
 
     The reference's per-call pipeline (SetShapeType → SetDependency →
     PushFCompute, imperative_utils.h:199-499) collapses to: unwrap buffers,
-    call the jnp emitter (async dispatch), wrap outputs, append tape entry.
+    call the jnp emitter through the jit dispatch cache (async dispatch),
+    wrap outputs, append tape entry.
     """
     from .. import autograd
 
@@ -485,12 +526,27 @@ def invoke(op: Op, inputs: Sequence[NDArray], attrs: dict, out=None):
         kwargs["rng_key"] = _random.next_key()
     if _op_accepts_training(op):
         kwargs.setdefault("_training", autograd.is_training())
+    from .. import profiler as _profiler
+
+    _prof = _profiler._op_profiling()
+    _t0 = _profiler.time.perf_counter() if _prof else 0.0
     try:
-        result = op.fn(*vals, **kwargs)
+        if hasattr(op.fn, "lower"):
+            # already a jax.jit product (hybridized CachedOp) — no second wrap
+            result = op.fn(*vals, **kwargs)
+        else:
+            jfn, dyn_vals = _jitted_op(op, kwargs)
+            result = jfn(vals, dyn_vals)
     except MXNetError:
         raise
     except Exception as e:
         raise MXNetError(f"operator {op.name} failed: {e}") from e
+    if _prof:
+        # host dispatch span (device time lives in the jax trace) —
+        # the ProfileOperator analogue (src/engine/threaded_engine.h:337-346)
+        _t1 = _profiler.time.perf_counter()
+        _profiler._emit("X", op.name, "operator", ts=_t0 * 1e6,
+                        dur=(_t1 - _t0) * 1e6)
 
     multi = isinstance(result, (tuple, list))
     results = list(result) if multi else [result]

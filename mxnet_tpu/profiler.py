@@ -32,6 +32,7 @@ def set_config(filename="profile.json", profile_all=False, profile_symbolic=Fals
     """Reference: MXSetProcessProfilerConfig."""
     _state["filename"] = filename
     _state["aggregate"] = aggregate_stats
+    _state["imperative"] = bool(profile_imperative or profile_all)
 
 
 profiler_set_config = set_config
@@ -73,6 +74,12 @@ def pause(profile_process="worker"):
 
 def resume(profile_process="worker"):
     _state["running"] = True
+
+
+def _op_profiling() -> bool:
+    """True when per-op imperative profiling is active — checked by
+    ndarray.invoke (the ProfileOperator analogue, threaded_engine.h:337)."""
+    return _state["running"] and _state.get("imperative", False)
 
 
 def _emit(ph, name, cat, ts=None, dur=None, args=None):

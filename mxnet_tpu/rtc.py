@@ -1,0 +1,71 @@
+"""Runtime kernel compilation (reference: include/mxnet/rtc.h `CudaModule` /
+`CudaKernel` over NVRTC, src/common/rtc.cc).
+
+TPU-native: there is no user-facing source-string JIT for TPU; the analogue
+of "hand me a kernel at runtime" is a Pallas kernel or a jax function
+compiled on the fly. `XlaModule` fills the CudaModule API shape with a
+callable-based contract; `CudaModule` remains as a gated stub that raises
+with guidance, matching the reference's behavior when built without CUDA."""
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence
+
+import jax
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["CudaModule", "CudaKernel", "XlaModule", "XlaKernel"]
+
+
+class CudaModule:
+    """Gated stub (reference raises MXNetError when USE_CUDA=0 too;
+    src/common/rtc.cc is compiled out)."""
+
+    def __init__(self, source, options=(), exports=()):
+        raise MXNetError(
+            "CUDA RTC is not available on TPU builds. Use mxnet_tpu.rtc."
+            "XlaModule (jax/pallas callables compiled at runtime) instead.")
+
+
+class CudaKernel:
+    def __init__(self, *a, **kw):
+        raise MXNetError("CUDA RTC is not available on TPU builds; "
+                         "see mxnet_tpu.rtc.XlaModule")
+
+
+class XlaKernel:
+    """A compiled runtime kernel (the CudaKernel analogue)."""
+
+    def __init__(self, fn: Callable, name: str):
+        self._fn = jax.jit(fn)
+        self._name = name
+
+    def launch(self, args: Sequence, ctx=None, grid_dims=None,
+               block_dims=None, shared_mem=0):
+        """Run the kernel. grid/block dims are accepted for API parity and
+        ignored — XLA owns scheduling (pallas kernels set their own grid)."""
+        vals = [a._data if isinstance(a, NDArray) else a for a in args]
+        out = self._fn(*vals)
+        if isinstance(out, (tuple, list)):
+            return [NDArray(o) for o in out]
+        return NDArray(out)
+
+    __call__ = launch
+
+
+class XlaModule:
+    """Runtime 'module' of jax/pallas callables (the CudaModule analogue).
+
+    Pass callables (plain jax functions or `pl.pallas_call` wrappers) as
+    exports; `get_kernel` returns a compiled launcher."""
+
+    def __init__(self, exports: Dict[str, Callable] = None, **named):
+        self._exports = dict(exports or {})
+        self._exports.update(named)
+
+    def get_kernel(self, name: str, signature: str = "") -> XlaKernel:
+        if name not in self._exports:
+            raise MXNetError(f"no kernel {name!r} in module; "
+                             f"available: {sorted(self._exports)}")
+        return XlaKernel(self._exports[name], name)

@@ -1,0 +1,148 @@
+"""Contrib + frontend-leftover modules (reference: tests/python/unittest/
+test_contrib_text.py, quantization tests, executor_manager usage in
+model.py)."""
+import collections
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import quantization, text
+
+
+def test_vocabulary_basic():
+    counter = collections.Counter(
+        {"hello": 5, "world": 4, "rare": 1, "mid": 2})
+    v = text.Vocabulary(counter, min_freq=2, reserved_tokens=["<pad>"])
+    assert v.unknown_token == "<unk>"
+    assert v.to_tokens(0) == "<unk>"
+    assert v.to_indices("hello") == v.token_to_idx["hello"]
+    assert v.to_indices("rare") == 0  # below min_freq → unk
+    assert len(v) == 5  # unk, pad, hello, world, mid
+    assert v.to_tokens(v.to_indices(["hello", "world"])) == ["hello", "world"]
+
+
+def test_custom_embedding_from_file(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("cat 1.0 2.0 3.0\ndog 4.0 5.0 6.0\n")
+    emb = text.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("dog").asnumpy(), [4.0, 5.0, 6.0])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("unknown").asnumpy(), [0.0, 0.0, 0.0])
+    emb.update_token_vectors("cat", nd.array(np.array([[9., 9., 9.]])))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("cat").asnumpy(), [9.0, 9.0, 9.0])
+
+
+def test_embedding_with_vocabulary(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("a 1.0 1.0\nb 2.0 2.0\n")
+    v = text.Vocabulary(collections.Counter({"b": 2, "zzz": 3}))
+    emb = text.CustomEmbedding(str(p), vocabulary=v)
+    assert len(emb) == len(v)
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("b").asnumpy(), [2.0, 2.0])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("zzz").asnumpy(), [0.0, 0.0])  # no pretrained row
+
+
+def test_quantize_params_roundtrip():
+    w = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    q = quantization.quantize_params({"w": nd.array(w), "fc_bias": nd.array(w[0])})
+    assert isinstance(q["w"], quantization.QuantizedParam)
+    assert q["w"].data.dtype == np.int8
+    np.testing.assert_allclose(q["w"].dequantize(), w,
+                               atol=float(np.abs(w).max()) / 127 + 1e-6)
+    assert isinstance(q["fc_bias"], np.ndarray)  # biases stay fp32
+
+
+def test_calibration_thresholds():
+    acts = {"x": [np.array([-3.0, 0.5]), np.array([1.0, 2.0])]}
+    naive = quantization.calib_thresholds_naive(acts)
+    assert naive["x"] == 3.0
+    rs = np.random.RandomState(0)
+    acts2 = {"y": [rs.randn(1000).astype(np.float32) for _ in range(4)]}
+    ent = quantization.calib_thresholds_entropy(acts2, num_bins=256)
+    assert 0 < ent["y"] <= float(max(np.abs(b).max() for b in acts2["y"])) + 1e-6
+
+
+def test_quantize_model_no_calib():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    exe = out.simple_bind(data=(2, 8), softmax_label=(2,))
+    args = {k: v for k, v in zip(out.list_arguments(), exe.arg_arrays)
+            if k != "data" and k != "softmax_label"}
+    qsym, qargs, _ = quantization.quantize_model(out, args, {})
+    assert isinstance(qargs["fc_weight"], quantization.QuantizedParam)
+
+
+def test_split_input_slice():
+    from mxnet_tpu.executor_manager import _split_input_slice
+
+    slices = _split_input_slice(16, [1, 1, 1, 1])
+    assert [s.stop - s.start for s in slices] == [4, 4, 4, 4]
+    slices = _split_input_slice(10, [2, 1])
+    assert slices[0] == slice(0, 7) and slices[1] == slice(7, 10)
+
+
+def test_executor_manager_forward_backward():
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    it = mx.io.NDArrayIter(np.random.rand(8, 6).astype(np.float32),
+                           np.random.randint(0, 4, (8,)).astype(np.float32),
+                           batch_size=4, label_name="softmax_label")
+    mgr = DataParallelExecutorManager(
+        out, mx.cpu(), it, arg_names=out.list_arguments(),
+        param_names=[n for n in out.list_arguments()
+                     if n not in ("data", "softmax_label")],
+        aux_names=out.list_auxiliary_states())
+    batch = it.next()
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    mgr.backward()
+    metric = mx.metric.Accuracy()
+    mgr.update_metric(metric, batch.label)
+    assert metric.get()[1] >= 0.0
+    grads = mgr.grad_arrays
+    assert all(g[0] is not None for g in grads)
+
+
+def test_rtc_xla_module():
+    from mxnet_tpu import rtc
+
+    mod = rtc.XlaModule(saxpy=lambda a, x, y: a * x + y)
+    kern = mod.get_kernel("saxpy")
+    out = kern.launch([nd.array([2.0]), nd.array([3.0]), nd.array([1.0])])
+    assert float(out.asnumpy()[0]) == 7.0
+    with pytest.raises(mx.MXNetError):
+        rtc.CudaModule("__global__ void k() {}")
+
+
+def test_contrib_onnx_gated():
+    from mxnet_tpu.contrib import onnx as onnx_mod
+
+    with pytest.raises(mx.MXNetError):
+        onnx_mod.export_model(None, {}, [(1, 3)])
+
+
+def test_tensorboard_jsonl_fallback(tmp_path):
+    from collections import namedtuple
+
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+
+    cb = LogMetricsCallback(str(tmp_path / "tb"))
+    Param = namedtuple("Param", ["eval_metric", "nbatch", "epoch"])
+    m = mx.metric.Accuracy()
+    m.update([nd.array([1.0, 0.0])], [nd.array(np.eye(2, dtype=np.float32))])
+    cb(Param(m, 1, 0))
+    files = list((tmp_path / "tb").glob("*")) if (tmp_path / "tb").exists() \
+        else []
+    assert files or cb._writer is not None

@@ -1,0 +1,160 @@
+"""Multi-process distributed kvstore (reference test model:
+tests/nightly/dist_sync_kvstore.py run via `tools/launch.py -n W --launcher
+local` — real processes over localhost sockets, no mock transport)."""
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_workers(script, num_workers, timeout=120):
+    port = _free_port()
+    procs = []
+    for rank in range(num_workers):
+        env = dict(os.environ)
+        env.update({
+            "MXTPU_COORDINATOR": f"127.0.0.1:{port}",
+            "MXTPU_NUM_PROCS": str(num_workers),
+            "MXTPU_PROC_ID": str(rank),
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+            "MXTPU_NO_NATIVE": "1",  # keep worker startup light
+        })
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen([sys.executable, "-c", script],
+                                      env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    outs = []
+    ok = True
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out.decode())
+        ok = ok and p.returncode == 0
+    assert ok, "worker failure:\n" + "\n----\n".join(outs)
+    return outs
+
+
+COMMON = textwrap.dedent("""
+    import numpy as np
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create("{mode}")
+    rank, num = kv.rank, kv.num_workers
+""")
+
+
+def test_dist_sync_push_pull():
+    # BSP: each worker pushes rank+1; merged value must be sum over workers
+    script = COMMON.format(mode="dist_sync") + textwrap.dedent("""
+        kv.init("a", nd.array(np.zeros((4, 2), np.float32)))
+        for step in range(3):
+            kv.push("a", nd.array(np.full((4, 2), rank + 1, np.float32)))
+            out = nd.zeros((4, 2))
+            kv.pull("a", out=out)
+            expect = sum(r + 1 for r in range(num))
+            assert np.allclose(out.asnumpy(), expect), (step, out.asnumpy())
+        kv.barrier()
+        kv.close()
+        print("OK")
+    """)
+    for out in _run_workers(script, 3):
+        assert "OK" in out
+
+
+def test_dist_sync_with_server_optimizer():
+    # server-side updater: w -= lr * merged_grad (reference RunServer path)
+    script = COMMON.format(mode="dist_sync") + textwrap.dedent("""
+        kv.init("w", nd.array(np.ones((3,), np.float32)))
+        if rank == 0:
+            kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+        else:
+            kv.barrier()  # match set_optimizer's barrier
+        kv.push("w", nd.array(np.ones((3,), np.float32)))
+        out = nd.zeros((3,))
+        kv.pull("w", out=out)
+        # merged grad = num, w = 1 - 0.1 * num
+        assert np.allclose(out.asnumpy(), 1 - 0.1 * num, atol=1e-5), out.asnumpy()
+        kv.barrier()
+        kv.close()
+        print("OK")
+    """)
+    for out in _run_workers(script, 2):
+        assert "OK" in out
+
+
+def test_dist_async_applies_immediately():
+    script = COMMON.format(mode="dist_async") + textwrap.dedent("""
+        kv.init("x", nd.array(np.zeros((2,), np.float32)))
+        kv.barrier()
+        kv.push("x", nd.array(np.ones((2,), np.float32)))
+        kv.barrier()
+        out = nd.zeros((2,))
+        kv.pull("x", out=out)
+        # async without updater: last replace wins; value is SOME worker's
+        # push (1.0), not necessarily the sum
+        assert np.allclose(out.asnumpy(), 1.0), out.asnumpy()
+        kv.barrier()
+        kv.close()
+        print("OK")
+    """)
+    for out in _run_workers(script, 2):
+        assert "OK" in out
+
+
+def test_dist_row_sparse_pull_and_liveness():
+    script = COMMON.format(mode="dist_sync") + textwrap.dedent("""
+        w = np.arange(12).reshape(4, 3).astype(np.float32)
+        kv.init("emb", nd.array(w))
+        out = nd.zeros((4, 3))
+        kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 3]))
+        expect = np.zeros_like(w); expect[[1, 3]] = w[[1, 3]]
+        assert np.allclose(out.asnumpy(), expect), out.asnumpy()
+        dead = kv.num_dead_node(timeout=30)
+        assert dead == 0, dead
+        kv.barrier()
+        kv.close()
+        print("OK")
+    """)
+    for out in _run_workers(script, 2):
+        assert "OK" in out
+
+
+def test_dist_single_process_fallback():
+    # no launcher env: rank 0 / num 1, everything degenerates to local-ish
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    for var in ("MXTPU_PROC_ID", "MXTPU_NUM_PROCS"):
+        os.environ.pop(var, None)
+    os.environ["MXTPU_COORDINATOR"] = f"127.0.0.1:{_free_port()}"
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == 0 and kv.num_workers == 1
+    kv.init("k", nd.array(np.ones((2, 2), np.float32)))
+    kv.push("k", nd.array(np.full((2, 2), 2.0, np.float32)))
+    out = nd.zeros((2, 2))
+    kv.pull("k", out=out)
+    assert np.allclose(out.asnumpy(), 2.0)
+    kv.close()

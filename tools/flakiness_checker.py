@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Run one test many times to measure flakiness (reference:
+tools/flakiness_checker.py — repeats a nose test under random seeds)."""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="re-run a pytest node N times with distinct seeds")
+    parser.add_argument("test", help="pytest node id, e.g. tests/test_a.py::test_b")
+    parser.add_argument("-n", "--num-trials", type=int, default=30)
+    parser.add_argument("-s", "--seed", type=int, default=None,
+                        help="fixed seed for every trial (default: trial index)")
+    args = parser.parse_args()
+    failures = 0
+    for trial in range(args.num_trials):
+        env = dict(os.environ)
+        env["MXNET_TEST_SEED"] = str(args.seed if args.seed is not None
+                                     else trial)
+        rc = subprocess.run([sys.executable, "-m", "pytest", "-q", "-x",
+                             args.test], env=env).returncode
+        if rc != 0:
+            failures += 1
+            print(f"trial {trial}: FAILED (seed {env['MXNET_TEST_SEED']})")
+    print(f"{failures}/{args.num_trials} trials failed")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Pack an image directory / list file into RecordIO (reference:
+tools/im2rec.py + tools/im2rec.cc — list generation and record packing).
+
+Uses the native RecordIO writer (cpp/src/recordio.cc) when available. Images
+are encoded with PIL when importable, else stored as raw shape-prefixed
+buffers (recordio.pack_img fallback)."""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from mxnet_tpu import recordio
+
+
+IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive=True, exts=IMG_EXTS):
+    """Yield (relpath, label) with labels assigned per sorted subdirectory
+    (reference: im2rec.py list_image)."""
+    label_map = {}
+    entries = []
+    if recursive:
+        for dirpath, dirnames, filenames in sorted(os.walk(root)):
+            dirnames.sort()
+            for fname in sorted(filenames):
+                if fname.lower().endswith(exts):
+                    cat = os.path.relpath(dirpath, root)
+                    if cat not in label_map:
+                        label_map[cat] = len(label_map)
+                    entries.append((os.path.join(os.path.relpath(dirpath, root),
+                                                 fname), label_map[cat]))
+    else:
+        for fname in sorted(os.listdir(root)):
+            if fname.lower().endswith(exts):
+                entries.append((fname, 0))
+    return entries, label_map
+
+
+def write_list(entries, path):
+    with open(path, "w") as f:
+        for i, (relpath, label) in enumerate(entries):
+            f.write(f"{i}\t{label}\t{relpath}\n")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) >= 3:
+                yield int(parts[0]), float(parts[1]), parts[2]
+
+
+def load_image(path):
+    try:
+        from PIL import Image
+
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError as e:
+        raise RuntimeError("PIL required to read compressed images") from e
+
+
+def make_record(list_path, image_root, out_prefix, quality=95, resize=None):
+    rec_path = out_prefix + ".rec"
+    idx_path = out_prefix + ".idx"
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    count = 0
+    for idx, label, relpath in read_list(list_path):
+        img = load_image(os.path.join(image_root, relpath))
+        if resize:
+            from PIL import Image
+
+            h, w = img.shape[:2]
+            scale = resize / min(h, w)
+            img = np.asarray(Image.fromarray(img).resize(
+                (int(round(w * scale)), int(round(h * scale)))))
+        header = recordio.IRHeader(0, label, idx, 0)
+        writer.write_idx(idx, recordio.pack_img(header, img, quality=quality))
+        count += 1
+    writer.close()
+    return count
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="make an image list and/or pack images into RecordIO")
+    parser.add_argument("prefix", help="prefix for .lst/.rec/.idx outputs")
+    parser.add_argument("root", help="image directory root")
+    parser.add_argument("--list", action="store_true",
+                        help="only generate the .lst file")
+    parser.add_argument("--no-shuffle", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--resize", type=int, default=None)
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    args = parser.parse_args()
+
+    entries, label_map = list_images(args.root)
+    if not args.no_shuffle:
+        random.seed(100)
+        random.shuffle(entries)
+    if args.train_ratio < 1.0:
+        k = int(len(entries) * args.train_ratio)
+        write_list(entries[:k], args.prefix + "_train.lst")
+        write_list(entries[k:], args.prefix + "_val.lst")
+        lists = [args.prefix + "_train", args.prefix + "_val"]
+    else:
+        write_list(entries, args.prefix + ".lst")
+        lists = [args.prefix]
+    print(f"wrote {len(entries)} entries, {len(label_map)} classes")
+    if args.list:
+        return
+    for prefix in lists:
+        n = make_record(prefix + ".lst", args.root, prefix,
+                        quality=args.quality, resize=args.resize)
+        print(f"{prefix}.rec: {n} records")
+
+
+if __name__ == "__main__":
+    main()

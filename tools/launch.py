@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Multi-host job launcher (reference: tools/launch.py — dmlc tracker
+spawning scheduler/servers/workers over ssh/mpi/local).
+
+TPU-native: there is no parameter-server tier; every process is a worker in
+one SPMD job coordinated by the JAX distributed runtime over DCN
+(SURVEY.md §5.8). The launcher assigns each process
+MXTPU_COORDINATOR / MXTPU_NUM_PROCS / MXTPU_PROC_ID (consumed by
+mxnet_tpu.kvstore.create('dist_sync') → jax.distributed.initialize) and
+spawns them locally or over ssh."""
+import argparse
+import os
+import subprocess
+import sys
+import threading
+
+
+def worker_env(args, rank):
+    env = dict(os.environ)
+    env["MXTPU_COORDINATOR"] = args.coordinator
+    env["MXTPU_NUM_PROCS"] = str(args.num_workers)
+    env["MXTPU_PROC_ID"] = str(rank)
+    # reference env names kept for script compat (tools/launch.py DMLC_*)
+    env["DMLC_NUM_WORKER"] = str(args.num_workers)
+    env["DMLC_ROLE"] = "worker"
+    return env
+
+
+def launch_local(args, command):
+    procs = []
+    for rank in range(args.num_workers):
+        p = subprocess.Popen(command, shell=True,
+                             env=worker_env(args, rank))
+        procs.append(p)
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def launch_ssh(args, command):
+    hosts = []
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    assert hosts, "empty hostfile"
+    procs = []
+
+    def run(rank, host):
+        env_fwd = " ".join(
+            f"{k}={v}" for k, v in worker_env(args, rank).items()
+            if k.startswith(("MXTPU_", "DMLC_")))
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host,
+               f"cd {os.getcwd()} && env {env_fwd} {command}"]
+        procs.append(subprocess.Popen(cmd))
+
+    threads = []
+    for rank in range(args.num_workers):
+        t = threading.Thread(target=run,
+                             args=(rank, hosts[rank % len(hosts)]))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="launch a distributed mxnet_tpu job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("--launcher", choices=("local", "ssh"),
+                        default="local")
+    parser.add_argument("-H", "--hostfile", type=str, default=None)
+    parser.add_argument("--coordinator", type=str, default="127.0.0.1:9027",
+                        help="host:port of process 0 for DCN bootstrap")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    command = " ".join(args.command)
+    assert command, "no command given"
+    if args.launcher == "ssh":
+        assert args.hostfile, "--hostfile required for ssh launcher"
+        sys.exit(launch_ssh(args, command))
+    sys.exit(launch_local(args, command))
+
+
+if __name__ == "__main__":
+    main()

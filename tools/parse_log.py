@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Parse training logs into a speed/accuracy table (reference:
+tools/parse_log.py — extracts epoch, train/val accuracy, speed from fit
+logs)."""
+import argparse
+import re
+import sys
+
+
+def parse(fname):
+    with open(fname) as f:
+        lines = f.readlines()
+    res = [re.compile(r"Epoch\[(\d+)\] Train-(\S+)=([.\d]+)"),
+           re.compile(r"Epoch\[(\d+)\] Validation-(\S+)=([.\d]+)"),
+           re.compile(r"Epoch\[(\d+)\] Time cost=([.\d]+)"),
+           re.compile(r"Epoch\[(\d+)\].*Speed: ([.\d]+)")]
+    data = {}
+    for line in lines:
+        for i, pat in enumerate(res):
+            m = pat.search(line)
+            if not m:
+                continue
+            epoch = int(m.group(1))
+            d = data.setdefault(epoch, {"train": None, "val": None,
+                                        "time": None, "speed": []})
+            if i == 0:
+                d["train"] = float(m.group(3))
+            elif i == 1:
+                d["val"] = float(m.group(3))
+            elif i == 2:
+                d["time"] = float(m.group(2))
+            else:
+                d["speed"].append(float(m.group(2)))
+    return data
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("logfile")
+    parser.add_argument("--format", choices=("markdown", "none"),
+                        default="markdown")
+    args = parser.parse_args()
+    data = parse(args.logfile)
+    if args.format == "markdown":
+        print("| epoch | train | val | time(s) | speed(samples/s) |")
+        print("| --- | --- | --- | --- | --- |")
+    for epoch in sorted(data):
+        d = data[epoch]
+        speed = sum(d["speed"]) / len(d["speed"]) if d["speed"] else 0.0
+        row = [str(epoch),
+               f"{d['train']:.4f}" if d["train"] is not None else "-",
+               f"{d['val']:.4f}" if d["val"] is not None else "-",
+               f"{d['time']:.1f}" if d["time"] is not None else "-",
+               f"{speed:.1f}"]
+        print("| " + " | ".join(row) + " |" if args.format == "markdown"
+              else "\t".join(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Regenerate the .idx sidecar for a .rec file (reference: tools/rec2idx.py)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu import recordio
+
+
+def main():
+    parser = argparse.ArgumentParser(description="build .idx from .rec")
+    parser.add_argument("record", help="path to .rec file")
+    parser.add_argument("index", nargs="?", default=None,
+                        help="output .idx path (default: alongside .rec)")
+    args = parser.parse_args()
+    idx_path = args.index or os.path.splitext(args.record)[0] + ".idx"
+    reader = recordio.MXRecordIO(args.record, "r")
+    count = 0
+    with open(idx_path, "w") as f:
+        while True:
+            pos = reader.tell()
+            buf = reader.read()
+            if buf is None:
+                break
+            f.write(f"{count}\t{pos}\n")
+            count += 1
+    reader.close()
+    print(f"{idx_path}: {count} records indexed")
+
+
+if __name__ == "__main__":
+    main()
