@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Control-flow micro-benchmark (reference: benchmark/python/control_flow/ —
+foreach/while_loop vs unrolled timing)."""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def bench_foreach(T, D, iters):
+    x = nd.array(np.random.rand(T, 8, D).astype(np.float32))
+    s0 = nd.zeros((8, D))
+
+    def body(xs, states):
+        h = states[0]
+        return h, [nd.tanh(h + xs)]
+
+    out, st = nd.contrib.foreach(body, x, [s0])  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out, st = nd.contrib.foreach(body, x, [s0])
+    st[0].wait_to_read()
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_while(T, D, iters):
+    def cond(i, s):
+        return (i < T).asscalar()
+
+    def step(i, s):
+        return [i + 1, nd.tanh(s + 1.0)]
+
+    i0, s0 = nd.array([0.0]), nd.zeros((8, D))
+
+    def run():
+        i, s = i0, s0
+        while (i < T).asscalar():
+            i, s = step(i, s)
+        return s
+
+    run()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        s = run()
+    s.wait_to_read()
+    return (time.perf_counter() - t0) / iters
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-T", type=int, default=32)
+    parser.add_argument("-D", type=int, default=64)
+    parser.add_argument("--iters", type=int, default=10)
+    args = parser.parse_args()
+    print(f"foreach  T={args.T}: {bench_foreach(args.T, args.D, args.iters)*1e3:.2f} ms")
+    print(f"while    T={args.T}: {bench_while(args.T, args.D, args.iters)*1e3:.2f} ms")

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Profiler demo (reference: example/profiler/profiler_ndarray.py /
+profiler_executor.py — chrome-trace dump of imperative + symbolic work)."""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def main(args):
+    mx.profiler.set_config(filename=args.output, profile_imperative=True,
+                           profile_symbolic=True, aggregate_stats=True)
+    mx.profiler.start()
+
+    # imperative section
+    with mx.profiler.scope("imperative_block"):
+        a = nd.array(np.random.rand(256, 256).astype(np.float32))
+        for _ in range(args.iters):
+            a = nd.dot(a, a) * 0.001 + 1.0
+        a.wait_to_read()
+
+    # symbolic section
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc")
+    net = mx.sym.Activation(net, act_type="relu")
+    exe = net.simple_bind(data=(64, 256))
+    with mx.profiler.scope("symbolic_block"):
+        for _ in range(args.iters):
+            exe.forward(is_train=False,
+                        data=nd.array(np.random.rand(64, 256)
+                                      .astype(np.float32)))
+        exe.outputs[0].wait_to_read()
+
+    mx.profiler.stop()
+    print(mx.profiler.dumps())
+    mx.profiler.dump()
+    events = json.load(open(args.output))["traceEvents"]
+    print(f"\nwrote {args.output}: {len(events)} events "
+          f"(open in chrome://tracing or perfetto)")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--output", type=str, default="profile.json")
+    main(parser.parse_args())

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Faster-RCNN style two-stage detector (reference: example/rcnn/ — RPN over
+a conv body, _contrib_Proposal for region proposals, ROIPooling, per-ROI
+classification head).
+
+Synthetic one-object dataset; trains the RPN objectness + box regression and
+the ROI classification head jointly, then reports proposal recall."""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+SIZE = 64
+STRIDE = 8
+SCALES = (2.0, 4.0)
+RATIOS = (1.0,)
+A = len(SCALES) * len(RATIOS)
+
+
+class RCNN(gluon.Block):
+    def __init__(self, num_classes, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.body = nn.Sequential()
+            for f in (16, 32):
+                self.body.add(nn.Conv2D(f, 3, padding=1, strides=2,
+                                        activation="relu"))
+            self.body.add(nn.Conv2D(64, 3, padding=1, strides=2,
+                                    activation="relu"))
+            self.rpn_conv = nn.Conv2D(64, 3, padding=1, activation="relu")
+            self.rpn_cls = nn.Conv2D(2 * A, 1)
+            self.rpn_loc = nn.Conv2D(4 * A, 1)
+            self.fc = nn.Dense(64, activation="relu")
+            self.cls = nn.Dense(num_classes + 1)
+
+    def features(self, x):
+        feat = self.body(x)
+        r = self.rpn_conv(feat)
+        return feat, self.rpn_cls(r), self.rpn_loc(r)
+
+    def roi_head(self, feat, rois):
+        pooled = nd.ROIPooling(feat, rois, pooled_size=(3, 3),
+                               spatial_scale=1.0 / STRIDE)
+        return self.cls(self.fc(pooled.reshape((pooled.shape[0], -1))))
+
+
+def synthetic_batch(rs, batch_size):
+    X = np.zeros((batch_size, 3, SIZE, SIZE), np.float32)
+    Y = np.zeros((batch_size, 5), np.float32)  # cls, l, t, r, b (pixels)
+    for i in range(batch_size):
+        cls = rs.randint(0, 2)
+        w = rs.randint(SIZE // 4, SIZE // 2)
+        l = rs.randint(0, SIZE - w)
+        t = rs.randint(0, SIZE - w)
+        X[i, cls, t:t + w, l:l + w] = 1.0
+        Y[i] = [cls, l, t, l + w, t + w]
+    return nd.array(X), nd.array(Y)
+
+
+def rpn_targets(labels_np, H, W):
+    """Assign each gt to its nearest anchor cell; objectness + delta targets."""
+    B = labels_np.shape[0]
+    cls_t = np.zeros((B, A, H, W), np.float32)
+    loc_t = np.zeros((B, 4 * A, H, W), np.float32)
+    mask = np.zeros((B, 4 * A, H, W), np.float32)
+    for i in range(B):
+        l, t, r, b = labels_np[i, 1:]
+        cx, cy = (l + r) / 2, (t + b) / 2
+        gx, gy = int(cx // STRIDE), int(cy // STRIDE)
+        gx, gy = min(gx, W - 1), min(gy, H - 1)
+        gw, gh = r - l, b - t
+        for a, s in enumerate(SCALES):
+            aw = ah = STRIDE * s
+            acx, acy = gx * STRIDE + STRIDE / 2, gy * STRIDE + STRIDE / 2
+            cls_t[i, a, gy, gx] = 1.0
+            loc_t[i, 4 * a:4 * a + 4, gy, gx] = [
+                (cx - acx) / aw, (cy - acy) / ah,
+                np.log(max(gw, 1.0) / aw), np.log(max(gh, 1.0) / ah)]
+            mask[i, 4 * a:4 * a + 4, gy, gx] = 1.0
+    return nd.array(cls_t), nd.array(loc_t), nd.array(mask)
+
+
+def train(args):
+    rs = np.random.RandomState(0)
+    net = RCNN(num_classes=2)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    huber = gluon.loss.HuberLoss()
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    H = W = SIZE // STRIDE
+    for epoch in range(args.epochs):
+        tot, t0 = 0.0, time.time()
+        for _ in range(args.iters):
+            X, Y = synthetic_batch(rs, args.batch_size)
+            cls_t, loc_t, mask = rpn_targets(Y.asnumpy(), H, W)
+            with autograd.record():
+                feat, rpn_cls, rpn_loc = net.features(X)
+                obj_logits = rpn_cls.reshape((0, 2, A, H, W))[:, 1]
+                L = bce(obj_logits, cls_t) \
+                    + huber(rpn_loc * mask, loc_t * mask)
+                # ROI head trained on ground-truth boxes (like reference's
+                # joint training with gt rois appended)
+                batch_idx = nd.arange(X.shape[0]).reshape((-1, 1))
+                gt_rois = nd.concat(batch_idx, Y[:, 1:5], dim=1)
+                roi_scores = net.roi_head(feat, gt_rois)
+                L = L + ce(roi_scores, Y[:, 0])
+            L.backward()
+            trainer.step(args.batch_size)
+            tot += float(L.mean().asnumpy())
+        logging.info("epoch %d: loss %.4f (%.1fs)", epoch, tot / args.iters,
+                     time.time() - t0)
+
+    # proposal recall: does any top-k proposal hit the gt with IoU>0.5?
+    X, Y = synthetic_batch(rs, 16)
+    feat, rpn_cls, rpn_loc = net.features(X)
+    probs = nd.softmax(rpn_cls.reshape((0, 2, -1)), axis=1).reshape(
+        (0, 2 * A, H, W))
+    im_info = nd.array(np.tile([SIZE, SIZE, 1.0], (16, 1)).astype(np.float32))
+    rois = nd.contrib.Proposal(probs, rpn_loc, im_info, scales=SCALES,
+                               ratios=RATIOS, feature_stride=STRIDE,
+                               rpn_pre_nms_top_n=64, rpn_post_nms_top_n=8,
+                               rpn_min_size=4)
+    r = rois.asnumpy().reshape(16, -1, 5)
+    hits = 0
+    for i in range(16):
+        gt = Y.asnumpy()[i, 1:]
+        best = 0.0
+        for box in r[i][:, 1:]:
+            ix = max(0.0, min(box[2], gt[2]) - max(box[0], gt[0]))
+            iy = max(0.0, min(box[3], gt[3]) - max(box[1], gt[1]))
+            inter = ix * iy
+            union = ((box[2] - box[0]) * (box[3] - box[1])
+                     + (gt[2] - gt[0]) * (gt[3] - gt[1]) - inter)
+            best = max(best, inter / union if union > 0 else 0.0)
+        hits += best > 0.5
+    logging.info("proposal recall@0.5 (top-8): %.2f", hits / 16)
+    return hits / 16
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="toy faster-rcnn")
+    parser.add_argument("--epochs", type=int, default=5)
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=0.003)
+    logging.basicConfig(level=logging.INFO, format="%(asctime)-15s %(message)s")
+    train(parser.parse_args())

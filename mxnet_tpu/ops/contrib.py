@@ -103,10 +103,12 @@ def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
 
     def one_roi(roi):
         b = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
-        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
-        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
-        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        # clamp to feature bounds (reference roi_pooling.cc does the same);
+        # otherwise an edge-touching roi yields an empty cell → max(-inf)
+        x1 = jnp.clip(jnp.round(roi[1] * spatial_scale), 0, W - 1).astype(jnp.int32)
+        y1 = jnp.clip(jnp.round(roi[2] * spatial_scale), 0, H - 1).astype(jnp.int32)
+        x2 = jnp.clip(jnp.round(roi[3] * spatial_scale), 0, W - 1).astype(jnp.int32)
+        y2 = jnp.clip(jnp.round(roi[4] * spatial_scale), 0, H - 1).astype(jnp.int32)
         rh = jnp.maximum(y2 - y1 + 1, 1)
         rw = jnp.maximum(x2 - x1 + 1, 1)
         img = data[b]  # (C, H, W)

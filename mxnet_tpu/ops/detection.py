@@ -1,0 +1,242 @@
+"""SSD / RCNN detection ops (reference: src/operator/contrib/multibox_*.cc,
+src/operator/contrib/proposal.cc — the example/ssd and example/rcnn
+dependencies).
+
+XLA-first design: everything is fixed-shape and masked. Anchor generation is
+pure arithmetic; target matching is an argmax bipartite assignment; proposal
+selection keeps top-k slots with -1 padding instead of the reference's
+dynamic-length outputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .contrib import _iou_matrix
+
+
+def _parse_floats(v):
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    if isinstance(v, str):
+        v = v.strip("()[] ")
+        return tuple(float(x) for x in v.split(",") if x.strip())
+    return tuple(float(x) for x in v)
+
+
+@register("_contrib_MultiBoxPrior", differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes per feature-map cell (reference: multibox_prior.cc).
+
+    data: (B, C, H, W) → (1, H*W*(S+R-1), 4) corner-format anchors."""
+    sizes = _parse_floats(sizes)
+    ratios = _parse_floats(ratios)
+    steps = _parse_floats(steps)
+    offsets = _parse_floats(offsets)
+    H, W = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if len(steps) > 1 and steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (H, W, 2)
+
+    # reference layout: (size[0], r) for all ratios + (size[i], 1) for i>0
+    ws, hs = [], []
+    for r in ratios:
+        sr = jnp.sqrt(r)
+        ws.append(sizes[0] * sr)
+        hs.append(sizes[0] / sr)
+    for s in sizes[1:]:
+        ws.append(s)
+        hs.append(s)
+    ws = jnp.asarray(ws, jnp.float32)  # (A,)
+    hs = jnp.asarray(hs, jnp.float32)
+    A = ws.shape[0]
+    cyx = jnp.broadcast_to(cyx[:, :, None, :], (H, W, A, 2))
+    half_w = jnp.broadcast_to(ws / 2, (H, W, A))
+    half_h = jnp.broadcast_to(hs / 2, (H, W, A))
+    anchors = jnp.stack([cyx[..., 1] - half_w, cyx[..., 0] - half_h,
+                         cyx[..., 1] + half_w, cyx[..., 0] + half_h], axis=-1)
+    anchors = anchors.reshape(1, H * W * A, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors
+
+
+def _center_form(boxes):
+    l, t, r, b = jnp.split(boxes, 4, axis=-1)
+    return (l + r) / 2, (t + b) / 2, r - l, b - t
+
+
+@register("_contrib_MultiBoxTarget", differentiable=False, num_outputs=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground-truth (reference: multibox_target.cc).
+
+    anchor: (1, N, 4) corners; label: (B, M, 5) [cls, l, t, r, b], -1 pad.
+    Returns (loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N))
+    with cls_target 0 = background, gt class + 1 otherwise."""
+    variances = _parse_floats(variances)
+    anchors = anchor[0]                      # (N, 4)
+    N = anchors.shape[0]
+
+    def one(lab):  # (M, 5)
+        valid = lab[:, 0] >= 0               # (M,)
+        gt = lab[:, 1:5]
+        iou = _iou_matrix(anchors, gt)       # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)    # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        # force-match: each gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)          # (M,)
+        forced = jnp.zeros((N,), bool).at[best_anchor].set(valid)
+        forced_gt = jnp.zeros((N,), jnp.int32).at[best_anchor].set(
+            jnp.arange(gt.shape[0], dtype=jnp.int32))
+        matched = forced | (best_iou >= overlap_threshold)
+        gt_idx = jnp.where(forced, forced_gt, best_gt.astype(jnp.int32))
+        cls_t = jnp.where(matched, lab[gt_idx, 0] + 1.0, 0.0)
+
+        # regression targets in center form with variances
+        ax, ay, aw, ah = _center_form(anchors)
+        gbox = gt[gt_idx]
+        gx, gy, gw, gh = _center_form(gbox)
+        eps = 1e-8
+        tx = (gx - ax) / jnp.maximum(aw, eps) / variances[0]
+        ty = (gy - ay) / jnp.maximum(ah, eps) / variances[1]
+        tw = jnp.log(jnp.maximum(gw, eps) / jnp.maximum(aw, eps)) / variances[2]
+        th = jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ah, eps)) / variances[3]
+        loc_t = jnp.concatenate([tx, ty, tw, th], axis=-1)  # (N, 4)
+        mask = matched[:, None].astype(jnp.float32)
+        return (loc_t * mask).reshape(-1), \
+            jnp.tile(mask, (1, 4)).reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label)
+    return loc_t, loc_m, cls_t
+
+
+@register("_contrib_MultiBoxDetection", differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode predictions + NMS (reference: multibox_detection.cc).
+
+    cls_prob: (B, num_classes, N); loc_pred: (B, N*4); anchor: (1, N, 4).
+    Returns (B, N, 6) rows [cls_id, score, l, t, r, b], cls_id -1 = invalid."""
+    from .contrib import box_nms
+
+    variances = _parse_floats(variances)
+    anchors = anchor[0]
+    ax, ay, aw, ah = _center_form(anchors)
+
+    def one(probs, locs):  # (C, N), (N*4,)
+        deltas = locs.reshape(-1, 4)
+        cx = ax[:, 0] + deltas[:, 0] * variances[0] * aw[:, 0]
+        cy = ay[:, 0] + deltas[:, 1] * variances[1] * ah[:, 0]
+        w = aw[:, 0] * jnp.exp(deltas[:, 2] * variances[2])
+        h = ah[:, 0] * jnp.exp(deltas[:, 3] * variances[3])
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best foreground class per anchor
+        fg = jnp.concatenate([probs[:background_id],
+                              probs[background_id + 1:]], axis=0)
+        best = jnp.argmax(fg, axis=0)                     # (N,)
+        score = jnp.take_along_axis(fg, best[None], axis=0)[0]
+        cls_id = jnp.where(score > threshold, best.astype(jnp.float32), -1.0)
+        score = jnp.where(cls_id >= 0, score, 0.0)
+        det = jnp.concatenate([cls_id[:, None], score[:, None], boxes], -1)
+        return box_nms(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                       topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                       force_suppress=force_suppress)
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+@register("_contrib_Proposal", aliases=("_contrib_MultiProposal",),
+          differentiable=False)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposal generation (reference: src/operator/contrib/proposal.cc).
+
+    cls_prob: (B, 2A, H, W); bbox_pred: (B, 4A, H, W); im_info: (B, 3)
+    [height, width, scale]. Returns (B*post_nms, 5) [batch_idx, l, t, r, b]
+    fixed-shape, padded with the last kept proposal."""
+    scales = _parse_floats(scales)
+    ratios = _parse_floats(ratios)
+    B, _, H, W = cls_prob.shape
+    A = len(scales) * len(ratios)
+
+    # base anchors around (0,0) at feature stride
+    base = float(feature_stride)
+    ws, hs = [], []
+    for r in ratios:
+        size = base * base / r
+        w0 = jnp.sqrt(size)
+        for s in scales:
+            ws.append(w0 * s)
+            hs.append(w0 * r * s)
+    ws = jnp.asarray(ws, jnp.float32)
+    hs = jnp.asarray(hs, jnp.float32)
+    shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    cy, cx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
+    ctr = base / 2.0
+    anchors = jnp.stack([
+        cx[..., None] + ctr - ws / 2, cy[..., None] + ctr - hs / 2,
+        cx[..., None] + ctr + ws / 2, cy[..., None] + ctr + hs / 2],
+        axis=-1).reshape(-1, 4)                        # (H*W*A, 4)
+
+    def one(probs, deltas, info):
+        fg = probs[A:].transpose(1, 2, 0).reshape(-1)   # (H*W*A,)
+        d = deltas.transpose(1, 2, 0).reshape(-1, 4)
+        l, t, r, b = jnp.split(anchors, 4, -1)
+        aw, ah = (r - l + 1.0), (b - t + 1.0)
+        acx, acy = l + aw / 2, t + ah / 2
+        px = d[:, 0:1] * aw + acx
+        py = d[:, 1:2] * ah + acy
+        pw = jnp.exp(jnp.clip(d[:, 2:3], -10, 10)) * aw
+        ph = jnp.exp(jnp.clip(d[:, 3:4], -10, 10)) * ah
+        boxes = jnp.concatenate([px - pw / 2, py - ph / 2,
+                                 px + pw / 2, py + ph / 2], -1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1.0),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1.0),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1.0),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1.0)], -1)
+        min_size = rpn_min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1.0) >= min_size) & \
+               ((boxes[:, 3] - boxes[:, 1] + 1.0) >= min_size)
+        fg = jnp.where(keep, fg, -jnp.inf)
+        pre_n = min(rpn_pre_nms_top_n, fg.shape[0])
+        top_scores, top_idx = lax.top_k(fg, pre_n)
+        top_boxes = boxes[top_idx]
+        # greedy NMS over the pre-nms window
+        ious = _iou_matrix(top_boxes, top_boxes)
+        alive = top_scores > -jnp.inf
+
+        def body(i, alive):
+            sup = (ious[i] > threshold) & (jnp.arange(pre_n) > i) & alive[i]
+            return alive & ~sup
+
+        alive = lax.fori_loop(0, pre_n, body, alive)
+        score_alive = jnp.where(alive, top_scores, -jnp.inf)
+        post_n = min(rpn_post_nms_top_n, pre_n)
+        keep_scores, keep_idx = lax.top_k(score_alive, post_n)
+        rois = top_boxes[keep_idx]
+        return rois, keep_scores
+
+    rois, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.broadcast_to(
+        jnp.arange(B, dtype=jnp.float32)[:, None, None],
+        (B, rois.shape[1], 1))
+    out = jnp.concatenate([batch_idx, rois], axis=-1).reshape(-1, 5)
+    if output_score:
+        return out, scores.reshape(-1, 1)
+    return out

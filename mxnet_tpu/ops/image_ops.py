@@ -1,0 +1,104 @@
+"""Image ops — the `mx.nd.image` namespace (reference:
+src/operator/image/image_random-inl.h — to_tensor, normalize, flips, color
+jitter; python/mxnet/gluon/data/vision/transforms.py consumes these)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_image_to_tensor")
+def to_tensor(data):
+    """HWC uint8 [0,255] → CHW float [0,1] (reference: ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize")
+def normalize(data, mean=(0.0,), std=(1.0,)):
+    """Channel-wise (x - mean) / std on CHW (reference: Normalize)."""
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    shape = (-1,) + (1,) * (data.ndim - 1 - (1 if data.ndim == 4 else 0))
+    if data.ndim == 4:
+        mean = mean.reshape((1,) + shape[0:1] + (1, 1))
+        std = std.reshape((1,) + shape[0:1] + (1, 1))
+    else:
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (data - mean) / std
+
+
+@register("_image_flip_left_right")
+def flip_left_right(data):
+    return jnp.flip(data, axis=-1 if data.ndim == 3 else -1)
+
+
+@register("_image_flip_top_bottom")
+def flip_top_bottom(data):
+    return jnp.flip(data, axis=-2)
+
+
+@register("_image_random_flip_left_right", rng=True, differentiable=False)
+def random_flip_left_right(data, rng_key=None, p=0.5):
+    do = jax.random.bernoulli(rng_key, p)
+    return jnp.where(do, jnp.flip(data, axis=-1), data)
+
+
+@register("_image_random_flip_top_bottom", rng=True, differentiable=False)
+def random_flip_top_bottom(data, rng_key=None, p=0.5):
+    do = jax.random.bernoulli(rng_key, p)
+    return jnp.where(do, jnp.flip(data, axis=-2), data)
+
+
+@register("_image_random_brightness", rng=True, differentiable=False)
+def random_brightness(data, min_factor=0.5, max_factor=1.5, rng_key=None):
+    f = jax.random.uniform(rng_key, (), minval=float(min_factor),
+                           maxval=float(max_factor))
+    return data * f
+
+
+@register("_image_random_contrast", rng=True, differentiable=False)
+def random_contrast(data, min_factor=0.5, max_factor=1.5, rng_key=None):
+    f = jax.random.uniform(rng_key, (), minval=float(min_factor),
+                           maxval=float(max_factor))
+    mean = jnp.mean(data, axis=(-1, -2), keepdims=True)
+    return (data - mean) * f + mean
+
+
+@register("_image_random_saturation", rng=True, differentiable=False)
+def random_saturation(data, min_factor=0.5, max_factor=1.5, rng_key=None):
+    f = jax.random.uniform(rng_key, (), minval=float(min_factor),
+                           maxval=float(max_factor))
+    # grayscale via channel mean (CHW: channel axis -3)
+    gray = jnp.mean(data, axis=-3, keepdims=True)
+    return data * f + gray * (1.0 - f)
+
+
+@register("_image_resize")
+def resize(data, size=0, keep_ratio=False, interp=1):
+    """Bilinear resize (reference: image resize op). size: int or (w, h)."""
+    if isinstance(size, (tuple, list)):
+        w, h = int(size[0]), int(size[1])
+    else:
+        w = h = int(size)
+    chw = data.ndim == 3
+    x = data[None] if chw else data
+    # NCHW expected
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], h, w),
+                           method="bilinear" if interp else "nearest")
+    return out[0] if chw else out
+
+
+@register("_image_crop")
+def crop(data, x=0, y=0, width=0, height=0):
+    """Spatial crop on CHW/NCHW (reference: image crop)."""
+    if data.ndim == 3:
+        return data[:, int(y):int(y) + int(height),
+                    int(x):int(x) + int(width)]
+    return data[:, :, int(y):int(y) + int(height),
+                int(x):int(x) + int(width)]

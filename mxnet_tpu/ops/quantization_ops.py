@@ -1,0 +1,102 @@
+"""Quantization ops (reference: src/operator/quantization/ —
+quantize{,_v2}.cc, dequantize.cc, requantize.cc).
+
+TPU note: int8 matmuls with int32 accumulation hit the MXU; these ops handle
+the float ↔ int8 boundary. Symmetric scaling mirrors the reference's
+`quantize_v2` with min/max calibration ranges.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_contrib_quantize", num_outputs=3, differentiable=False)
+def quantize(data, min_range, max_range, out_type="int8"):
+    """Quantize float → int8/uint8 given a calibration range
+    (reference: quantize.cc). Returns (q, min, max)."""
+    lo = jnp.min(min_range)
+    hi = jnp.max(max_range)
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(hi - lo, 1e-8)
+        q = jnp.clip(jnp.round((data - lo) * scale), 0, 255).astype(jnp.uint8)
+    else:
+        t = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+        scale = 127.0 / jnp.maximum(t, 1e-8)
+        q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, lo.reshape(1), hi.reshape(1)
+
+
+@register("_contrib_quantize_v2", num_outputs=3, differentiable=False)
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """Quantize with optional embedded calibration range
+    (reference: quantize_v2.cc)."""
+    lo = jnp.asarray(min_calib_range if min_calib_range is not None
+                     else jnp.min(data), jnp.float32)
+    hi = jnp.asarray(max_calib_range if max_calib_range is not None
+                     else jnp.max(data), jnp.float32)
+    return quantize(data, lo, hi, out_type=out_type)
+
+
+@register("_contrib_dequantize", differentiable=False)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """int8/uint8 → float (reference: dequantize.cc)."""
+    lo = jnp.min(min_range)
+    hi = jnp.max(max_range)
+    if data.dtype == jnp.uint8:
+        scale = jnp.maximum(hi - lo, 1e-8) / 255.0
+        return data.astype(jnp.float32) * scale + lo
+    t = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
+    return data.astype(jnp.float32) * (t / 127.0)
+
+
+@register("_contrib_requantize", num_outputs=3, differentiable=False)
+def requantize(data, min_range, max_range, out_type="int8",
+               min_calib_range=None, max_calib_range=None):
+    """int32 accumulator → int8 (reference: requantize.cc). The int32 range
+    is the product of the int8 input scales carried in min/max_range."""
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(jnp.min(min_range)), jnp.abs(jnp.max(max_range)))
+        / float(2 ** 31 - 1))
+    if min_calib_range is not None and max_calib_range is not None:
+        t = max(abs(float(min_calib_range)), abs(float(max_calib_range)))
+        t = jnp.asarray(t, jnp.float32)
+    else:
+        t = jnp.maximum(jnp.max(jnp.abs(real)), 1e-8)
+    q = jnp.clip(jnp.round(real / t * 127.0), -127, 127).astype(jnp.int8)
+    return q, (-t).reshape(1), t.reshape(1)
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3,
+          differentiable=False)
+def quantized_fully_connected(*args, num_hidden=0, no_bias=False,
+                              flatten=True):
+    """int8 FC with int32 accumulation on the MXU
+    (reference: quantized_fully_connected.cc).
+
+    Inputs with bias: (data, weight, bias, min_data, max_data, min_weight,
+    max_weight, min_bias, max_bias); without: the same minus the three bias
+    entries (the reference drops them from the input list under no_bias)."""
+    if no_bias or len(args) == 6:
+        data, weight, min_data, max_data, min_weight, max_weight = args
+        bias = min_bias = max_bias = None
+    else:
+        (data, weight, bias, min_data, max_data, min_weight, max_weight,
+         min_bias, max_bias) = args
+    x = data.astype(jnp.int32)
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = jnp.matmul(x, weight.astype(jnp.int32).T,
+                     preferred_element_type=jnp.int32)
+    sd = jnp.maximum(jnp.abs(jnp.min(min_data)), jnp.abs(jnp.max(max_data)))
+    sw = jnp.maximum(jnp.abs(jnp.min(min_weight)), jnp.abs(jnp.max(max_weight)))
+    out_scale = (sd / 127.0) * (sw / 127.0)
+    if bias is not None:
+        sb = jnp.maximum(jnp.abs(jnp.min(min_bias)), jnp.abs(jnp.max(max_bias)))
+        # rescale int8 bias into the accumulator's scale
+        b = jnp.round(bias.astype(jnp.float32) * (sb / 127.0) / out_scale)
+        acc = acc + b.astype(jnp.int32)
+    t = out_scale * float(2 ** 31 - 1)
+    return acc, (-t).reshape(1), t.reshape(1)

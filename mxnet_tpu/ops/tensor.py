@@ -326,6 +326,46 @@ def flatten(x):
     return jnp.reshape(x, (x.shape[0], -1))
 
 
+@register("histogram", num_outputs=2, differentiable=False)
+def histogram(data, bin_cnt=10, range=None):
+    """Reference: src/operator/tensor/histogram.cc. Returns (counts, edges)."""
+    lo, hi = (float(range[0]), float(range[1])) if range is not None else \
+        (None, None)
+    if lo is None:
+        lo_v, hi_v = jnp.min(data), jnp.max(data)
+    else:
+        lo_v, hi_v = jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32)
+    counts, edges = jnp.histogram(
+        data, bins=int(bin_cnt),
+        range=(lo_v, hi_v))
+    return counts.astype(jnp.int64), edges.astype(jnp.float32)
+
+
+@register("ravel_multi_index", differentiable=False, aliases=("_ravel_multi_index",))
+def ravel_multi_index(data, shape=None):
+    """(ndim, N) indices → flat ids (reference: src/operator/tensor/ravel.cc)."""
+    dims = tuple(int(d) for d in shape)
+    strides = []
+    s = 1
+    for d in reversed(dims):
+        strides.append(s)
+        s *= d
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return jnp.sum(data * strides[:, None], axis=0)
+
+
+@register("unravel_index", differentiable=False, aliases=("_unravel_index",))
+def unravel_index(data, shape=None):
+    """flat ids → (ndim, N) indices (reference: ravel.cc UnravelIndex)."""
+    dims = tuple(int(d) for d in shape)
+    out = []
+    rem = data.astype(jnp.int64)
+    for d in reversed(dims):
+        out.append(rem % d)
+        rem = rem // d
+    return jnp.stack(list(reversed(out)), axis=0).astype(data.dtype)
+
+
 @register("swapaxes", aliases=("SwapAxis",))
 def swapaxes(x, dim1=0, dim2=1):
     """Reference: src/operator/swapaxis.cc `SwapAxis`."""

@@ -1,0 +1,178 @@
+"""Detection / quantization / image op tests (reference:
+tests/python/unittest/test_operator.py multibox + quantization sections,
+test_image.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+# ------------------------------------------------------------- detection
+
+
+def test_multibox_prior_counts_and_range():
+    x = nd.zeros((1, 3, 4, 6))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    # A = S + R - 1 = 3 per cell
+    assert anchors.shape == (1, 4 * 6 * 3, 4)
+    a = anchors.asnumpy()
+    assert (a[..., 2] >= a[..., 0]).all() and (a[..., 3] >= a[..., 1]).all()
+
+
+def test_multibox_prior_centers():
+    x = nd.zeros((1, 1, 2, 2))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=(0.4,)).asnumpy()[0]
+    # cell (0,0): center (0.25, 0.25)
+    np.testing.assert_allclose(anchors[0], [0.25 - 0.2, 0.25 - 0.2,
+                                            0.25 + 0.2, 0.25 + 0.2],
+                               rtol=1e-5)
+
+
+def test_multibox_target_matches_gt():
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.5, 0.5],
+                                  [0.5, 0.5, 1.0, 1.0],
+                                  [0.0, 0.5, 0.5, 1.0]]], np.float32))
+    # one gt box of class 2 exactly on anchor 1
+    label = nd.array(np.array([[[2.0, 0.5, 0.5, 1.0, 1.0]]], np.float32))
+    cls_pred = nd.zeros((1, 4, 3))
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    c = cls_t.asnumpy()[0]
+    assert c[1] == 3.0  # class 2 → target 3 (bg=0)
+    assert c[0] == 0.0 and c[2] == 0.0
+    m = loc_m.asnumpy()[0].reshape(3, 4)
+    assert m[1].all() and not m[0].any()
+    t = loc_t.asnumpy()[0].reshape(3, 4)
+    np.testing.assert_allclose(t[1], 0.0, atol=1e-5)  # perfect match → 0 offset
+
+
+def test_multibox_detection_decodes():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.3, 0.3],
+                                  [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    # class probs: bg, c1, c2 — anchor0 → c1, anchor1 → c2
+    cls_prob = nd.array(np.array([[[0.1, 0.2], [0.8, 0.1], [0.1, 0.7]]],
+                                 np.float32))
+    loc_pred = nd.zeros((1, 8))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       nms_threshold=0.5).asnumpy()[0]
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 2
+    ids = sorted(kept[:, 0].tolist())
+    assert ids == [0.0, 1.0]
+    row_c1 = kept[kept[:, 0] == 0.0][0]
+    np.testing.assert_allclose(row_c1[2:], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
+
+
+def test_proposal_shapes_and_validity():
+    rs = np.random.RandomState(0)
+    B, A, H, W = 1, 9, 4, 4
+    cls_prob = nd.array(rs.rand(B, 2 * A, H, W).astype(np.float32))
+    bbox_pred = nd.array((rs.rand(B, 4 * A, H, W) * 0.1).astype(np.float32))
+    im_info = nd.array(np.array([[64.0, 64.0, 1.0]], np.float32))
+    rois = nd.contrib.Proposal(cls_prob, bbox_pred, im_info,
+                               rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+                               rpn_min_size=2, scales=(4.0, 8.0, 16.0),
+                               ratios=(0.5, 1.0, 2.0))
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1] <= r[:, 3] + 1e-3).all() and (r[:, 2] <= r[:, 4] + 1e-3).all()
+    assert (r[:, 1:] >= -1e-3).all() and (r[:, [1, 3]] <= 64.0).all()
+
+
+def test_roi_pooling_edge_box_finite():
+    # regression: an roi touching the image edge must not produce -inf
+    # (empty-pool cells; clamped like reference roi_pooling.cc)
+    feat = nd.array(np.random.rand(1, 2, 8, 8).astype(np.float32))
+    rois = nd.array(np.array([[0, 56.0, 56.0, 64.0, 64.0]], np.float32))
+    out = nd.ROIPooling(feat, rois, pooled_size=(3, 3), spatial_scale=1.0 / 8)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+# ---------------------------------------------------------- quantization
+
+
+def test_quantize_dequantize_roundtrip():
+    x = nd.array(np.linspace(-2, 2, 32).astype(np.float32))
+    q, lo, hi = nd.contrib.quantize(x, nd.array([-2.0]), nd.array([2.0]))
+    assert q.asnumpy().dtype == np.int8
+    back = nd.contrib.dequantize(q, lo, hi)
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=2 / 127 + 1e-6)
+
+
+def test_quantize_v2_auto_range():
+    x = nd.array(np.array([-1.0, 0.5, 3.0], np.float32))
+    q, lo, hi = nd.contrib.quantize_v2(x)
+    assert float(q.asnumpy()[2]) == 127  # max maps to 127
+    back = nd.contrib.dequantize(q, lo, hi).asnumpy()
+    np.testing.assert_allclose(back, [-1.0, 0.5, 3.0], atol=3 / 127 + 1e-6)
+
+
+def test_quantized_fully_connected_matches_float():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 8).astype(np.float32)
+    w = rs.randn(5, 8).astype(np.float32)
+    qx, xlo, xhi = nd.contrib.quantize_v2(nd.array(x))
+    qw, wlo, whi = nd.contrib.quantize_v2(nd.array(w))
+    acc, lo, hi = nd.contrib.quantized_fully_connected(
+        qx, qw, xlo, xhi, wlo, whi, num_hidden=5, no_bias=True)
+    # dequantize the int32 accumulator: acc * (sx/127)*(sw/127)
+    sx = max(abs(x.min()), abs(x.max()))
+    sw = max(abs(w.min()), abs(w.max()))
+    approx = acc.asnumpy().astype(np.float64) * (sx / 127) * (sw / 127)
+    np.testing.assert_allclose(approx, x @ w.T, atol=0.15)
+
+
+# ----------------------------------------------------------------- image
+
+
+def test_image_to_tensor_and_normalize():
+    img = nd.array(np.full((4, 6, 3), 255, np.uint8).astype(np.float32))
+    t = nd.image.to_tensor(img)
+    assert t.shape == (3, 4, 6)
+    np.testing.assert_allclose(t.asnumpy(), 1.0)
+    n = nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.25, 0.25, 0.25))
+    np.testing.assert_allclose(n.asnumpy(), 2.0)
+
+
+def test_image_flips():
+    img = nd.array(np.arange(12).reshape(1, 3, 4).astype(np.float32))
+    lr = nd.image.flip_left_right(img).asnumpy()
+    np.testing.assert_allclose(lr[0, 0], [3, 2, 1, 0])
+    tb = nd.image.flip_top_bottom(img).asnumpy()
+    np.testing.assert_allclose(tb[0, :, 0], [8, 4, 0])
+
+
+def test_image_resize_and_crop():
+    img = nd.array(np.random.rand(3, 8, 8).astype(np.float32))
+    out = nd.image.resize(img, size=4)
+    assert out.shape == (3, 4, 4)
+    c = nd.image.crop(img, x=2, y=1, width=4, height=3)
+    assert c.shape == (3, 3, 4)
+    np.testing.assert_allclose(c.asnumpy(), img.asnumpy()[:, 1:4, 2:6])
+
+
+def test_image_random_flip_deterministic_seed():
+    mx.random.seed(0)
+    img = nd.array(np.arange(6).reshape(1, 2, 3).astype(np.float32))
+    outs = {tuple(nd.image.random_flip_left_right(img).asnumpy().ravel())
+            for _ in range(20)}
+    assert len(outs) == 2  # both flipped and unflipped occur
+
+
+# ------------------------------------------------------------------ misc
+
+
+def test_histogram():
+    x = nd.array(np.array([0.0, 0.1, 0.9, 1.0, 0.5], np.float32))
+    counts, edges = nd.histogram(x, bin_cnt=2, range=(0.0, 1.0))
+    np.testing.assert_allclose(counts.asnumpy(), [2, 3])
+    np.testing.assert_allclose(edges.asnumpy(), [0.0, 0.5, 1.0])
+
+
+def test_ravel_unravel():
+    idx = nd.array(np.array([[0, 1, 2], [2, 1, 0]], np.float32))
+    flat = nd.ravel_multi_index(idx, shape=(3, 4))
+    np.testing.assert_allclose(flat.asnumpy(), [2, 5, 8])
+    back = nd.unravel_index(flat, shape=(3, 4))
+    np.testing.assert_allclose(back.asnumpy(), idx.asnumpy())
