@@ -22,7 +22,10 @@ NORTH_STAR = 1200.0  # img/s/chip (BASELINE.json)
 
 
 def main():
-    batch_size = int(os.environ.get("BENCH_BATCH", "64"))
+    # bs=512 saturates one v5e MXU (measured: 64→752, 256→1537, 512→1665
+    # img/s; 1024 OOMs in 16 GB HBM); fall back on allocation failure
+    requested = os.environ.get("BENCH_BATCH")
+    batch_candidates = [int(requested)] if requested else [512, 256, 128, 64]
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     import jax
     import jax.numpy as jnp
@@ -51,19 +54,31 @@ def main():
 
     jstep = jax.jit(step, donate_argnums=(0, 1))
     rng0 = jax.random.PRNGKey(0)
-    x = jnp.asarray(np.random.rand(batch_size, 3, 224, 224).astype(np.float32))
-    y = jnp.asarray(np.random.randint(0, 1000, (batch_size,)).astype(np.int32))
 
-    # compile + warmup
-    loss, params, momenta = jstep(params, momenta, x, y, rng0)
-    float(loss)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        loss, params, momenta = jstep(params, momenta, x, y,
-                                      jax.random.fold_in(rng0, i))
-    float(loss)  # sync
-    dt = time.perf_counter() - t0
-    img_per_sec = batch_size * steps / dt
+    img_per_sec = None
+    batch_size = None
+    for bs in batch_candidates:
+        try:
+            x = jnp.asarray(np.random.rand(bs, 3, 224, 224).astype(np.float32))
+            y = jnp.asarray(np.random.randint(0, 1000, (bs,)).astype(np.int32))
+            # fresh copies — donation consumes them on every attempt
+            p = jax.tree_util.tree_map(jnp.copy, params)
+            m = jax.tree_util.tree_map(jnp.copy, momenta)
+            loss, p, m = jstep(p, m, x, y, rng0)  # compile + warmup
+            float(loss)
+            t0 = time.perf_counter()
+            for i in range(steps):
+                loss, p, m = jstep(p, m, x, y, jax.random.fold_in(rng0, i))
+            float(loss)  # sync
+            dt = time.perf_counter() - t0
+            img_per_sec = bs * steps / dt
+            batch_size = bs
+            break
+        except Exception as e:  # OOM on small-HBM chips → next size down
+            sys.stderr.write(f"batch {bs} failed ({type(e).__name__}); "
+                             "trying smaller\n")
+    if img_per_sec is None:
+        raise RuntimeError("all batch sizes failed")
     print(json.dumps({
         "metric": "resnet50_train_throughput",
         "value": round(img_per_sec, 2),

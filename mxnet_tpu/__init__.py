@@ -54,6 +54,7 @@ from . import executor_manager
 from . import kvstore_server
 from . import log
 from . import rtc
+from . import operator
 from . import test_utils
 from . import visualization as viz
 from . import visualization

@@ -1,0 +1,228 @@
+"""User-defined operators — `mx.operator` (reference: python/mxnet/operator.py
+CustomOp/CustomOpProp/register; native bridge src/operator/custom/custom-inl.h
+runs these on a dedicated thread pool with async engine integration).
+
+TPU-native: the eager path runs the Python body directly (host callback
+territory); under autograd the op records as a custom-vjp tape entry whose
+backward calls the user's `backward` — exactly the CustomOperator contract.
+The symbolic path wraps forward in `jax.pure_callback` so Custom nodes embed
+in compiled graphs, with shapes from `CustomOpProp.infer_shape`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+_CUSTOM_REGISTRY: Dict[str, type] = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst: NDArray, req: str, src):
+        """Write helper honoring grad_req (reference: CustomOp.assign)."""
+        if req in ("null", None):
+            return
+        src = src if isinstance(src, NDArray) else NDArray(jnp.asarray(src))
+        if req == "add":
+            dst._data = dst._data + src._data
+        else:
+            dst._data = src._data
+
+
+class CustomOpProp:
+    """Op metadata + factory (reference: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass (reference:
+    operator.register). Makes the op reachable as
+    `mx.nd.Custom(..., op_type=reg_name)` and `mx.sym.Custom(...)`."""
+
+    def do_register(prop_cls):
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered_operators():
+    return list(_CUSTOM_REGISTRY)
+
+
+def _get_prop(op_type, kwargs):
+    if op_type not in _CUSTOM_REGISTRY:
+        raise MXNetError(
+            f"custom op {op_type!r} not registered; known: "
+            f"{sorted(_CUSTOM_REGISTRY)}")
+    return _CUSTOM_REGISTRY[op_type](**kwargs)
+
+
+def invoke_custom(inputs: Sequence[NDArray], op_type: str, **kwargs):
+    """Eager Custom dispatch (the MXImperativeInvoke path for op 'Custom').
+
+    Records a custom-vjp tape entry so autograd.backward drives the user's
+    `backward` (reference: CustomOperator async fwd/bwd, custom-inl.h:50-148).
+    """
+    from . import autograd
+
+    prop = _get_prop(op_type, kwargs)
+    in_shapes = [list(i.shape) for i in inputs]
+    _, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    op = prop.create_operator(None, in_shapes,
+                              [i.dtype for i in inputs])
+    n_out = len(prop.list_outputs())
+
+    class _Fn(autograd.Function):
+        def forward(self, *ins):
+            outs = [NDArray(jnp.zeros(tuple(s), ins[0]._data.dtype))
+                    for s in out_shapes]
+            op.forward(is_train=autograd.is_recording(),
+                       req=["write"] * n_out,
+                       in_data=list(ins), out_data=outs, aux=[])
+            self.save_for_backward(*ins, *outs)
+            return outs if len(outs) > 1 else outs[0]
+
+        def backward(self, *ograds):
+            saved = self.saved_tensors
+            ins, outs = list(saved[:len(inputs)]), list(saved[len(inputs):])
+            igrads = [NDArray(jnp.zeros_like(i._data)) for i in ins]
+            op.backward(req=["write"] * len(ins), out_grad=list(ograds),
+                        in_data=ins, out_data=outs, in_grad=igrads, aux=[])
+            return igrads if len(igrads) > 1 else igrads[0]
+
+    return _Fn()(*inputs)
+
+
+_CUSTOM_FN_CACHE: Dict[tuple, object] = {}
+
+
+def _custom_fn(op_type: str, kwargs: dict):
+    key = (op_type, tuple(sorted((k, repr(v)) for k, v in kwargs.items())))
+    fn = _CUSTOM_FN_CACHE.get(key)
+    if fn is None:
+        fn = _CUSTOM_FN_CACHE[key] = make_custom_symbol_fn(op_type, kwargs)
+    return fn
+
+
+def _register_custom_op():
+    """Registers the graph-level 'Custom' op so symbols can embed user ops
+    (reference: NNVM op 'Custom', src/operator/custom/custom.cc)."""
+    from .ops.registry import register as _register
+
+    def n_outputs(attrs):
+        kw = {k: v for k, v in attrs.items() if k != "op_type"}
+        return len(_get_prop(attrs["op_type"], kw).list_outputs())
+
+    @_register("Custom", num_outputs=n_outputs)
+    def custom(*arrays, op_type=None, **kwargs):
+        return _custom_fn(op_type, kwargs)(*arrays)
+
+    custom._mxtpu_custom = True  # backward cache: treat as custom closure
+
+
+_register_custom_op()
+
+
+def make_custom_symbol_fn(op_type: str, kwargs: dict):
+    """jax-traceable Custom fn for the symbol executor: pure_callback forward
+    + custom_vjp callback backward, shapes from the prop."""
+    prop = _get_prop(op_type, kwargs)
+    n_out = len(prop.list_outputs())
+
+    def run_forward(*arrays):
+        ins = [NDArray(jnp.asarray(a)) for a in arrays]
+        in_shapes = [list(i.shape) for i in ins]
+        _, out_shapes, _ = prop.infer_shape(in_shapes)
+        op = prop.create_operator(None, in_shapes, [i.dtype for i in ins])
+        outs = [NDArray(jnp.zeros(tuple(s), ins[0]._data.dtype))
+                for s in out_shapes]
+        op.forward(is_train=False, req=["write"] * n_out, in_data=ins,
+                   out_data=outs, aux=[])
+        return tuple(_np.asarray(o._data) for o in outs)
+
+    @jax.custom_vjp
+    def fn(*arrays):
+        in_shapes = [list(a.shape) for a in arrays]
+        _, out_shapes, _ = prop.infer_shape(in_shapes)
+        result_shapes = tuple(
+            jax.ShapeDtypeStruct(tuple(s), arrays[0].dtype)
+            for s in out_shapes)
+        out = jax.pure_callback(run_forward, result_shapes, *arrays,
+                                vmap_method="sequential")
+        return out if n_out > 1 else out[0]
+
+    def fwd(*arrays):
+        out = fn(*arrays)
+        return out, (arrays, out if n_out > 1 else (out,))
+
+    def bwd(res, g):
+        arrays, outs = res
+        gs = g if n_out > 1 else (g,)
+
+        def run_backward(*flat):
+            n_in = len(arrays)
+            ins = [NDArray(jnp.asarray(a)) for a in flat[:n_in]]
+            os_ = [NDArray(jnp.asarray(a)) for a in flat[n_in:n_in + n_out]]
+            ogs = [NDArray(jnp.asarray(a)) for a in flat[n_in + n_out:]]
+            in_shapes = [list(i.shape) for i in ins]
+            op = prop.create_operator(None, in_shapes,
+                                      [i.dtype for i in ins])
+            igrads = [NDArray(jnp.zeros_like(i._data)) for i in ins]
+            op.backward(req=["write"] * n_in, out_grad=ogs, in_data=ins,
+                        out_data=os_, in_grad=igrads, aux=[])
+            return tuple(_np.asarray(i._data) for i in igrads)
+
+        result_shapes = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                              for a in arrays)
+        grads = jax.pure_callback(run_backward, result_shapes,
+                                  *arrays, *outs, *gs,
+                                  vmap_method="sequential")
+        return tuple(grads)
+
+    fn.defvjp(fwd, bwd)
+    return fn

@@ -22,8 +22,9 @@ from . import compression
 from .data_parallel import DataParallelTrainer
 from .ring_attention import ring_attention
 from .sequence_parallel import ulysses_attention
+from . import moe
 from . import pipeline
 
 __all__ = ["MeshConfig", "get_mesh", "make_mesh", "local_mesh", "collectives",
            "compression", "DataParallelTrainer", "ring_attention",
-           "ulysses_attention", "pipeline"]
+           "ulysses_attention", "pipeline", "moe"]

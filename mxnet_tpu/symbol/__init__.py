@@ -25,3 +25,13 @@ for _name in list(OP_REGISTRY):
     if _name.startswith("_contrib_"):
         setattr(contrib, _name[len("_contrib_"):], getattr(_mod, _name))
         setattr(contrib, _name, getattr(_mod, _name))
+
+
+def __getattr__(name):
+    """Late-registered ops (e.g. 'Custom', registered by mx.operator at
+    import) get wrappers on demand."""
+    if name in OP_REGISTRY:
+        wrapper = _make_sym_wrapper(name)
+        setattr(_mod, name, wrapper)
+        return wrapper
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,74 @@
+"""mx.operator Custom op bridge (reference: tests/python/unittest/
+test_operator.py test_custom_op — forward/backward through a registered
+Python op in eager, gluon-autograd, and symbolic executors)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+@mx.operator.register("squareit")
+class SquareProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class Square(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                self.assign(in_grad[0], req[0],
+                            2.0 * in_data[0] * out_grad[0])
+
+        return Square()
+
+
+def test_custom_eager_forward():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    y = nd.Custom(x, op_type="squareit")
+    np.testing.assert_allclose(y.asnumpy(), [1.0, 4.0, 9.0])
+
+
+def test_custom_autograd_backward():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="squareit").sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_custom_in_symbol_executor():
+    data = mx.sym.Variable("data")
+    out = mx.sym.Custom(data, op_type="squareit", name="sq")
+    exe = out.simple_bind(data=(4,))
+    exe.forward(is_train=False,
+                data=nd.array(np.array([1, 2, 3, 4], np.float32)))
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), [1, 4, 9, 16])
+
+
+def test_custom_symbol_backward():
+    data = mx.sym.Variable("data")
+    out = mx.sym.sum(mx.sym.Custom(data, op_type="squareit"))
+    exe = out.simple_bind(data=(3,), grad_req="write")
+    exe.forward(is_train=True,
+                data=nd.array(np.array([1.0, 2.0, 3.0], np.float32)))
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_arrays[0].asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_custom_registry_listing():
+    assert "squareit" in mx.operator.get_all_registered_operators()
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.zeros((2,)), op_type="no_such_op")
