@@ -1,0 +1,128 @@
+"""Pallas TPU kernels for ops XLA fuses poorly (SURVEY.md §7: 'Pallas for the
+few kernels XLA fuses poorly — e.g. 2-bit compression pack/unpack').
+
+Kernels run natively on TPU; on CPU (tests, virtual meshes) `interpret=True`
+executes the same kernel through the Pallas interpreter, which is the
+same-op-two-backends oracle the reference used for GPU-vs-CPU tests
+(SURVEY.md §4).
+
+2-bit gradient compression (reference: src/kvstore/gradient_compression.cu):
+one fused pass computes sign thresholding, error-feedback residual, and the
+16-lane bit-pack — three HBM round-trips in the jnp version, one here.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_LANES = 16  # 2-bit codes per uint32 word (reference layout)
+
+
+def _twobit_pack_kernel(g_ref, res_ref, thresh_ref, packed_ref, newres_ref):
+    t = thresh_ref[0, 0]
+    g = g_ref[:] + res_ref[:]                      # error feedback
+    pos = (g >= t)
+    neg = (g <= -t)
+    newres_ref[:] = g - jnp.where(pos, t, 0.0) + jnp.where(neg, t, 0.0)
+    codes = pos.astype(jnp.uint32) | (neg.astype(jnp.uint32) << 1)
+    # codes: (rows, LANES*128) → pack 16 consecutive lane-groups per word:
+    # view as (rows, 128, LANES) words × lanes, shift-or across the lane dim
+    rows = codes.shape[0]
+    lanes = codes.reshape(rows, _LANES, 128)
+    # static unrolled OR-pack: Mosaic has no unsigned reductions
+    acc = lanes[:, 0, :]
+    for i in range(1, _LANES):
+        acc = acc | (lanes[:, i, :] << jnp.uint32(2 * i))
+    packed_ref[:] = acc
+
+
+def _twobit_unpack_kernel(packed_ref, thresh_ref, out_ref):
+    t = thresh_ref[0, 0]
+    rows = packed_ref.shape[0]
+    shifts = (jnp.arange(_LANES, dtype=jnp.uint32) * 2)[None, :, None]
+    lanes = (packed_ref[:][:, None, :] >> shifts) & jnp.uint32(0x3)
+    vals = jnp.where(lanes == 1, t, jnp.where(lanes == 2, -t, 0.0))
+    out_ref[:] = vals.reshape(rows, _LANES * 128).astype(out_ref.dtype)
+
+
+_ROW_BLOCK = 64  # rows per program: 64×2048 f32 ≈ 0.5 MB per VMEM buffer
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pack_call(g2d, res2d, thresh, interpret):
+    rows = g2d.shape[0]  # caller pads rows to a _ROW_BLOCK multiple
+    rb = min(_ROW_BLOCK, rows)
+    block = _LANES * 128
+    return pl.pallas_call(
+        _twobit_pack_kernel,
+        grid=(rows // rb,),
+        in_specs=[pl.BlockSpec((rb, block), lambda i: (i, 0)),
+                  pl.BlockSpec((rb, block), lambda i: (i, 0)),
+                  pl.BlockSpec((1, 1), lambda i: (0, 0))],
+        out_specs=(pl.BlockSpec((rb, 128), lambda i: (i, 0)),
+                   pl.BlockSpec((rb, block), lambda i: (i, 0))),
+        out_shape=(jax.ShapeDtypeStruct((rows, 128), jnp.uint32),
+                   jax.ShapeDtypeStruct(g2d.shape, g2d.dtype)),
+        interpret=interpret,
+    )(g2d, res2d, thresh)
+
+
+@functools.partial(jax.jit, static_argnames=("dtype", "interpret"))
+def _unpack_call(packed2d, thresh, dtype, interpret):
+    rows = packed2d.shape[0]
+    rb = min(_ROW_BLOCK, rows)
+    return pl.pallas_call(
+        _twobit_unpack_kernel,
+        grid=(rows // rb,),
+        in_specs=[pl.BlockSpec((rb, 128), lambda i: (i, 0)),
+                  pl.BlockSpec((1, 1), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((rb, _LANES * 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES * 128), dtype),
+        interpret=interpret,
+    )(packed2d, thresh)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def twobit_pack(grad, residual, threshold):
+    """Fused 2-bit quantize with error feedback.
+
+    grad/residual: same shape, any rank. Returns (packed uint32 (W, 128),
+    new_residual like grad). Elements are padded to LANES*128 blocks.
+    """
+    flat = grad.reshape(-1)
+    res = residual.reshape(-1)
+    block = _LANES * 128
+    rows = -(-flat.shape[0] // block)
+    if rows > _ROW_BLOCK:  # gridded path needs a whole number of row blocks
+        rows = -(-rows // _ROW_BLOCK) * _ROW_BLOCK
+    pad = rows * block - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, flat.dtype)])
+        res = jnp.concatenate([res, jnp.zeros(pad, res.dtype)])
+    thresh = jnp.full((1, 1), threshold, flat.dtype)
+    packed, newres = _pack_call(flat.reshape(rows, block),
+                                res.reshape(rows, block), thresh,
+                                _use_interpret())
+    newres = newres.reshape(-1)[:grad.size].reshape(grad.shape)
+    return packed, newres
+
+
+def twobit_unpack(packed, shape, threshold, dtype=jnp.float32):
+    """Inverse of twobit_pack: packed (W, 128) → dense tensor of `shape`."""
+    rows = packed.shape[0]
+    if rows > _ROW_BLOCK and rows % _ROW_BLOCK:
+        pad = -(-rows // _ROW_BLOCK) * _ROW_BLOCK - rows
+        packed = jnp.concatenate(
+            [packed, jnp.zeros((pad, 128), packed.dtype)])
+    thresh = jnp.full((1, 1), threshold, jnp.dtype(dtype))
+    out = _unpack_call(packed, thresh, jnp.dtype(dtype), _use_interpret())
+    n = 1
+    for s in shape:
+        n *= s
+    return out.reshape(-1)[:n].reshape(shape)

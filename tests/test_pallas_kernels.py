@@ -1,0 +1,68 @@
+"""Pallas 2-bit compression kernels (interpret mode on CPU — the
+same-kernel-two-backends oracle; reference: gradient_compression tests in
+tests/nightly/dist_sync_kvstore.py:28-50)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mxnet_tpu.ops import pallas_kernels as pk
+
+
+def _roundtrip(g, res, t):
+    packed, newres = pk.twobit_pack(jnp.asarray(g), jnp.asarray(res), t)
+    out = pk.twobit_unpack(packed, g.shape, t, dtype=jnp.float32)
+    return np.asarray(out), np.asarray(newres), np.asarray(packed)
+
+
+def test_twobit_pack_semantics():
+    t = 0.5
+    g = np.array([0.7, -0.6, 0.1, 0.0, 2.0, -3.0], np.float32)
+    res = np.zeros_like(g)
+    out, newres, _ = _roundtrip(g, res, t)
+    np.testing.assert_allclose(out[:6], [t, -t, 0.0, 0.0, t, -t])
+    # error feedback: residual keeps what quantization lost
+    np.testing.assert_allclose(newres, g - out[:6].reshape(g.shape), atol=1e-6)
+
+
+def test_twobit_error_feedback_accumulates():
+    t = 1.0
+    g = np.full((64,), 0.4, np.float32)
+    res = np.zeros_like(g)
+    # three pushes of 0.4 accumulate: residuals 0.4, 0.8, then fire at 1.2
+    for step in range(3):
+        packed, res_j = pk.twobit_pack(jnp.asarray(g), jnp.asarray(res), t)
+        out = np.asarray(pk.twobit_unpack(packed, g.shape, t))
+        res = np.asarray(res_j)
+        if step < 2:
+            np.testing.assert_allclose(out, 0.0)
+        else:
+            np.testing.assert_allclose(out, t)
+    np.testing.assert_allclose(res, 3 * 0.4 - 1.0, atol=1e-5)
+
+
+def test_twobit_roundtrip_random_shapes():
+    rs = np.random.RandomState(0)
+    for shape in [(5,), (127,), (16, 129), (3, 4, 5)]:
+        g = rs.randn(*shape).astype(np.float32)
+        res = rs.randn(*shape).astype(np.float32) * 0.1
+        out, newres, packed = _roundtrip(g, res, 0.5)
+        eff = g + res
+        expect = np.where(eff >= 0.5, 0.5, np.where(eff <= -0.5, -0.5, 0.0))
+        np.testing.assert_allclose(out, expect.astype(np.float32), atol=1e-6)
+        np.testing.assert_allclose(newres, eff - expect, atol=1e-6)
+        assert packed.dtype == np.uint32
+        # 16x compression vs f32 (modulo block padding)
+        assert packed.size * 4 <= (g.size * 4) / 4 + 128 * 4
+
+
+def test_gradient_compression_uses_pallas_backend():
+    from mxnet_tpu.parallel.compression import GradientCompression
+
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    g = jnp.asarray(np.random.RandomState(1).randn(1000).astype(np.float32))
+    packed, res = gc.quantize(g)
+    out = gc.dequantize(packed, (1000,))
+    eff = np.asarray(g)
+    expect = np.where(eff >= 0.5, 0.5, np.where(eff <= -0.5, -0.5, 0.0))
+    np.testing.assert_allclose(np.asarray(out), expect, atol=1e-6)
