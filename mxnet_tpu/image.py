@@ -20,7 +20,7 @@ __all__ = ["imresize", "resize_short", "fixed_crop", "random_crop", "center_crop
            "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
            "HorizontalFlipAug", "CastAug", "ColorNormalizeAug", "BrightnessJitterAug",
            "ContrastJitterAug", "SaturationJitterAug", "LightingAug", "ColorJitterAug",
-           "CreateAugmenter", "ImageIter", "ImageRecordIterImpl"]
+           "CreateAugmenter", "ImageIter", "ImageDetIter", "ImageRecordIterImpl"]
 
 
 def _resize_np(img, h, w, interp=1):
@@ -370,3 +370,79 @@ def ImageRecordIterImpl(path_imgrec=None, data_shape=(3, 224, 224), batch_size=1
                      shuffle=shuffle, rand_crop=rand_crop, rand_mirror=rand_mirror,
                      mean=mean, std=std, num_parts=num_parts, part_index=part_index,
                      **kwargs)
+
+
+class ImageDetIter(ImageIter):
+    """Detection image iterator (reference: python/mxnet/image/detection.py
+    ImageDetIter — labels are variable-length object lists padded to a fixed
+    (max_objects, label_width) block per image; header-array records carry
+    [header_width, obj_width, obj0..., obj1...]).
+
+    Label layout per object: [cls, xmin, ymin, xmax, ymax, ...] normalized.
+    Batches yield label shape (B, max_objects, obj_width); missing objects
+    are -1-padded (the MultiBoxTarget invalid marker).
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 max_objects=16, obj_width=5, rand_mirror=False, **kwargs):
+        self.max_objects = int(max_objects)
+        self.obj_width = int(obj_width)
+        self._det_rand_mirror = rand_mirror
+        kwargs.pop("label_width", None)
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, rand_mirror=False, **kwargs)
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.max_objects, self.obj_width))]
+
+    def _parse_det_label(self, raw):
+        """Reference layout: [header_width, obj_width, (header...), objs...]"""
+        arr = _np.asarray(raw, dtype=_np.float32).ravel()
+        out = _np.full((self.max_objects, self.obj_width), -1.0, _np.float32)
+        if arr.size < 2:
+            return out
+        header_width = int(arr[0])
+        obj_width = int(arr[1])
+        body = arr[header_width:]
+        n = min(body.size // obj_width, self.max_objects)
+        objs = body[:n * obj_width].reshape(n, obj_width)
+        out[:n, :min(obj_width, self.obj_width)] = \
+            objs[:, :min(obj_width, self.obj_width)]
+        return out
+
+    def next(self):
+        if self.record is None or self.cursor + self.batch_size > len(self.imgkeys):
+            raise StopIteration
+        imgs, labels = [], []
+        for i in range(self.batch_size):
+            key = self.imgkeys[self.cursor + i]
+            header, img = _recordio.unpack_img(self.record.read_idx(key))
+            lab = self._parse_det_label(header.label)
+            for aug in self.auglist:
+                img = aug(img)
+            if self._det_rand_mirror and _pyrandom.random() < 0.5:
+                img = img[:, ::-1]
+                flipped = lab.copy()
+                valid = flipped[:, 0] >= 0
+                flipped[valid, 1] = 1.0 - lab[valid, 3]
+                flipped[valid, 3] = 1.0 - lab[valid, 1]
+                lab = flipped
+            if img.ndim == 2:
+                img = img[:, :, None]
+            imgs.append(_np.transpose(img, (2, 0, 1)))
+            labels.append(lab)
+        self.cursor += self.batch_size
+        return DataBatch([nd_array(_np.stack(imgs).astype(_np.float32))],
+                         [nd_array(_np.stack(labels))], pad=0)
+
+    @staticmethod
+    def pack_label(objects, header_width=2):
+        """Build the reference header-array label for pack_img:
+        [header_width, obj_width, obj0..., ...]."""
+        objects = _np.asarray(objects, dtype=_np.float32)
+        obj_width = objects.shape[1] if objects.ndim == 2 else 0
+        return _np.concatenate([
+            _np.asarray([header_width, obj_width], _np.float32),
+            objects.ravel()])

@@ -176,3 +176,40 @@ def test_ravel_unravel():
     np.testing.assert_allclose(flat.asnumpy(), [2, 5, 8])
     back = nd.unravel_index(flat, shape=(3, 4))
     np.testing.assert_allclose(back.asnumpy(), idx.asnumpy())
+
+
+def test_image_det_iter(tmp_path):
+    # reference: image/detection.py ImageDetIter — header-array labels,
+    # fixed-max-objects padding, box-aware mirror
+    from mxnet_tpu import recordio, image
+
+    path = str(tmp_path / "det.rec")
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "det.idx"), path, "w")
+    rs = np.random.RandomState(0)
+    for i in range(8):
+        img = (rs.rand(16, 16, 3) * 255).astype(np.uint8)
+        objs = np.array([[1.0, 0.1, 0.1, 0.5, 0.5],
+                         [0.0, 0.4, 0.4, 0.9, 0.9]], np.float32)[:1 + i % 2]
+        label = image.ImageDetIter.pack_label(objs)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, img_fmt=".png"))
+    w.close()
+
+    it = image.ImageDetIter(batch_size=4, data_shape=(3, 16, 16),
+                            path_imgrec=path, max_objects=4)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 16, 16)
+    assert batch.label[0].shape == (4, 4, 5)
+    lab = batch.label[0].asnumpy()
+    assert (lab[:, 0, 0] >= 0).all()
+    assert (lab[:, 2:, 0] == -1).all()
+    np.testing.assert_allclose(lab[0, 0], [1.0, 0.1, 0.1, 0.5, 0.5], atol=1e-6)
+    batches = 0
+    it.reset()
+    try:
+        while True:
+            it.next()
+            batches += 1
+    except StopIteration:
+        pass
+    assert batches == 2
