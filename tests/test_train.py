@@ -1,0 +1,105 @@
+"""Convergence/integration tests — real small models must hit accuracy
+thresholds (reference: tests/python/train/{test_mlp,test_conv,test_dtype}.py,
+SURVEY §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def _mnist_iters(batch_size=100, flat=True):
+    train = mx.io.MNISTIter(batch_size=batch_size, flat=flat, image=None)
+    val = mx.io.MNISTIter(batch_size=batch_size, flat=flat, image=None,
+                          shuffle=False)
+    return train, val
+
+
+def test_mlp_convergence():
+    # reference: tests/python/train/test_mlp.py — accuracy > 0.97 threshold
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    act1 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, num_hidden=64, name="fc2")
+    act2 = mx.sym.Activation(fc2, act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, num_hidden=10, name="fc3")
+    softmax = mx.sym.SoftmaxOutput(fc3, name="softmax")
+
+    train, val = _mnist_iters()
+    mod = mx.mod.Module(softmax, label_names=["softmax_label"])
+    mod.fit(train, eval_data=val, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    metric = mx.metric.Accuracy()
+    val.reset()
+    mod.score(val, metric)
+    assert metric.get()[1] > 0.97, metric.get()
+
+
+def test_conv_convergence():
+    # reference: tests/python/train/test_conv.py — lenet-ish > 0.93
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="c1")
+    a1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    flat = mx.sym.Flatten(p1)
+    fc = mx.sym.FullyConnected(flat, num_hidden=10, name="fc")
+    softmax = mx.sym.SoftmaxOutput(fc, name="softmax")
+
+    train, val = _mnist_iters(flat=False)
+    mod = mx.mod.Module(softmax, label_names=["softmax_label"])
+    mod.fit(train, num_epoch=3, optimizer="adam",
+            optimizer_params={"learning_rate": 0.003})
+    metric = mx.metric.Accuracy()
+    val.reset()
+    mod.score(val, metric)
+    assert metric.get()[1] > 0.93, metric.get()
+
+
+def test_gluon_bf16_training():
+    # reference: tests/python/train/test_dtype.py (fp16) — TPU analogue: the
+    # net trains with bfloat16 casts without diverging
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(512, 16).astype(np.float32)
+    yv = (X.sum(axis=1) > 0.0).astype(np.float32)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02, "multi_precision": True})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for epoch in range(30):
+        with autograd.record():
+            out = net(nd.array(X).astype("bfloat16")).astype("float32")
+            L = loss_fn(out, nd.array(yv))
+        L.backward()
+        trainer.step(len(X))
+        losses.append(float(L.mean().asnumpy()))
+    assert losses[-1] < 0.3 and losses[-1] < losses[0] / 2, losses
+
+
+def test_module_checkpoint_resume():
+    # reference: fit(begin_epoch=N) resume path (base_module.py:472-475)
+    import tempfile
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=10, name="fc")
+    softmax = mx.sym.SoftmaxOutput(fc, name="softmax")
+    train, _ = _mnist_iters()
+    with tempfile.TemporaryDirectory() as d:
+        prefix = f"{d}/model"
+        mod = mx.mod.Module(softmax, label_names=["softmax_label"])
+        mod.fit(train, num_epoch=1,
+                epoch_end_callback=mx.callback.do_checkpoint(prefix),
+                optimizer_params={"learning_rate": 0.1})
+        sym, args, auxs = mx.model.load_checkpoint(prefix, 1)
+        mod2 = mx.mod.Module(sym, label_names=["softmax_label"])
+        train.reset()
+        mod2.fit(train, num_epoch=2, arg_params=args, aux_params=auxs,
+                 begin_epoch=1, optimizer_params={"learning_rate": 0.1})
+        metric = mx.metric.Accuracy()
+        train.reset()
+        mod2.score(train, metric)
+        assert metric.get()[1] > 0.9
