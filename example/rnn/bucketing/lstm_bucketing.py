@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""LSTM with bucketing (reference: example/rnn/bucketing/lstm_bucketing.py).
+Thin entry over word_lm: BucketSentenceIter + BucketingModule + stacked
+LSTMCells; one compiled XLA program per bucket length."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from word_lm import train  # noqa: E402
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="LSTM bucketing LM")
+    parser.add_argument("--train-data", type=str, default=None)
+    parser.add_argument("--valid-data", type=str, default=None)
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--num-hidden", type=int, default=200)
+    parser.add_argument("--num-embed", type=int, default=200)
+    parser.add_argument("--buckets", type=str, default="10,20,30,40")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--optimizer", type=str, default="adam")
+    parser.add_argument("--disp-batches", type=int, default=50)
+    logging.basicConfig(level=logging.INFO, format="%(asctime)-15s %(message)s")
+    train(parser.parse_args())

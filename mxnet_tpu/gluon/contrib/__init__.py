@@ -1,0 +1,5 @@
+"""gluon.contrib (reference: python/mxnet/gluon/contrib/ — experimental
+blocks: nn.Concurrent/HybridConcurrent, convolutional RNN cells,
+VariationalDropoutCell)."""
+from . import nn  # noqa: F401
+from . import rnn  # noqa: F401

@@ -1,0 +1,66 @@
+"""Contrib nn blocks (reference: gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ..block import Block, HybridBlock
+from ..nn import Sequential, HybridSequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding"]
+
+
+class Concurrent(Sequential):
+    """Runs children on the same input and concatenates outputs
+    (reference: contrib.nn.Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference: contrib.nn.HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through (reference: contrib.nn.Identity)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding with row-sparse gradient semantics (reference:
+    contrib.nn.SparseEmbedding). On TPU the lookup is a dense gather; the
+    'sparse grad' optimization is XLA's scatter-add in the backward."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim}
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer, grad_stype="row_sparse")
+
+    def forward(self, x):
+        from ...ops.registry import get_op
+        from ...ndarray.ndarray import invoke
+
+        return invoke(get_op("Embedding"), [x, self.weight.data()],
+                      dict(self._kwargs))
+
+    def __repr__(self):
+        return (f"SparseEmbedding({self._kwargs['input_dim']} -> "
+                f"{self._kwargs['output_dim']})")

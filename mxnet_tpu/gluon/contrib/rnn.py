@@ -1,0 +1,155 @@
+"""Contrib RNN cells (reference: gluon/contrib/rnn/ — Conv{RNN,LSTM,GRU}Cell
+over spatial states, VariationalDropoutCell with a dropout mask fixed across
+time steps)."""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ..rnn.rnn_cell import RecurrentCell, _init
+
+__all__ = ["VariationalDropoutCell", "Conv2DRNNCell", "Conv2DLSTMCell",
+           "Conv2DGRUCell"]
+
+
+class VariationalDropoutCell(RecurrentCell):
+    """Wraps a cell applying the SAME dropout mask at every step
+    (reference: contrib.rnn.VariationalDropoutCell / Gal & Ghahramani)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._masks = {}
+
+    def reset(self):
+        super().reset()
+        self._masks = {}
+        if hasattr(self, "base_cell"):
+            self.base_cell.reset()
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def _mask(self, key, like, p):
+        if p == 0.0:
+            return None
+        if key not in self._masks:
+            keep = 1.0 - p
+            m = nd.random.uniform(shape=like.shape) < keep
+            self._masks[key] = m.astype("float32") / keep
+        return self._masks[key]
+
+    def __call__(self, inputs, states):
+        from ... import autograd
+
+        if autograd.is_training():
+            mi = self._mask("i", inputs, self.drop_inputs)
+            if mi is not None:
+                inputs = inputs * mi
+            ms = self._mask("s", states[0], self.drop_states)
+            if ms is not None:
+                states = [s * ms for s in states]
+        output, states = self.base_cell(inputs, states)
+        if autograd.is_training():
+            mo = self._mask("o", output, self.drop_outputs)
+            if mo is not None:
+                output = output * mo
+        return output, states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        self.reset()
+        return super().unroll(length, inputs, begin_state=begin_state,
+                              layout=layout, merge_outputs=merge_outputs,
+                              valid_length=valid_length)
+
+
+class _ConvRNNBase(RecurrentCell):
+    """Convolutional recurrence: gates are convs over (C, H, W) states
+    (reference: contrib/rnn/conv_rnn_cell.py)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), num_gates=1, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)   # (C, H, W)
+        self._hc = int(hidden_channels)
+        self._ng = num_gates
+        self._ik = tuple(i2h_kernel)
+        self._hk = tuple(h2h_kernel)
+        self._activation = activation
+        cin = self._input_shape[0]
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(self._ng * self._hc, cin) + self._ik,
+                init=_init(i2h_weight_initializer))
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(self._ng * self._hc, self._hc) + self._hk,
+                init=_init(h2h_weight_initializer))
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(self._ng * self._hc,), init="zeros")
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(self._ng * self._hc,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hc) + self._input_shape[1:]
+        n_states = 2 if self._ng == 4 else 1
+        return [{"shape": shape, "__layout__": "NCHW"}] * n_states
+
+    def _conv(self, x, weight, bias, kernel):
+        pad = tuple(k // 2 for k in kernel)
+        return nd.Convolution(x, weight, bias, kernel=kernel, pad=pad,
+                              num_filter=weight.shape[0])
+
+    def _gates(self, inputs, h):
+        i2h = self._conv(inputs, self.i2h_weight.data(),
+                         self.i2h_bias.data(), self._ik)
+        h2h = self._conv(h, self.h2h_weight.data(),
+                         self.h2h_bias.data(), self._hk)
+        return i2h, h2h
+
+
+class Conv2DRNNCell(_ConvRNNBase):
+    def __init__(self, input_shape, hidden_channels, **kwargs):
+        super().__init__(input_shape, hidden_channels, num_gates=1, **kwargs)
+
+    def __call__(self, inputs, states):
+        i2h, h2h = self._gates(inputs, states[0])
+        out = nd.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class Conv2DLSTMCell(_ConvRNNBase):
+    def __init__(self, input_shape, hidden_channels, **kwargs):
+        super().__init__(input_shape, hidden_channels, num_gates=4, **kwargs)
+
+    def __call__(self, inputs, states):
+        h, c = states
+        i2h, h2h = self._gates(inputs, h)
+        gates = i2h + h2h
+        sl = nd.SliceChannel(gates, num_outputs=4, axis=1)
+        i = nd.sigmoid(sl[0])
+        f = nd.sigmoid(sl[1])
+        g = nd.Activation(sl[2], act_type=self._activation)
+        o = nd.sigmoid(sl[3])
+        c_new = f * c + i * g
+        h_new = o * nd.Activation(c_new, act_type=self._activation)
+        return h_new, [h_new, c_new]
+
+
+class Conv2DGRUCell(_ConvRNNBase):
+    def __init__(self, input_shape, hidden_channels, **kwargs):
+        super().__init__(input_shape, hidden_channels, num_gates=3, **kwargs)
+
+    def __call__(self, inputs, states):
+        h = states[0]
+        i2h, h2h = self._gates(inputs, h)
+        isl = nd.SliceChannel(i2h, num_outputs=3, axis=1)
+        hsl = nd.SliceChannel(h2h, num_outputs=3, axis=1)
+        r = nd.sigmoid(isl[0] + hsl[0])
+        z = nd.sigmoid(isl[1] + hsl[1])
+        n = nd.Activation(isl[2] + r * hsl[2], act_type=self._activation)
+        return (1 - z) * n + z * h, [(1 - z) * n + z * h]

@@ -248,3 +248,30 @@ def test_split_and_load():
     parts = gluon.utils.split_and_load(data, [mx.cpu(), mx.cpu()])
     assert len(parts) == 2
     assert parts[0].shape == (3, 2)
+
+
+def test_gluon_contrib_blocks():
+    # reference: gluon/contrib — Concurrent, conv RNN cells, variational
+    # dropout (mask fixed across steps)
+    import numpy as np
+
+    from mxnet_tpu.gluon.contrib import nn as cnn, rnn as crnn
+
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(gluon.nn.Dense(3), gluon.nn.Dense(5))
+    net.initialize()
+    out = net(nd.array(np.random.rand(2, 4).astype(np.float32)))
+    assert out.shape == (2, 8)
+
+    cell = crnn.Conv2DLSTMCell((2, 8, 8), hidden_channels=4)
+    cell.initialize()
+    x = nd.array(np.random.rand(1, 2, 8, 8).astype(np.float32))
+    out, st = cell(x, cell.begin_state(batch_size=1))
+    assert out.shape == (1, 4, 8, 8) and len(st) == 2
+
+    base = gluon.rnn.LSTMCell(8, input_size=4)
+    vd = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    vd.initialize()
+    xs = nd.array(np.random.rand(2, 5, 4).astype(np.float32))
+    outs, _ = vd.unroll(5, xs, merge_outputs=True)
+    assert outs.shape == (2, 5, 8)
