@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Sparse op micro-benchmark (reference: benchmark/python/sparse/ —
+dot(csr, dense), row_sparse pull timing)."""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def bench(fn, iters=20):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    if hasattr(out, "wait_to_read"):
+        out.wait_to_read()
+    return (time.perf_counter() - t0) / iters
+
+
+def main(args):
+    rs = np.random.RandomState(0)
+    dense = rs.rand(args.rows, args.cols).astype(np.float32)
+    mask = rs.rand(args.rows, args.cols) < args.density
+    sparse_np = (dense * mask).astype(np.float32)
+    csr = sp.csr_matrix(sparse_np)
+    rhs = nd.array(rs.rand(args.cols, 64).astype(np.float32))
+    t = bench(lambda: nd.dot(csr, rhs))
+    print(f"dot(csr {args.rows}x{args.cols} d={args.density}, dense x64): "
+          f"{t*1e3:.2f} ms")
+
+    kv = mx.kv.create("local")
+    emb = rs.rand(args.rows, 64).astype(np.float32)
+    kv.init("emb", nd.array(emb))
+    out = nd.zeros((args.rows, 64))
+    row_ids = nd.array(rs.choice(args.rows, 256, replace=False)
+                       .astype(np.float32))
+    t = bench(lambda: kv.row_sparse_pull("emb", out=out, row_ids=row_ids))
+    print(f"row_sparse_pull 256/{args.rows} rows x64: {t*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rows", type=int, default=10000)
+    parser.add_argument("--cols", type=int, default=1000)
+    parser.add_argument("--density", type=float, default=0.01)
+    args = parser.parse_args()
+    main(args)

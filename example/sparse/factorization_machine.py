@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Factorization machine on sparse input (reference:
+example/sparse/factorization_machine/ — FM over LibSVM csr features:
+y = w0 + <w, x> + 0.5 * sum((Vx)^2 - (V^2)(x^2))).
+
+Synthetic click data; reports log-loss and AUC-ish accuracy."""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+class FM(gluon.Block):
+    def __init__(self, num_features, factor_size, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.w = self.params.get("w", shape=(num_features, 1),
+                                     init=mx.init.Normal(0.01))
+            self.V = self.params.get("V", shape=(num_features, factor_size),
+                                     init=mx.init.Normal(0.01))
+            self.b = self.params.get("b", shape=(1,), init="zeros")
+
+    def forward(self, x):
+        w, V, b = self.w.data(), self.V.data(), self.b.data()
+        linear = nd.dot(x, w).reshape((-1,))
+        vx = nd.dot(x, V)                       # (B, k)
+        v2x2 = nd.dot(x * x, V * V)             # (B, k)
+        pairwise = 0.5 * (vx * vx - v2x2).sum(axis=1)
+        return linear + pairwise + b.reshape((1,))
+
+
+def synthetic_clicks(n, num_features, rank, seed=0):
+    rs = np.random.RandomState(seed)
+    X = np.zeros((n, num_features), np.float32)
+    for i in range(n):
+        active = rs.choice(num_features, 10, replace=False)
+        X[i, active] = 1.0
+    Vt = rs.randn(num_features, rank).astype(np.float32) * 0.5
+    wt = rs.randn(num_features).astype(np.float32) * 0.3
+    score = X @ wt + 0.5 * (((X @ Vt) ** 2).sum(1)
+                            - ((X ** 2) @ (Vt ** 2)).sum(1))
+    y = (score > np.median(score)).astype(np.float32)
+    return X, y
+
+
+def main(args):
+    X, y = synthetic_clicks(args.num_samples, args.num_features,
+                            args.factor_size)
+    net = FM(args.num_features, args.factor_size)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    n = len(y)
+    from mxnet_tpu.ndarray import sparse as sp
+
+    for epoch in range(args.epochs):
+        perm = np.random.RandomState(epoch).permutation(n)
+        total = 0.0
+        for i in range(0, n - args.batch_size + 1, args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            xb = sp.csr_matrix(X[idx])
+            yb = nd.array(y[idx])
+            with autograd.record():
+                L = loss_fn(net(xb), yb)
+            L.backward()
+            trainer.step(args.batch_size)
+            total += float(L.mean().asnumpy())
+        logging.info("epoch %d: logloss %.4f", epoch,
+                     total / (n // args.batch_size))
+    pred = net(sp.csr_matrix(X)).asnumpy() > 0
+    acc = float((pred == y).mean())
+    logging.info("train accuracy: %.3f", acc)
+    return acc
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="factorization machine")
+    parser.add_argument("--num-samples", type=int, default=4000)
+    parser.add_argument("--num-features", type=int, default=200)
+    parser.add_argument("--factor-size", type=int, default=8)
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--epochs", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=0.01)
+    logging.basicConfig(level=logging.INFO, format="%(asctime)-15s %(message)s")
+    main(parser.parse_args())

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Matrix factorization with sparse embedding gradients (reference:
+example/sparse/matrix_factorization/ — user/item embeddings trained on
+rating triples; row-sparse grads only touch the rows in the batch).
+
+Synthetic ratings from a low-rank ground truth; reports RMSE."""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+class MFBlock(gluon.Block):
+    def __init__(self, num_users, num_items, factor_size, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.user_emb = nn.Embedding(num_users, factor_size)
+            self.item_emb = nn.Embedding(num_items, factor_size)
+            self.user_bias = nn.Embedding(num_users, 1)
+            self.item_bias = nn.Embedding(num_items, 1)
+
+    def forward(self, users, items):
+        p = self.user_emb(users) * self.item_emb(items)
+        return (p.sum(axis=1) + self.user_bias(users).reshape((-1,))
+                + self.item_bias(items).reshape((-1,)))
+
+
+def synthetic_ratings(num_users, num_items, rank, n, seed=0):
+    rs = np.random.RandomState(seed)
+    U = rs.randn(num_users, rank).astype(np.float32) / np.sqrt(rank)
+    V = rs.randn(num_items, rank).astype(np.float32) / np.sqrt(rank)
+    users = rs.randint(0, num_users, n).astype(np.float32)
+    items = rs.randint(0, num_items, n).astype(np.float32)
+    ratings = (U[users.astype(int)] * V[items.astype(int)]).sum(axis=1) \
+        + 0.05 * rs.randn(n).astype(np.float32)
+    return users, items, ratings.astype(np.float32)
+
+
+def main(args):
+    users, items, ratings = synthetic_ratings(
+        args.num_users, args.num_items, args.factor_size, args.num_samples)
+    net = MFBlock(args.num_users, args.num_items, args.factor_size)
+    net.initialize(mx.init.Normal(0.05))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.L2Loss()
+    n = len(ratings)
+    for epoch in range(args.epochs):
+        perm = np.random.RandomState(epoch).permutation(n)
+        total, t0 = 0.0, time.time()
+        for i in range(0, n - args.batch_size + 1, args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            u = nd.array(users[idx])
+            v = nd.array(items[idx])
+            r = nd.array(ratings[idx])
+            with autograd.record():
+                L = loss_fn(net(u, v), r)
+            L.backward()
+            trainer.step(args.batch_size)
+            total += float(L.mean().asnumpy())
+        rmse = np.sqrt(2 * total / (n // args.batch_size))
+        logging.info("epoch %d: rmse %.4f (%.1fs)", epoch, rmse,
+                     time.time() - t0)
+    return rmse
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="matrix factorization")
+    parser.add_argument("--num-users", type=int, default=500)
+    parser.add_argument("--num-items", type=int, default=300)
+    parser.add_argument("--factor-size", type=int, default=16)
+    parser.add_argument("--num-samples", type=int, default=20000)
+    parser.add_argument("--batch-size", type=int, default=512)
+    parser.add_argument("--epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.01)
+    logging.basicConfig(level=logging.INFO, format="%(asctime)-15s %(message)s")
+    main(parser.parse_args())
