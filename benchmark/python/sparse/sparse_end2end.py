@@ -16,12 +16,12 @@ from mxnet_tpu.ndarray import sparse as sp
 
 
 def bench(fn, iters=20):
-    fn()  # warm
+    fn()
+    nd.waitall()  # warm-up fully drained before the timed window
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn()
-    if hasattr(out, "wait_to_read"):
-        out.wait_to_read()
+        fn()
+    nd.waitall()  # include the last iteration's device work
     return (time.perf_counter() - t0) / iters
 
 
@@ -40,10 +40,11 @@ def main(args):
     emb = rs.rand(args.rows, 64).astype(np.float32)
     kv.init("emb", nd.array(emb))
     out = nd.zeros((args.rows, 64))
-    row_ids = nd.array(rs.choice(args.rows, 256, replace=False)
+    n_pull = min(256, args.rows)
+    row_ids = nd.array(rs.choice(args.rows, n_pull, replace=False)
                        .astype(np.float32))
     t = bench(lambda: kv.row_sparse_pull("emb", out=out, row_ids=row_ids))
-    print(f"row_sparse_pull 256/{args.rows} rows x64: {t*1e3:.2f} ms")
+    print(f"row_sparse_pull {n_pull}/{args.rows} rows x64: {t*1e3:.2f} ms")
 
 
 if __name__ == "__main__":

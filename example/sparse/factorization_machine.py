@@ -59,6 +59,7 @@ def main(args):
                             {"learning_rate": args.lr})
     loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
     n = len(y)
+    num_batches = max(1, n // args.batch_size)
     from mxnet_tpu.ndarray import sparse as sp
 
     for epoch in range(args.epochs):
@@ -74,7 +75,7 @@ def main(args):
             trainer.step(args.batch_size)
             total += float(L.mean().asnumpy())
         logging.info("epoch %d: logloss %.4f", epoch,
-                     total / (n // args.batch_size))
+                     total / num_batches)
     pred = net(sp.csr_matrix(X)).asnumpy() > 0
     acc = float((pred == y).mean())
     logging.info("train accuracy: %.3f", acc)

@@ -54,6 +54,7 @@ def main(args):
                             {"learning_rate": args.lr})
     loss_fn = gluon.loss.L2Loss()
     n = len(ratings)
+    num_batches = max(1, n // args.batch_size)
     for epoch in range(args.epochs):
         perm = np.random.RandomState(epoch).permutation(n)
         total, t0 = 0.0, time.time()
@@ -67,7 +68,7 @@ def main(args):
             L.backward()
             trainer.step(args.batch_size)
             total += float(L.mean().asnumpy())
-        rmse = np.sqrt(2 * total / (n // args.batch_size))
+        rmse = np.sqrt(2 * total / num_batches)
         logging.info("epoch %d: rmse %.4f (%.1fs)", epoch, rmse,
                      time.time() - t0)
     return rmse

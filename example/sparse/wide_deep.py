@@ -67,6 +67,7 @@ def main(args):
                             {"learning_rate": args.lr})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     n = len(y)
+    num_batches = max(1, n // args.batch_size)
     from mxnet_tpu.ndarray import sparse as sp
 
     for epoch in range(args.epochs):
@@ -85,7 +86,7 @@ def main(args):
             trainer.step(args.batch_size)
             total += float(L.mean().asnumpy())
         logging.info("epoch %d: loss %.4f", epoch,
-                     total / (n // args.batch_size))
+                     total / num_batches)
     # accuracy
     logits = net(sp.csr_matrix(wide), nd.array(cats)).asnumpy()
     acc = float((logits.argmax(axis=1) == y).mean())
