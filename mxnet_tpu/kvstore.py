@@ -69,9 +69,21 @@ class KVStore:
         self._updater = Updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
+        """Enable 2-bit gradient compression on the push path.
+
+        Matches the reference's support matrix (python/mxnet/kvstore.py +
+        kvstore_dist.h:348-370): device-reduce and dist stores only, dense
+        fp32 gradients only; pulls stay full precision
+        (docs/faq/gradient_compression.md).
+        """
+        if self._type == "local":
+            raise MXNetError(
+                "gradient compression is not supported for 'local' kvstore "
+                "(reference supports 'device' and 'dist' types only)")
         from .parallel.compression import GradientCompression
 
         self._grad_compression = GradientCompression(**compression_params)
+        self._residuals: Dict = {}
 
     def _set_updater(self, updater):
         self._updater = updater
@@ -134,20 +146,41 @@ class KVStoreLocal(KVStore):
             else:
                 self._store[k] = NDArray(v0._data)
 
-    def _reduce(self, vals: List[NDArray]):
+    def _compress(self, key, slot, data: jnp.ndarray) -> jnp.ndarray:
+        """Quantize-dequantize one contribution with error feedback, as the
+        reference does per device before the reduce (gradient_compression.h:
+        111-121 — quantize accumulates the error into a per-slot residual)."""
+        gc = self._grad_compression
+        if data.dtype != jnp.float32:
+            raise MXNetError("gradient compression supports fp32 only "
+                             "(reference kvstore_dist_server.h:607)")
+        dq, new_res = gc.quantize_dequantize(data, self._residuals.get((key, slot)))
+        self._residuals[(key, slot)] = new_res
+        return dq
+
+    def _reduce(self, vals: List[NDArray], key=None):
+        compress = (self._grad_compression is not None
+                    and self._grad_compression.type != "none"
+                    and not any(isinstance(v, _sparse.BaseSparseNDArray)
+                                for v in vals))
         if len(vals) == 1:
             v = vals[0]
             if isinstance(v, _sparse.RowSparseNDArray):
                 return v
+            if compress:
+                return NDArray(self._compress(key, 0, v._data))
             return NDArray(v._data)
         if any(isinstance(v, _sparse.RowSparseNDArray) for v in vals):
             idx = jnp.concatenate([v.indices_ for v in vals])
             values = jnp.concatenate([v.values_ for v in vals])
             return _sparse.RowSparseNDArray(values, idx, vals[0].shape)
         # one fused XLA reduction; inputs migrate to the first buffer's device
-        acc = vals[0]._data
-        for v in vals[1:]:
-            acc = acc + jax.device_put(v._data, list(acc.devices())[0])
+        datas = [v._data for v in vals]
+        if compress:
+            datas = [self._compress(key, i, d) for i, d in enumerate(datas)]
+        acc = datas[0]
+        for d in datas[1:]:
+            acc = acc + jax.device_put(d, list(acc.devices())[0])
         return NDArray(acc)
 
     def push(self, key, value, priority=0):
@@ -158,7 +191,7 @@ class KVStoreLocal(KVStore):
             values = [values] if not isinstance(values[0], (list, tuple)) else values
         for k, v in zip(keys, values):
             vlist = _as_list(v)
-            merged = self._reduce(vlist)
+            merged = self._reduce(vlist, key=k)
             if k not in self._store:
                 raise MXNetError(f"kvstore: key {k!r} not initialized")
             if self._updater is not None:

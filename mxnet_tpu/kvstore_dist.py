@@ -95,6 +95,7 @@ class KVStoreDistServer:
         self._num_workers = num_workers
         self._updater = None
         self._sync_mode = False
+        self._grad_compression = None  # set by the workers' set_compression
         self._barrier_count = {}
         self._heartbeats: Dict[int, float] = {}
         self._stop = False
@@ -145,6 +146,32 @@ class KVStoreDistServer:
         if cmd == "push":
             _, key, rank, value = msg
             return self._push(key, rank, value)
+        if cmd == "push_c":
+            # Compressed push: the worker quantized, the server dequantizes
+            # into the merge buffer (reference kvstore_dist_server.h:636-655).
+            _, key, rank, packed, shape = msg
+            if self._grad_compression is None:
+                return ("error", "compressed push before set_compression")
+            try:
+                import jax.numpy as jnp
+
+                value = _np.asarray(self._grad_compression.dequantize(
+                    jnp.asarray(packed), shape, dtype=jnp.float32))
+            except Exception as e:  # malformed blob must not kill the thread
+                return ("error", f"dequantize failed for {key!r}: {e}")
+            return self._push(key, rank, value)
+        if cmd == "set_compression":
+            from .parallel.compression import GradientCompression
+
+            with self._lock:
+                if self._grad_compression is None:
+                    self._grad_compression = GradientCompression(**msg[1])
+                elif self._grad_compression.wire_params() != msg[1]:
+                    return ("error",
+                            f"compression params mismatch across workers: "
+                            f"server has {self._grad_compression.wire_params()},"
+                            f" got {msg[1]}")
+            return ("ok",)
         if cmd == "pull":
             _, key, min_version = msg
             return self._pull(key, min_version)
@@ -347,7 +374,23 @@ class KVStoreDist(KVStore):
             local = vs[0].asnumpy()
             for v in vs[1:]:  # reduce device list locally first
                 local = local + v.asnumpy()
-            self._request("push", str(k), self._rank, local)
+            gc = self._grad_compression
+            if gc is not None and gc.type != "none":
+                if local.dtype != _np.float32:
+                    raise MXNetError(
+                        "gradient compression supports fp32 only "
+                        "(reference kvstore_dist_server.h:607)")
+                # quantize on the worker; 2 bits/elem cross the wire
+                # (reference kvstore_dist.h:379-390)
+                import jax.numpy as jnp
+
+                packed, new_res = gc.quantize(
+                    jnp.asarray(local), self._residuals.get(str(k)))
+                self._residuals[str(k)] = new_res
+                self._request("push_c", str(k), self._rank,
+                              _np.asarray(packed), local.shape)
+            else:
+                self._request("push", str(k), self._rank, local)
             if self._sync:
                 self._pull_version[str(k)] = \
                     self._pull_version.get(str(k), 0) + 1
@@ -384,6 +427,14 @@ class KVStoreDist(KVStore):
     def set_optimizer(self, optimizer):
         if self._rank == 0:
             self._request("set_optimizer", pickle.dumps(optimizer))
+        self.barrier()
+
+    def set_gradient_compression(self, compression_params):
+        super().set_gradient_compression(compression_params)
+        # every worker must call this (reference requirement); the server
+        # keeps the first params and needs them before any push_c arrives,
+        # which the barrier guarantees
+        self._request("set_compression", self._grad_compression.wire_params())
         self.barrier()
 
     @property

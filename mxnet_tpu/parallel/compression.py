@@ -28,6 +28,19 @@ class GradientCompression:
     def get_params(self):
         return {"type": self.type, "threshold": self.threshold}
 
+    def wire_params(self):
+        """Everything a peer needs to decode this instance's packed blobs —
+        the backend determines the packed layout, so it must match."""
+        return {"type": self.type, "threshold": self.threshold,
+                "backend": self.backend}
+
+    def quantize_dequantize(self, grad, residual=None):
+        """One error-feedback round trip: returns (dequantized, new_residual)."""
+        if residual is None:
+            residual = jnp.zeros_like(grad)
+        packed, new_residual = self.quantize(grad, residual)
+        return self.dequantize(packed, grad.shape, dtype=grad.dtype), new_residual
+
     def quantize(self, grad, residual=None):
         """Returns (packed int32 words, new_residual).
 

