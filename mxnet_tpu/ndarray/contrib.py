@@ -22,32 +22,90 @@ for _name, _op in list(OP_REGISTRY.items()):
         setattr(_mod, _name, getattr(_mod, short))
 
 
+def _as_nd_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _pack_like(values, template):
+    if isinstance(template, (list, tuple)):
+        return list(values)
+    return values[0]
+
+
+def _is_traced(*arrays) -> bool:
+    import jax
+
+    return any(isinstance(a._data, jax.core.Tracer) for a in arrays
+               if isinstance(a, NDArray))
+
+
 def foreach(body, data, init_states):
-    """Reference: control-flow op _foreach (src/operator/control_flow.cc:1256).
-    Imperative version: a Python loop (the symbolic/jit path uses lax.scan)."""
-    states = init_states if isinstance(init_states, list) else [init_states]
-    seq = data if isinstance(data, list) else [data]
+    """Scan `body` over axis 0 of `data`, threading `states`.
+
+    Reference: control-flow op _foreach (src/operator/control_flow.cc:1256).
+    Eager inputs run a Python loop (each op recorded by autograd); traced
+    inputs (hybridize / jit) lower to ``lax.scan`` so the loop compiles
+    without unrolling.  The symbolic twin is ``sym.contrib.foreach``.
+    """
+    seq = _as_nd_list(data)
+    states = _as_nd_list(init_states)
+    if _is_traced(*seq, *states):
+        return _traced_foreach(body, data, init_states)
     T = seq[0].shape[0]
     outs = None
+    out_is_list = False
+    st = _pack_like(states, init_states)
     for t in range(T):
         xs = [s[t] for s in seq]
-        out, states = body(xs[0] if len(xs) == 1 else xs, states)
-        out_list = out if isinstance(out, list) else [out]
+        out, st = body(xs[0] if len(xs) == 1 else xs, st)
+        out_is_list = isinstance(out, (list, tuple))
+        out_list = list(out) if out_is_list else [out]
         if outs is None:
             outs = [[] for _ in out_list]
         for acc, o in zip(outs, out_list):
             acc.append(o)
     import mxnet_tpu.ndarray as nd
 
+    if outs is None:  # zero-length sequence
+        return [], st
     stacked = [nd.stack(*acc, axis=0) for acc in outs]
-    return (stacked[0] if len(stacked) == 1 else stacked), states
+    return (list(stacked) if out_is_list else stacked[0]), st
+
+
+def _traced_foreach(body, data, init_states):
+    import jax
+
+    seq = _as_nd_list(data)
+    states = _as_nd_list(init_states)
+    out_is_list = [None]  # discovered inside the first trace of `step`
+
+    def step(carry, xs):
+        out, ns = body(_pack_like([NDArray(x) for x in xs], data),
+                       _pack_like([NDArray(c) for c in carry], init_states))
+        out_is_list[0] = isinstance(out, (list, tuple))
+        return (tuple(n._data for n in _as_nd_list(ns)),
+                tuple(o._data for o in _as_nd_list(out)))
+
+    carry, ys = jax.lax.scan(step, tuple(s._data for s in states),
+                             tuple(d._data for d in seq))
+    outs = [NDArray(y) for y in ys]
+    final = [NDArray(c) for c in carry]
+    return (list(outs) if out_is_list[0] else outs[0],
+            _pack_like(final, init_states))
 
 
 def while_loop(cond, func, loop_vars, max_iterations=None):
-    """Reference: _while_loop (control_flow.cc:1317). Imperative version."""
+    """Reference: _while_loop (control_flow.cc:1317).
+
+    Eager: a Python loop with a host-evaluated condition.  Traced inputs use
+    a masked ``lax.scan`` over max_iterations (required then), zero-padding
+    outputs after the condition fails — same contract as the symbolic twin.
+    """
+    lv = list(loop_vars)
+    if _is_traced(*lv):
+        return _traced_while_loop(cond, func, lv, max_iterations)
     steps = 0
     outs = None
-    lv = list(loop_vars)
     while bool(cond(*lv).asscalar()) and (max_iterations is None or steps < max_iterations):
         out, lv = func(*lv)
         out_list = out if isinstance(out, list) else [out]
@@ -63,8 +121,52 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
     return [nd.stack(*acc, axis=0) for acc in outs], lv
 
 
+def _traced_while_loop(cond, func, loop_vars, max_iterations):
+    import jax
+    import jax.numpy as jnp
+
+    if max_iterations is None:
+        raise ValueError("while_loop under trace requires max_iterations "
+                         "(static shapes)")
+
+    def step(carry, _):
+        lv, active = carry
+        lv_nd = [NDArray(a) for a in lv]
+        c = cond(*lv_nd)._data
+        run = jnp.logical_and(active, jnp.squeeze(c).astype(jnp.bool_))
+        out, new_lv = func(*lv_nd)
+        new_lv = tuple(jnp.where(run, n._data, a)
+                       for a, n in zip(lv, new_lv))
+        ys = tuple(jnp.where(run, o._data, jnp.zeros_like(o._data))
+                   for o in _as_nd_list(out))
+        return (new_lv, run), ys
+
+    (final_lv, _), ys = jax.lax.scan(
+        step, (tuple(a._data for a in loop_vars), jnp.bool_(True)),
+        None, length=int(max_iterations))
+    return [NDArray(y) for y in ys], [NDArray(a) for a in final_lv]
+
+
 def cond(pred, then_func, else_func):
-    """Reference: _cond (control_flow.cc:1379). Imperative version."""
+    """Reference: _cond (control_flow.cc:1379).  Traced predicates lower to
+    ``lax.cond`` (both branches must match in shape/dtype)."""
+    if _is_traced(pred):
+        import jax
+        import jax.numpy as jnp
+
+        is_list = [False]  # set at trace time inside the branch
+
+        def wrap(branch):
+            def f(_):
+                out = branch()
+                is_list[0] = isinstance(out, (list, tuple))
+                return tuple(o._data for o in _as_nd_list(out))
+            return f
+
+        picked = jax.lax.cond(jnp.squeeze(pred._data).astype(jnp.bool_),
+                              wrap(then_func), wrap(else_func), None)
+        outs = [NDArray(p) for p in picked]
+        return list(outs) if is_list[0] else outs[0]
     if bool(pred.asscalar()):
         return then_func()
     return else_func()

@@ -26,6 +26,14 @@ for _name in list(OP_REGISTRY):
         setattr(contrib, _name[len("_contrib_"):], getattr(_mod, _name))
         setattr(contrib, _name, getattr(_mod, _name))
 
+# traceable control flow (reference: src/operator/control_flow.cc via
+# python/mxnet/symbol/contrib.py)
+from .control_flow import foreach, while_loop, cond  # noqa: E402
+
+contrib.foreach = foreach
+contrib.while_loop = while_loop
+contrib.cond = cond
+
 
 def __getattr__(name):
     """Late-registered ops (e.g. 'Custom', registered by mx.operator at

@@ -1,0 +1,274 @@
+"""Traceable control flow: sym.contrib.{foreach,while_loop,cond} and the
+tracer-aware nd.contrib twins.
+
+Reference model: tests/python/unittest/test_contrib_control_flow.py — an RNN
+built with foreach must match the hand-unrolled oracle in forward AND
+gradient, inside a bound symbol; while_loop/cond must match their eager
+semantics.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym, autograd
+
+
+def _rnn_oracle(x, h0, w, u, b):
+    """Unrolled reference: h_t = tanh(x_t @ w + h_{t-1} @ u + b)."""
+    hs = []
+    h = h0
+    for t in range(x.shape[0]):
+        h = np.tanh(x[t] @ w + h @ u + b)
+        hs.append(h)
+    return np.stack(hs), h
+
+
+def test_foreach_rnn_matches_unrolled_oracle():
+    T, B, D, H = 5, 3, 4, 6
+    rs = np.random.RandomState(0)
+    x_np = rs.rand(T, B, D).astype(np.float32)
+    h0_np = rs.rand(B, H).astype(np.float32)
+    w_np = (rs.randn(D, H) * 0.4).astype(np.float32)
+    u_np = (rs.randn(H, H) * 0.4).astype(np.float32)
+    b_np = rs.rand(H).astype(np.float32)
+
+    data = sym.var("data")
+    h0 = sym.var("h0")
+    w = sym.var("w")
+    u = sym.var("u")
+    b = sym.var("b")
+
+    def body(x_t, states):
+        h = states[0]
+        nh = sym.tanh(sym.broadcast_add(sym.dot(x_t, w) + sym.dot(h, u), b))
+        return nh, [nh]
+
+    outs, final = mx.sym.contrib.foreach(body, data, [h0])
+    ex = outs.bind(ctx=mx.cpu(), args={
+        "data": nd.array(x_np), "h0": nd.array(h0_np), "w": nd.array(w_np),
+        "u": nd.array(u_np), "b": nd.array(b_np)},
+        args_grad={"w": nd.zeros((D, H)), "u": nd.zeros((H, H)),
+                   "data": nd.zeros((T, B, D))},
+        grad_req={"w": "write", "u": "write", "data": "write"})
+    y = ex.forward(is_train=True)
+    ys_ref, h_ref = _rnn_oracle(x_np, h0_np, w_np, u_np, b_np)
+    assert np.allclose(np.asarray(y[0].asnumpy()), ys_ref, atol=1e-5)
+
+    # gradient vs jax oracle
+    ex.backward(nd.ones((T, B, H)))
+    import jax
+    import jax.numpy as jnp
+
+    def loss(w_, u_, x_):
+        h = jnp.asarray(h0_np)
+        tot = 0.0
+        for t in range(T):
+            h = jnp.tanh(x_[t] @ w_ + h @ u_ + jnp.asarray(b_np))
+            tot = tot + h.sum()
+        return tot
+
+    gw, gu, gx = jax.grad(loss, argnums=(0, 1, 2))(
+        jnp.asarray(w_np), jnp.asarray(u_np), jnp.asarray(x_np))
+    assert np.allclose(ex.grad_dict["w"].asnumpy(), np.asarray(gw), atol=1e-4)
+    assert np.allclose(ex.grad_dict["u"].asnumpy(), np.asarray(gu), atol=1e-4)
+    assert np.allclose(ex.grad_dict["data"].asnumpy(), np.asarray(gx), atol=1e-4)
+
+
+def test_foreach_closure_over_outer_computation():
+    # body closes over an outer op RESULT (not just a var): the subgraph must
+    # cut at the boundary and wire the outer entry as a closure input
+    data = sym.var("data")
+    h0 = sym.var("h0")
+    scale = sym.var("scale")
+    doubled = scale * 2.0  # outer computation
+
+    def body(x_t, states):
+        s = states[0] + sym.broadcast_mul(x_t, doubled)
+        return s, [s]
+
+    outs, final = mx.sym.contrib.foreach(body, data, [h0])
+    T, B = 4, 3
+    rs = np.random.RandomState(1)
+    x_np = rs.rand(T, B).astype(np.float32)
+    h0_np = np.zeros((B,), np.float32)
+    ex = final[0].bind(ctx=mx.cpu(), args={
+        "data": nd.array(x_np), "h0": nd.array(h0_np),
+        "scale": nd.array(np.array([3.0], np.float32))}, grad_req="null")
+    out = ex.forward(is_train=False)
+    expect = (x_np * 6.0).sum(axis=0)
+    assert np.allclose(out[0].asnumpy(), expect, atol=1e-5)
+
+
+def test_while_loop_symbolic_matches_eager():
+    # accumulate i into s while s < 10, max 8 iterations
+    s0 = sym.var("s0")
+    i0 = sym.var("i0")
+
+    outs, finals = mx.sym.contrib.while_loop(
+        lambda s, i: s < 10.0,
+        lambda s, i: ([s + i], [s + i, i + 1.0]),
+        [s0, i0], max_iterations=8)
+    ex = sym.Group([outs[0], finals[0], finals[1]]).bind(
+        ctx=mx.cpu(),
+        args={"s0": nd.array(np.array([0.0], np.float32)),
+              "i0": nd.array(np.array([1.0], np.float32))},
+        grad_req="null")
+    got = ex.forward(is_train=False)
+    # eager oracle
+    s, i = 0.0, 1.0
+    rows = []
+    while s < 10.0 and len(rows) < 8:
+        s = s + i
+        rows.append(s)
+        i += 1.0
+    padded = np.zeros((8, 1), np.float32)
+    padded[:len(rows), 0] = rows
+    assert np.allclose(got[0].asnumpy(), padded), got[0].asnumpy()
+    assert np.allclose(got[1].asnumpy(), s)
+    assert np.allclose(got[2].asnumpy(), i)
+
+
+def test_cond_symbolic():
+    a = sym.var("a")
+    b = sym.var("b")
+    pred = sym.sum(a) > sym.sum(b)
+    out = mx.sym.contrib.cond(pred, lambda: a * 2.0, lambda: b * 3.0)
+    for av, bv, expect in [(3.0, 1.0, 6.0), (1.0, 3.0, 9.0)]:
+        ex = out.bind(ctx=mx.cpu(), args={
+            "a": nd.array(np.array([av], np.float32)),
+            "b": nd.array(np.array([bv], np.float32))}, grad_req="null")
+        got = ex.forward(is_train=False)
+        assert np.allclose(got[0].asnumpy(), expect), (av, bv)
+
+
+def test_cond_gradient_flows_through_taken_branch():
+    a = sym.var("a")
+    pred = sym.sum(a) > 0.0
+    out = mx.sym.contrib.cond(pred, lambda: a * 2.0, lambda: a * 5.0)
+    ex = out.bind(ctx=mx.cpu(),
+                  args={"a": nd.array(np.array([2.0], np.float32))},
+                  args_grad={"a": nd.zeros((1,))}, grad_req="write")
+    ex.forward(is_train=True)
+    ex.backward(nd.ones((1,)))
+    assert np.allclose(ex.grad_dict["a"].asnumpy(), 2.0)
+
+
+def test_nd_foreach_eager_and_traced_agree():
+    T, B = 6, 2
+    rs = np.random.RandomState(2)
+    x = nd.array(rs.rand(T, B).astype(np.float32))
+    s0 = nd.array(np.zeros((B,), np.float32))
+
+    def body(x_t, states):
+        s = states[0] + x_t * x_t
+        return s * 0.5, [s]
+
+    outs, fin = nd.contrib.foreach(body, x, [s0])
+    import jax
+
+    def traced(xv, sv):
+        o, f = nd.contrib.foreach(body, nd.NDArray(xv), [nd.NDArray(sv)])
+        return o._data, f[0]._data
+
+    o2, f2 = jax.jit(traced)(x._data, s0._data)
+    assert np.allclose(outs.asnumpy(), np.asarray(o2), atol=1e-6)
+    assert np.allclose(fin[0].asnumpy(), np.asarray(f2), atol=1e-6)
+
+
+def test_nd_while_and_cond_traced():
+    import jax
+
+    def traced_while(s):
+        outs, lv = nd.contrib.while_loop(
+            lambda a: nd.sum(a) < 10.0,
+            lambda a: ([a], [a * 2.0]),
+            [nd.NDArray(s)], max_iterations=6)
+        return lv[0]._data
+
+    got = jax.jit(traced_while)(np.array([1.0], np.float32))
+    # 1 -> 2 -> 4 -> 8 -> 16 (cond fails at 16)
+    assert np.allclose(np.asarray(got), 16.0), got
+
+    def traced_cond(p, a):
+        out = nd.contrib.cond(nd.NDArray(p),
+                              lambda: nd.NDArray(a) * 2.0,
+                              lambda: nd.NDArray(a) * 3.0)
+        return out._data
+
+    assert np.allclose(np.asarray(jax.jit(traced_cond)(
+        np.array(1.0, np.float32), np.array([2.0], np.float32))), 4.0)
+    assert np.allclose(np.asarray(jax.jit(traced_cond)(
+        np.array(0.0, np.float32), np.array([2.0], np.float32))), 6.0)
+
+
+def test_foreach_dropout_masks_differ_per_step():
+    # each scan step must draw fresh randomness (a fold_in of the step index)
+    data = sym.var("data")
+    s0 = sym.var("s0")
+
+    def body(x_t, states):
+        return sym.Dropout(x_t, p=0.5), states
+
+    outs, _ = mx.sym.contrib.foreach(body, data, [s0])
+    T, B = 8, 64
+    ex = outs.bind(ctx=mx.cpu(),
+                   args={"data": nd.array(np.ones((T, B), np.float32)),
+                         "s0": nd.array(np.zeros((1,), np.float32))},
+                   grad_req="null")
+    y = ex.forward(is_train=True)[0].asnumpy()
+    masks = (y != 0)
+    distinct = {masks[t].tobytes() for t in range(T)}
+    assert len(distinct) > 1, "same dropout mask at every timestep"
+
+
+def test_nd_foreach_single_element_list_output_consistent():
+    # body returning a 1-element list must yield a list in BOTH eager and
+    # traced modes
+    import jax
+
+    x = nd.array(np.random.rand(3, 2).astype(np.float32))
+    s0 = nd.array(np.zeros((2,), np.float32))
+
+    def body(x_t, states):
+        return [x_t * 2.0], [states[0] + x_t]
+
+    eager_out, _ = nd.contrib.foreach(body, x, [s0])
+    assert isinstance(eager_out, list) and len(eager_out) == 1
+
+    def traced(xv, sv):
+        out, st = nd.contrib.foreach(body, nd.NDArray(xv), [nd.NDArray(sv)])
+        assert isinstance(out, list) and len(out) == 1
+        return out[0]._data
+
+    got = jax.jit(traced)(x._data, s0._data)
+    assert np.allclose(np.asarray(got), eager_out[0].asnumpy())
+
+
+def test_foreach_under_hybridize():
+    from mxnet_tpu import gluon
+
+    class ScanBlock(gluon.HybridBlock):
+        def __init__(self, hidden, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.proj = gluon.nn.Dense(hidden, in_units=hidden,
+                                           flatten=False)
+
+        def hybrid_forward(self, F, x):
+            def body(x_t, states):
+                h = F.tanh(self.proj(x_t) + states[0])
+                return h, [h]
+
+            init = F.sum(x, axis=0) * 0.0  # (B, H) of zeros
+            outs, _ = F.contrib.foreach(body, x, [init])
+            return outs
+
+    T, B, H = 4, 2, 8
+    rs = np.random.RandomState(3)
+    x = nd.array(rs.rand(T, B, H).astype(np.float32))
+    net = ScanBlock(H)
+    net.initialize()
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert np.allclose(eager, hybrid, atol=1e-5)
