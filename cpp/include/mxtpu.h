@@ -91,6 +91,29 @@ int mxtpu_rec_write(void *handle, const uint8_t *data, uint64_t len);
 int64_t mxtpu_rec_writer_tell(void *handle);
 void mxtpu_rec_writer_close(void *handle);
 
+/* --------------------------------------------------------- image pipeline */
+
+/* Threaded decode+augment pipeline over a RecordIO file of packed images
+ * (reference: ImageRecordIOParser2 OMP loop, src/io/iter_image_recordio_2.cc:
+ * 138-171). Workers decode JPEG (libjpeg) or RAW0 blobs, resize the shorter
+ * side to `resize_px`, crop out_h x out_w (random if rand_crop, else center),
+ * optionally mirror, and emit uint8 NHWC batches + float labels.
+ * Trailing partial batches are discarded. */
+int mxtpu_imgpipe_open(const char *path, int batch_size, int out_h, int out_w,
+                       int resize_px, int num_threads, int queue_depth,
+                       int shard_index, int num_shards, int rand_crop,
+                       int rand_mirror, int label_width, uint64_t seed,
+                       void **out_handle);
+void mxtpu_imgpipe_close(void *handle);
+
+/* 0 with *out_batch != NULL: a batch; 0 with NULL: end of epoch; nonzero:
+ * error (mxtpu_last_error()). */
+int mxtpu_imgpipe_next(void *handle, void **out_batch);
+void mxtpu_imgpipe_get(void *batch, const uint8_t **data, const float **labels,
+                       int *count);
+void mxtpu_imgpipe_free(void *batch);
+int mxtpu_imgpipe_reset(void *handle);
+
 /* --------------------------------------------------------------- storage */
 
 void *mxtpu_pool_alloc(size_t size);

@@ -13,6 +13,13 @@
  * ImageRecordIOParser2 (src/io/iter_image_recordio_2.cc:175-206). Sharding
  * for data parallelism assigns record ordinals round-robin
  * (ordinal % num_shards == shard_index).
+ *
+ * Multipart framing (dmlc recordio escaping): a payload containing the magic
+ * word at a 4-byte-aligned offset is split there on write — the magic word
+ * is dropped and the pieces are written as consecutive parts with the
+ * continuation flag (bits 31..29 of the length word) set to 1 (start),
+ * 2 (middle), 3 (end). Readers rejoin the parts with the magic word
+ * re-inserted between them, so ordinals/sharding count LOGICAL records.
  */
 #include "../include/mxtpu.h"
 
@@ -31,11 +38,52 @@
 namespace {
 
 constexpr uint32_t kMagic = 0xced7230a;
-constexpr uint32_t kLenMask = (1u << 29) - 1;
+constexpr uint32_t kLenBits = 29;
+constexpr uint32_t kLenMask = (1u << kLenBits) - 1;
 
 struct Batch {
   std::vector<std::string> records;
 };
+
+// Reads one *part* (header + payload). Returns 1 on success, 0 on clean
+// EOF before the header, -1 on corruption/truncation.
+int ReadPart(FILE *f, uint32_t *cflag, std::string *payload, bool skip) {
+  uint32_t header[2];
+  size_t n = std::fread(header, 1, sizeof(header), f);
+  if (n == 0) return 0;
+  if (n < sizeof(header) || header[0] != kMagic) return -1;
+  uint32_t len = header[1] & kLenMask;
+  uint32_t padded = (len + 3u) & ~3u;
+  *cflag = header[1] >> kLenBits;
+  if (skip) {
+    std::fseek(f, padded, SEEK_CUR);
+    return 1;
+  }
+  size_t base = payload->size();
+  payload->resize(base + len);
+  if (len && std::fread(&(*payload)[base], 1, len, f) != len) return -1;
+  if (padded != len) std::fseek(f, padded - len, SEEK_CUR);
+  return 1;
+}
+
+// Reads one LOGICAL record, reassembling multipart payloads with the magic
+// word re-inserted between parts (dmlc recordio semantics). Same returns
+// as ReadPart.
+int ReadLogical(FILE *f, std::string *rec, bool skip) {
+  uint32_t cflag = 0;
+  rec->clear();
+  int r = ReadPart(f, &cflag, rec, skip);
+  if (r <= 0) return r;
+  if (cflag == 0) return 1;
+  if (cflag != 1) return -1;  // stream must not start mid-record
+  for (;;) {
+    if (!skip) rec->append(reinterpret_cast<const char *>(&kMagic), 4);
+    r = ReadPart(f, &cflag, rec, skip);
+    if (r <= 0) return -1;  // EOF inside a multipart record is corruption
+    if (cflag == 3) return 1;
+    if (cflag != 2) return -1;
+  }
+}
 
 class RecReader {
  public:
@@ -105,26 +153,17 @@ class RecReader {
     auto batch = std::make_unique<Batch>();
     int64_t ordinal = 0;
     for (;;) {
-      uint32_t header[2];
-      size_t n = std::fread(header, 1, sizeof(header), f);
-      if (n == 0) break;  // clean EOF
-      if (n < sizeof(header) || header[0] != kMagic) {
-        Finish(path_ + ": corrupt record header");
+      bool mine = (ordinal % num_shards_) == shard_index_;
+      ++ordinal;
+      std::string rec;
+      int r = ReadLogical(f, &rec, !mine);
+      if (r == 0) break;  // clean EOF
+      if (r < 0) {
+        Finish(path_ + ": corrupt or truncated record");
         std::fclose(f);
         return;
       }
-      uint32_t len = header[1] & kLenMask;
-      uint32_t padded = (len + 3u) & ~3u;
-      bool mine = (ordinal % num_shards_) == shard_index_;
-      ++ordinal;
       if (mine) {
-        std::string rec(len, '\0');
-        if (std::fread(&rec[0], 1, len, f) != len) {
-          Finish(path_ + ": truncated record");
-          std::fclose(f);
-          return;
-        }
-        if (padded != len) std::fseek(f, padded - len, SEEK_CUR);
         batch->records.push_back(std::move(rec));
         if (static_cast<int>(batch->records.size()) >= batch_records_) {
           if (!Emit(std::move(batch))) {
@@ -133,8 +172,6 @@ class RecReader {
           }
           batch = std::make_unique<Batch>();
         }
-      } else {
-        std::fseek(f, padded, SEEK_CUR);
       }
     }
     std::fclose(f);
@@ -180,8 +217,28 @@ class RecWriter {
   bool ok() const { return f_ != nullptr; }
 
   int Write(const uint8_t *data, uint64_t len) {
-    if (len > kLenMask) return 1;  // multipart framing unsupported; reject
-    uint32_t header[2] = {kMagic, static_cast<uint32_t>(len & kLenMask)};
+    if (len > kLenMask) return 1;  // dmlc caps a logical record at 2^29
+    // find 4-byte-aligned magic occurrences; split there (dmlc escaping)
+    std::vector<uint64_t> splits;
+    for (uint64_t off = 0; off + 4 <= len; off += 4) {
+      uint32_t word;
+      std::memcpy(&word, data + off, 4);
+      if (word == kMagic) splits.push_back(off);
+    }
+    if (splits.empty()) return WritePart(data, len, 0);
+    uint64_t pos = 0;
+    for (size_t i = 0; i <= splits.size(); ++i) {
+      uint64_t end = i < splits.size() ? splits[i] : len;
+      uint32_t cflag = i == 0 ? 1u : (i == splits.size() ? 3u : 2u);
+      if (WritePart(data + pos, end - pos, cflag)) return 1;
+      pos = end + 4;  // skip the magic word itself
+    }
+    return 0;
+  }
+
+  int WritePart(const uint8_t *data, uint64_t len, uint32_t cflag) {
+    uint32_t header[2] = {kMagic, static_cast<uint32_t>(len & kLenMask) |
+                                      (cflag << kLenBits)};
     if (std::fwrite(header, 1, sizeof(header), f_) != sizeof(header)) return 1;
     if (len && std::fwrite(data, 1, len, f_) != len) return 1;
     uint32_t pad = (4u - (len & 3u)) & 3u;
@@ -237,17 +294,15 @@ int mxtpu_rec_reset(void *handle) {
 int64_t mxtpu_rec_count(const char *path) {
   FILE *f = std::fopen(path, "rb");
   if (!f) return -1;
-  int64_t count = 0;
+  int64_t count = 0;  // LOGICAL records: multipart groups count once
+  std::string scratch;
   for (;;) {
-    uint32_t header[2];
-    size_t n = std::fread(header, 1, sizeof(header), f);
-    if (n == 0) break;
-    if (n < sizeof(header) || header[0] != kMagic) {
+    int r = ReadLogical(f, &scratch, /*skip=*/true);
+    if (r == 0) break;
+    if (r < 0) {
       std::fclose(f);
       return -1;
     }
-    uint32_t padded = ((header[1] & kLenMask) + 3u) & ~3u;
-    std::fseek(f, padded, SEEK_CUR);
     ++count;
   }
   std::fclose(f);

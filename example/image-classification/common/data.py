@@ -1,0 +1,95 @@
+"""Data helpers for the image-classification examples (reference:
+example/image-classification/common/data.py — add_data_args/get_rec_iter)."""
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def add_data_args(parser: argparse.ArgumentParser):
+    data = parser.add_argument_group("Data")
+    data.add_argument("--data-train", type=str, default=None,
+                      help="training RecordIO file")
+    data.add_argument("--data-val", type=str, default=None,
+                      help="validation RecordIO file")
+    data.add_argument("--data-dir", type=str, default="data")
+    data.add_argument("--image-shape", type=str, default="3,224,224")
+    data.add_argument("--num-classes", type=int, default=1000)
+    data.add_argument("--resize", type=int, default=256,
+                      help="shorter-side resize before crop")
+    data.add_argument("--data-nthreads", type=int, default=4,
+                      help="native decode worker threads")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
+    data.add_argument("--rgb-std", type=str, default="1,1,1")
+    data.add_argument("--synthetic", action="store_true",
+                      help="generate a synthetic RecordIO set when the "
+                           "requested files are absent (no-egress runs)")
+    data.add_argument("--synthetic-size", type=int, default=2048,
+                      help="images per synthetic split")
+    data.add_argument("--synthetic-encoding", type=str, default="raw",
+                      choices=("raw", "jpeg"),
+                      help="raw = uint8 blobs (IO-bound benchmark), "
+                           "jpeg = real decode work")
+    return data
+
+
+def make_synthetic_rec(path, num, shape_chw, num_classes, encoding="raw",
+                       seed=0, edge=None):
+    """Write a synthetic .rec: random images whose class is recoverable from
+    the image mean, so training on it actually converges."""
+    c, h, w = shape_chw
+    edge = edge or max(h, w) + 32   # stored bigger than the crop target
+    rs = np.random.RandomState(seed)
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(num):
+        label = i % num_classes
+        base = 32 + (label * (192 // max(1, num_classes - 1)) if num_classes > 1
+                     else 96)
+        img = rs.randint(0, 64, (edge, edge, 3)).astype(np.int16) + base
+        img = np.clip(img, 0, 255).astype(np.uint8)
+        if encoding == "jpeg":
+            buf = recordio.pack_img(
+                recordio.IRHeader(0, float(label), i, 0), img, img_fmt=".jpg")
+        else:
+            enc = b"RAW0" + struct.pack("<I", 3) + \
+                np.asarray(img.shape, np.int32).tobytes() + img.tobytes()
+            buf = recordio.pack(recordio.IRHeader(0, float(label), i, 0), enc)
+        writer.write(buf)
+    writer.close()
+
+
+def get_rec_iter(args, kv):
+    """(train, val) iterators over RecordIO files; synthesizes the files when
+    --synthetic is set and they don't exist."""
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    mean = [float(x) for x in args.rgb_mean.split(",")]
+    std = [float(x) for x in args.rgb_std.split(",")]
+    train_path = args.data_train or os.path.join(args.data_dir, "train.rec")
+    val_path = args.data_val or os.path.join(args.data_dir, "val.rec")
+    if args.synthetic:
+        os.makedirs(os.path.dirname(os.path.abspath(train_path)), exist_ok=True)
+        if not os.path.exists(train_path):
+            make_synthetic_rec(train_path, args.synthetic_size, shape,
+                               args.num_classes, args.synthetic_encoding)
+        if not os.path.exists(val_path):
+            make_synthetic_rec(val_path, max(args.batch_size,
+                                             args.synthetic_size // 8),
+                               shape, args.num_classes,
+                               args.synthetic_encoding, seed=1)
+    common = dict(
+        data_shape=shape, batch_size=args.batch_size, resize=args.resize,
+        preprocess_threads=args.data_nthreads,
+        mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        std_r=std[0], std_g=std[1], std_b=std[2],
+        num_parts=kv.num_workers, part_index=kv.rank)
+    train = mx.io.ImageRecordIter(path_imgrec=train_path, rand_crop=True,
+                                  rand_mirror=True, shuffle=True, **common)
+    val = mx.io.ImageRecordIter(path_imgrec=val_path, **common) \
+        if os.path.exists(val_path) else None
+    return train, val

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Train on ImageNet-class data — the judge config (reference:
+example/image-classification/train_imagenet.py + common/fit.py).
+
+Feeds the chip from RecordIO via the native C++ decode+augment pipeline
+(cpp/src/imagedec.cc); with --synthetic it manufactures a convergeable
+synthetic .rec set first (raw blobs for an IO-bound run, JPEG for real
+decode work), so the full train path runs without the dataset.
+
+  python train_imagenet.py --network resnet --num-layers 50 \
+      --synthetic --num-classes 100 --batch-size 128
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from common import data, fit
+
+
+def get_network(args):
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    if args.network == "resnet":
+        from symbols import resnet
+
+        return resnet.get_symbol(args.num_classes, args.num_layers or 50,
+                                 ",".join(str(s) for s in shape))
+    if args.network == "mlp":
+        from symbols import mlp
+
+        return mlp.get_symbol(args.num_classes)
+    if args.network == "lenet":
+        from symbols import lenet
+
+        return lenet.get_symbol(args.num_classes)
+    raise ValueError(f"unknown network {args.network!r}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train on imagenet-class data",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(network="resnet", num_layers=50, batch_size=128,
+                        num_epochs=1, lr=0.1, lr_step_epochs="30,60,80",
+                        num_examples=2048)
+    args = parser.parse_args()
+    net = get_network(args)
+    fit.fit(args, net, data.get_rec_iter)

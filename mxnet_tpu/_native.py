@@ -15,7 +15,7 @@ import subprocess
 import threading
 
 __all__ = ["lib", "last_error", "NativeEngine", "RecordReader", "RecordWriter",
-           "rec_count", "pool_stats"]
+           "ImagePipeline", "rec_count", "pool_stats"]
 
 _lock = threading.Lock()
 _lib = None
@@ -59,6 +59,18 @@ def _declare(lib):
     lib.mxtpu_rec_writer_tell.argtypes = [p]
     lib.mxtpu_rec_writer_tell.restype = ctypes.c_int64
     lib.mxtpu_rec_writer_close.argtypes = [p]
+    lib.mxtpu_imgpipe_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, u64, ctypes.POINTER(p)]
+    lib.mxtpu_imgpipe_close.argtypes = [p]
+    lib.mxtpu_imgpipe_next.argtypes = [p, ctypes.POINTER(p)]
+    lib.mxtpu_imgpipe_get.argtypes = [
+        p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.mxtpu_imgpipe_free.argtypes = [p]
+    lib.mxtpu_imgpipe_reset.argtypes = [p]
     lib.mxtpu_pool_alloc.argtypes = [ctypes.c_size_t]
     lib.mxtpu_pool_alloc.restype = p
     lib.mxtpu_pool_free.argtypes = [p, ctypes.c_size_t]
@@ -286,6 +298,74 @@ class RecordWriter:
     def close(self):
         if getattr(self, "_h", None):
             self._lib.mxtpu_rec_writer_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ImagePipeline:
+    """Native threaded decode+augment pipeline (cpp/src/imagedec.cc).
+
+    Yields (uint8 NHWC ndarray (B,H,W,3), float32 labels (B,label_width))
+    per batch; the device side does transpose/normalize (uint8 crosses the
+    host link, 4x cheaper than float32).
+    """
+
+    def __init__(self, path, batch_size, data_shape=(3, 224, 224),
+                 resize=256, num_threads=4, queue_depth=4, shard_index=0,
+                 num_shards=1, rand_crop=False, rand_mirror=False,
+                 label_width=1, seed=0):
+        l = lib()
+        if l is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = l
+        c, h, w = data_shape
+        if c != 3:
+            raise ValueError("native image pipeline is RGB-only (C=3)")
+        self.batch_size = batch_size
+        self.h, self.w = h, w
+        self.label_width = label_width
+        handle = ctypes.c_void_p()
+        if l.mxtpu_imgpipe_open(path.encode(), batch_size, h, w, resize,
+                                num_threads, queue_depth, shard_index,
+                                num_shards, int(rand_crop), int(rand_mirror),
+                                label_width, seed, ctypes.byref(handle)):
+            raise IOError(last_error())
+        self._h = handle
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = ctypes.c_void_p()
+        if self._lib.mxtpu_imgpipe_next(self._h, ctypes.byref(batch)):
+            raise IOError(last_error())
+        if not batch.value:
+            raise StopIteration
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        labels = ctypes.POINTER(ctypes.c_float)()
+        count = ctypes.c_int()
+        self._lib.mxtpu_imgpipe_get(batch, ctypes.byref(data),
+                                    ctypes.byref(labels), ctypes.byref(count))
+        import numpy as np
+
+        n = count.value
+        img = np.ctypeslib.as_array(data, (n, self.h, self.w, 3)).copy()
+        lab = np.ctypeslib.as_array(labels, (n, self.label_width)).copy()
+        self._lib.mxtpu_imgpipe_free(batch)
+        return img, lab
+
+    def reset(self):
+        if self._lib.mxtpu_imgpipe_reset(self._h):
+            raise IOError(last_error())
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.mxtpu_imgpipe_close(self._h)
             self._h = None
 
     def __del__(self):

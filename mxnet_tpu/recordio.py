@@ -55,21 +55,41 @@ class MXRecordIO:
         if self.pid != os.getpid():
             self.open()
 
-    def write(self, buf: bytes):
-        assert self.flag == "w"
-        self._check_pid()
-        self.handle.write(struct.pack("<II", _MAGIC, len(buf)))
+    def _write_part(self, buf: bytes, cflag: int):
+        self.handle.write(struct.pack("<II", _MAGIC,
+                                      len(buf) | (cflag << _LFLAG_BITS)))
         self.handle.write(buf)
         pad = (4 - (len(buf) % 4)) % 4
         if pad:
             self.handle.write(b"\x00" * pad)
 
-    def read(self):
-        assert self.flag == "r"
+    def write(self, buf: bytes):
+        """Write one logical record, escaping embedded magic words via dmlc
+        multipart framing (split at 4-byte-aligned magic occurrences; parts
+        carry continuation flags 1/2/3 and readers rejoin with the magic
+        re-inserted)."""
+        assert self.flag == "w"
         self._check_pid()
+        assert len(buf) < (1 << _LFLAG_BITS), "record too large"
+        magic_bytes = struct.pack("<I", _MAGIC)
+        aligned = len(buf) - (len(buf) % 4)
+        words = _np.frombuffer(buf[:aligned], dtype="<u4") if aligned else \
+            _np.empty(0, dtype="<u4")
+        splits = (4 * _np.flatnonzero(words == _MAGIC)).tolist()
+        if not splits:
+            self._write_part(buf, 0)
+            return
+        pos = 0
+        bounds = splits + [len(buf)]
+        for i, end in enumerate(bounds):
+            cflag = 1 if i == 0 else (3 if i == len(bounds) - 1 else 2)
+            self._write_part(buf[pos:end], cflag)
+            pos = end + len(magic_bytes)  # skip the magic word itself
+
+    def _read_part(self):
         header = self.handle.read(8)
         if len(header) < 8:
-            return None
+            return None, 0
         magic, lrec = struct.unpack("<II", header)
         if magic != _MAGIC:
             raise ValueError(f"{self.uri}: bad record magic {magic:#x}")
@@ -78,7 +98,30 @@ class MXRecordIO:
         pad = (4 - (length % 4)) % 4
         if pad:
             self.handle.read(pad)
-        return buf
+        return buf, lrec >> _LFLAG_BITS
+
+    def read(self):
+        """Read one logical record, reassembling multipart payloads (the
+        inverse of write's escaping; dmlc recordio semantics)."""
+        assert self.flag == "r"
+        self._check_pid()
+        buf, cflag = self._read_part()
+        if buf is None or cflag == 0:
+            return buf
+        if cflag != 1:
+            raise ValueError(f"{self.uri}: stream starts mid-record")
+        parts = [buf]
+        magic_bytes = struct.pack("<I", _MAGIC)
+        while True:
+            buf, cflag = self._read_part()
+            if buf is None:
+                raise ValueError(f"{self.uri}: EOF inside multipart record")
+            parts.append(magic_bytes)
+            parts.append(buf)
+            if cflag == 3:
+                return b"".join(parts)
+            if cflag != 2:
+                raise ValueError(f"{self.uri}: bad continuation flag {cflag}")
 
     def tell(self):
         return self.handle.tell()
