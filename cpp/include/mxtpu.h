@@ -97,13 +97,16 @@ void mxtpu_rec_writer_close(void *handle);
  * (reference: ImageRecordIOParser2 OMP loop, src/io/iter_image_recordio_2.cc:
  * 138-171). Workers decode JPEG (libjpeg) or RAW0 blobs, resize the shorter
  * side to `resize_px`, crop out_h x out_w (random if rand_crop, else center),
- * optionally mirror, and emit uint8 NHWC batches + float labels.
- * Trailing partial batches are discarded. */
+ * optionally mirror, and emit uint8 NHWC batches + float labels. `shuffle`
+ * permutes record order within a per-worker window of several batches.
+ * A trailing partial batch is padded to batch_size by repeating its own rows;
+ * mxtpu_imgpipe_get reports the real sample count so callers can set
+ * DataBatch.pad = batch_size - count. */
 int mxtpu_imgpipe_open(const char *path, int batch_size, int out_h, int out_w,
                        int resize_px, int num_threads, int queue_depth,
                        int shard_index, int num_shards, int rand_crop,
-                       int rand_mirror, int label_width, uint64_t seed,
-                       void **out_handle);
+                       int rand_mirror, int shuffle, int label_width,
+                       uint64_t seed, void **out_handle);
 void mxtpu_imgpipe_close(void *handle);
 
 /* 0 with *out_batch != NULL: a batch; 0 with NULL: end of epoch; nonzero:

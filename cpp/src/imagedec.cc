@@ -37,12 +37,15 @@
 #include <thread>
 #include <vector>
 
+#ifdef MXTPU_HAVE_LIBJPEG
 #include <jpeglib.h>
+#endif
 
 namespace {
 
 // ------------------------------------------------------------------ decode
 
+#ifdef MXTPU_HAVE_LIBJPEG
 struct JpegErr {
   jpeg_error_mgr mgr;
   jmp_buf jmp;
@@ -105,6 +108,22 @@ bool DecodeJpeg(const uint8_t *data, size_t len, int min_side,
   jpeg_destroy_decompress(&cinfo);
   return true;
 }
+#else
+// Built without libjpeg: JPEG records are reported as undecodable (skipped);
+// RAW0 blobs still work so the core runtime never disappears. Diagnose once
+// instead of silently yielding an empty epoch on a JPEG dataset.
+bool DecodeJpeg(const uint8_t *, size_t, int, std::vector<uint8_t> *,
+                std::vector<uint8_t> *, int *, int *) {
+  static std::atomic<bool> warned{false};
+  if (!warned.exchange(true)) {
+    std::fprintf(stderr,
+                 "[mxtpu] libmxtpu.so was built without libjpeg; JPEG "
+                 "records are skipped (rebuild with libjpeg-dev for JPEG "
+                 "datasets)\n");
+  }
+  return false;
+}
+#endif
 
 // The repo's PIL-free fallback blob: "RAW0" + ndim + int32 shape + uint8 data.
 bool DecodeRaw0(const uint8_t *data, size_t len, std::vector<uint8_t> *out,
@@ -364,7 +383,8 @@ class ImagePipeline {
 
   bool DecodeOne(const std::string &rec, std::mt19937 &rng,
                  std::vector<uint8_t> *decoded, std::vector<uint8_t> *resized,
-                 uint8_t *out_px, float *out_label) {
+                 std::vector<uint8_t> *row_scratch, uint8_t *out_px,
+                 float *out_label) {
     // IRHeader: uint32 flag, float label, uint64 id, uint64 id2 (24 bytes)
     if (rec.size() < 24) return false;
     const uint8_t *p = reinterpret_cast<const uint8_t *>(rec.data());
@@ -390,7 +410,7 @@ class ImagePipeline {
     if (img_len >= 4 && std::memcmp(img, "RAW0", 4) == 0) {
       ok = DecodeRaw0(img, img_len, decoded, &h, &w);
     } else {
-      ok = DecodeJpeg(img, img_len, cfg_.resize_px, decoded, &h, &w);
+      ok = DecodeJpeg(img, img_len, cfg_.resize_px, decoded, row_scratch, &h, &w);
     }
     if (!ok) return false;
 
@@ -446,9 +466,10 @@ class ImagePipeline {
   std::condition_variable cv_push_, cv_pop_, cv_rec_;
   std::deque<std::unique_ptr<ImgBatch>> queue_;
   std::deque<std::string> pending_;
-  void *pending_batch_ = nullptr;
-  bool stop_ = false, stream_end_ = false;
+  std::atomic<bool> stop_{false};
+  bool stream_end_ = false;
   int workers_done_ = 0;
+  int epoch_ = 0;
   std::string error_;
 };
 
@@ -459,8 +480,8 @@ extern "C" {
 int mxtpu_imgpipe_open(const char *path, int batch_size, int out_h, int out_w,
                        int resize_px, int num_threads, int queue_depth,
                        int shard_index, int num_shards, int rand_crop,
-                       int rand_mirror, int label_width, uint64_t seed,
-                       void **out_handle) {
+                       int rand_mirror, int shuffle, int label_width,
+                       uint64_t seed, void **out_handle) {
   void *rec = nullptr;
   if (mxtpu_rec_open(path, std::max(64, batch_size), 4, shard_index,
                      num_shards, &rec)) {
@@ -475,6 +496,7 @@ int mxtpu_imgpipe_open(const char *path, int batch_size, int out_h, int out_w,
   cfg.queue_depth = std::max(1, queue_depth);
   cfg.rand_crop = rand_crop;
   cfg.rand_mirror = rand_mirror;
+  cfg.shuffle = shuffle;
   cfg.label_width = std::max(1, label_width);
   cfg.seed = seed;
   *out_handle = new ImagePipeline(rec, cfg);

@@ -15,7 +15,14 @@ import subprocess
 import threading
 
 __all__ = ["lib", "last_error", "NativeEngine", "RecordReader", "RecordWriter",
-           "ImagePipeline", "rec_count", "pool_stats"]
+           "ImagePipeline", "rec_count", "pool_stats",
+           "NativeUnsupportedError"]
+
+
+class NativeUnsupportedError(ValueError):
+    """A configuration the native pipeline intentionally does not support;
+    callers may fall back to the Python path on exactly this error."""
+
 
 _lock = threading.Lock()
 _lib = None
@@ -62,7 +69,8 @@ def _declare(lib):
     lib.mxtpu_imgpipe_open.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int, ctypes.c_int, u64, ctypes.POINTER(p)]
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, u64,
+        ctypes.POINTER(p)]
     lib.mxtpu_imgpipe_close.argtypes = [p]
     lib.mxtpu_imgpipe_next.argtypes = [p, ctypes.POINTER(p)]
     lib.mxtpu_imgpipe_get.argtypes = [
@@ -91,12 +99,22 @@ def lib():
         _tried = True
         if os.environ.get("MXTPU_NO_NATIVE"):
             return None
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(["make", "-C", _CPP_DIR], check=True,
-                               capture_output=True, timeout=300)
-            except Exception:
-                return None
+        # always invoke make: the dependency rule makes it a no-op when the
+        # .so is current, and it rebuilds stale libraries after C ABI changes
+        try:
+            subprocess.run(["make", "-C", _CPP_DIR], check=True,
+                           capture_output=True, timeout=300)
+        except subprocess.CalledProcessError as e:
+            import logging
+            logging.getLogger("mxnet_tpu").error(
+                "native runtime build failed (make -C %s):\n%s",
+                _CPP_DIR, (e.stderr or b"").decode(errors="replace")[-2000:])
+            return None
+        except Exception as e:
+            import logging
+            logging.getLogger("mxnet_tpu").error(
+                "native runtime build failed: %s", e)
+            return None
         try:
             _lib = _declare(ctypes.CDLL(_LIB_PATH))
         except OSError:
@@ -318,14 +336,15 @@ class ImagePipeline:
     def __init__(self, path, batch_size, data_shape=(3, 224, 224),
                  resize=256, num_threads=4, queue_depth=4, shard_index=0,
                  num_shards=1, rand_crop=False, rand_mirror=False,
-                 label_width=1, seed=0):
+                 shuffle=False, label_width=1, seed=0):
         l = lib()
         if l is None:
             raise RuntimeError("native runtime unavailable")
         self._lib = l
         c, h, w = data_shape
         if c != 3:
-            raise ValueError("native image pipeline is RGB-only (C=3)")
+            raise NativeUnsupportedError(
+                "native image pipeline is RGB-only (C=3)")
         self.batch_size = batch_size
         self.h, self.w = h, w
         self.label_width = label_width
@@ -333,7 +352,8 @@ class ImagePipeline:
         if l.mxtpu_imgpipe_open(path.encode(), batch_size, h, w, resize,
                                 num_threads, queue_depth, shard_index,
                                 num_shards, int(rand_crop), int(rand_mirror),
-                                label_width, seed, ctypes.byref(handle)):
+                                int(shuffle), label_width, seed,
+                                ctypes.byref(handle)):
             raise IOError(last_error())
         self._h = handle
 
@@ -353,11 +373,13 @@ class ImagePipeline:
                                     ctypes.byref(labels), ctypes.byref(count))
         import numpy as np
 
-        n = count.value
-        img = np.ctypeslib.as_array(data, (n, self.h, self.w, 3)).copy()
-        lab = np.ctypeslib.as_array(labels, (n, self.label_width)).copy()
+        # the native side pads trailing batches to batch_size by repeating
+        # rows; count is the real sample count (DataBatch.pad = B - count)
+        B = self.batch_size
+        img = np.ctypeslib.as_array(data, (B, self.h, self.w, 3)).copy()
+        lab = np.ctypeslib.as_array(labels, (B, self.label_width)).copy()
         self._lib.mxtpu_imgpipe_free(batch)
-        return img, lab
+        return img, lab, count.value
 
     def reset(self):
         if self._lib.mxtpu_imgpipe_reset(self._h):

@@ -413,7 +413,7 @@ class ImageRecordIterNative(DataIter):
             path_imgrec, batch_size, data_shape=data_shape, resize=resize,
             num_threads=preprocess_threads, queue_depth=prefetch_buffer,
             shard_index=part_index, num_shards=num_parts,
-            rand_crop=rand_crop or shuffle, rand_mirror=rand_mirror,
+            rand_crop=rand_crop, rand_mirror=rand_mirror, shuffle=shuffle,
             label_width=label_width, seed=seed)
         self._shape = data_shape
         self._label_width = label_width
@@ -447,13 +447,13 @@ class ImageRecordIterNative(DataIter):
     def next(self):
         from .ndarray.ndarray import NDArray
 
-        try:
-            img, lab = next(self._pipe)
-        except StopIteration:
-            raise
+        img, lab, count = next(self._pipe)
         data = NDArray(self._preprocess(img))
         label = lab[:, 0] if self._label_width == 1 else lab
-        return DataBatch([data], [NDArray(_jnp_asarray(label))], pad=0)
+        # trailing batches arrive padded to batch_size (fixed shapes keep the
+        # jitted step from recompiling); pad counts the repeated rows
+        return DataBatch([data], [NDArray(_jnp_asarray(label))],
+                         pad=self.batch_size - count)
 
     def reset(self):
         self._pipe.reset()
@@ -484,8 +484,14 @@ def ImageRecordIter(**kwargs):
         if native_ok:
             try:
                 return ImageRecordIterNative(**kwargs)
-            except (TypeError, IOError, RuntimeError, ValueError):
-                pass  # unsupported combination: fall back to Python path
+            except (_native.NativeUnsupportedError, TypeError) as e:
+                # only configurations the native stage declares unsupported
+                # (or kwargs it doesn't take) fall back; real IO errors raise
+                import logging
+
+                logging.getLogger("mxnet_tpu").warning(
+                    "native image pipeline unavailable for this "
+                    "configuration (%s); using the Python path", e)
     from .image import ImageRecordIterImpl
 
     return ImageRecordIterImpl(**kwargs)

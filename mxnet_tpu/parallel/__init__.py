@@ -20,11 +20,13 @@ from .mesh import MeshConfig, get_mesh, make_mesh, local_mesh
 from . import collectives
 from . import compression
 from .data_parallel import DataParallelTrainer
-from .ring_attention import ring_attention
-from .sequence_parallel import ulysses_attention
+from .ring_attention import ring_attention, ring_attention_sharded, \
+    local_attention
+from .sequence_parallel import ulysses_attention, ulysses_attention_sharded
 from . import moe
 from . import pipeline
 
 __all__ = ["MeshConfig", "get_mesh", "make_mesh", "local_mesh", "collectives",
            "compression", "DataParallelTrainer", "ring_attention",
-           "ulysses_attention", "pipeline", "moe"]
+           "ring_attention_sharded", "local_attention", "ulysses_attention",
+           "ulysses_attention_sharded", "pipeline", "moe"]

@@ -121,7 +121,8 @@ def test_pipeline_center_crop_matches_oracle(raw_rec):
     path, imgs = raw_rec
     pipe = _native.ImagePipeline(path, batch_size=48, data_shape=(3, 32, 32),
                                  resize=40, num_threads=1)
-    data, labels = next(pipe)
+    data, labels, count = next(pipe)
+    assert count == 48
     assert data.shape == (48, 32, 32, 3) and data.dtype == np.uint8
     # single thread, no shuffle: order preserved; center crop of the 40x40
     for i in (0, 7, 47):
@@ -144,7 +145,7 @@ def test_pipeline_jpeg_decode_close_to_pil(raw_rec):
     w.close()
     pipe = _native.ImagePipeline(path, batch_size=1, data_shape=(3, 64, 64),
                                  resize=64, num_threads=1)
-    data, labels = next(pipe)
+    data, labels, _count = next(pipe)
     # compare against PIL's decode of the same JPEG bytes
     _, jpg = recordio.unpack(recordio.MXRecordIO(path, "r").read())
     ref = np.asarray(Image.open(_io.BytesIO(jpg)))
@@ -159,7 +160,7 @@ def test_pipeline_epoch_determinism_and_reset(raw_rec):
     pipe = _native.ImagePipeline(path, batch_size=16, data_shape=(3, 32, 32),
                                  resize=40, num_threads=3)
     for _ in range(4):
-        n = sum(d.shape[0] for d, _l in pipe)
+        n = sum(c for _d, _l, c in pipe)
         assert n == 48, n
         pipe.reset()
     pipe.close()
@@ -181,8 +182,8 @@ def test_pipeline_skips_corrupt_images(raw_rec):
     w.close()
     pipe = _native.ImagePipeline(path, batch_size=4, data_shape=(3, 32, 32),
                                  resize=36, num_threads=1)
-    n = sum(d.shape[0] for d, _l in pipe)
-    assert n == (good // 4) * 4, (n, good)
+    n = sum(c for _d, _l, c in pipe)
+    assert n == good, (n, good)
     pipe.close()
 
 
@@ -208,6 +209,49 @@ def test_image_record_iter_native_end_to_end(raw_rec):
     assert len(list(it)) == 3
 
 
+def test_pipeline_pads_trailing_batch_to_full_shape(raw_rec):
+    path, _ = raw_rec
+    # 48 records, B=20 -> counts 20, 20, 8; every batch full-shaped
+    pipe = _native.ImagePipeline(path, batch_size=20, data_shape=(3, 32, 32),
+                                 resize=40, num_threads=1)
+    counts = []
+    for data, labels, count in pipe:
+        assert data.shape == (20, 32, 32, 3)
+        assert labels.shape == (20, 1)
+        counts.append(count)
+        if count < 20:  # padded rows repeat real rows of the same batch
+            assert np.array_equal(data[count], data[0])
+    assert sorted(counts) == [8, 20, 20]
+    pipe.close()
+
+
+def test_iter_native_reports_pad_on_trailing_batch(raw_rec):
+    path, _ = raw_rec
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 32, 32),
+                               batch_size=20, resize=40, preprocess_threads=1)
+    pads = [b.pad for b in it]
+    assert sorted(pads) == [0, 0, 12]
+    it.reset()
+    for b in it:  # all batches keep the declared fixed shape
+        assert b.data[0].shape == (20, 3, 32, 32)
+
+
+def test_pipeline_shuffle_permutes_record_order(raw_rec):
+    path, _ = raw_rec
+    def order(shuffle, seed=5):
+        pipe = _native.ImagePipeline(path, batch_size=48,
+                                     data_shape=(3, 32, 32), resize=40,
+                                     num_threads=1, shuffle=shuffle, seed=seed)
+        _d, lab, c = next(pipe)
+        pipe.close()
+        return lab[:c, 0].tolist()
+
+    plain = order(False)
+    shuffled = order(True)
+    assert sorted(plain) == sorted(shuffled)  # same multiset of labels
+    assert plain != shuffled                  # but actually permuted
+
+
 def test_pipeline_sharding_partitions_stream(raw_rec):
     path, _ = raw_rec
     seen = []
@@ -216,7 +260,7 @@ def test_pipeline_sharding_partitions_stream(raw_rec):
                                      data_shape=(3, 32, 32), resize=40,
                                      num_threads=1, shard_index=part,
                                      num_shards=2)
-        labs = [l for _d, lab in pipe for l in lab[:, 0].tolist()]
+        labs = [l for _d, lab, c in pipe for l in lab[:c, 0].tolist()]
         seen.append(sorted(labs))
         pipe.close()
     # 48 records split round-robin: 24 each, disjoint ordinals

@@ -1,0 +1,19 @@
+"""Hard gate: the native runtime must BUILD — a compile error in cpp/ must
+fail CI, not silently skip every native test (the reference treats libmxnet
+build failure as fatal, not optional)."""
+import os
+import subprocess
+
+import pytest
+
+from mxnet_tpu import _native
+
+
+@pytest.mark.skipif(bool(os.environ.get("MXTPU_NO_NATIVE")),
+                    reason="native runtime disabled explicitly")
+def test_native_library_builds_and_loads():
+    cpp_dir = os.path.join(os.path.dirname(os.path.dirname(_native.__file__)),
+                           "cpp")
+    r = subprocess.run(["make", "-C", cpp_dir], capture_output=True, text=True)
+    assert r.returncode == 0, "native build failed:\n" + r.stderr[-4000:]
+    assert _native.lib() is not None, "libmxtpu.so built but failed to load"
