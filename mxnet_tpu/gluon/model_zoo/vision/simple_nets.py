@@ -1,6 +1,26 @@
-"""AlexNet, VGG, SqueezeNet, MobileNet v1/v2, DenseNet, Inception-v3
-(reference: python/mxnet/gluon/model_zoo/vision/{alexnet,vgg,squeezenet,
-mobilenet,densenet,inception}.py)."""
+"""Non-residual Gluon model-zoo families, built TPU-first.
+
+Capability parity target: the reference model zoo's AlexNet / VGG /
+SqueezeNet / MobileNet v1+v2 / DenseNet / Inception-v3 constructors
+(``python/mxnet/gluon/model_zoo/vision/`` in the reference tree), with the
+same factory names and ``classes=``/width-multiplier arguments.
+
+The implementation is original: every architecture here is written as a
+*data table* interpreted by a handful of shared combinators —
+
+- ``_unit``: the one conv(+BN)(+activation) builder all families share,
+- ``_chain``: HybridSequential from already-built parts,
+- ``_fanout``: concat-of-branches (squeeze "fire", every Inception cell),
+- ``_SkipJoin`` / ``_WidenJoin``: add- and concat-type skip connections
+  (MobileNetV2 inverted residuals, DenseNet growth),
+
+rather than per-family helper functions. Channel/stride tables are the
+canonical published ones (MobileNetV2 uses the paper's (t, c, n, s) rows).
+
+TPU notes: everything is a static-shape op chain that XLA fuses; the
+depthwise convs (``groups=channels``) lower to XLA feature-group convs.
+Run hybridized + bf16 for MXU throughput.
+"""
 from __future__ import annotations
 
 from ...block import HybridBlock
@@ -16,28 +36,108 @@ __all__ = ["AlexNet", "alexnet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
 
 
 # ---------------------------------------------------------------------------
-# AlexNet
+# shared combinators
 # ---------------------------------------------------------------------------
+
+def _chain(*parts):
+    out = nn.HybridSequential(prefix="")
+    for part in parts:
+        out.add(part)
+    return out
+
+
+def _relu6():
+    return nn.HybridLambda(lambda F, x: F.clip(x, 0, 6))
+
+
+def _unit(ch, k=1, s=1, p=0, groups=1, bias=False, norm=True, act="relu",
+          eps=1e-5):
+    """conv [+ BatchNorm] [+ activation] — the one conv builder here.
+
+    ``act`` is "relu", "relu6", or None. Returns a HybridSequential so a
+    unit can be dropped anywhere a block is expected.
+    """
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(ch, k, s, p, groups=groups, use_bias=bias))
+    if norm:
+        out.add(nn.BatchNorm(epsilon=eps))
+    if act == "relu":
+        out.add(nn.Activation("relu"))
+    elif act == "relu6":
+        out.add(_relu6())
+    return out
+
+
+def _fanout(*branches):
+    out = nn.HybridConcatenate(axis=1)
+    for branch in branches:
+        out.add(branch)
+    return out
+
+
+class _SkipJoin(HybridBlock):
+    """x + body(x) when ``joined``, else just body(x) (stride/width change)."""
+
+    def __init__(self, body, joined, **kwargs):
+        super().__init__(**kwargs)
+        self.body = body
+        self._joined = joined
+
+    def hybrid_forward(self, F, x):
+        y = self.body(x)
+        return y + x if self._joined else y
+
+
+class _WidenJoin(HybridBlock):
+    """concat(x, body(x)) along channels — DenseNet's growth step."""
+
+    def __init__(self, body, **kwargs):
+        super().__init__(**kwargs)
+        self.body = body
+
+    def hybrid_forward(self, F, x):
+        return F.concat(x, self.body(x), dim=1)
+
+
+def _strip(kwargs):
+    for unsupported in ("pretrained", "ctx", "root"):
+        if kwargs.pop(unsupported, None):
+            if unsupported == "pretrained":
+                raise RuntimeError("pretrained weights unavailable "
+                                   "(no egress)")
+    return kwargs
+
+
+def _head(classes):
+    return nn.Dense(classes)
+
+
+# ---------------------------------------------------------------------------
+# AlexNet — a flat token list
+# ---------------------------------------------------------------------------
+
+# (channels, kernel, stride, pad) conv rows; "P" = 3x3/2 maxpool
+_ALEX_TRUNK = [(64, 11, 4, 2), "P", (192, 5, 1, 2), "P",
+               (384, 3, 1, 1), (256, 3, 1, 1), (256, 3, 1, 1), "P"]
+
 
 class AlexNet(HybridBlock):
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(64, 11, 4, 2, activation="relu"))
-            self.features.add(nn.MaxPool2D(3, 2))
-            self.features.add(nn.Conv2D(192, 5, padding=2, activation="relu"))
-            self.features.add(nn.MaxPool2D(3, 2))
-            self.features.add(nn.Conv2D(384, 3, padding=1, activation="relu"))
-            self.features.add(nn.Conv2D(256, 3, padding=1, activation="relu"))
-            self.features.add(nn.Conv2D(256, 3, padding=1, activation="relu"))
-            self.features.add(nn.MaxPool2D(3, 2))
+            for row in _ALEX_TRUNK:
+                if row == "P":
+                    self.features.add(nn.MaxPool2D(3, 2))
+                else:
+                    ch, k, s, p = row
+                    self.features.add(_unit(ch, k, s, p, bias=True,
+                                            norm=False))
             self.features.add(nn.Flatten())
-            self.features.add(nn.Dense(4096, activation="relu"))
-            self.features.add(nn.Dropout(0.5))
-            self.features.add(nn.Dense(4096, activation="relu"))
-            self.features.add(nn.Dropout(0.5))
-            self.output = nn.Dense(classes)
+            for _ in range(2):
+                self.features.add(nn.Dense(4096, activation="relu"))
+                self.features.add(nn.Dropout(0.5))
+            self.output = _head(classes)
 
     def hybrid_forward(self, F, x):
         return self.output(self.features(x))
@@ -47,141 +147,96 @@ def alexnet(**kwargs):
     return AlexNet(**_strip(kwargs))
 
 
-def _strip(kwargs):
-    kwargs.pop("pretrained", None)
-    kwargs.pop("ctx", None)
-    kwargs.pop("root", None)
-    return kwargs
-
-
 # ---------------------------------------------------------------------------
-# VGG
+# VGG — (repeats, width) rows
 # ---------------------------------------------------------------------------
+
+_VGG_ROWS = {11: (1, 1, 2, 2, 2), 13: (2, 2, 2, 2, 2),
+             16: (2, 2, 3, 3, 3), 19: (2, 2, 4, 4, 4)}
+_VGG_WIDTHS = (64, 128, 256, 512, 512)
+
 
 class VGG(HybridBlock):
-    def __init__(self, layers, filters, classes=1000, batch_norm=False, **kwargs):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 **kwargs):
         super().__init__(**kwargs)
-        assert len(layers) == len(filters)
+        if len(layers) != len(filters):
+            raise ValueError("one filter width per VGG stage")
         with self.name_scope():
-            self.features = self._make_features(layers, filters, batch_norm)
-            self.features.add(nn.Dense(4096, activation="relu", weight_initializer="normal"))
-            self.features.add(nn.Dropout(0.5))
-            self.features.add(nn.Dense(4096, activation="relu", weight_initializer="normal"))
-            self.features.add(nn.Dropout(0.5))
+            self.features = nn.HybridSequential(prefix="")
+            for reps, width in zip(layers, filters):
+                for _ in range(reps):
+                    self.features.add(_unit(width, 3, 1, 1, bias=True,
+                                            norm=batch_norm))
+                self.features.add(nn.MaxPool2D(strides=2))
+            self.features.add(nn.Flatten())
+            for _ in range(2):
+                self.features.add(nn.Dense(4096, activation="relu",
+                                           weight_initializer="normal"))
+                self.features.add(nn.Dropout(0.5))
             self.output = nn.Dense(classes, weight_initializer="normal")
-
-    def _make_features(self, layers, filters, batch_norm):
-        featurizer = nn.HybridSequential(prefix="")
-        for i, num in enumerate(layers):
-            for _ in range(num):
-                featurizer.add(nn.Conv2D(filters[i], kernel_size=3, padding=1))
-                if batch_norm:
-                    featurizer.add(nn.BatchNorm())
-                featurizer.add(nn.Activation("relu"))
-            featurizer.add(nn.MaxPool2D(strides=2))
-        return featurizer
 
     def hybrid_forward(self, F, x):
         return self.output(self.features(x))
 
 
-vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
-            13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
-            16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
-            19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+def _vgg_constructor(depth, batch_norm):
+    def ctor(**kwargs):
+        return VGG(list(_VGG_ROWS[depth]), list(_VGG_WIDTHS),
+                   batch_norm=batch_norm, **_strip(kwargs))
+
+    ctor.__name__ = ctor.__qualname__ = (f"vgg{depth}_bn" if batch_norm
+                                         else f"vgg{depth}")
+    ctor.__doc__ = (f"VGG-{depth}" + (" with BatchNorm" if batch_norm
+                                      else ""))
+    return ctor
 
 
-def get_vgg(num_layers, **kwargs):
-    layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **_strip(kwargs))
-
-
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
-
-
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
-
-
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
-
-
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    return get_vgg(11, batch_norm=True, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    return get_vgg(13, batch_norm=True, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    return get_vgg(16, batch_norm=True, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    return get_vgg(19, batch_norm=True, **kwargs)
+for _d in _VGG_ROWS:
+    for _bn in (False, True):
+        _f = _vgg_constructor(_d, _bn)
+        globals()[_f.__name__] = _f
+del _d, _bn, _f
 
 
 # ---------------------------------------------------------------------------
-# SqueezeNet
+# SqueezeNet — token lists of fire cells and pools
 # ---------------------------------------------------------------------------
 
-def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(squeeze_channels, kernel_size=1, activation="relu"))
-    exp = nn.HybridConcatenate(axis=1)
-    exp.add(nn.Conv2D(expand1x1_channels, kernel_size=1, activation="relu"))
-    exp.add(nn.Conv2D(expand3x3_channels, kernel_size=3, padding=1, activation="relu"))
-    out.add(exp)
-    return out
+def _fire(squeeze, expand):
+    """1x1 squeeze feeding a (1x1 || 3x3) expand fanout."""
+    return _chain(_unit(squeeze, 1, bias=True, norm=False),
+                  _fanout(_unit(expand, 1, bias=True, norm=False),
+                          _unit(expand, 3, p=1, bias=True, norm=False)))
+
+
+# stem conv row then "P" pools / fire (squeeze, expand) rows
+_SQUEEZE_PLANS = {
+    "1.0": [(96, 7, 2), "P", (16, 64), (16, 64), (32, 128), "P",
+            (32, 128), (48, 192), (48, 192), (64, 256), "P", (64, 256)],
+    "1.1": [(64, 3, 2), "P", (16, 64), (16, 64), "P", (32, 128), (32, 128),
+            "P", (48, 192), (48, 192), (64, 256), (64, 256)],
+}
 
 
 class SqueezeNet(HybridBlock):
     def __init__(self, version, classes=1000, **kwargs):
         super().__init__(**kwargs)
-        assert version in ("1.0", "1.1")
+        if version not in _SQUEEZE_PLANS:
+            raise ValueError(f"unknown SqueezeNet version {version!r}")
+        plan = _SQUEEZE_PLANS[version]
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            if version == "1.0":
-                self.features.add(nn.Conv2D(96, kernel_size=7, strides=2,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(64, 256, 256))
-            else:
-                self.features.add(nn.Conv2D(64, kernel_size=3, strides=2,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(_make_fire(64, 256, 256))
+            ch, k, s = plan[0]
+            self.features.add(_unit(ch, k, s, bias=True, norm=False))
+            for row in plan[1:]:
+                if row == "P":
+                    self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+                else:
+                    self.features.add(_fire(*row))
             self.features.add(nn.Dropout(0.5))
-            self.output = nn.HybridSequential(prefix="")
-            self.output.add(nn.Conv2D(classes, kernel_size=1))
-            self.output.add(nn.Activation("relu"))
-            self.output.add(nn.GlobalAvgPool2D())
-            self.output.add(nn.Flatten())
+            self.output = _chain(_unit(classes, 1, bias=True, norm=False),
+                                 nn.GlobalAvgPool2D(), nn.Flatten())
 
     def hybrid_forward(self, F, x):
         return self.output(self.features(x))
@@ -196,317 +251,240 @@ def squeezenet1_1(**kwargs):
 
 
 # ---------------------------------------------------------------------------
-# MobileNet v1/v2
+# MobileNet v1 — (out_channels, stride) separable rows
 # ---------------------------------------------------------------------------
 
-def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
-              active=True, relu6=False):
-    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
-                      use_bias=False))
-    out.add(nn.BatchNorm())
-    if active:
-        out.add(nn.HybridLambda(lambda F, x: F.clip(x, 0, 6)) if relu6
-                else nn.Activation("relu"))
+_MOBILE_V1_ROWS = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1),
+                   (512, 2), (512, 1), (512, 1), (512, 1), (512, 1),
+                   (512, 1), (1024, 2), (1024, 1)]
 
 
-def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
-    _add_conv(out, dw_channels, kernel=3, stride=stride, pad=1,
-              num_group=dw_channels, relu6=relu6)
-    _add_conv(out, channels, relu6=relu6)
-
-
-class LinearBottleneck(HybridBlock):
-    def __init__(self, in_channels, channels, t, stride, **kwargs):
-        super().__init__(**kwargs)
-        self.use_shortcut = stride == 1 and in_channels == channels
-        with self.name_scope():
-            self.out = nn.HybridSequential()
-            _add_conv(self.out, in_channels * t, relu6=True)
-            _add_conv(self.out, in_channels * t, kernel=3, stride=stride, pad=1,
-                      num_group=in_channels * t, relu6=True)
-            _add_conv(self.out, channels, active=False, relu6=True)
-
-    def hybrid_forward(self, F, x):
-        out = self.out(x)
-        if self.use_shortcut:
-            out = out + x
-        return out
+def _separable(width_in, width_out, stride, act="relu"):
+    """Depthwise 3x3 over ``width_in`` then pointwise to ``width_out``."""
+    return _chain(_unit(width_in, 3, stride, 1, groups=width_in, act=act),
+                  _unit(width_out, act=act))
 
 
 class MobileNet(HybridBlock):
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        scale = lambda c: int(c * multiplier)  # noqa: E731
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2, pad=1)
-            dw_channels = [int(x * multiplier) for x in
-                           [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
-            channels = [int(x * multiplier) for x in
-                        [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
-            strides = [1, 2, 1, 2, 1, 2] + [1] * 5 + [2, 1]
-            for dwc, c, s in zip(dw_channels, channels, strides):
-                _add_conv_dw(self.features, dwc, c, s)
+            width = scale(32)
+            self.features.add(_unit(width, 3, 2, 1))
+            for out, stride in _MOBILE_V1_ROWS:
+                out = scale(out)
+                self.features.add(_separable(width, out, stride))
+                width = out
             self.features.add(nn.GlobalAvgPool2D())
             self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes)
+            self.output = _head(classes)
 
     def hybrid_forward(self, F, x):
         return self.output(self.features(x))
+
+
+# ---------------------------------------------------------------------------
+# MobileNet v2 — the paper's (expansion t, channels c, repeats n, stride s)
+# ---------------------------------------------------------------------------
+
+_MOBILE_V2_ROWS = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+                   (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+                   (6, 320, 1, 1)]
+
+
+def _inverted_residual(width_in, width_out, t, stride):
+    mid = width_in * t
+    body = _chain(_unit(mid, act="relu6"),
+                  _unit(mid, 3, stride, 1, groups=mid, act="relu6"),
+                  _unit(width_out, act=None))
+    return _SkipJoin(body, joined=stride == 1 and width_in == width_out)
 
 
 class MobileNetV2(HybridBlock):
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
+        scale = lambda c: int(c * multiplier)  # noqa: E731
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="features_")
-            _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2,
-                      pad=1, relu6=True)
-            in_channels_group = [int(x * multiplier) for x in
-                                 [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4
-                                 + [96] * 3 + [160] * 3]
-            channels_group = [int(x * multiplier) for x in
-                              [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
-                              + [160] * 3 + [320]]
-            ts = [1] + [6] * 16
-            strides = [1, 2] + [1] * 2 + [2] + [1] * 2 + [2] + [1] * 3 \
-                + [1] * 3 + [2] + [1] * 3
-            for in_c, c, t, s in zip(in_channels_group, channels_group, ts, strides):
-                self.features.add(LinearBottleneck(in_c, c, t, s, prefix=""))
-            last_channels = int(1280 * multiplier) if multiplier > 1.0 else 1280
-            _add_conv(self.features, last_channels, relu6=True)
+            self.features = nn.HybridSequential(prefix="")
+            width = scale(32)
+            self.features.add(_unit(width, 3, 2, 1, act="relu6"))
+            for t, c, n, s in _MOBILE_V2_ROWS:
+                out = scale(c)
+                for i in range(n):
+                    self.features.add(_inverted_residual(
+                        width, out, t, s if i == 0 else 1))
+                    width = out
+            tip = scale(1280) if multiplier > 1.0 else 1280
+            self.features.add(_unit(tip, act="relu6"))
             self.features.add(nn.GlobalAvgPool2D())
-            self.output = nn.HybridSequential(prefix="output_")
-            self.output.add(nn.Conv2D(classes, 1, use_bias=False, prefix="pred_"),
-                            nn.Flatten())
+            self.output = _chain(_unit(classes, 1, norm=False, act=None),
+                                 nn.Flatten())
 
     def hybrid_forward(self, F, x):
         return self.output(self.features(x))
 
 
-def mobilenet1_0(**kwargs):
-    return MobileNet(1.0, **_strip(kwargs))
+def _mobile_constructor(cls, multiplier, tag):
+    def ctor(**kwargs):
+        return cls(multiplier, **_strip(kwargs))
+
+    ctor.__name__ = ctor.__qualname__ = tag
+    ctor.__doc__ = f"{cls.__name__} with width multiplier {multiplier}"
+    return ctor
 
 
-def mobilenet0_75(**kwargs):
-    return MobileNet(0.75, **_strip(kwargs))
-
-
-def mobilenet0_5(**kwargs):
-    return MobileNet(0.5, **_strip(kwargs))
-
-
-def mobilenet0_25(**kwargs):
-    return MobileNet(0.25, **_strip(kwargs))
-
-
-def mobilenet_v2_1_0(**kwargs):
-    return MobileNetV2(1.0, **_strip(kwargs))
-
-
-def mobilenet_v2_0_75(**kwargs):
-    return MobileNetV2(0.75, **_strip(kwargs))
-
-
-def mobilenet_v2_0_5(**kwargs):
-    return MobileNetV2(0.5, **_strip(kwargs))
-
-
-def mobilenet_v2_0_25(**kwargs):
-    return MobileNetV2(0.25, **_strip(kwargs))
+for _mult, _suffix in ((1.0, "1_0"), (0.75, "0_75"), (0.5, "0_5"),
+                       (0.25, "0_25")):
+    _f = _mobile_constructor(MobileNet, _mult, f"mobilenet{_suffix}")
+    globals()[_f.__name__] = _f
+    _f = _mobile_constructor(MobileNetV2, _mult, f"mobilenet_v2_{_suffix}")
+    globals()[_f.__name__] = _f
+del _mult, _suffix, _f
 
 
 # ---------------------------------------------------------------------------
-# DenseNet
+# DenseNet — (stem width, growth rate, per-block repeats)
 # ---------------------------------------------------------------------------
 
-def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
-    out = nn.HybridSequential(prefix=f"stage{stage_index}_")
-    with out.name_scope():
-        for _ in range(num_layers):
-            out.add(_make_dense_layer(growth_rate, bn_size, dropout))
-    return out
+_DENSE_ROWS = {121: (64, 32, (6, 12, 24, 16)),
+               161: (96, 48, (6, 12, 36, 24)),
+               169: (64, 32, (6, 12, 32, 32)),
+               201: (64, 32, (6, 12, 48, 32))}
 
 
-class _DenseLayer(HybridBlock):
-    def __init__(self, growth_rate, bn_size, dropout, **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1, use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1, use_bias=False))
-        if dropout:
-            self.body.add(nn.Dropout(dropout))
-
-    def hybrid_forward(self, F, x):
-        out = self.body(x)
-        return F.concat(x, out, dim=1)
+def _norm_relu():
+    return _chain(nn.BatchNorm(), nn.Activation("relu"))
 
 
-def _make_dense_layer(growth_rate, bn_size, dropout):
-    return _DenseLayer(growth_rate, bn_size, dropout)
-
-
-def _make_transition(num_output_features):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.BatchNorm())
-    out.add(nn.Activation("relu"))
-    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
-    out.add(nn.AvgPool2D(pool_size=2, strides=2))
-    return out
+def _grow(growth, bn_size, dropout):
+    """BN-relu-1x1-BN-relu-3x3, concatenated onto the running features."""
+    body = _chain(_norm_relu(), _unit(bn_size * growth, 1, norm=False,
+                                      act=None),
+                  _norm_relu(), _unit(growth, 3, p=1, norm=False, act=None))
+    if dropout:
+        body.add(nn.Dropout(dropout))
+    return _WidenJoin(body)
 
 
 class DenseNet(HybridBlock):
-    def __init__(self, num_init_features, growth_rate, block_config, bn_size=4,
-                 dropout=0, classes=1000, **kwargs):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
-                                        strides=2, padding=3, use_bias=False))
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
-            num_features = num_init_features
-            for i, num_layers in enumerate(block_config):
-                self.features.add(_make_dense_block(num_layers, bn_size,
-                                                    growth_rate, dropout, i + 1))
-                num_features = num_features + num_layers * growth_rate
-                if i != len(block_config) - 1:
-                    self.features.add(_make_transition(num_features // 2))
-                    num_features = num_features // 2
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
+            self.features.add(_unit(num_init_features, 7, 2, 3))
+            self.features.add(nn.MaxPool2D(3, 2, 1))
+            width = num_init_features
+            for i, reps in enumerate(block_config):
+                for _ in range(reps):
+                    self.features.add(_grow(growth_rate, bn_size, dropout))
+                width += reps * growth_rate
+                if i + 1 < len(block_config):
+                    width //= 2
+                    self.features.add(_chain(_norm_relu(),
+                                             _unit(width, 1, norm=False,
+                                                   act=None),
+                                             nn.AvgPool2D(2, 2)))
+            self.features.add(_norm_relu())
             self.features.add(nn.AvgPool2D(pool_size=7))
             self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes)
+            self.output = _head(classes)
 
     def hybrid_forward(self, F, x):
         return self.output(self.features(x))
 
 
-densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
-                 161: (96, 48, [6, 12, 36, 24]),
-                 169: (64, 32, [6, 12, 32, 32]),
-                 201: (64, 32, [6, 12, 48, 32])}
+def _dense_constructor(depth):
+    def ctor(**kwargs):
+        stem, growth, reps = _DENSE_ROWS[depth]
+        return DenseNet(stem, growth, reps, **_strip(kwargs))
+
+    ctor.__name__ = ctor.__qualname__ = f"densenet{depth}"
+    ctor.__doc__ = f"DenseNet-{depth}"
+    return ctor
 
 
-def get_densenet(num_layers, **kwargs):
-    num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **_strip(kwargs))
-
-
-def densenet121(**kwargs):
-    return get_densenet(121, **kwargs)
-
-
-def densenet161(**kwargs):
-    return get_densenet(161, **kwargs)
-
-
-def densenet169(**kwargs):
-    return get_densenet(169, **kwargs)
-
-
-def densenet201(**kwargs):
-    return get_densenet(201, **kwargs)
+for _d in _DENSE_ROWS:
+    _f = _dense_constructor(_d)
+    globals()[_f.__name__] = _f
+del _d, _f
 
 
 # ---------------------------------------------------------------------------
-# Inception v3
+# Inception v3 — cells as branch tables
 # ---------------------------------------------------------------------------
+# A branch is a tuple of steps; a step is either a pool token
+# ("avg"/"max", pool, stride, pad) or a conv row (ch, kernel, stride, pad),
+# where kernel/pad may be 2-tuples for the factorized 7x7 paths.
 
-def _make_basic_conv(**kwargs):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation("relu"))
-    return out
-
-
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix="")
-    if use_pool == "avg":
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == "max":
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    setting_names = ["channels", "kernel_size", "strides", "padding"]
-    for setting in conv_settings:
-        kwargs = {}
-        for i, value in enumerate(setting):
-            if value is not None:
-                kwargs[setting_names[i]] = value
-        out.add(_make_basic_conv(**kwargs))
-    return out
+def _iconv(ch, k=1, s=1, p=0):
+    return _unit(ch, k, s, p, eps=0.001)
 
 
-def _concurrent(*branches):
-    out = nn.HybridConcatenate(axis=1)
-    for b in branches:
-        out.add(b)
-    return out
+def _branch(steps):
+    parts = []
+    for step in steps:
+        if step[0] == "avg":
+            parts.append(nn.AvgPool2D(step[1], step[2], step[3]))
+        elif step[0] == "max":
+            parts.append(nn.MaxPool2D(step[1], step[2], step[3]))
+        else:
+            parts.append(_iconv(*step))
+    return parts[0] if len(parts) == 1 else _chain(*parts)
 
 
-def _make_A(pool_features, prefix):
-    return _concurrent(
-        _make_branch(None, (64, 1, None, None)),
-        _make_branch(None, (48, 1, None, None), (64, 5, None, 2)),
-        _make_branch(None, (64, 1, None, None), (96, 3, None, 1), (96, 3, None, 1)),
-        _make_branch("avg", (pool_features, 1, None, None)))
+def _cell(*branch_specs):
+    return _fanout(*(_branch(s) for s in branch_specs))
 
 
-def _make_B(prefix):
-    return _concurrent(
-        _make_branch(None, (384, 3, 2, None)),
-        _make_branch(None, (64, 1, None, None), (96, 3, None, 1), (96, 3, 2, None)),
-        _make_branch("max"))
+def _cell_a(tail):
+    return _cell(((64, 1),),
+                 ((48, 1), (64, 5, 1, 2)),
+                 ((64, 1), (96, 3, 1, 1), (96, 3, 1, 1)),
+                 (("avg", 3, 1, 1), (tail, 1)))
 
 
-def _make_C(channels_7x7, prefix):
-    return _concurrent(
-        _make_branch(None, (192, 1, None, None)),
-        _make_branch(None, (channels_7x7, 1, None, None),
-                     (channels_7x7, (1, 7), None, (0, 3)),
-                     (192, (7, 1), None, (3, 0))),
-        _make_branch(None, (channels_7x7, 1, None, None),
-                     (channels_7x7, (7, 1), None, (3, 0)),
-                     (channels_7x7, (1, 7), None, (0, 3)),
-                     (channels_7x7, (7, 1), None, (3, 0)),
-                     (192, (1, 7), None, (0, 3))),
-        _make_branch("avg", (192, 1, None, None)))
+def _cell_b():
+    return _cell(((384, 3, 2, 0),),
+                 ((64, 1), (96, 3, 1, 1), (96, 3, 2, 0)),
+                 (("max", 3, 2, 0),))
 
 
-def _make_D(prefix):
-    return _concurrent(
-        _make_branch(None, (192, 1, None, None), (320, 3, 2, None)),
-        _make_branch(None, (192, 1, None, None), (192, (1, 7), None, (0, 3)),
-                     (192, (7, 1), None, (3, 0)), (192, 3, 2, None)),
-        _make_branch("max"))
+def _cell_c(mid):
+    return _cell(((192, 1),),
+                 ((mid, 1), (mid, (1, 7), 1, (0, 3)),
+                  (192, (7, 1), 1, (3, 0))),
+                 ((mid, 1), (mid, (7, 1), 1, (3, 0)),
+                  (mid, (1, 7), 1, (0, 3)), (mid, (7, 1), 1, (3, 0)),
+                  (192, (1, 7), 1, (0, 3))),
+                 (("avg", 3, 1, 1), (192, 1)))
 
 
-class _InceptionE(HybridBlock):
-    def __init__(self, **kwargs):
-        super().__init__(**kwargs)
-        self.branch1 = _make_branch(None, (320, 1, None, None))
-        self.branch2_stem = _make_branch(None, (384, 1, None, None))
-        self.branch2_a = _make_branch(None, (384, (1, 3), None, (0, 1)))
-        self.branch2_b = _make_branch(None, (384, (3, 1), None, (1, 0)))
-        self.branch3_stem = _make_branch(None, (448, 1, None, None),
-                                         (384, 3, None, 1))
-        self.branch3_a = _make_branch(None, (384, (1, 3), None, (0, 1)))
-        self.branch3_b = _make_branch(None, (384, (3, 1), None, (1, 0)))
-        self.branch4 = _make_branch("avg", (192, 1, None, None))
+def _cell_d():
+    return _cell(((192, 1), (320, 3, 2, 0)),
+                 ((192, 1), (192, (1, 7), 1, (0, 3)),
+                  (192, (7, 1), 1, (3, 0)), (192, 3, 2, 0)),
+                 (("max", 3, 2, 0),))
 
-    def hybrid_forward(self, F, x):
-        b1 = self.branch1(x)
-        s2 = self.branch2_stem(x)
-        b2 = F.concat(self.branch2_a(s2), self.branch2_b(s2), dim=1)
-        s3 = self.branch3_stem(x)
-        b3 = F.concat(self.branch3_a(s3), self.branch3_b(s3), dim=1)
-        b4 = self.branch4(x)
-        return F.concat(b1, b2, b3, b4, dim=1)
+
+def _split_pair(ch):
+    """The E-cell's (1x3 || 3x1) split applied to one stem."""
+    return _fanout(_iconv(ch, (1, 3), 1, (0, 1)),
+                   _iconv(ch, (3, 1), 1, (1, 0)))
+
+
+def _cell_e():
+    return _fanout(_iconv(320, 1),
+                   _chain(_iconv(384, 1), _split_pair(384)),
+                   _chain(_iconv(448, 1), _iconv(384, 3, 1, 1),
+                          _split_pair(384)),
+                   _chain(nn.AvgPool2D(3, 1, 1), _iconv(192, 1)))
+
+
+_INCEPTION_STEM = [(32, 3, 2, 0), (32, 3, 1, 0), (64, 3, 1, 1), "P",
+                   (80, 1, 1, 0), (192, 3, 1, 0), "P"]
 
 
 class Inception3(HybridBlock):
@@ -514,27 +492,19 @@ class Inception3(HybridBlock):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3, padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B("B_"))
-            self.features.add(_make_C(128, "C1_"))
-            self.features.add(_make_C(160, "C2_"))
-            self.features.add(_make_C(160, "C3_"))
-            self.features.add(_make_C(192, "C4_"))
-            self.features.add(_make_D("D_"))
-            self.features.add(_InceptionE(prefix="E1_"))
-            self.features.add(_InceptionE(prefix="E2_"))
+            for row in _INCEPTION_STEM:
+                if row == "P":
+                    self.features.add(nn.MaxPool2D(3, 2))
+                else:
+                    self.features.add(_iconv(*row))
+            for cell in (_cell_a(32), _cell_a(64), _cell_a(64), _cell_b(),
+                         _cell_c(128), _cell_c(160), _cell_c(160),
+                         _cell_c(192), _cell_d(), _cell_e(), _cell_e()):
+                self.features.add(cell)
             self.features.add(nn.AvgPool2D(pool_size=8))
             self.features.add(nn.Dropout(0.5))
-            self.output = nn.Dense(classes)
+            self.features.add(nn.Flatten())
+            self.output = _head(classes)
 
     def hybrid_forward(self, F, x):
         return self.output(self.features(x))
