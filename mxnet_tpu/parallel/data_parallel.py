@@ -24,40 +24,77 @@ from ..gluon.block import Block
 from ..ndarray.ndarray import NDArray
 from .mesh import get_mesh
 
-__all__ = ["DataParallelTrainer", "block_apply_fn"]
+__all__ = ["DataParallelTrainer", "block_apply_fn", "block_train_fn"]
 
 
 def block_apply_fn(block: Block, is_train: bool = True):
-    """Extract a pure fn(params_dict, x, rng) -> out from a Gluon block."""
+    """Extract a pure fn(params_dict, x, rng) -> out from a Gluon block.
+
+    The params dict holds *every* parameter including non-differentiable aux
+    state (BatchNorm running stats); aux updates made during a traced forward
+    are discarded.  For training use :func:`block_train_fn`, which threads aux
+    state functionally.
+    """
+    train_fn, init_params, init_aux = block_train_fn(block, is_train=is_train)
+    aux_names = set(init_aux)
+
+    def apply_fn(params: Dict[str, jnp.ndarray], x, rng=None):
+        out, _ = train_fn({k: v for k, v in params.items()
+                           if k not in aux_names},
+                          {k: params[k] for k in aux_names}, x, rng)
+        return out
+
+    return apply_fn, {**init_params, **init_aux}
+
+
+def block_train_fn(block: Block, is_train: bool = True):
+    """Extract fn(params, aux, x, rng) -> (out, new_aux) from a Gluon block.
+
+    ``params`` are the differentiable leaves; ``aux`` the non-differentiable
+    state leaves (``grad_req == "null"`` — BatchNorm running stats and
+    frozen parameters).  Layers mutate aux in place during the traced
+    forward (basic_layers.py BatchNorm writes the moving averages into the
+    Parameter); here those writes are captured *inside* the trace and
+    returned as ``new_aux``, making aux a functional carry the caller
+    threads through steps — the TPU-side answer to the reference's in-op
+    aux-state mutation (src/operator/nn/batch_norm.cc).
+    """
     from .. import random as _random
 
     pd = block.collect_params()
-    names = list(pd.keys())
+    param_names = [n for n in pd if pd[n].grad_req != "null"]
+    aux_names = [n for n in pd if pd[n].grad_req == "null"]
 
-    def apply_fn(params: Dict[str, jnp.ndarray], x, rng=None):
-        saved = []
-        for name in names:
-            p = pd[name]
-            saved.append(p._data._data)
-            p._data._data = params[name]
+    def apply_fn(params: Dict[str, jnp.ndarray], aux: Dict[str, jnp.ndarray],
+                 x, rng=None):
+        saved = {}
+        for name in param_names:
+            saved[name] = pd[name]._data._data
+            pd[name]._data._data = params[name]
+        for name in aux_names:
+            saved[name] = pd[name]._data._data
+            pd[name]._data._data = aux[name]
         saved_key = _random.swap_key(rng if rng is not None else jax.random.PRNGKey(0))
         try:
             with autograd.pause(train_mode=is_train):
                 out = block(NDArray(x))
+            new_aux = {n: pd[n]._data._data for n in aux_names}
         finally:
             _random.swap_key(saved_key)
-            for name, s in zip(names, saved):
+            for name, s in saved.items():
                 pd[name]._data._data = s
-        return out._data if isinstance(out, NDArray) else tuple(o._data for o in out)
+        out = out._data if isinstance(out, NDArray) else tuple(o._data for o in out)
+        return out, new_aux
 
     try:
-        init_params = {n: pd[n].data()._data for n in names}
+        init_params = {n: pd[n].data()._data for n in param_names}
+        init_aux = {n: pd[n].data()._data for n in aux_names}
     except Exception as e:
         raise RuntimeError(
             "block has uninitialized (deferred-shape) parameters; run one "
             "forward pass or construct layers with in_units/in_channels before "
             "creating a DataParallelTrainer") from e
-    return apply_fn, init_params
+    return apply_fn, init_params, init_aux
 
 
 class DataParallelTrainer:
@@ -81,7 +118,8 @@ class DataParallelTrainer:
         self._wd = weight_decay
         self._compute_dtype = compute_dtype
         self._update_fn = update_fn
-        self._apply_fn, self.params = block_apply_fn(block, is_train=True)
+        self._apply_fn, self.params, self.aux = block_train_fn(
+            block, is_train=True)
         self.momenta = {k: jnp.zeros_like(v) for k, v in self.params.items()}
         self._step_fn = None
         self._donate = donate
@@ -106,6 +144,7 @@ class DataParallelTrainer:
         repl = NamedSharding(self._mesh, PartitionSpec())
         self.params = {k: jax.device_put(v, repl) for k, v in self.params.items()}
         self.momenta = {k: jax.device_put(v, repl) for k, v in self.momenta.items()}
+        self.aux = {k: jax.device_put(v, repl) for k, v in self.aux.items()}
         if self.residuals is not None:
             shard = NamedSharding(self._mesh, PartitionSpec(self._axis))
             self.residuals = {k: jax.device_put(v, shard)
@@ -118,12 +157,12 @@ class DataParallelTrainer:
         cdt = self._compute_dtype
         update_fn = self._update_fn
 
-        def loss_of(p, x, y, rng):
+        def loss_of(p, aux, x, y, rng):
             pc = p if cdt is None else jax.tree_util.tree_map(
                 lambda a: a.astype(cdt), p)
             xin = x if cdt is None else x.astype(cdt)
-            pred = apply_fn(pc, xin, rng)
-            return jnp.mean(loss_fn(pred, y).astype(jnp.float32))
+            pred, new_aux = apply_fn(pc, aux, xin, rng)
+            return jnp.mean(loss_fn(pred, y).astype(jnp.float32)), new_aux
 
         def apply_update(params, momenta, grads):
             if update_fn is not None:
@@ -138,22 +177,26 @@ class DataParallelTrainer:
         if self._compression is not None:
             return self._build_compressed_step(loss_of, apply_update)
 
-        def step(params, momenta, x, y, rng):
-            loss, grads = jax.value_and_grad(loss_of)(params, x, y, rng)
+        def step(params, momenta, aux, x, y, rng):
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params, aux, x, y, rng)
             new_params, new_momenta = apply_update(params, momenta, grads)
-            return loss, new_params, new_momenta
+            return loss, new_params, new_momenta, new_aux
 
         if self._mesh is None:
-            return jax.jit(step, donate_argnums=(0, 1) if self._donate else ())
+            return jax.jit(step,
+                           donate_argnums=(0, 1, 2) if self._donate else ())
         repl = NamedSharding(self._mesh, PartitionSpec())
         shard = NamedSharding(self._mesh, PartitionSpec(self._axis))
         return jax.jit(
             step,
             in_shardings=({k: repl for k in self.params},
-                          {k: repl for k in self.momenta}, shard, shard, repl),
+                          {k: repl for k in self.momenta},
+                          {k: repl for k in self.aux}, shard, shard, repl),
             out_shardings=(repl, {k: repl for k in self.params},
-                           {k: repl for k in self.momenta}),
-            donate_argnums=(0, 1) if self._donate else (),
+                           {k: repl for k in self.momenta},
+                           {k: repl for k in self.aux}),
+            donate_argnums=(0, 1, 2) if self._donate else (),
         )
 
     def _build_compressed_step(self, loss_of, apply_update):
@@ -177,31 +220,37 @@ class DataParallelTrainer:
                 new_res[k] = r[None]
             return dq, new_res
 
-        def local_grads(params, residuals, x, y, rng):
+        def local_grads(params, aux, residuals, x, y, rng):
             # runs per device under shard_map: x/y/residuals are local shards
-            loss, g = jax.value_and_grad(loss_of)(params, x, y, rng)
+            (loss, new_aux), g = jax.value_and_grad(
+                loss_of, has_aux=True)(params, aux, x, y, rng)
             dq, new_res = compress_grads(g, residuals)
             mean = jax.tree_util.tree_map(
                 lambda a: jax.lax.pmean(a, axis), dq)
-            return jax.lax.pmean(loss, axis), mean, new_res
+            # aux (BN running stats) computed from per-device batch stats:
+            # average across the dp axis so the carry stays replicated
+            new_aux = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, axis), new_aux)
+            return jax.lax.pmean(loss, axis), mean, new_res, new_aux
 
-        def step(params, momenta, residuals, x, y, rng):
+        def step(params, momenta, aux, residuals, x, y, rng):
             if self._mesh is not None:
                 P = PartitionSpec
-                loss, grads, new_res = jax.shard_map(
+                loss, grads, new_res, new_aux = jax.shard_map(
                     local_grads, mesh=self._mesh,
-                    in_specs=(P(), P(axis), P(axis), P(axis), P()),
-                    out_specs=(P(), P(), P(axis)),
+                    in_specs=(P(), P(), P(axis), P(axis), P(axis), P()),
+                    out_specs=(P(), P(), P(axis), P()),
                     # pallas_call can't declare varying-mesh-axes metadata
                     check_vma=False,
-                )(params, residuals, x, y, rng)
+                )(params, aux, residuals, x, y, rng)
             else:
-                loss, g = jax.value_and_grad(loss_of)(params, x, y, rng)
+                (loss, new_aux), g = jax.value_and_grad(
+                    loss_of, has_aux=True)(params, aux, x, y, rng)
                 grads, new_res = compress_grads(g, residuals)
             new_params, new_momenta = apply_update(params, momenta, grads)
-            return loss, new_params, new_momenta, new_res
+            return loss, new_params, new_momenta, new_res, new_aux
 
-        donate = (0, 1, 2) if self._donate else ()
+        donate = (0, 1, 2, 3) if self._donate else ()
         if self._mesh is None:
             return jax.jit(step, donate_argnums=donate)
         repl = NamedSharding(self._mesh, PartitionSpec())
@@ -210,10 +259,12 @@ class DataParallelTrainer:
             step,
             in_shardings=({k: repl for k in self.params},
                           {k: repl for k in self.momenta},
+                          {k: repl for k in self.aux},
                           {k: shard for k in self.params}, shard, shard, repl),
             out_shardings=(repl, {k: repl for k in self.params},
                            {k: repl for k in self.momenta},
-                           {k: shard for k in self.params}),
+                           {k: shard for k in self.params},
+                           {k: repl for k in self.aux}),
             donate_argnums=donate,
         )
 
@@ -235,16 +286,20 @@ class DataParallelTrainer:
             x = jax.device_put(x, shard)
             y = jax.device_put(y, shard)
         if self._compression is not None:
-            loss, self.params, self.momenta, self.residuals = self._step_fn(
-                self.params, self.momenta, self.residuals, x, y, rng)
+            (loss, self.params, self.momenta, self.residuals,
+             self.aux) = self._step_fn(
+                self.params, self.momenta, self.aux, self.residuals, x, y, rng)
         else:
-            loss, self.params, self.momenta = self._step_fn(
-                self.params, self.momenta, x, y, rng)
+            loss, self.params, self.momenta, self.aux = self._step_fn(
+                self.params, self.momenta, self.aux, x, y, rng)
         return loss
 
     def write_back(self):
-        """Copy trained params back into the Gluon block's buffers (re-placed
-        on a single device so the eager frontend can keep using them)."""
+        """Copy trained params + aux state back into the Gluon block's buffers
+        (re-placed on a single device so the eager frontend can keep using
+        them)."""
         pd = self._block.collect_params()
         for name, v in self.params.items():
+            pd[name]._data._data = jax.device_put(_np.asarray(v))
+        for name, v in self.aux.items():
             pd[name]._data._data = jax.device_put(_np.asarray(v))

@@ -150,3 +150,71 @@ def test_graft_entry_dryrun():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_dp_batchnorm_aux_states():
+    """BN running stats must (a) move off their init through the fused DP
+    step, (b) never be touched by the optimizer (weight_decay would decay
+    them toward zero), and (c) make eval-mode predictions match an
+    eager-trained oracle (reference semantics: aux update inside the op,
+    src/operator/nn/batch_norm.cc)."""
+    r = np.random.RandomState(3)
+    X = (r.rand(64, 8).astype(np.float32) * 2.0 + 1.5)  # mean well off 0
+    Y = r.randint(0, 2, (64,)).astype(np.float32)
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    loss_fn = lambda p, y: lf(NDArray(p), NDArray(y))._data
+
+    def make_net():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, in_units=8), gluon.nn.BatchNorm(),
+                gluon.nn.Activation("relu"), gluon.nn.Dense(2, in_units=16))
+        net.initialize()
+        net(nd.array(X))  # shape BN params
+        return net
+
+    # --- fused DP training with weight decay (the old corruption trigger)
+    net = make_net()
+    init_state = [p.data().asnumpy().copy()
+                  for p in net.collect_params().values()]
+    mesh = make_mesh(dp=8)
+    tr = DataParallelTrainer(net, loss_fn, lr=0.05, momentum=0.9,
+                             weight_decay=1e-2, mesh=mesh)
+    for _ in range(20):
+        tr.step(X, Y)
+    tr.write_back()
+
+    bn = [b for b in net._children.values()
+          if isinstance(b, gluon.nn.BatchNorm)][0]
+    rm = bn.running_mean.data().asnumpy()
+    rv = bn.running_var.data().asnumpy()
+    assert np.abs(rm).sum() > 1e-3, "running_mean never updated"
+    assert np.abs(rv - 1.0).sum() > 1e-3, "running_var never updated"
+
+    # --- eager oracle: same init, same schedule, running stats via eager path
+    oracle = make_net()
+    for p, v in zip(oracle.collect_params().values(), init_state):
+        p.set_data(nd.array(v))
+    from mxnet_tpu import autograd as ag
+    params = oracle.collect_params()
+    momenta = {k: np.zeros(params[k].shape, np.float32) for k in params
+               if params[k].grad_req != "null"}
+    for _ in range(20):
+        with ag.record():
+            loss = lf(oracle(nd.array(X)), nd.array(Y)).mean()
+        loss.backward()
+        for k, p in params.items():
+            if p.grad_req == "null":
+                continue
+            g = p.grad().asnumpy()
+            momenta[k] = 0.9 * momenta[k] + g
+            newv = p.data().asnumpy() * (1.0 - 0.05 * 1e-2) - 0.05 * momenta[k]
+            p.set_data(nd.array(newv))
+    bn_o = [b for b in oracle._children.values()
+            if isinstance(b, gluon.nn.BatchNorm)][0]
+    assert np.allclose(rm, bn_o.running_mean.data().asnumpy(), atol=1e-3)
+    assert np.allclose(rv, bn_o.running_var.data().asnumpy(), atol=1e-3)
+
+    # --- eval-mode predictions agree
+    pred_dp = net(nd.array(X)).asnumpy()
+    pred_or = oracle(nd.array(X)).asnumpy()
+    assert np.allclose(pred_dp, pred_or, atol=1e-2)
