@@ -4,7 +4,15 @@ from __future__ import annotations
 from ..ops.registry import get_op
 from .ndarray import invoke
 
-__all__ = ["gemm2", "potrf", "trsm", "syrk"]
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "syrk",
+           "gelqf", "syevd", "sumlogdiag", "makediag", "extractdiag"]
+
+
+def gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+         out=None):
+    return invoke(get_op("linalg_gemm"), [a, b, c],
+                  {"transpose_a": transpose_a, "transpose_b": transpose_b,
+                   "alpha": alpha, "beta": beta}, out=out)
 
 
 def gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, out=None):
@@ -17,8 +25,18 @@ def potrf(a, out=None):
     return invoke(get_op("linalg_potrf"), [a], {}, out=out)
 
 
+def potri(a, out=None):
+    return invoke(get_op("linalg_potri"), [a], {}, out=out)
+
+
 def trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0, out=None):
     return invoke(get_op("linalg_trsm"), [a, b],
+                  {"transpose": transpose, "rightside": rightside,
+                   "lower": lower, "alpha": alpha}, out=out)
+
+
+def trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0, out=None):
+    return invoke(get_op("linalg_trmm"), [a, b],
                   {"transpose": transpose, "rightside": rightside,
                    "lower": lower, "alpha": alpha}, out=out)
 
@@ -26,3 +44,23 @@ def trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0, out=None
 def syrk(a, transpose=False, alpha=1.0, out=None):
     return invoke(get_op("linalg_syrk"), [a], {"transpose": transpose, "alpha": alpha},
                   out=out)
+
+
+def gelqf(a):
+    return invoke(get_op("linalg_gelqf"), [a], {})
+
+
+def syevd(a):
+    return invoke(get_op("linalg_syevd"), [a], {})
+
+
+def sumlogdiag(a, out=None):
+    return invoke(get_op("linalg_sumlogdiag"), [a], {}, out=out)
+
+
+def makediag(a, offset=0, out=None):
+    return invoke(get_op("linalg_makediag"), [a], {"offset": offset}, out=out)
+
+
+def extractdiag(a, offset=0, out=None):
+    return invoke(get_op("linalg_extractdiag"), [a], {"offset": offset}, out=out)

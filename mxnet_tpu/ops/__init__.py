@@ -7,6 +7,7 @@ from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import detection  # noqa: F401
 from . import quantization_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
 from . import image_ops  # noqa: F401
 
 __all__ = ["Op", "register", "get_op", "list_ops", "OP_REGISTRY"]

@@ -27,7 +27,7 @@ def random_uniform(rng_key=None, low=0.0, high=1.0, shape=None, dtype="float32")
                               minval=low, maxval=high)
 
 
-@register("_random_normal", rng=True, differentiable=False, aliases=("normal", "_sample_normal"))
+@register("_random_normal", rng=True, differentiable=False, aliases=("normal",))
 def random_normal(rng_key=None, loc=0.0, scale=1.0, shape=None, dtype="float32"):
     return loc + scale * jax.random.normal(rng_key, _shape(shape), dtype=np_dtype(dtype))
 
@@ -88,3 +88,67 @@ def sample_unique_zipfian(rng_key=None, range_max=1, shape=None):
     u = jax.random.uniform(rng_key, n)
     out = jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int64) - 1
     return jnp.clip(out, 0, range_max - 1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# tensor-parameterized sampling (reference: src/operator/random/multisample_op.cc
+# — _sample_uniform etc.: one draw block per distribution-parameter element)
+# ---------------------------------------------------------------------------
+
+def _multisample(draw):
+    def impl(*params, rng_key=None, shape=None, dtype="float32"):
+        s = _shape(shape)
+        flat = [jnp.ravel(jnp.asarray(p)) for p in params]
+        n = flat[0].shape[0]
+        keys = jax.random.split(rng_key, n)
+        out = jax.vmap(lambda k, *ps: draw(k, *ps, s, np_dtype(dtype)))(
+            keys, *flat)
+        return out.reshape(params[0].shape + s)
+
+    return impl
+
+
+register("_sample_uniform", rng=True, differentiable=False, aliases=("sample_uniform",))(
+    _multisample(lambda k, lo, hi, s, dt: jax.random.uniform(
+        k, s, minval=lo, maxval=hi, dtype=dt)))
+
+register("_sample_normal", rng=True, differentiable=False,
+         aliases=("sample_normal",))(
+    _multisample(lambda k, mu, sigma, s, dt: (
+        mu + sigma * jax.random.normal(k, s)).astype(dt)))
+
+register("_sample_gamma", rng=True, differentiable=False, aliases=("sample_gamma",))(
+    _multisample(lambda k, a, b, s, dt: (
+        b * jax.random.gamma(k, a, s)).astype(dt)))
+
+register("_sample_exponential", rng=True, differentiable=False, aliases=("sample_exponential",))(
+    _multisample(lambda k, lam, s, dt: (
+        jax.random.exponential(k, s) / lam).astype(dt)))
+
+register("_sample_poisson", rng=True, differentiable=False, aliases=("sample_poisson",))(
+    _multisample(lambda k, lam, s, dt: jax.random.poisson(
+        k, lam, s).astype(dt)))
+
+register("_sample_negative_binomial", rng=True, differentiable=False, aliases=("sample_negative_binomial",))(
+    _multisample(lambda k, kk, p, s, dt: jax.random.poisson(
+        jax.random.fold_in(k, 1),
+        jax.random.gamma(k, kk, s) * (1 - p) / p).astype(dt)))
+
+
+def _gnb_draw(k, mu, alpha, s, dt):
+    # generalized negative binomial: Poisson with Gamma(1/alpha, mu*alpha) rate
+    r = 1.0 / alpha
+    lam = jax.random.gamma(k, r, s) * (mu * alpha)
+    return jax.random.poisson(jax.random.fold_in(k, 1), lam, s).astype(dt)
+
+
+register("_sample_generalized_negative_binomial", rng=True,
+         differentiable=False, aliases=("sample_generalized_negative_binomial",))(_multisample(_gnb_draw))
+
+
+@register("_random_generalized_negative_binomial", rng=True,
+          differentiable=False)
+def random_generalized_negative_binomial(rng_key=None, mu=1.0, alpha=1.0,
+                                         shape=None, dtype="float32"):
+    return _gnb_draw(rng_key, jnp.float32(mu), jnp.float32(alpha),
+                     _shape(shape), np_dtype(dtype))

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register
+from .registry import OP_REGISTRY, register
 
 # ---------------------------------------------------------------------------
 # helpers
@@ -673,8 +673,239 @@ def linalg_syrk(a, transpose=False, alpha=1.0):
     return alpha * r
 
 
+@register("linalg_gemm")
+def linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0):
+    """alpha*op(A)op(B) + beta*C (reference: la_op.cc:36 _linalg_gemm)."""
+    r = batch_dot(a, b, transpose_a, transpose_b)
+    return alpha * r + beta * c
+
+
+@register("linalg_trmm")
+def linalg_trmm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular matrix multiply out = alpha*op(A)*B (or B*op(A) when
+    rightside) with A triangular (reference: la_op.cc _linalg_trmm)."""
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = tri.swapaxes(-1, -2)
+    r = jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b)
+    return alpha * r
+
+
+@register("linalg_potri")
+def linalg_potri(a, lower=True):
+    """Inverse of the SPD matrix whose Cholesky factor is A: out = (A·Aᵀ)⁻¹
+    for lower-triangular A (reference: la_op.cc:225 _linalg_potri)."""
+    import jax.scipy.linalg as jsl
+
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    # (A Aᵀ)⁻¹ = A⁻ᵀ A⁻¹ via two triangular solves
+    inv_a = jsl.solve_triangular(a, eye, lower=lower)
+    return jsl.solve_triangular(a, inv_a, lower=lower, trans=1)
+
+
+@register("linalg_gelqf", num_outputs=2)
+def linalg_gelqf(a):
+    """LQ factorization A = L·Q with row-orthonormal Q; returns (Q, L)
+    (reference: la_op.cc _linalg_gelqf).  Computed via QR of Aᵀ."""
+    q, r = jnp.linalg.qr(a.swapaxes(-1, -2), mode="reduced")
+    # sign-normalize so L's diagonal is positive (LAPACK convention parity)
+    d = jnp.sign(jnp.diagonal(r, axis1=-2, axis2=-1))
+    d = jnp.where(d == 0, 1.0, d).astype(a.dtype)
+    q = q * d[..., None, :]
+    r = r * d[..., :, None]
+    return q.swapaxes(-1, -2), r.swapaxes(-1, -2)
+
+
+@register("linalg_syevd", num_outputs=2)
+def linalg_syevd(a):
+    """Symmetric eigendecomposition; returns (U, L) with U·A = diag(L)·U,
+    eigenvalues ascending (reference: la_op.cc _linalg_syevd)."""
+    w, v = jnp.linalg.eigh(a)
+    return v.swapaxes(-1, -2), w
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(a):
+    """Sum of log of the diagonal, per matrix (reference: la_op.cc
+    _linalg_sumlogdiag)."""
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_makediag")
+def linalg_makediag(a, offset=0):
+    k = int(offset)
+    n = a.shape[-1] + abs(k)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    rows = idx + max(-k, 0)
+    cols = idx + max(k, 0)
+    return out.at[..., rows, cols].set(a)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=int(offset), axis1=-2, axis2=-1)
+
+
 @register("smooth_l1")
 def smooth_l1(x, scalar=1.0):
     s2 = scalar * scalar
     return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * jnp.square(x),
                      jnp.abs(x) - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# init / shape-reflection / layout ops (reference: init_op.cc, matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("_zeros", differentiable=False)
+def _zeros_op(shape=(), dtype="float32", ctx=None):
+    from ..base import np_dtype
+    return jnp.zeros(tuple(int(s) for s in shape), np_dtype(dtype))
+
+
+@register("_ones", differentiable=False)
+def _ones_op(shape=(), dtype="float32", ctx=None):
+    from ..base import np_dtype
+    return jnp.ones(tuple(int(s) for s in shape), np_dtype(dtype))
+
+
+@register("_full", differentiable=False)
+def _full_op(shape=(), value=0.0, dtype="float32", ctx=None):
+    from ..base import np_dtype
+    return jnp.full(tuple(int(s) for s in shape), value, np_dtype(dtype))
+
+
+@register("_eye", differentiable=False)
+def _eye_op(N=0, M=0, k=0, dtype="float32", ctx=None):
+    from ..base import np_dtype
+    return jnp.eye(int(N), int(M) if M else None, int(k), dtype=np_dtype(dtype))
+
+
+@register("_arange", differentiable=False)
+def _arange_op(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32",
+               ctx=None, infer_range=False):
+    from ..base import np_dtype
+    r = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if int(repeat) != 1:
+        r = jnp.repeat(r, int(repeat))
+    return r
+
+
+@register("shape_array", differentiable=False)
+def shape_array(data):
+    # reference contract is int64; jax without x64 truncates, so request
+    # int32 explicitly to avoid per-call truncation warnings
+    return jnp.asarray(data.shape, dtype=jnp.int32)
+
+
+@register("size_array", differentiable=False)
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int32)
+
+
+@register("round")
+def round_op(x):
+    return jnp.round(x)
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(x, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=1):
+    b = int(block_size)
+    N, C, H, W = data.shape
+    x = data.reshape(N, b, b, C // (b * b), H, W)
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return x.reshape(N, C // (b * b), H * b, W * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=1):
+    b = int(block_size)
+    N, C, H, W = data.shape
+    x = data.reshape(N, C, H // b, b, W // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(N, C * b * b, H // b, W // b)
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1).squeeze(1)
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(data.dtype)
+
+
+@register("khatri_rao")
+def khatri_rao(*args):
+    """Column-wise Kronecker product (reference: la_op khatri_rao)."""
+    out = args[0]
+    for m in args[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[1])
+    return out
+
+
+@register("make_loss")
+def make_loss_op(data, grad_scale=1.0, valid_thresh=0.0,
+                 normalization="null"):
+    """Identity marking a loss head (reference: make_loss.cc); grad handled
+    by the autograd head-gradient path."""
+    return data
+
+
+@register("_square_sum")
+def square_sum(data, axis=None, keepdims=False):
+    return jnp.sum(jnp.square(data), axis=_axis_arg(axis),
+                   keepdims=bool(keepdims))
+
+
+@register("_grad_add")
+def grad_add(a, b):
+    return a + b
+
+
+@register("_identity_with_attr_like_rhs")
+def identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
+                                  momentum=0.9):
+    return data
+
+
+@register("_slice_assign")
+def slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    idx = _slice_index(lhs.shape, begin, end, step)
+    return lhs.at[idx].set(rhs)
+
+
+@register("_slice_assign_scalar")
+def slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=()):
+    idx = _slice_index(data.shape, begin, end, step)
+    return data.at[idx].set(jnp.asarray(scalar, data.dtype))
+
+
+def _slice_index(shape, begin, end, step):
+    step = step if step else (1,) * len(begin)
+    return tuple(
+        slice(None if b is None else int(b), None if e is None else int(e),
+              int(s) if s else 1)
+        for b, e, s in zip(begin, end, step))
+
+
+# reference scalar-op spelling aliases (_plus_scalar == _add_scalar etc.)
+for _ref, _ours in (("_plus_scalar", "_add_scalar"),
+                    ("_minus_scalar", "_sub_scalar"),
+                    ("_rminus_scalar", "_rsub_scalar")):
+    if _ref not in OP_REGISTRY and _ours in OP_REGISTRY:
+        OP_REGISTRY[_ref] = OP_REGISTRY[_ours]

@@ -1,0 +1,255 @@
+"""Optimizer-update ops, multisample ops, CTC loss, misc tensor ops
+(VERDICT r3 item 7 — registry breadth with per-family tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_registry_over_300():
+    from mxnet_tpu.ops.registry import OP_REGISTRY
+    assert len(OP_REGISTRY) >= 300, len(OP_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer updates vs the python Optimizer implementations
+# ---------------------------------------------------------------------------
+
+def test_sgd_update_op():
+    r = np.random.RandomState(0)
+    w = r.rand(5).astype(np.float32)
+    g = r.rand(5).astype(np.float32)
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01).asnumpy()
+    assert np.allclose(out, w - 0.1 * (g + 0.01 * w), atol=1e-6)
+
+
+def test_sgd_mom_update_op():
+    r = np.random.RandomState(1)
+    w, g, m = (r.rand(4).astype(np.float32) for _ in range(3))
+    new_w, new_m = nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(m),
+                                     lr=0.1, momentum=0.9)
+    em = 0.9 * m - 0.1 * g
+    assert np.allclose(new_m.asnumpy(), em, atol=1e-6)
+    assert np.allclose(new_w.asnumpy(), w + em, atol=1e-6)
+
+
+def test_adam_update_op():
+    r = np.random.RandomState(2)
+    w, g, m, v = (r.rand(6).astype(np.float32) for _ in range(4))
+    new_w, new_m, new_v = nd.adam_update(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v), lr=0.01)
+    em = 0.9 * m + 0.1 * g
+    ev = 0.999 * v + 0.001 * g * g
+    assert np.allclose(new_m.asnumpy(), em, atol=1e-6)
+    assert np.allclose(new_v.asnumpy(), ev, atol=1e-6)
+    assert np.allclose(new_w.asnumpy(), w - 0.01 * em / (np.sqrt(ev) + 1e-8),
+                       atol=1e-6)
+
+
+def test_mp_sgd_update_keeps_f32_master():
+    w16 = np.ones(4, np.float16)
+    w32 = np.ones(4, np.float32) * 1.0001
+    g = np.full(4, 1e-4, np.float16)
+    new_w, new_w32 = nd.mp_sgd_update(
+        nd.array(w16, dtype="float16"), nd.array(g, dtype="float16"),
+        nd.array(w32), lr=1.0)
+    # master stays f32 and accumulates the small step exactly
+    assert new_w32.asnumpy().dtype == np.float32
+    assert np.allclose(new_w32.asnumpy(), w32 - 1e-4, atol=1e-6)
+    assert new_w.asnumpy().dtype == np.float16
+
+
+def test_signum_and_rmsprop_and_ftrl_shapes():
+    r = np.random.RandomState(3)
+    w, g, m = (r.rand(3).astype(np.float32) for _ in range(3))
+    nw, nm = nd.signum_update(nd.array(w), nd.array(g), nd.array(m),
+                              lr=0.1, momentum=0.9)
+    assert nw.shape == (3,)
+    nw, nn = nd.rmsprop_update(nd.array(w), nd.array(g), nd.array(m), lr=0.1)
+    assert nw.shape == (3,)
+    z = np.zeros(3, np.float32)
+    n = np.zeros(3, np.float32)
+    nw, nz, nn = nd.ftrl_update(nd.array(w), nd.array(g), nd.array(z),
+                                nd.array(n), lr=0.1)
+    assert nw.shape == (3,)
+
+
+# ---------------------------------------------------------------------------
+# multisample ops
+# ---------------------------------------------------------------------------
+
+def test_sample_uniform_shape_and_range():
+    lo = nd.array(np.array([0.0, 10.0], np.float32))
+    hi = nd.array(np.array([1.0, 20.0], np.float32))
+    out = nd.sample_uniform(lo, hi, shape=(500,)).asnumpy()
+    assert out.shape == (2, 500)
+    assert (out[0] >= 0).all() and (out[0] < 1).all()
+    assert (out[1] >= 10).all() and (out[1] < 20).all()
+
+
+def test_sample_gamma_mean():
+    a = nd.array(np.array([2.0, 8.0], np.float32))
+    b = nd.array(np.array([1.0, 0.5], np.float32))
+    out = nd.sample_gamma(a, b, shape=(4000,)).asnumpy()
+    assert abs(out[0].mean() - 2.0) < 0.2
+    assert abs(out[1].mean() - 4.0) < 0.3
+
+
+def test_sample_poisson_mean():
+    lam = nd.array(np.array([1.0, 6.0], np.float32))
+    out = nd.sample_poisson(lam, shape=(3000,)).asnumpy()
+    assert abs(out[0].mean() - 1.0) < 0.15
+    assert abs(out[1].mean() - 6.0) < 0.3
+
+
+# ---------------------------------------------------------------------------
+# CTC loss vs torch oracle
+# ---------------------------------------------------------------------------
+
+def test_ctc_loss_matches_torch():
+    torch = pytest.importorskip("torch")
+    r = np.random.RandomState(0)
+    T, B, A, L = 10, 3, 6, 4
+    data = r.randn(T, B, A).astype(np.float32)
+    # labels 1-based (blank_label='first'), 0-padded
+    lab = np.zeros((B, L), np.float32)
+    lens = [4, 2, 3]
+    for b, n in enumerate(lens):
+        lab[b, :n] = r.randint(1, A, n)
+
+    out = nd.contrib.CTCLoss(nd.array(data), nd.array(lab)).asnumpy()
+
+    t_logp = torch.nn.functional.log_softmax(torch.tensor(data), dim=-1)
+    t_loss = torch.nn.functional.ctc_loss(
+        t_logp, torch.tensor(lab, dtype=torch.long),
+        torch.full((B,), T, dtype=torch.long),
+        torch.tensor(lens, dtype=torch.long),
+        blank=0, reduction="none", zero_infinity=False)
+    assert np.allclose(out, t_loss.numpy(), atol=1e-3), (out, t_loss)
+
+
+def test_ctc_loss_variable_data_lengths():
+    torch = pytest.importorskip("torch")
+    r = np.random.RandomState(1)
+    T, B, A, L = 12, 2, 5, 3
+    data = r.randn(T, B, A).astype(np.float32)
+    lab = np.array([[1, 2, 0], [3, 0, 0]], np.float32)
+    dlen = np.array([12, 7], np.float32)
+    llen = np.array([2, 1], np.float32)
+    out = nd.contrib.CTCLoss(nd.array(data), nd.array(lab), nd.array(dlen),
+                             nd.array(llen), use_data_lengths=True,
+                             use_label_lengths=True).asnumpy()
+    t_logp = torch.nn.functional.log_softmax(torch.tensor(data), dim=-1)
+    t_loss = torch.nn.functional.ctc_loss(
+        t_logp, torch.tensor(lab, dtype=torch.long),
+        torch.tensor(dlen, dtype=torch.long),
+        torch.tensor(llen, dtype=torch.long),
+        blank=0, reduction="none")
+    assert np.allclose(out, t_loss.numpy(), atol=1e-3)
+
+
+def test_ctc_loss_grad_flows():
+    from mxnet_tpu import autograd
+    r = np.random.RandomState(2)
+    data = nd.array(r.randn(6, 2, 4).astype(np.float32))
+    lab = nd.array(np.array([[1, 2], [3, 0]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        loss = nd.contrib.CTCLoss(data, lab).sum()
+    loss.backward()
+    g = data.grad.asnumpy()
+    assert np.abs(g).sum() > 0
+    assert np.isfinite(g).all()
+
+
+# ---------------------------------------------------------------------------
+# misc tensor ops
+# ---------------------------------------------------------------------------
+
+def test_depth_space_roundtrip():
+    r = np.random.RandomState(0)
+    x = r.rand(2, 8, 4, 6).astype(np.float32)
+    d = nd.depth_to_space(nd.array(x), block_size=2)
+    assert d.shape == (2, 2, 8, 12)
+    back = nd.space_to_depth(d, block_size=2).asnumpy()
+    assert np.allclose(back, x)
+
+
+def test_shape_size_array():
+    x = nd.array(np.zeros((3, 4, 5), np.float32))
+    assert list(nd.shape_array(x).asnumpy()) == [3, 4, 5]
+    assert list(nd.size_array(x).asnumpy()) == [60]
+
+
+def test_batch_take_and_argmax_channel():
+    x = np.array([[1, 2, 3], [6, 5, 4]], np.float32)
+    out = nd.batch_take(nd.array(x), nd.array(np.array([2, 0], np.float32)))
+    assert list(out.asnumpy()) == [3, 6]
+    am = nd.argmax_channel(nd.array(x)).asnumpy()
+    assert list(am) == [2, 0]
+
+
+def test_khatri_rao():
+    a = np.array([[1., 2.], [3., 4.]], np.float32)
+    b = np.array([[5., 6.], [7., 8.]], np.float32)
+    out = nd.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+    assert out.shape == (4, 2)
+    assert np.allclose(out[:, 0], np.kron(a[:, 0], b[:, 0]))
+    assert np.allclose(out[:, 1], np.kron(a[:, 1], b[:, 1]))
+
+
+def test_slice_assign():
+    x = np.zeros((4, 4), np.float32)
+    v = np.ones((2, 2), np.float32)
+    out = nd._slice_assign(nd.array(x), nd.array(v), begin=(1, 1),
+                           end=(3, 3)).asnumpy()
+    assert out[1:3, 1:3].sum() == 4 and out.sum() == 4
+    out = nd._slice_assign_scalar(nd.array(x), scalar=5.0, begin=(0, 0),
+                                  end=(1, 4)).asnumpy()
+    assert out[0].sum() == 20 and out.sum() == 20
+
+
+def test_init_ops_via_symbol():
+    import mxnet_tpu.symbol as sym
+    s = sym.zeros(shape=(2, 3)) if hasattr(sym, "zeros") else None
+    # registered _zeros op usable through nd.invoke path
+    from mxnet_tpu.ops.registry import get_op
+    assert get_op("_zeros") is not None
+    assert get_op("_eye") is not None
+    assert get_op("_arange") is not None
+
+
+def test_hard_sigmoid_round():
+    x = nd.array(np.array([-5.0, 0.0, 5.0], np.float32))
+    hs = nd.hard_sigmoid(x).asnumpy()
+    assert np.allclose(hs, [0.0, 0.5, 1.0])
+    assert list(nd.round(nd.array(np.array([1.4, 2.6], np.float32))).asnumpy()) == [1.0, 3.0]
+
+
+def test_bipartite_matching():
+    score = np.array([[0.9, 0.1], [0.8, 0.7]], np.float32)
+    r, c = nd.contrib.bipartite_matching(nd.array(score), threshold=0.0)
+    # greedy: (0,0) first (0.9), then row1 takes col1 (0.7)
+    assert list(r.asnumpy()) == [0, 1]
+    assert list(c.asnumpy()) == [0, 1]
+
+
+def test_sample_normal_tensor_params():
+    mu = nd.array(np.array([0.0, 50.0], np.float32))
+    sig = nd.array(np.array([1.0, 5.0], np.float32))
+    out = nd.sample_normal(mu, sig, shape=(4000,)).asnumpy()
+    assert out.shape == (2, 4000)
+    assert abs(out[0].mean()) < 0.15 and abs(out[1].mean() - 50.0) < 0.5
+    assert abs(out[0].std() - 1.0) < 0.1 and abs(out[1].std() - 5.0) < 0.4
+
+
+def test_bipartite_matching_ascending_threshold():
+    cost = np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+    # ascending: smallest cost first, only matches with cost < threshold
+    r, c = nd.contrib.bipartite_matching(nd.array(cost), is_ascend=True,
+                                         threshold=0.5)
+    assert list(r.asnumpy()) == [0, 1]   # (0,0)=0.1 and (1,1)=0.2 accepted
+    r2, c2 = nd.contrib.bipartite_matching(nd.array(cost), is_ascend=True,
+                                           threshold=0.15)
+    assert list(r2.asnumpy()) == [0, -1]  # only 0.1 clears the bar
