@@ -16,7 +16,8 @@ import numpy as _np
 from ..base import MXNetError
 
 __all__ = ["quantize_params", "calib_thresholds_naive",
-           "calib_thresholds_entropy", "quantize_model", "QuantizedParam"]
+           "calib_thresholds_entropy", "quantize_model", "quantize_graph",
+           "QuantizedParam"]
 
 
 class QuantizedParam:
@@ -108,41 +109,158 @@ def calib_thresholds_entropy(activations: Dict[str, List[_np.ndarray]],
     return out
 
 
+_QUANTIZABLE = {"Convolution": "_contrib_quantized_conv",
+                "FullyConnected": "_contrib_quantized_fully_connected"}
+
+
+def quantize_graph(sym, excluded_sym_names=None, calib_thresholds=None,
+                   param_shapes=None):
+    """The QuantizeGraph pass (reference:
+    src/operator/quantization/quantize_graph_pass.cc:97), rebuilt over this
+    framework's Symbol DAG.
+
+    Every Convolution/FullyConnected node (unless excluded) is replaced by
+    a quantize_v2 → quantized-op → dequantize sandwich: activations are
+    quantized to int8 at runtime (with calibrated static ranges when
+    ``calib_thresholds[node_name]`` is present — no runtime min/max scan),
+    weights/bias are quantized in-graph from the same float params, and the
+    int32 accumulator is dequantized back to float so the surrounding graph
+    is untouched.  Note the weight quantize_v2 re-runs per forward (params
+    are traced jit arguments, not constants); use :func:`quantize_params`
+    for offline weight quantization when that cost matters.
+    """
+    from ..symbol.graph import Node, SymbolEntry, topo_order
+    from ..symbol.symbol import Symbol
+    from ..ops.registry import get_op
+
+    excluded = set(excluded_sym_names or ())
+    calib = calib_thresholds or {}
+    remap: Dict[tuple, SymbolEntry] = {}
+
+    def mapped(e):
+        return remap.get((e.node._uid, e.index), e)
+
+    def make(opname, name, inputs, attrs):
+        node = Node("op", name, get_op(opname), attrs,
+                    [mapped(i) if isinstance(i, SymbolEntry) else i
+                     for i in inputs])
+        return [SymbolEntry(node, i)
+                for i in range(node.op.n_outputs(attrs))]
+
+    for node in topo_order(sym._entries):
+        if node.kind == "var":
+            # clone vars that get a known shape so the stamp never leaks
+            # into the caller's original symbol (shape_solver honors the
+            # clone's __shape__; every consumer below picks up the clone
+            # through the remap)
+            if param_shapes and node.name in param_shapes:
+                clone = Node("var", node.name,
+                             attr_dict=dict(node.attr_dict))
+                clone.attr_dict["__shape__"] = repr(
+                    tuple(param_shapes[node.name]))
+                remap[(node._uid, 0)] = SymbolEntry(clone, 0)
+            continue
+        if node.op.name not in _QUANTIZABLE or node.name in excluded:
+            if node.kind == "op":
+                node_inputs = [mapped(e) for e in node.inputs]
+                if any(m is not e for m, e in zip(node_inputs, node.inputs)):
+                    clone = Node("op", node.name, node.op, node.attrs,
+                                 node_inputs, node.attr_dict)
+                    for i in range(node.num_outputs()):
+                        remap[(node._uid, i)] = SymbolEntry(clone, i)
+            continue
+        has_bias = not node.attrs.get("no_bias") and len(node.inputs) >= 3
+        data_e, weight_e = node.inputs[0], node.inputs[1]
+        bias_e = node.inputs[2] if has_bias else None
+
+        qattrs = {"out_type": "int8"}
+        t = calib.get(node.name)
+        if t is not None:
+            qattrs["min_calib_range"] = -float(t)
+            qattrs["max_calib_range"] = float(t)
+        qd = make("_contrib_quantize_v2", node.name + "_quantize",
+                  [data_e], qattrs)
+        qw = make("_contrib_quantize_v2", node.name + "_qweight",
+                  [weight_e], {"out_type": "int8"})
+        ins = [qd[0], qw[0]]
+        tail = [qd[1], qd[2], qw[1], qw[2]]
+        if bias_e is not None:
+            qb = make("_contrib_quantize_v2", node.name + "_qbias",
+                      [bias_e], {"out_type": "int8"})
+            ins.append(qb[0])
+            tail += [qb[1], qb[2]]
+        qop = make(_QUANTIZABLE[node.op.name], node.name + "_quantized",
+                   ins + tail, dict(node.attrs))
+        deq = make("_contrib_dequantize", node.name + "_dequantize",
+                   qop, {})
+        remap[(node._uid, 0)] = deq[0]
+
+    return Symbol([mapped(e) for e in sym._entries])
+
+
+def _collect_calib_thresholds(sym, arg_params, aux_params, data_names,
+                              calib_data, num_calib_examples, calib_mode,
+                              excluded):
+    """Per-quantized-node input ranges: bind a probe symbol grouping every
+    conv/fc data input, run the calibration batches, and hand the
+    activations to the naive/entropy threshold pickers (reference:
+    quantization.py _LayerOutputCollector path)."""
+    from ..symbol.graph import topo_order
+    from ..symbol.symbol import Symbol, Group
+    from ..module import Module
+
+    probes = []
+    names = []
+    for node in topo_order(sym._entries):
+        if node.kind == "op" and node.op.name in _QUANTIZABLE \
+                and node.name not in excluded:
+            probes.append(Symbol([node.inputs[0]]))
+            names.append(node.name)
+    if not probes:
+        return {}
+    probe = Group(probes)
+    mod = Module(probe, data_names=list(data_names), label_names=None)
+    acts: Dict[str, List[_np.ndarray]] = {n: [] for n in names}
+    n_seen = 0
+    for batch in calib_data:
+        if not mod.binded:
+            mod.bind(data_shapes=calib_data.provide_data, for_training=False)
+            mod.set_params(arg_params, aux_params, allow_missing=True,
+                           allow_extra=True)
+        mod.forward(batch, is_train=False)
+        for name, out in zip(names, mod.get_outputs()):
+            acts[name].append(out.asnumpy())
+        n_seen += batch.data[0].shape[0]
+        if num_calib_examples and n_seen >= num_calib_examples:
+            break
+    fn = calib_thresholds_entropy if calib_mode == "entropy" \
+        else calib_thresholds_naive
+    return fn(acts)
+
+
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    excluded_sym_names=None, calib_mode="none",
                    calib_data=None, num_calib_examples=None, ctx=None,
                    quantized_dtype="int8", logger=None):
-    """Quantize a symbolic model's parameters (reference: quantization.py
-    quantize_model). Returns (symbol, quantized arg_params, aux_params);
-    consumers dequantize QuantizedParam entries (or feed them to int8
-    kernels). calib_mode 'naive'/'entropy' runs forward passes over
-    calib_data to pick activation thresholds, stored as symbol attrs."""
+    """Quantize a symbolic model (reference: quantization.py
+    quantize_model).  Returns (quantized symbol, arg_params, aux_params):
+    the symbol has conv/fc nodes rewritten to int8 compute via
+    :func:`quantize_graph`; params pass through unchanged (weight
+    quantization happens in-graph).  calib_mode 'naive'/'entropy' runs
+    forward passes over calib_data to fix the activation ranges statically.
+    """
     if quantized_dtype not in ("int8", "auto"):
         raise MXNetError(f"unsupported quantized_dtype {quantized_dtype!r}")
-    qargs = quantize_params(arg_params, exclude=excluded_sym_names)
+    excluded = set(excluded_sym_names or ())
     thresholds = {}
     if calib_mode != "none":
         if calib_data is None:
             raise MXNetError("calib_data required when calib_mode != 'none'")
-        from ..module import Module
-
-        mod = Module(sym, data_names=list(data_names),
-                     label_names=None)
-        acts: Dict[str, List[_np.ndarray]] = {"output": []}
-        n = 0
-        for batch in calib_data:
-            mod.bind(data_shapes=calib_data.provide_data, for_training=False,
-                     force_rebind=False)
-            mod.set_params(arg_params, aux_params, allow_missing=True)
-            mod.forward(batch, is_train=False)
-            acts["output"].append(mod.get_outputs()[0].asnumpy())
-            n += batch.data[0].shape[0]
-            if num_calib_examples and n >= num_calib_examples:
-                break
-        fn = calib_thresholds_entropy if calib_mode == "entropy" \
-            else calib_thresholds_naive
-        thresholds = fn(acts)
-    qsym = sym
-    for name, t in thresholds.items():
-        qsym._entries[0].node.attr_dict[f"__calib_{name}__"] = repr(t)
-    return qsym, qargs, aux_params
+        thresholds = _collect_calib_thresholds(
+            sym, arg_params, aux_params, data_names, calib_data,
+            num_calib_examples, calib_mode, excluded)
+    shapes = {k: tuple(v.shape) for k, v in {**arg_params,
+                                             **(aux_params or {})}.items()}
+    qsym = quantize_graph(sym, excluded_sym_names=excluded,
+                          calib_thresholds=thresholds, param_shapes=shapes)
+    return qsym, arg_params, aux_params

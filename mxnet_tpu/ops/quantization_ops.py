@@ -42,14 +42,17 @@ def quantize_v2(data, out_type="int8", min_calib_range=None,
 
 @register("_contrib_dequantize", differentiable=False)
 def dequantize(data, min_range, max_range, out_type="float32"):
-    """int8/uint8 → float (reference: dequantize.cc)."""
+    """int8/uint8/int32 → float (reference: dequantize.cc).  int32 inputs
+    are quantized-op accumulators whose range convention spans the full
+    int32 domain (quantized_conv/fc output)."""
     lo = jnp.min(min_range)
     hi = jnp.max(max_range)
     if data.dtype == jnp.uint8:
         scale = jnp.maximum(hi - lo, 1e-8) / 255.0
         return data.astype(jnp.float32) * scale + lo
     t = jnp.maximum(jnp.abs(lo), jnp.abs(hi))
-    return data.astype(jnp.float32) * (t / 127.0)
+    denom = float(2 ** 31 - 1) if data.dtype == jnp.int32 else 127.0
+    return data.astype(jnp.float32) * (t / denom)
 
 
 @register("_contrib_requantize", num_outputs=3, differentiable=False)
@@ -100,3 +103,78 @@ def quantized_fully_connected(*args, num_hidden=0, no_bias=False,
         acc = acc + b.astype(jnp.int32)
     t = out_scale * float(2 ** 31 - 1)
     return acc, (-t).reshape(1), t.reshape(1)
+
+
+@register("_contrib_quantized_conv", num_outputs=3, differentiable=False)
+def quantized_conv(*args, kernel=(), stride=(), dilate=(), pad=(),
+                   num_filter=0, num_group=1, no_bias=False, layout=None,
+                   cudnn_tune=None, cudnn_off=False, workspace=1024):
+    """int8 convolution with int32 accumulation on the MXU
+    (reference: src/operator/quantization/quantized_conv.cu).
+
+    Inputs with bias: (data, weight, bias, min_data, max_data, min_weight,
+    max_weight, min_bias, max_bias); without bias the three bias entries are
+    absent.  data/weight int8; returns (int32 acc, min, max) where the range
+    is the accumulator's real-value span (product of input scales), matching
+    the reference's convention so requantize/dequantize compose.
+    """
+    from jax import lax
+    from .nn import _conv_dnums
+
+    if no_bias or len(args) == 6:
+        data, weight, min_data, max_data, min_weight, max_weight = args
+        bias = min_bias = max_bias = None
+    else:
+        (data, weight, bias, min_data, max_data, min_weight, max_weight,
+         min_bias, max_bias) = args
+    nd_sp = data.ndim - 2
+    k = len(kernel) if kernel else nd_sp
+    stride = tuple(stride) if stride else (1,) * k
+    dilate = tuple(dilate) if dilate else (1,) * k
+    pad = tuple(pad) if pad else (0,) * k
+    dnums = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                       _conv_dnums(data.ndim, layout))
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int32), weight.astype(jnp.int32),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dnums,
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.int32)
+    sd = jnp.maximum(jnp.abs(jnp.min(min_data)), jnp.abs(jnp.max(max_data)))
+    sw = jnp.maximum(jnp.abs(jnp.min(min_weight)),
+                     jnp.abs(jnp.max(max_weight)))
+    out_scale = (sd / 127.0) * (sw / 127.0)
+    if bias is not None:
+        sb = jnp.maximum(jnp.abs(jnp.min(min_bias)),
+                         jnp.abs(jnp.max(max_bias)))
+        b = jnp.round(bias.astype(jnp.float32) * (sb / 127.0) / out_scale)
+        acc = acc + b.astype(jnp.int32).reshape((1, -1) + (1,) * nd_sp)
+    t = out_scale * float(2 ** 31 - 1)
+    return acc, (-t).reshape(1), t.reshape(1)
+
+
+@register("_contrib_quantized_pooling", num_outputs=3, differentiable=False)
+def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                      stride=(), pad=(), global_pool=False, cudnn_off=False,
+                      pooling_convention="valid", count_include_pad=True):
+    """int8 pooling (reference: quantized_pooling.cc) — max pool stays int8
+    exactly; avg pool accumulates in int32 and rounds back, range unchanged."""
+    from .nn import pooling
+
+    out = pooling(data.astype(jnp.float32), kernel=kernel,
+                  pool_type=pool_type, stride=stride, pad=pad,
+                  global_pool=global_pool,
+                  pooling_convention=pooling_convention,
+                  count_include_pad=count_include_pad)
+    out = jnp.clip(jnp.round(out), -127, 127).astype(data.dtype) \
+        if data.dtype in (jnp.int8, jnp.uint8) else out.astype(data.dtype)
+    return out, jnp.reshape(jnp.min(min_data), (1,)), \
+        jnp.reshape(jnp.max(max_data), (1,))
+
+
+@register("_contrib_quantized_flatten", num_outputs=3, differentiable=False)
+def quantized_flatten(data, min_data, max_data):
+    """int8 flatten (reference: quantized_flatten.cc)."""
+    return data.reshape(data.shape[0], -1), \
+        jnp.reshape(jnp.min(min_data), (1,)), \
+        jnp.reshape(jnp.max(max_data), (1,))

@@ -71,6 +71,9 @@ def test_calibration_thresholds():
 
 
 def test_quantize_model_no_calib():
+    """quantize_model rewrites fc/conv nodes in-graph (weights quantized by
+    quantize_v2 nodes, not offline), so params pass through as float and the
+    quantized symbol gains quantize/dequantize nodes."""
     data = mx.sym.Variable("data")
     fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
     out = mx.sym.SoftmaxOutput(fc, name="softmax")
@@ -78,7 +81,14 @@ def test_quantize_model_no_calib():
     args = {k: v for k, v in zip(out.list_arguments(), exe.arg_arrays)
             if k != "data" and k != "softmax_label"}
     qsym, qargs, _ = quantization.quantize_model(out, args, {})
-    assert isinstance(qargs["fc_weight"], quantization.QuantizedParam)
+    assert set(qargs) == set(args)  # params unchanged, quantization in-graph
+    names = " ".join(n.name for n in
+                     __import__("mxnet_tpu").symbol.graph.topo_order(
+                         qsym._entries))
+    assert "fc_quantized" in names and "fc_dequantize" in names
+    # offline path still available:
+    q = quantization.quantize_params(args)
+    assert isinstance(q["fc_weight"], quantization.QuantizedParam)
 
 
 def test_split_input_slice():
