@@ -14,13 +14,17 @@ no bespoke backward pass (the reference needs ~2k LoC of subgraph gradient
 plumbing).  The resulting Symbol binds/hybridizes like any other; the whole
 loop compiles into the enclosing XLA program with static shapes.
 
-Not yet supported: serializing a control-flow Symbol with ``tojson`` (the
-subgraph closure is not JSON-round-trippable; the reference embeds subgraphs
-in its JSON).
+Serialization: each control-flow node carries a ``__control_flow__`` attr
+holding its subgraph(s) as nested symbol JSON plus the boundary-name lists
+(the analogue of the reference embedding subgraphs in symbol JSON,
+control_flow.cc:1256-1310); ``load_json`` hands that spec back to
+:func:`op_from_spec`, which rebuilds the per-call-site Op — so
+foreach/while/cond symbols round-trip through ``tojson``/``load``.
 """
 from __future__ import annotations
 
 import itertools
+import json as _json
 from typing import Dict, List, Tuple
 
 import jax
@@ -32,7 +36,7 @@ from .. import attribute
 from .graph import Node, SymbolEntry, topo_order, trace
 from .symbol import Symbol, Variable, _apply_op
 
-__all__ = ["foreach", "while_loop", "cond"]
+__all__ = ["foreach", "while_loop", "cond", "op_from_spec"]
 
 _uid = itertools.count()
 
@@ -126,7 +130,26 @@ def foreach(body, data, init_states, name: str = None):
     sub_entries, closure_names, closure_syms = _cut_subgraph(
         head_entries, scope, set(item_names + state_names))
 
-    n_data, n_state, n_out = len(data_list), len(state_list), len(out_list)
+    n_state, n_out = len(state_list), len(out_list)
+    op = _make_foreach_op(sub_entries, item_names, state_names,
+                          closure_names, n_out)
+    res = _apply_op(op, data_list + state_list + closure_syms, {}, scope)
+    _stamp_spec(res, {"kind": "foreach",
+                      "subgraph": Symbol(sub_entries).tojson(),
+                      "item_names": item_names, "state_names": state_names,
+                      "closure_names": closure_names, "n_out": n_out})
+    outputs = [res[i] for i in range(n_out)]
+    states = [res[n_out + i] for i in range(n_state)]
+    return _pack_like(outputs, out), _pack_like(states, init_states)
+
+
+def _stamp_spec(res: Symbol, spec: dict):
+    res._entries[0].node.attr_dict["__control_flow__"] = _json.dumps(spec)
+
+
+def _make_foreach_op(sub_entries, item_names, state_names, closure_names,
+                     n_out):
+    n_data, n_state = len(item_names), len(state_names)
 
     def _foreach_fn(*arrays, _training=True, rng_key=None):
         datas = arrays[:n_data]
@@ -148,11 +171,7 @@ def foreach(body, data, init_states, name: str = None):
             step, (jnp.int32(0), tuple(init)), tuple(datas))
         return tuple(ys) + tuple(carry)
 
-    op = Op(f"_foreach", _foreach_fn, num_outputs=n_out + n_state, rng=True)
-    res = _apply_op(op, data_list + state_list + closure_syms, {}, scope)
-    outputs = [res[i] for i in range(n_out)]
-    states = [res[n_out + i] for i in range(n_state)]
-    return _pack_like(outputs, out), _pack_like(states, init_states)
+    return Op("_foreach", _foreach_fn, num_outputs=n_out + n_state, rng=True)
 
 
 def while_loop(cond_fn, func, loop_vars, max_iterations, name: str = None):
@@ -188,6 +207,19 @@ def while_loop(cond_fn, func, loop_vars, max_iterations, name: str = None):
         heads, scope, set(lv_names))
 
     n_lv, n_out, T = len(lv_list), len(out_list), int(max_iterations)
+    op = _make_while_op(sub_entries, lv_names, closure_names, n_out, T)
+    res = _apply_op(op, lv_list + closure_syms, {}, scope)
+    _stamp_spec(res, {"kind": "while_loop",
+                      "subgraph": Symbol(sub_entries).tojson(),
+                      "lv_names": lv_names, "closure_names": closure_names,
+                      "n_out": n_out, "max_iterations": T})
+    outputs = [res[i] for i in range(n_out)]
+    states = [res[n_out + i] for i in range(n_lv)]
+    return outputs, _pack_like(states, loop_vars)
+
+
+def _make_while_op(sub_entries, lv_names, closure_names, n_out, T):
+    n_lv = len(lv_names)
 
     def _while_fn(*arrays, _training=True, rng_key=None):
         lv0 = arrays[:n_lv]
@@ -213,11 +245,7 @@ def while_loop(cond_fn, func, loop_vars, max_iterations, name: str = None):
             step, (jnp.int32(0), tuple(lv0), jnp.bool_(True)), None, length=T)
         return tuple(ys) + tuple(final_lv)
 
-    op = Op("_while_loop", _while_fn, num_outputs=n_out + n_lv, rng=True)
-    res = _apply_op(op, lv_list + closure_syms, {}, scope)
-    outputs = [res[i] for i in range(n_out)]
-    states = [res[n_out + i] for i in range(n_lv)]
-    return outputs, _pack_like(states, loop_vars)
+    return Op("_while_loop", _while_fn, num_outputs=n_out + n_lv, rng=True)
 
 
 def cond(pred, then_func, else_func, name: str = None):
@@ -242,6 +270,21 @@ def cond(pred, then_func, else_func, name: str = None):
         [s._entries[0] for s in then_list], scope, set())
     else_entries, else_cnames, else_csyms = _cut_subgraph(
         [s._entries[0] for s in else_list], scope, set())
+
+    op = _make_cond_op(then_entries, else_entries, then_cnames, else_cnames,
+                       n_out)
+    res = _apply_op(op, [pred] + then_csyms + else_csyms, {}, scope)
+    _stamp_spec(res, {"kind": "cond",
+                      "then_subgraph": Symbol(then_entries).tojson(),
+                      "else_subgraph": Symbol(else_entries).tojson(),
+                      "then_cnames": then_cnames, "else_cnames": else_cnames,
+                      "n_out": n_out})
+    outputs = [res[i] for i in range(n_out)] if n_out > 1 else res
+    return _pack_like(_as_sym_list(outputs), then_out)
+
+
+def _make_cond_op(then_entries, else_entries, then_cnames, else_cnames,
+                  n_out):
     n_then = len(then_cnames)
 
     def _cond_fn(pred_v, *closures, _training=True, rng_key=None):
@@ -262,7 +305,29 @@ def cond(pred, then_func, else_func, name: str = None):
                               then_branch, else_branch, None)
         return picked if n_out > 1 else picked[0]
 
-    op = Op("_cond", _cond_fn, num_outputs=n_out, rng=True)
-    res = _apply_op(op, [pred] + then_csyms + else_csyms, {}, scope)
-    outputs = [res[i] for i in range(n_out)] if n_out > 1 else res
-    return _pack_like(_as_sym_list(outputs), then_out)
+    return Op("_cond", _cond_fn, num_outputs=n_out, rng=True)
+
+
+def op_from_spec(spec_json: str) -> Op:
+    """Rebuild a control-flow node's per-call-site Op from its serialized
+    ``__control_flow__`` spec (used by ``load_json``; nested control flow
+    recurses through the same path)."""
+    from .symbol import load_json
+
+    spec = _json.loads(spec_json)
+    kind = spec["kind"]
+    if kind == "foreach":
+        return _make_foreach_op(load_json(spec["subgraph"])._entries,
+                                spec["item_names"], spec["state_names"],
+                                spec["closure_names"], int(spec["n_out"]))
+    if kind == "while_loop":
+        return _make_while_op(load_json(spec["subgraph"])._entries,
+                              spec["lv_names"], spec["closure_names"],
+                              int(spec["n_out"]),
+                              int(spec["max_iterations"]))
+    if kind == "cond":
+        return _make_cond_op(load_json(spec["then_subgraph"])._entries,
+                             load_json(spec["else_subgraph"])._entries,
+                             spec["then_cnames"], spec["else_cnames"],
+                             int(spec["n_out"]))
+    raise MXNetError(f"unknown control-flow kind {kind!r}")

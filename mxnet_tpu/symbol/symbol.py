@@ -305,7 +305,8 @@ class Symbol:
             if req.get(n, "null") != "null":
                 grad_arrays[n] = zeros(sh, ctx=ctx, dtype=type_dict.get(n, "float32"))
         aux = {n: zeros(sh, ctx=ctx) for n, sh in zip(aux_names, aux_shapes)}
-        return Executor(self, ctx, args, grad_arrays, req, aux)
+        return Executor(self, ctx, args, grad_arrays, req, aux,
+                        group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
@@ -324,7 +325,7 @@ class Symbol:
         if isinstance(grad_req, (list, tuple)):
             req = dict(zip(arg_names, grad_req))
         return Executor(self, ctx, dict(args), dict(args_grad or {}), req,
-                        dict(aux_states or {}))
+                        dict(aux_states or {}), group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         ex = self.bind(ctx=ctx, args=kwargs, args_grad=None, grad_req="null")
@@ -332,8 +333,42 @@ class Symbol:
 
     # gradient of this symbol's (summed) outputs — reference: Symbol.grad
     def grad(self, wrt: Sequence[str]) -> "Symbol":
-        raise NotImplementedError(
-            "symbolic grad graphs are implicit: bind with grad_req and call backward")
+        """Gradient symbol of the summed outputs w.r.t. ``wrt`` arguments
+        (reference: Symbol.grad / nnvm Gradient pass).  The returned symbol
+        has one output per name in ``wrt`` and the same arguments as self;
+        binding it evaluates the vjp with ones-seeded heads — the same
+        seeding Executor.backward uses without explicit out_grads."""
+        wrt = list(wrt)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        unknown = [w for w in wrt if w not in arg_names]
+        if unknown:
+            raise MXNetError(f"grad: unknown argument(s) {unknown}; "
+                             f"arguments are {arg_names}")
+        entries = self._entries
+        in_names = arg_names + aux_names
+        in_syms = []
+        by_name = {n.name: n for n in input_nodes(entries)}
+        for n in in_names:
+            in_syms.append(Symbol([SymbolEntry(by_name[n])]))
+
+        def _grad_fn(*arrays, _training=True, rng_key=None):
+            env = dict(zip(in_names, arrays))
+
+            def f(wvals):
+                e2 = dict(env)
+                e2.update(wvals)
+                outs = trace(entries, e2, _training, rng_key)
+                return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+
+            _, vjp = jax.vjp(f, {n: env[n] for n in wrt})
+            (g,) = vjp(jnp.ones((), jnp.float32))
+            out = tuple(g[n] for n in wrt)
+            return out if len(out) > 1 else out[0]
+
+        op = Op("_grad", _grad_fn, num_outputs=len(wrt), rng=True)
+        return _apply_op(op, in_syms, {},
+                         (self.name or "sym") + "_grad")
 
     # -- serialization ------------------------------------------------------------
     def tojson(self) -> str:
@@ -397,13 +432,20 @@ def load_json(json_str: str) -> Symbol:
     data = json.loads(json_str)
     nodes: List[Node] = []
     for spec in data["nodes"]:
+        attr_dict = spec.get("attr_dict", {})
         if spec["op"] == "null":
-            n = Node("var", spec["name"], attr_dict=spec.get("attr_dict", {}))
+            n = Node("var", spec["name"], attr_dict=attr_dict)
         else:
-            op = get_op(spec["op"])
+            if "__control_flow__" in attr_dict:
+                # per-call-site op rebuilt from its embedded subgraph json
+                from . import control_flow as _cf
+
+                op = _cf.op_from_spec(attr_dict["__control_flow__"])
+            else:
+                op = get_op(spec["op"])
             attrs = {k: eval(v) for k, v in spec.get("attrs", {}).items()}  # noqa: S307 — own format
             inputs = [SymbolEntry(nodes[i], idx) for i, idx, _ in spec["inputs"]]
-            n = Node("op", spec["name"], op, attrs, inputs, spec.get("attr_dict", {}))
+            n = Node("op", spec["name"], op, attrs, inputs, attr_dict)
         nodes.append(n)
     heads = [SymbolEntry(nodes[i], idx) for i, idx, _ in data["heads"]]
     return Symbol(heads)

@@ -272,3 +272,63 @@ def test_foreach_under_hybridize():
     net.hybridize()
     hybrid = net(x).asnumpy()
     assert np.allclose(eager, hybrid, atol=1e-5)
+
+
+def test_foreach_tojson_roundtrip():
+    """Control-flow symbol JSON round-trip (reference embeds subgraphs in
+    symbol JSON, control_flow.cc:1256-1310)."""
+    T, B, H = 5, 2, 4
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+    w = mx.sym.Variable("w")
+
+    def body(x, states):
+        h = states[0]
+        nh = mx.sym.tanh(mx.sym.FullyConnected(
+            x + h, weight=w, num_hidden=H, no_bias=True))
+        return nh, [nh]
+
+    outs, final = sym.contrib.foreach(body, data, [init])
+    r = np.random.RandomState(0)
+    args = {"data": nd.array(r.rand(T, B, H).astype(np.float32)),
+            "init": nd.array(np.zeros((B, H), np.float32)),
+            "w": nd.array(r.rand(H, H).astype(np.float32) * 0.3)}
+    ref = outs.bind(args=args).forward()[0].asnumpy()
+
+    js = outs.tojson()
+    loaded = mx.sym.load_json(js)
+    out2 = loaded.bind(args=args).forward()[0].asnumpy()
+    assert out2.shape == (T, B, H)
+    assert np.allclose(out2, ref, atol=1e-6)
+
+
+def test_while_loop_tojson_roundtrip():
+    i = mx.sym.Variable("i")
+    s = mx.sym.Variable("s")
+    outs, finals = sym.contrib.while_loop(
+        lambda i, s: i < 5, lambda i, s: ([i], [i + 1, s + i]),
+        [i, s], max_iterations=8)
+    grp = mx.sym.Group(finals)
+    args = {"i": nd.array(np.zeros((1,), np.float32)),
+            "s": nd.array(np.zeros((1,), np.float32))}
+    ref = [a.asnumpy() for a in grp.bind(args=args).forward()]
+    loaded = mx.sym.load_json(grp.tojson())
+    got = [a.asnumpy() for a in loaded.bind(args=args).forward()]
+    for a, b in zip(ref, got):
+        assert np.allclose(a, b)
+    assert float(got[0][0]) == 5.0 and float(got[1][0]) == 10.0
+
+
+def test_cond_tojson_roundtrip():
+    p = mx.sym.Variable("p")
+    x = mx.sym.Variable("x")
+    out = sym.contrib.cond(p, lambda: x * 2.0, lambda: x - 1.0)
+    args = {"p": nd.array(np.ones((1,), np.float32)),
+            "x": nd.array(np.full((3,), 5.0, np.float32))}
+    ref = out.bind(args=args).forward()[0].asnumpy()
+    loaded = mx.sym.load_json(out.tojson())
+    got = loaded.bind(args=args).forward()[0].asnumpy()
+    assert np.allclose(got, ref) and np.allclose(got, 10.0)
+    args["p"] = nd.array(np.zeros((1,), np.float32))
+    got2 = loaded.bind(args=args).forward()[0].asnumpy()
+    assert np.allclose(got2, 4.0)

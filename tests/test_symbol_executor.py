@@ -157,3 +157,18 @@ def test_shape_solver_rnn():
     d = dict(zip(net.list_arguments(), arg_shapes))
     assert d["rnn_state"] == (2, 4, 8)
     assert out_shapes == [(10, 4, 8)]
+
+
+def test_symbol_grad():
+    """Symbol.grad returns a bindable gradient symbol (reference:
+    Symbol.grad over the nnvm Gradient pass)."""
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    y = mx.sym.sum(x * w + x * x)
+    gsym = y.grad(["x", "w"])
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    wv = np.array([4.0, 5.0, 6.0], np.float32)
+    outs = gsym.bind(args={"x": nd.array(xv), "w": nd.array(wv)}).forward()
+    gx, gw = outs[0].asnumpy(), outs[1].asnumpy()
+    assert np.allclose(gx, wv + 2 * xv)   # d/dx (xw + x^2)
+    assert np.allclose(gw, xv)            # d/dw
