@@ -39,7 +39,7 @@ def _ones_cotangent(x):
 class Executor:
     def __init__(self, symbol, ctx: Context, args: Dict[str, NDArray],
                  args_grad: Dict[str, NDArray], grad_req: Dict[str, str],
-                 aux_states: Dict[str, NDArray]):
+                 aux_states: Dict[str, NDArray], group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
         self.arg_dict = args
@@ -56,6 +56,19 @@ class Executor:
         self._grad_arg_names = sorted(
             n for n in self._arg_names if self.grad_req.get(n, "null") != "null"
             and n in self.grad_dict)
+        self._grouped = None
+        self._group2ctx = group2ctx
+        if group2ctx:
+            from .symbol.placement import GroupedProgram
+
+            self._grouped = GroupedProgram(symbol, group2ctx, ctx,
+                                           self._grad_arg_names)
+            # place bound params on their group devices (the reference's
+            # AssignContext does the same for per-group arg arrays)
+            for n in self._arg_names:
+                if n in self.arg_dict:
+                    self.arg_dict[n]._data = jax.device_put(
+                        self.arg_dict[n]._data, self._grouped.arg_device(n))
 
     # -- public mirror of the reference Executor API ------------------------------
     @property
@@ -167,7 +180,15 @@ class Executor:
         arg_vals, aux_vals = self._collect_vals()
         rng = _random.next_key()
         self._cached_grads = None
-        if is_train and self._grad_arg_names:
+        if self._grouped is not None:
+            env = dict(arg_vals)
+            env.update(aux_vals)
+            with_grad = bool(is_train and self._grad_arg_names)
+            outs, aux_updates, grads = self._grouped.forward(
+                env, rng, is_train, with_grad=with_grad)
+            if with_grad:
+                self._cached_grads = grads
+        elif is_train and self._grad_arg_names:
             fn = self._get_fwdbwd()
             outs, aux_updates, grads = fn(arg_vals, aux_vals, rng)
             self._cached_grads = grads
@@ -200,15 +221,26 @@ class Executor:
             arg_vals, aux_vals = self._collect_vals()
             cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                    for g in out_grads]
-            fn = self._get_bwd_with_grads()
-            grads = fn(arg_vals, aux_vals, self._last_rng, cts)
+            if self._grouped is not None:
+                env = dict(arg_vals)
+                env.update(aux_vals)
+                _, _, grads = self._grouped.forward(
+                    env, self._last_rng, True, with_grad=True, out_cts=cts)
+            else:
+                fn = self._get_bwd_with_grads()
+                grads = fn(arg_vals, aux_vals, self._last_rng, cts)
         for n in self._grad_arg_names:
             g = self.grad_dict[n]
             req = self.grad_req.get(n, "write")
+            gn = grads.get(n) if isinstance(grads, dict) else grads[n]
+            if gn is None:  # no gradient path reached this argument
+                gn = jnp.zeros_like(g._data)
             if req == "add":
-                g._data = g._data + grads[n]
+                if self._grouped is not None:
+                    gn = jax.device_put(gn, list(g._data.devices())[0])
+                g._data = g._data + gn
             else:
-                g._data = grads[n]
+                g._data = gn
 
     # -- params & misc ------------------------------------------------------------
     def copy_params_from(self, arg_params: Dict[str, NDArray],
@@ -229,7 +261,8 @@ class Executor:
         """Rebind with new input shapes, carrying over current params/aux
         (reference: Executor.reshape shares the bound arrays)."""
         new_exec = self._symbol.simple_bind(
-            ctx=self._ctx, grad_req=self.grad_req, **kwargs)
+            ctx=self._ctx, grad_req=self.grad_req,
+            group2ctx=self._group2ctx, **kwargs)
         param_names = set(new_exec._arg_names) - set(kwargs)
         new_exec.copy_params_from(
             {n: self.arg_dict[n] for n in param_names

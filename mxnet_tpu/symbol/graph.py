@@ -111,6 +111,33 @@ def input_nodes(entries: Sequence[SymbolEntry], include_aux=True) -> List[Node]:
     return out
 
 
+def eval_node(node: Node, ins: List[object], is_train: bool, rng_key=None,
+              collect_aux: Optional[dict] = None) -> tuple:
+    """Evaluate one op node over jax values (shared by whole-graph trace and
+    the group2ctx segment executor)."""
+    from ..ndarray.ndarray import _op_accepts_training
+
+    kwargs = dict(node.attrs)
+    op = node.op
+    if op.rng:
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(0)
+        kwargs["rng_key"] = jax.random.fold_in(rng_key, node._uid)
+    if _op_accepts_training(op):
+        kwargs["_training"] = is_train
+    if op.name == "BatchNorm" and collect_aux is not None and is_train \
+            and not kwargs.get("use_global_stats"):
+        kwargs["output_mean_var"] = True
+        y, mean, var = op.fn(*ins, **kwargs)
+        aux_names = [e.node.name for e in node.inputs[-2:]]
+        momentum = float(kwargs.get("momentum", 0.9))
+        collect_aux[aux_names[0]] = momentum * ins[-2] + (1 - momentum) * mean
+        collect_aux[aux_names[1]] = momentum * ins[-1] + (1 - momentum) * var
+        return (y,)
+    out = op.fn(*ins, **kwargs)
+    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+
 def trace(entries: Sequence[SymbolEntry], env: Dict[str, object], is_train: bool,
           rng_key=None, collect_aux: Optional[dict] = None):
     """Evaluate the DAG over jax values.
@@ -120,10 +147,6 @@ def trace(entries: Sequence[SymbolEntry], env: Dict[str, object], is_train: bool
     their (batch_mean, batch_var) under their aux variable names so the
     executor can update running stats functionally.
     """
-    import inspect
-
-    from ..ndarray.ndarray import _op_accepts_training
-
     values: Dict[int, tuple] = {}
 
     for node in topo_order(entries):
@@ -133,28 +156,6 @@ def trace(entries: Sequence[SymbolEntry], env: Dict[str, object], is_train: bool
             values[id(node)] = (env[node.name],)
             continue
         ins = [values[id(e.node)][e.index] for e in node.inputs]
-        kwargs = dict(node.attrs)
-        op = node.op
-        if op.rng:
-            if rng_key is None:
-                rng_key = jax.random.PRNGKey(0)
-            kwargs["rng_key"] = jax.random.fold_in(rng_key, node._uid)
-        if _op_accepts_training(op):
-            kwargs["_training"] = is_train
-        if op.name == "BatchNorm" and collect_aux is not None and is_train \
-                and not kwargs.get("use_global_stats"):
-            kwargs["output_mean_var"] = True
-            out = op.fn(*ins, **kwargs)
-            y, mean, var = out
-            aux_names = [e.node.name for e in node.inputs[-2:]]
-            momentum = float(kwargs.get("momentum", 0.9))
-            old_mean = ins[-2]
-            old_var = ins[-1]
-            collect_aux[aux_names[0]] = momentum * old_mean + (1 - momentum) * mean
-            collect_aux[aux_names[1]] = momentum * old_var + (1 - momentum) * var
-            values[id(node)] = (y,)
-            continue
-        out = op.fn(*ins, **kwargs)
-        values[id(node)] = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+        values[id(node)] = eval_node(node, ins, is_train, rng_key, collect_aux)
 
     return [values[id(e.node)][e.index] for e in entries]

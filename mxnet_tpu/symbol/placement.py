@@ -1,0 +1,255 @@
+"""group2ctx placement: per-group segment executors + cross-device copies.
+
+Reference: symbol-level model parallelism — ``group2ctx`` on bind
+(``python/mxnet/symbol/symbol.py:1288,1434-1446``), the NNVM ``PlaceDevice``
+pass + ``_CrossDeviceCopy`` insertion (``src/common/exec_utils.h:500-593``,
+``src/operator/cross_device_copy.cc``), used by
+``docs/faq/model_parallel_lstm.md`` / ``example/model-parallel``.
+
+TPU-native design: one XLA program cannot mix committed single-device
+placements (verified: jit raises "incompatible devices"), which is exactly
+why the reference also splits the graph.  So the symbol DAG is partitioned
+at bind time into contiguous same-group segments in topo order; each segment
+compiles to its own jitted program whose inputs are ``device_put`` onto the
+group's device (the _CrossDeviceCopy analogue — XLA's computation-follows-
+data then pins the whole segment there); gradients flow backward across the
+same boundaries by chaining per-segment ``jax.vjp``s with reverse copies.
+Group attrs come from ``AttrScope(ctx_group=...)`` → ``__ctx_group__``,
+propagated forward like PlaceDevice; an attr naming a group missing from
+``group2ctx`` raises (never silently ignored).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .graph import Node, SymbolEntry, eval_node, input_nodes, topo_order
+
+__all__ = ["GroupedProgram", "collect_groups"]
+
+_DEFAULT = "__default__"
+
+
+def collect_groups(entries) -> set:
+    """All ctx_group names appearing in the DAG."""
+    out = set()
+    for n in topo_order(entries):
+        g = n.attr_dict.get("__ctx_group__") or n.attr_dict.get("ctx_group")
+        if g:
+            out.add(g)
+    return out
+
+
+def _assign_groups(nodes: List[Node], valid: set) -> Dict[int, str]:
+    """PlaceDevice-style forward propagation: a node keeps its own
+    __ctx_group__; otherwise it inherits from its first grouped input;
+    otherwise the default group."""
+    gmap: Dict[int, str] = {}
+    for node in nodes:
+        if node.kind == "var":
+            continue
+        g = node.attr_dict.get("__ctx_group__") \
+            or node.attr_dict.get("ctx_group")
+        if g is not None and g not in valid:
+            raise MXNetError(
+                f"bind: node {node.name!r} has ctx_group {g!r} but "
+                f"group2ctx only defines {sorted(valid)}")
+        if g is None:
+            for e in node.inputs:
+                gi = gmap.get(id(e.node))
+                if gi is not None:
+                    g = gi
+                    break
+        gmap[id(node)] = g or _DEFAULT
+    return gmap
+
+
+class GroupedProgram:
+    """A symbol partitioned into per-group jitted segments."""
+
+    def __init__(self, symbol, group2ctx: Dict[str, object], default_dev,
+                 grad_names: Sequence[str]):
+        from ..context import Context
+
+        def _dev(c):
+            return c.jax_device if isinstance(c, Context) else c
+
+        self._entries = symbol._entries
+        self._nodes = topo_order(self._entries)
+        valid = set(group2ctx)
+        self._gmap = _assign_groups(self._nodes, valid)
+        self._devs = {name: _dev(c) for name, c in group2ctx.items()}
+        self._devs[_DEFAULT] = _dev(default_dev)
+        self._grad_names = list(grad_names)
+
+        # contiguous same-group segments over op nodes
+        self._segments: List[Tuple[str, List[Node]]] = []
+        for node in self._nodes:
+            if node.kind == "var":
+                continue
+            g = self._gmap[id(node)]
+            if self._segments and self._segments[-1][0] == g:
+                self._segments[-1][1].append(node)
+            else:
+                self._segments.append((g, [node]))
+
+        # var placement: group of the first consuming op
+        self._var_group: Dict[str, str] = {}
+        for node in self._nodes:
+            if node.kind != "op":
+                continue
+            g = self._gmap[id(node)]
+            for e in node.inputs:
+                if e.node.kind == "var":
+                    self._var_group.setdefault(e.node.name, g)
+        # static per-segment external inputs (var names, cross keys)
+        self._seg_in: List[Tuple[set, set]] = [
+            self._seg_inputs(si) for si in range(len(self._segments))]
+        self._jit_cache: Dict[tuple, object] = {}
+
+    # -- public ---------------------------------------------------------------
+    def arg_device(self, name: str):
+        return self._devs[self._var_group.get(name, _DEFAULT)]
+
+    def group_of(self, name: str) -> str:
+        g = self._var_group.get(name, _DEFAULT)
+        return "" if g == _DEFAULT else g
+
+    @property
+    def num_segments(self) -> int:
+        return len(self._segments)
+
+    def _seg_fn(self, si: int, is_train: bool):
+        """Jitted segment body: env dict -> (produced dict, aux dict)."""
+        key = (si, is_train)
+        if key not in self._jit_cache:
+            _, nodes = self._segments[si]
+
+            def run(env, rng):
+                values: Dict[int, tuple] = {}
+                aux: Dict[str, object] = {}
+
+                def get(e: SymbolEntry):
+                    if id(e.node) in values:
+                        return values[id(e.node)][e.index]
+                    if e.node.kind == "var":
+                        return env[e.node.name]
+                    return env[f"__x_{e.node._uid}_{e.index}"]
+
+                for node in nodes:
+                    ins = [get(e) for e in node.inputs]
+                    values[id(node)] = eval_node(
+                        node, ins, is_train, rng,
+                        aux if is_train else None)
+                produced = {}
+                for node in nodes:
+                    for i, v in enumerate(values[id(node)]):
+                        produced[f"__x_{node._uid}_{i}"] = v
+                return produced, aux
+
+            self._jit_cache[key] = jax.jit(run)
+        return self._jit_cache[key]
+
+    def _seg_inputs(self, si: int) -> Tuple[set, set]:
+        """(var names, cross keys) consumed by segment si from outside it."""
+        _, nodes = self._segments[si]
+        node_set = {id(n) for n in nodes}
+        var_names, cross = set(), set()
+        for node in nodes:
+            for e in node.inputs:
+                if id(e.node) in node_set:
+                    continue
+                if e.node.kind == "var":
+                    var_names.add(e.node.name)
+                else:
+                    cross.add(f"__x_{e.node._uid}_{e.index}")
+        return var_names, cross
+
+    def forward(self, env: Dict[str, object], rng, is_train: bool,
+                with_grad: bool = False, out_cts=None):
+        """Run all segments; returns (outputs, aux_updates, grads or None).
+
+        env holds arg+aux values.  Each segment's inputs are device_put onto
+        its group device (the cross-device copies); when with_grad, each
+        segment records a vjp and cotangents are chained in reverse with the
+        mirror copies.  out_cts (list aligned with the symbol's outputs)
+        overrides the default ones-seeded head cotangents.
+        """
+        pool: Dict[str, object] = dict(env)
+        aux_updates: Dict[str, object] = {}
+        records = []  # (vjp, group, produced values, aux values)
+
+        for si, (g, _) in enumerate(self._segments):
+            dev = self._devs[g]
+            var_names, cross = self._seg_in[si]
+            seg_env = {k: jax.device_put(pool[k], dev)
+                       for k in (var_names | cross)}
+            fn = self._seg_fn(si, is_train)
+            if with_grad:
+                (produced, aux), vjp = jax.vjp(lambda e: fn(e, rng), seg_env)
+                records.append((vjp, g, produced, aux))
+            else:
+                produced, aux = fn(seg_env, rng)
+            pool.update(produced)
+            aux_updates.update(aux)
+
+        outs = []
+        for e in self._entries:
+            if e.node.kind == "var":
+                outs.append(pool[e.node.name])
+            else:
+                outs.append(pool[f"__x_{e.node._uid}_{e.index}"])
+        if not with_grad:
+            return outs, aux_updates, None
+
+        def zero_like(v):
+            if jnp.issubdtype(v.dtype, jnp.inexact):
+                return jnp.zeros_like(v)
+            import numpy as _np
+            return _np.zeros(jnp.shape(v), jax.dtypes.float0)
+
+        def ones_like(v):
+            if jnp.issubdtype(v.dtype, jnp.inexact):
+                return jnp.ones_like(v)
+            return zero_like(v)
+
+        # seed head cotangents: caller-provided or ones (d of summed outputs)
+        cts: Dict[str, object] = {}
+        for i, (e, o) in enumerate(zip(self._entries, outs)):
+            if e.node.kind != "var":
+                k = f"__x_{e.node._uid}_{e.index}"
+                c = (jnp.asarray(out_cts[i]).astype(o.dtype)
+                     if out_cts is not None else ones_like(o))
+                cts[k] = self._acc(cts.get(k), c)
+
+        grads: Dict[str, object] = {}
+        for vjp, g, produced, aux in reversed(records):
+            dev = self._devs[g]
+            out_ct = {k: (jax.device_put(cts.pop(k), dev)
+                          if k in cts else zero_like(v))
+                      for k, v in produced.items()}
+            aux_ct = {k: zero_like(v) for k, v in aux.items()}
+            (in_ct,) = vjp((out_ct, aux_ct))
+            for k, v in in_ct.items():
+                if getattr(v, "dtype", None) == jax.dtypes.float0:
+                    continue
+                if k.startswith("__x_"):
+                    cts[k] = self._acc(cts.get(k), v)
+                elif k in self._grad_names:
+                    grads[k] = self._acc(grads.get(k), v)
+        return outs, aux_updates, grads
+
+    @staticmethod
+    def _acc(acc, v):
+        """Accumulate cotangents whose contributions may be committed to
+        different group devices: copy onto the accumulator's device first
+        (mixed committed devices cannot meet in one add)."""
+        if acc is None:
+            return v
+        devs = list(acc.devices()) if hasattr(acc, "devices") else []
+        if devs and hasattr(v, "devices") and list(v.devices()) != devs:
+            v = jax.device_put(v, devs[0])
+        return acc + v

@@ -1,0 +1,163 @@
+"""group2ctx model parallelism (VERDICT r3 item 5; reference:
+python/mxnet/symbol/symbol.py:1434-1446 + PlaceDevice/_CrossDeviceCopy,
+docs/faq/model_parallel_lstm.md)."""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _two_stage_net():
+    """fc1 on group dev1, fc2 on group dev2 — the model-parallel pattern."""
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="dev1"):
+        fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        a1 = mx.sym.Activation(fc1, act_type="tanh")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(a1, num_hidden=3, name="fc2")
+    return fc2
+
+
+def test_group2ctx_places_params_on_distinct_devices():
+    sym = _two_stage_net()
+    devs = jax.devices()
+    assert len(devs) >= 2
+    g2c = {"dev1": devs[0], "dev2": devs[1]}
+    exe = sym.simple_bind(ctx=mx.cpu(), group2ctx=g2c, data=(4, 6))
+    # sharding inspection: each group's params live on its device
+    assert list(exe.arg_dict["fc1_weight"]._data.devices()) == [devs[0]]
+    assert list(exe.arg_dict["fc1_bias"]._data.devices()) == [devs[0]]
+    assert list(exe.arg_dict["fc2_weight"]._data.devices()) == [devs[1]]
+    out = exe.forward()[0]
+    assert out.shape == (4, 3)
+    # output computed on the last group's device
+    assert list(out._data.devices()) == [devs[1]]
+
+
+def test_group2ctx_forward_backward_matches_ungrouped():
+    sym = _two_stage_net()
+    devs = jax.devices()
+    g2c = {"dev1": devs[0], "dev2": devs[1]}
+    rs = np.random.RandomState(0)
+    vals = {"data": rs.rand(4, 6).astype(np.float32),
+            "fc1_weight": (rs.rand(8, 6) - 0.5).astype(np.float32),
+            "fc1_bias": np.zeros(8, np.float32),
+            "fc2_weight": (rs.rand(3, 8) - 0.5).astype(np.float32),
+            "fc2_bias": np.zeros(3, np.float32)}
+
+    def run(group2ctx):
+        args = {k: nd.array(v) for k, v in vals.items()}
+        grads = {k: nd.array(np.zeros_like(v)) for k, v in vals.items()
+                 if k != "data"}
+        exe = sym.bind(ctx=mx.cpu(), args=args, args_grad=grads,
+                       group2ctx=group2ctx)
+        out = exe.forward(is_train=True)[0].asnumpy()
+        exe.backward()
+        return out, {k: g.asnumpy() for k, g in exe.grad_dict.items()}
+
+    out_g, grads_g = run(g2c)
+    out_r, grads_r = run(None)
+    assert np.allclose(out_g, out_r, atol=1e-5)
+    for k in grads_r:
+        assert np.allclose(grads_g[k], grads_r[k], atol=1e-5), k
+
+
+def test_group2ctx_unknown_group_raises():
+    sym = _two_stage_net()
+    devs = jax.devices()
+    with pytest.raises(mx.base.MXNetError, match="dev2"):
+        sym.simple_bind(ctx=mx.cpu(), group2ctx={"dev1": devs[0]},
+                        data=(4, 6))
+
+
+def test_group2ctx_model_parallel_lstm_pattern():
+    """The model_parallel_lstm layout: each layer's cell on its own group,
+    trained end-to-end (reference: docs/faq/model_parallel_lstm.md)."""
+    devs = jax.devices()
+    T, B, H = 4, 2, 8
+    data = mx.sym.Variable("data")  # (T, B, H)
+    h = mx.sym.reshape(mx.sym.slice_axis(data, axis=0, begin=0, end=1),
+                       shape=(B, H))
+    layers = []
+    for layer, grp in ((0, "g0"), (1, "g1")):
+        with mx.AttrScope(ctx_group=grp):
+            w = mx.sym.Variable(f"l{layer}_w")
+            h = mx.sym.Activation(mx.sym.FullyConnected(
+                h, weight=w, num_hidden=H, no_bias=True), act_type="tanh")
+            layers.append(h)
+    out = mx.sym.FullyConnected(h, num_hidden=2, name="out")
+    g2c = {"g0": devs[0], "g1": devs[1]}
+    exe = out.simple_bind(ctx=mx.cpu(), group2ctx=g2c, data=(T, B, H))
+    assert list(exe.arg_dict["l0_w"]._data.devices()) == [devs[0]]
+    assert list(exe.arg_dict["l1_w"]._data.devices()) == [devs[1]]
+    # one train step moves the grouped weights
+    rs = np.random.RandomState(1)
+    exe.arg_dict["data"]._data = jax.numpy.asarray(
+        rs.rand(T, B, H).astype(np.float32))
+    for k in ("l0_w", "l1_w", "out_weight"):
+        exe.arg_dict[k]._data = jax.numpy.asarray(
+            (rs.rand(*exe.arg_dict[k].shape) - 0.5).astype(np.float32) * 0.3)
+    exe.forward(is_train=True)
+    exe.backward()
+    g0 = exe.grad_dict["l0_w"].asnumpy()
+    g1 = exe.grad_dict["l1_w"].asnumpy()
+    assert np.abs(g0).sum() > 0 and np.abs(g1).sum() > 0
+
+
+def test_group2ctx_shared_trunk_two_group_heads():
+    """A trunk consumed by heads in two different groups: cotangents from
+    both groups accumulate across devices (review regression)."""
+    devs = jax.devices()
+    data = mx.sym.Variable("data")
+    trunk = mx.sym.FullyConnected(data, num_hidden=6, name="trunk")
+    with mx.AttrScope(ctx_group="h1"):
+        a = mx.sym.FullyConnected(trunk, num_hidden=2, name="heada")
+    with mx.AttrScope(ctx_group="h2"):
+        b = mx.sym.FullyConnected(trunk, num_hidden=2, name="headb")
+    grp = mx.sym.Group([a, b])
+    exe = grp.simple_bind(ctx=mx.cpu(), data=(4, 5),
+                          group2ctx={"h1": devs[1], "h2": devs[2]})
+    rs = np.random.RandomState(0)
+    exe.arg_dict["data"]._data = jax.numpy.asarray(
+        rs.rand(4, 5).astype(np.float32))
+    for k in exe.arg_dict:
+        if k.endswith("weight"):
+            exe.arg_dict[k]._data = jax.device_put(jax.numpy.asarray(
+                (rs.rand(*exe.arg_dict[k].shape) - 0.5).astype(np.float32)),
+                list(exe.arg_dict[k]._data.devices())[0])
+    exe.forward(is_train=True)
+    exe.backward()
+    g = exe.grad_dict["trunk_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_group2ctx_backward_with_out_grads():
+    """Explicit head cotangents through the grouped path (review
+    regression: used to fall into the single-jit mixed-device crash)."""
+    sym = _two_stage_net()
+    devs = jax.devices()
+    rs = np.random.RandomState(1)
+    vals = {"data": rs.rand(4, 6).astype(np.float32),
+            "fc1_weight": (rs.rand(8, 6) - 0.5).astype(np.float32),
+            "fc1_bias": np.zeros(8, np.float32),
+            "fc2_weight": (rs.rand(3, 8) - 0.5).astype(np.float32),
+            "fc2_bias": np.zeros(3, np.float32)}
+    ct = rs.rand(4, 3).astype(np.float32)
+
+    def run(g2c):
+        args = {k: nd.array(v) for k, v in vals.items()}
+        grads = {k: nd.array(np.zeros_like(v)) for k, v in vals.items()
+                 if k != "data"}
+        exe = sym.bind(ctx=mx.cpu(), args=args, args_grad=grads,
+                       group2ctx=g2c)
+        exe.forward(is_train=True)
+        exe.backward(out_grads=[nd.array(ct)])
+        return {k: g.asnumpy() for k, g in exe.grad_dict.items()}
+
+    gg = run({"dev1": devs[0], "dev2": devs[1]})
+    gr = run(None)
+    for k in gr:
+        assert np.allclose(gg[k], gr[k], atol=1e-5), k
