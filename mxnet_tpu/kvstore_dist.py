@@ -17,13 +17,28 @@ plain multi-process jobs via ``tools/launch.py`` exactly like the
 reference's nightly dist tests (``tests/nightly/dist_sync_kvstore.py``).
 
 Bootstrap env (set by tools/launch.py): ``MXTPU_COORDINATOR`` (host:port of
-rank 0's server), ``MXTPU_NUM_PROCS``, ``MXTPU_PROC_ID``.
+the first server), ``MXTPU_NUM_PROCS``, ``MXTPU_PROC_ID``,
+``MXTPU_NUM_SERVERS`` (servers listen on consecutive ports from the
+coordinator's, all hosted by rank 0 — the reference's single-machine
+"local" tracker layout), optional ``MXTPU_SERVER_ADDRS`` comma list for a
+spread server tier.
 
-Wire protocol: 4-byte little-endian length + pickled (cmd, *args) tuples,
-one request/response per round-trip, a persistent socket per worker.
+Wire protocol: 4-byte little-endian length + a typed binary frame (tag
+bytes for none/bool/int/float/str/bytes/ndarray/list/dict — dtype+shape
+header then raw buffer for tensors, the analogue of the reference's
+``ps::KVPairs<char>`` blobs).  No pickle on the data path: a hostile peer
+can at worst corrupt values, not execute code.  The single exception is the
+``set_optimizer`` payload, which carries a pickled optimizer exactly like
+the reference's server controller (``python/mxnet/kvstore_server.py``); it
+is only honored when the job was launched with that feature.
+
+Big tensors are sliced across the server tier when their element count
+exceeds ``MXNET_KVSTORE_BIGARRAY_BOUND`` (default 1e6, reference
+``kvstore_dist.h:58``); small keys are assigned to one server by hash.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 import socket
@@ -43,17 +58,115 @@ __all__ = ["KVStoreDist", "KVStoreDistServer"]
 
 
 # ------------------------------------------------------------------ wire
+# typed binary frames (no pickle on the data path)
+
+def _enc(obj, out: list) -> None:
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, int):
+        out.append(b"i" + struct.pack("<q", obj))
+    elif isinstance(obj, float):
+        out.append(b"f" + struct.pack("<d", obj))
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out.append(b"s" + struct.pack("<I", len(b)) + b)
+    elif isinstance(obj, (bytes, bytearray)):
+        out.append(b"b" + struct.pack("<I", len(obj)) + bytes(obj))
+    elif isinstance(obj, _np.ndarray):
+        a = _np.ascontiguousarray(obj)
+        dt = a.dtype.str.encode("ascii")
+        out.append(b"a" + struct.pack("<B", len(dt)) + dt
+                   + struct.pack("<B", a.ndim)
+                   + struct.pack(f"<{a.ndim}q", *a.shape)
+                   + struct.pack("<Q", a.nbytes))
+        out.append(a.tobytes())
+    elif isinstance(obj, (list, tuple)):
+        out.append(b"L" + struct.pack("<I", len(obj)))
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, dict):
+        out.append(b"D" + struct.pack("<I", len(obj)))
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise MXNetError(f"wire dicts need str keys, got {type(k)}")
+            _enc(k, out)
+            _enc(v, out)
+    elif isinstance(obj, _np.generic):  # numpy scalar
+        _enc(obj.item(), out)
+    else:
+        raise MXNetError(f"unencodable wire type {type(obj)!r}")
+
+
+def _dec(buf: memoryview, pos: int):
+    tag = bytes(buf[pos:pos + 1])
+    pos += 1
+    if tag == b"N":
+        return None, pos
+    if tag == b"T":
+        return True, pos
+    if tag == b"F":
+        return False, pos
+    if tag == b"i":
+        return struct.unpack_from("<q", buf, pos)[0], pos + 8
+    if tag == b"f":
+        return struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if tag == b"s":
+        n = struct.unpack_from("<I", buf, pos)[0]
+        pos += 4
+        return bytes(buf[pos:pos + n]).decode("utf-8"), pos + n
+    if tag == b"b":
+        n = struct.unpack_from("<I", buf, pos)[0]
+        pos += 4
+        return bytes(buf[pos:pos + n]), pos + n
+    if tag == b"a":
+        dtl = struct.unpack_from("<B", buf, pos)[0]
+        pos += 1
+        dt = _np.dtype(bytes(buf[pos:pos + dtl]).decode("ascii"))
+        pos += dtl
+        ndim = struct.unpack_from("<B", buf, pos)[0]
+        pos += 1
+        shape = struct.unpack_from(f"<{ndim}q", buf, pos)
+        pos += 8 * ndim
+        nbytes = struct.unpack_from("<Q", buf, pos)[0]
+        pos += 8
+        a = _np.frombuffer(buf[pos:pos + nbytes], dtype=dt).reshape(shape)
+        return a.copy(), pos + nbytes
+    if tag == b"L":
+        n = struct.unpack_from("<I", buf, pos)[0]
+        pos += 4
+        items = []
+        for _ in range(n):
+            v, pos = _dec(buf, pos)
+            items.append(v)
+        return tuple(items), pos
+    if tag == b"D":
+        n = struct.unpack_from("<I", buf, pos)[0]
+        pos += 4
+        d = {}
+        for _ in range(n):
+            k, pos = _dec(buf, pos)
+            v, pos = _dec(buf, pos)
+            d[k] = v
+        return d, pos
+    raise MXNetError(f"bad wire tag {tag!r}")
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    parts: list = []
+    _enc(obj, parts)
+    payload = b"".join(parts)
     sock.sendall(struct.pack("<I", len(payload)) + payload)
 
 
 def _recv_msg(sock: socket.socket):
     header = _recv_exact(sock, 4)
     (length,) = struct.unpack("<I", header)
-    return pickle.loads(_recv_exact(sock, length))
+    obj, _ = _dec(memoryview(_recv_exact(sock, length)), 0)
+    return obj
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -64,6 +177,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed")
         buf += chunk
     return buf
+
+
+# number of elements above which a tensor is sliced across the server tier
+def _bigarray_bound() -> int:
+    return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000))
 
 
 # ------------------------------------------------------------------ server
@@ -94,6 +212,12 @@ class KVStoreDistServer:
         self._lock = threading.Condition()
         self._num_workers = num_workers
         self._updater = None
+        # pickled-optimizer commands are only honored when explicitly
+        # enabled: rank 0 flips this directly on its in-process servers,
+        # or MXNET_KVSTORE_ALLOW_PICKLE=1 for an external server tier —
+        # a remote peer cannot turn it on
+        self.allow_pickle_optimizer = \
+            os.environ.get("MXNET_KVSTORE_ALLOW_PICKLE") == "1"
         self._sync_mode = False
         self._grad_compression = None  # set by the workers' set_compression
         self._barrier_count = {}
@@ -198,6 +322,10 @@ class KVStoreDistServer:
             self._sync_mode = bool(msg[1])
             return ("ok",)
         if cmd == "set_optimizer":
+            if not self.allow_pickle_optimizer:
+                return ("error",
+                        "server-side optimizer disabled: enable via rank-0 "
+                        "in-process setup or MXNET_KVSTORE_ALLOW_PICKLE=1")
             from .optimizer import Updater, Optimizer
 
             opt = pickle.loads(msg[1])
@@ -300,24 +428,42 @@ class KVStoreDist(KVStore):
                                        os.environ.get("TPUMX_NUM_WORKERS", "1")))
         coord = os.environ.get("MXTPU_COORDINATOR", "127.0.0.1:9027")
         host, port = coord.rsplit(":", 1)
-        self._server: Optional[KVStoreDistServer] = None
-        if self._rank == 0:
-            # rank 0 hosts the server tier in-process (the reference runs
-            # separate server processes; one SPMD job needs no extra tier)
-            self._server = KVStoreDistServer(host="0.0.0.0", port=int(port),
-                                             num_workers=self._num)
-        self._sock = self._connect(host if self._rank else "127.0.0.1",
-                                   int(port))
-        self._sock_lock = threading.Lock()
+        n_servers = int(os.environ.get("MXTPU_NUM_SERVERS", "1"))
+        addrs_env = os.environ.get("MXTPU_SERVER_ADDRS")
+        if addrs_env:
+            addrs = [a.rsplit(":", 1) for a in addrs_env.split(",")]
+            addrs = [(h, int(p)) for h, p in addrs]
+            n_servers = len(addrs)
+        else:
+            # server tier on consecutive ports from the coordinator's
+            # (the reference local tracker's one-host layout)
+            addrs = [(host, int(port) + s) for s in range(n_servers)]
+        self._servers: List[KVStoreDistServer] = []
+        if self._rank == 0 and not addrs_env:
+            for s in range(n_servers):
+                self._servers.append(KVStoreDistServer(
+                    host="0.0.0.0", port=addrs[s][1], num_workers=self._num))
+        self._socks: List[socket.socket] = []
+        self._sock_locks: List[threading.Lock] = []
+        for h, p in addrs:
+            self._socks.append(self._connect(
+                h if self._rank or addrs_env else "127.0.0.1", p))
+            self._sock_locks.append(threading.Lock())
+        self._n_servers = n_servers
         self._pull_version: Dict[str, int] = {}
         self._barrier_seq = 0
-        self._request("set_sync", self._sync)
+        for s in range(n_servers):
+            self._request_on(s, "set_sync", self._sync)
         self._hb_stop = threading.Event()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True)
         self._hb_thread.start()
 
     # -- plumbing -----------------------------------------------------------------
+
+    @property
+    def _sock(self):  # primary (server 0) socket — barrier/heartbeat channel
+        return self._socks[0]
 
     def _connect(self, host, port, timeout=60):
         deadline = time.time() + timeout
@@ -332,13 +478,35 @@ class KVStoreDist(KVStore):
                         f"cannot reach kvstore server at {host}:{port}")
                 time.sleep(0.1)
 
-    def _request(self, *msg):
-        with self._sock_lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
+    def _request_on(self, server: int, *msg):
+        with self._sock_locks[server]:
+            _send_msg(self._socks[server], msg)
+            reply = _recv_msg(self._socks[server])
         if reply[0] != "ok":
             raise MXNetError(f"kvstore server error: {reply[1:]}")
         return reply
+
+    def _request(self, *msg):
+        return self._request_on(0, *msg)
+
+    # -- key -> server sharding (reference kvstore_dist.h:532-584) ---------------
+
+    def _partition(self, key: str, size: int):
+        """Returns [(server, lo, hi)] flat slices covering the value, or
+        [(server, None, None)] for an unsliced key."""
+        if self._n_servers == 1:
+            return [(0, None, None)]
+        if size < _bigarray_bound():
+            h = int(hashlib.md5(key.encode()).hexdigest()[:8], 16)
+            return [(h % self._n_servers, None, None)]
+        per = -(-size // self._n_servers)
+        out = []
+        for s in range(self._n_servers):
+            lo, hi = s * per, min((s + 1) * per, size)
+            if lo >= hi:
+                break
+            out.append((s, lo, hi))
+        return out
 
     def _heartbeat_loop(self):
         sock = None
@@ -362,7 +530,10 @@ class KVStoreDist(KVStore):
         keys = _as_list(key)
         values = _as_list(value)
         for k, v in zip(keys, values):
-            self._request("init", str(k), v.asnumpy())
+            arr = v.asnumpy()
+            for s, lo, hi in self._partition(str(k), arr.size):
+                part = arr if lo is None else arr.reshape(-1)[lo:hi]
+                self._request_on(s, "init", str(k), part)
             self._pull_version[str(k)] = 0
         self.barrier()
 
@@ -381,16 +552,22 @@ class KVStoreDist(KVStore):
                         "gradient compression supports fp32 only "
                         "(reference kvstore_dist_server.h:607)")
                 # quantize on the worker; 2 bits/elem cross the wire
-                # (reference kvstore_dist.h:379-390)
+                # (reference kvstore_dist.h:379-390).  Residual state is
+                # per-slice so error feedback composes with sharding.
                 import jax.numpy as jnp
 
-                packed, new_res = gc.quantize(
-                    jnp.asarray(local), self._residuals.get(str(k)))
-                self._residuals[str(k)] = new_res
-                self._request("push_c", str(k), self._rank,
-                              _np.asarray(packed), local.shape)
+                for s, lo, hi in self._partition(str(k), local.size):
+                    part = local if lo is None else local.reshape(-1)[lo:hi]
+                    rkey = f"{k}@{s}"
+                    packed, new_res = gc.quantize(
+                        jnp.asarray(part), self._residuals.get(rkey))
+                    self._residuals[rkey] = new_res
+                    self._request_on(s, "push_c", str(k), self._rank,
+                                     _np.asarray(packed), tuple(part.shape))
             else:
-                self._request("push", str(k), self._rank, local)
+                for s, lo, hi in self._partition(str(k), local.size):
+                    part = local if lo is None else local.reshape(-1)[lo:hi]
+                    self._request_on(s, "push", str(k), self._rank, part)
             if self._sync:
                 self._pull_version[str(k)] = \
                     self._pull_version.get(str(k), 0) + 1
@@ -401,9 +578,20 @@ class KVStoreDist(KVStore):
         results = []
         for k, o in zip(keys, outs):
             min_version = self._pull_version.get(str(k)) if self._sync else None
-            rep = self._request("pull", str(k), min_version)
-            arr = rep[1]
-            for dst in _as_list(o):
+            dsts = _as_list(o)
+            parts = self._partition(str(k), dsts[0].size)
+            if parts[0][1] is None:
+                arr = self._request_on(parts[0][0], "pull", str(k),
+                                       min_version)[1]
+            else:
+                flat = _np.empty(dsts[0].size, dtype=_np.float32)
+                for s, lo, hi in parts:
+                    piece = self._request_on(s, "pull", str(k),
+                                             min_version)[1]
+                    flat = flat.astype(piece.dtype) if flat.dtype != piece.dtype else flat
+                    flat[lo:hi] = piece
+                arr = flat.reshape(dsts[0].shape)
+            for dst in dsts:
                 dst[:] = nd_array(arr)
             results.append(o)
         return out
@@ -415,18 +603,37 @@ class KVStoreDist(KVStore):
         for k, o, rid in zip(keys, outs, ids):
             min_version = self._pull_version.get(str(k)) if self._sync else None
             rid_np = rid.asnumpy().astype(_np.int64)
-            rep = self._request("row_sparse_pull", str(k), rid_np, min_version)
-            for dst in _as_list(o):
+            dsts = _as_list(o)
+            parts = self._partition(str(k), dsts[0].size)
+            if parts[0][1] is None:
+                rows = self._request_on(parts[0][0], "row_sparse_pull",
+                                        str(k), rid_np, min_version)[1]
+            else:
+                # sliced key: rows may straddle server boundaries, so
+                # reassemble the flat value and gather the requested rows
+                flat = None
+                for s, lo, hi in parts:
+                    piece = self._request_on(s, "pull", str(k),
+                                             min_version)[1]
+                    if flat is None:
+                        flat = _np.empty(dsts[0].size, dtype=piece.dtype)
+                    flat[lo:hi] = piece
+                rows = flat.reshape(dsts[0].shape)[rid_np]
+            for dst in dsts:
                 # local-kvstore semantics: full-shape out, requested rows
                 # filled, others zero (kvstore.h:209-223)
-                full = _np.zeros(dst.shape, dtype=rep[1].dtype)
-                full[rid_np] = rep[1]
+                full = _np.zeros(dst.shape, dtype=rows.dtype)
+                full[rid_np] = rows
                 dst[:] = nd_array(full)
         return out
 
     def set_optimizer(self, optimizer):
         if self._rank == 0:
-            self._request("set_optimizer", pickle.dumps(optimizer))
+            for srv in self._servers:  # in-process tier: rank 0 authorizes
+                srv.allow_pickle_optimizer = True
+            blob = pickle.dumps(optimizer)
+            for s in range(self._n_servers):
+                self._request_on(s, "set_optimizer", blob)
         self.barrier()
 
     def set_gradient_compression(self, compression_params):
@@ -434,7 +641,9 @@ class KVStoreDist(KVStore):
         # every worker must call this (reference requirement); the server
         # keeps the first params and needs them before any push_c arrives,
         # which the barrier guarantees
-        self._request("set_compression", self._grad_compression.wire_params())
+        for s in range(self._n_servers):
+            self._request_on(s, "set_compression",
+                             self._grad_compression.wire_params())
         self.barrier()
 
     @property
@@ -461,14 +670,16 @@ class KVStoreDist(KVStore):
         if self._hb_stop.is_set():
             return
         self._hb_stop.set()
-        try:
-            self._request("shutdown")
-        except (MXNetError, ConnectionError, OSError):
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        for s in range(self._n_servers):
+            try:
+                self._request_on(s, "shutdown")
+            except (MXNetError, ConnectionError, OSError):
+                pass
+        for sock in self._socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def __del__(self):
         try:

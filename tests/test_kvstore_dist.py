@@ -23,7 +23,7 @@ def _free_port():
     return port
 
 
-def _run_workers(script, num_workers, timeout=120):
+def _run_workers(script, num_workers, timeout=120, extra_env=None):
     port = _free_port()
     procs = []
     for rank in range(num_workers):
@@ -36,6 +36,7 @@ def _run_workers(script, num_workers, timeout=120):
             "JAX_PLATFORMS": "cpu",
             "MXTPU_NO_NATIVE": "1",  # keep worker startup light
         })
+        env.update(extra_env or {})
         env.pop("PALLAS_AXON_POOL_IPS", None)
         procs.append(subprocess.Popen([sys.executable, "-c", script],
                                       env=env, stdout=subprocess.PIPE,
@@ -158,3 +159,89 @@ def test_dist_single_process_fallback():
     kv.pull("k", out=out)
     assert np.allclose(out.asnumpy(), 2.0)
     kv.close()
+
+
+def test_dist_sync_two_servers_bigarray_sharding():
+    """VERDICT r3 item 9: 2 servers, a >4MB tensor sliced across both with
+    MXNET_KVSTORE_BIGARRAY_BOUND, plus a small hash-routed key (reference:
+    kvstore_dist.h:58,532-584 EncodeDefaultKey slicing)."""
+    script = COMMON.format(mode="dist_sync") + textwrap.dedent("""
+        import os
+        assert kv._n_servers == 2, kv._n_servers
+        # big: 1.25M floats = 5 MB > bound -> sliced across both servers
+        N = 1250000
+        big0 = np.arange(N, dtype=np.float32).reshape(1250, 1000) / N
+        kv.init("big", nd.array(big0))
+        small0 = np.ones((8, 4), np.float32)
+        kv.init("small", nd.array(small0))
+        # partitions: big sliced in two, small on one hash server
+        parts = kv._partition("big", N)
+        assert len(parts) == 2 and parts[0][1] == 0, parts
+        assert {s for s, _, _ in parts} == {0, 1}
+        assert len(kv._partition("small", 32)) == 1
+        for step in range(2):
+            kv.push("big", nd.array(np.full((1250, 1000), rank + 1.0,
+                                            np.float32)))
+            out = nd.zeros((1250, 1000))
+            kv.pull("big", out=out)
+            expect = sum(r + 1.0 for r in range(num))
+            got = out.asnumpy()
+            assert np.allclose(got, expect), (step, got[0, :3], expect)
+        kv.push("small", nd.array(np.full((8, 4), float(rank + 1),
+                                          np.float32)))
+        out = nd.zeros((8, 4))
+        kv.pull("small", out=out)
+        assert np.allclose(out.asnumpy(), sum(r + 1.0 for r in range(num)))
+        kv.barrier()
+        kv.close()
+        print("OK2SRV")
+    """)
+    outs = _run_workers(script, 2, timeout=180,
+                        extra_env={"MXTPU_NUM_SERVERS": "2",
+                                   "MXNET_KVSTORE_BIGARRAY_BOUND": "1000000"})
+    assert all("OK2SRV" in o for o in outs)
+
+
+def test_wire_codec_roundtrip():
+    """Typed binary frames replace pickle on the data path."""
+    from mxnet_tpu.kvstore_dist import _enc, _dec
+    cases = [
+        ("push", "k", 3, np.arange(12, dtype=np.float32).reshape(3, 4)),
+        ("pull", "x", None),
+        ("ok", np.zeros((2, 2), np.float16), 7),
+        ("set_compression", {"type": "2bit", "threshold": 0.5}),
+        ("barrier", "b1"),
+        (True, False, None, 1.5, -42, b"raw"),
+        ("nested", (1, (2, "three")), [4.0]),
+    ]
+    for obj in cases:
+        parts = []
+        _enc(obj, parts)
+        back, pos = _dec(memoryview(b"".join(parts)), 0)
+        flat_ok = True
+
+        def eq(a, b):
+            if isinstance(a, np.ndarray):
+                return isinstance(b, np.ndarray) and a.dtype == b.dtype \
+                    and np.array_equal(a, b)
+            if isinstance(a, (tuple, list)):
+                return len(a) == len(b) and all(eq(x, y)
+                                                for x, y in zip(a, b))
+            if isinstance(a, dict):
+                return set(a) == set(b) and all(eq(a[k], b[k]) for k in a)
+            return a == b and type(a) == type(b)
+        assert eq(obj, back), (obj, back)
+
+
+def test_wire_codec_rejects_arbitrary_objects():
+    """No pickle on the data path: unknown types must be refused, not
+    serialized."""
+    from mxnet_tpu.kvstore_dist import _enc
+    import mxnet_tpu as mx
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("true",))
+
+    with pytest.raises(mx.base.MXNetError):
+        _enc(("push", Evil()), [])
