@@ -1,57 +1,18 @@
 """ONNX import/export (reference: python/mxnet/contrib/onnx/ —
 mx2onnx export_model, onnx2mx import_model).
 
-Gated: the `onnx` package is not part of the TPU image; entry points are
-importable and raise with guidance when the dependency is missing
-(environment rule: stub or gate optional deps)."""
+Self-contained: the TPU image ships no `onnx` package, so serialization
+goes through a minimal protobuf wire-format codec (``_proto.py``) that
+reads/writes the ModelProto subset directly.  Covered op set: Conv, Gemm/
+MatMul, Relu/Sigmoid/Tanh/Softplus/LeakyRelu, Max/Average/Global pooling,
+BatchNormalization, Add/Sub/Mul/Div/Sum, Concat, Flatten, Reshape,
+Softmax, Dropout, Identity — the CNN surface the reference's converter
+handles for its model zoo.
+"""
 from __future__ import annotations
 
-from ...base import MXNetError
+from .mx2onnx import export_model
+from .onnx2mx import import_model, get_model_metadata, parse_model
 
-__all__ = ["import_model", "export_model", "get_model_metadata"]
-
-
-def _require_onnx():
-    try:
-        import onnx  # noqa: F401
-
-        return onnx
-    except ImportError as e:
-        raise MXNetError(
-            "the `onnx` package is not installed in this environment; "
-            "contrib.onnx import/export requires it") from e
-
-
-def import_model(model_file):
-    """Load an ONNX model as (sym, arg_params, aux_params)
-    (reference: onnx2mx/import_model.py)."""
-    onnx = _require_onnx()
-    model = onnx.load(model_file)
-    raise MXNetError(
-        "ONNX graph import is not yet implemented for the TPU build "
-        f"(model ir_version={model.ir_version}); file an issue with the "
-        "op list you need")
-
-
-def export_model(sym, params, input_shape, input_type=None,
-                 onnx_file_path="model.onnx", verbose=False):
-    """Export a symbol+params to ONNX (reference: mx2onnx/export_model.py)."""
-    _require_onnx()
-    raise MXNetError(
-        "ONNX export is not yet implemented for the TPU build; "
-        "HybridBlock.export / model.save_checkpoint cover native "
-        "serialization")
-
-
-def get_model_metadata(model_file):
-    onnx = _require_onnx()
-    model = onnx.load(model_file)
-    graph = model.graph
-    return {
-        "input_tensor_data": [(i.name, tuple(
-            d.dim_value for d in i.type.tensor_type.shape.dim))
-            for i in graph.input],
-        "output_tensor_data": [(o.name, tuple(
-            d.dim_value for d in o.type.tensor_type.shape.dim))
-            for o in graph.output],
-    }
+__all__ = ["import_model", "export_model", "get_model_metadata",
+           "parse_model"]

@@ -1,0 +1,135 @@
+"""Minimal protobuf wire-format codec for the ONNX proto subset.
+
+The TPU image has no `onnx` package, but ONNX files are plain protobuf —
+varint tags + length-delimited submessages — so this module reads/writes
+the ModelProto/GraphProto/NodeProto/TensorProto/AttributeProto/
+ValueInfoProto subset directly (field numbers from the public onnx.proto
+spec).  Messages are represented as plain dicts of {field_name: value}.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+# AttributeProto.type enum
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+# TensorProto.DataType enum (subset)
+DT_FLOAT, DT_UINT8, DT_INT8, DT_INT32, DT_INT64 = 1, 2, 3, 6, 7
+DT_BOOL, DT_FLOAT16, DT_DOUBLE = 9, 10, 11
+DT_BFLOAT16 = 16
+
+_NP_TO_DT = {"float32": DT_FLOAT, "uint8": DT_UINT8, "int8": DT_INT8,
+             "int32": DT_INT32, "int64": DT_INT64, "bool": DT_BOOL,
+             "float16": DT_FLOAT16, "float64": DT_DOUBLE,
+             "bfloat16": DT_BFLOAT16}
+_DT_TO_NP = {v: k for k, v in _NP_TO_DT.items()}
+
+
+def np_to_datatype(dtype) -> int:
+    return _NP_TO_DT[str(dtype)]
+
+
+def datatype_to_np(dt: int) -> str:
+    return _DT_TO_NP[dt]
+
+
+# ---------------------------------------------------------------- writing
+
+def _varint(n: int) -> bytes:
+    out = b""
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def w_varint(field: int, value: int) -> bytes:
+    return _tag(field, _VARINT) + _varint(int(value))
+
+
+def w_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, _LEN) + _varint(len(data)) + data
+
+
+def w_str(field: int, s: str) -> bytes:
+    return w_bytes(field, s.encode("utf-8"))
+
+
+def w_msg(field: int, payload: bytes) -> bytes:
+    return w_bytes(field, payload)
+
+
+def w_packed_varints(field: int, values) -> bytes:
+    body = b"".join(_varint(int(v)) for v in values)
+    return w_bytes(field, body)
+
+
+def w_float(field: int, value: float) -> bytes:
+    return _tag(field, _I32) + struct.pack("<f", float(value))
+
+
+# ---------------------------------------------------------------- reading
+
+def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def iter_fields(buf: memoryview):
+    """Yields (field_number, wire_type, value) over a message body.
+    LEN values come back as memoryview; varints as int; I32/I64 as bytes."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == _VARINT:
+            v, pos = _read_varint(buf, pos)
+            yield field, wire, v
+        elif wire == _LEN:
+            ln, pos = _read_varint(buf, pos)
+            yield field, wire, buf[pos:pos + ln]
+            pos += ln
+        elif wire == _I32:
+            yield field, wire, bytes(buf[pos:pos + 4])
+            pos += 4
+        elif wire == _I64:
+            yield field, wire, bytes(buf[pos:pos + 8])
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+def unpack_varints(v) -> List[int]:
+    """A packed or single varint field → list of ints."""
+    if isinstance(v, int):
+        return [v]
+    out = []
+    pos = 0
+    while pos < len(v):
+        x, pos = _read_varint(v, pos)
+        out.append(x)
+    return out
+
+
+def signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v

@@ -1,0 +1,209 @@
+"""Symbol → ONNX export (reference: python/mxnet/contrib/onnx/mx2onnx/).
+
+Walks the symbol DAG in topo order, mapping each framework op to its ONNX
+node (opset 11 semantics for the covered subset), with params embedded as
+graph initializers.  Serialization via the self-contained protobuf codec in
+``_proto.py`` — no onnx package needed.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import _proto as P
+
+
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return [int(x) for x in v]
+    return [int(v)] * n
+
+
+def _attr_int(name: str, value: int) -> bytes:
+    return P.w_str(1, name) + P.w_varint(3, value) + P.w_varint(20, P.ATTR_INT)
+
+
+def _attr_float(name: str, value: float) -> bytes:
+    return P.w_str(1, name) + P.w_float(2, value) + P.w_varint(20, P.ATTR_FLOAT)
+
+
+def _attr_ints(name: str, values) -> bytes:
+    return P.w_str(1, name) + P.w_packed_varints(8, values) \
+        + P.w_varint(20, P.ATTR_INTS)
+
+
+def _attr_str(name: str, value: str) -> bytes:
+    return P.w_str(1, name) + P.w_bytes(4, value.encode()) \
+        + P.w_varint(20, P.ATTR_STRING)
+
+
+def _node(op_type: str, inputs: List[str], outputs: List[str],
+          name: str, attrs: List[bytes]) -> bytes:
+    body = b"".join(P.w_str(1, i) for i in inputs)
+    body += b"".join(P.w_str(2, o) for o in outputs)
+    body += P.w_str(3, name) + P.w_str(4, op_type)
+    body += b"".join(P.w_msg(5, a) for a in attrs)
+    return body
+
+
+def _tensor(name: str, arr: _np.ndarray) -> bytes:
+    arr = _np.ascontiguousarray(arr)
+    body = P.w_packed_varints(1, arr.shape) if arr.ndim else b""
+    body += P.w_varint(2, P.np_to_datatype(arr.dtype))
+    body += P.w_str(8, name)
+    body += P.w_bytes(9, arr.tobytes())
+    return body
+
+
+def _value_info(name: str, shape, elem_type=P.DT_FLOAT) -> bytes:
+    dims = b"".join(P.w_msg(1, P.w_varint(1, int(d))) for d in shape)
+    tensor_type = P.w_varint(1, elem_type) + P.w_msg(2, dims)
+    return P.w_str(1, name) + P.w_msg(2, P.w_msg(1, tensor_type))
+
+
+class _Exporter:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.names: Dict[tuple, str] = {}  # (node_uid, out_idx) -> onnx name
+        self.counter = 0
+
+    def out_name(self, entry) -> str:
+        if entry.node.kind == "var":
+            return entry.node.name
+        return self.names[(entry.node._uid, entry.index)]
+
+    def emit(self, op_type, node, attrs, inputs=None, n_out=1):
+        ins = [self.out_name(e) for e in (inputs if inputs is not None
+                                          else node.inputs)]
+        outs = []
+        for i in range(n_out):
+            outs.append(f"{node.name}_out{i}" if i else node.name)
+            self.names[(node._uid, i)] = outs[i]
+        self.nodes.append(_node(op_type, ins, outs, node.name + "_node",
+                                attrs))
+
+
+def _convert(ex: _Exporter, node):
+    a = node.attrs
+    op = node.op.name
+    if op == "Convolution":
+        attrs = [_attr_ints("kernel_shape", _pair(a.get("kernel", (1, 1)))),
+                 _attr_ints("strides", _pair(a.get("stride") or 1)),
+                 _attr_ints("dilations", _pair(a.get("dilate") or 1)),
+                 _attr_int("group", int(a.get("num_group", 1)))]
+        pads = _pair(a.get("pad") or 0)
+        attrs.append(_attr_ints("pads", pads + pads))
+        ex.emit("Conv", node, attrs)
+    elif op == "FullyConnected":
+        # onnx Gemm needs 2-D input; FullyConnected flattens implicitly
+        flat = f"{node.name}_flat"
+        ex.nodes.append(_node("Flatten", [ex.out_name(node.inputs[0])],
+                              [flat], flat + "_node", [_attr_int("axis", 1)]))
+        ins = [flat, ex.out_name(node.inputs[1])]
+        if len(node.inputs) > 2 and not a.get("no_bias"):
+            ins.append(ex.out_name(node.inputs[2]))
+        ex.names[(node._uid, 0)] = node.name
+        ex.nodes.append(_node("Gemm", ins, [node.name], node.name + "_node",
+                              [_attr_int("transB", 1)]))
+    elif op == "Activation":
+        kind = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+                "softrelu": "Softplus"}.get(a.get("act_type", "relu"))
+        if kind is None:
+            raise MXNetError(f"onnx export: activation {a.get('act_type')!r}")
+        ex.emit(kind, node, [])
+    elif op == "Pooling":
+        global_pool = a.get("global_pool")
+        ptype = a.get("pool_type", "max")
+        if global_pool:
+            ex.emit("GlobalMaxPool" if ptype == "max"
+                    else "GlobalAveragePool", node, [])
+        else:
+            attrs = [_attr_ints("kernel_shape", _pair(a.get("kernel", (2, 2)))),
+                     _attr_ints("strides", _pair(a.get("stride")
+                                                 or a.get("kernel", (2, 2))))]
+            pads = _pair(a.get("pad") or 0)
+            attrs.append(_attr_ints("pads", pads + pads))
+            if ptype == "avg":
+                attrs.append(_attr_int("count_include_pad",
+                                       1 if a.get("count_include_pad", True)
+                                       else 0))
+            ex.emit("MaxPool" if ptype == "max" else "AveragePool",
+                    node, attrs)
+    elif op == "BatchNorm":
+        attrs = [_attr_float("epsilon", float(a.get("eps", 1e-3))),
+                 _attr_float("momentum", float(a.get("momentum", 0.9)))]
+        ex.emit("BatchNormalization", node, attrs)
+    elif op in ("elemwise_add", "broadcast_add", "_add"):
+        ex.emit("Add", node, [])
+    elif op in ("elemwise_sub", "broadcast_sub", "_sub"):
+        ex.emit("Sub", node, [])
+    elif op in ("elemwise_mul", "broadcast_mul", "_mul"):
+        ex.emit("Mul", node, [])
+    elif op in ("elemwise_div", "broadcast_div", "_div"):
+        ex.emit("Div", node, [])
+    elif op in ("add_n", "ElementWiseSum"):
+        ex.emit("Sum", node, [])
+    elif op == "concat":
+        ex.emit("Concat", node, [_attr_int("axis", int(a.get("dim", 1)))])
+    elif op == "flatten":
+        ex.emit("Flatten", node, [_attr_int("axis", 1)])
+    elif op in ("softmax", "SoftmaxOutput", "SoftmaxActivation"):
+        # SoftmaxOutput's label input is a training artifact: drop it
+        ex.emit("Softmax", node, [_attr_int("axis", -1)],
+                inputs=node.inputs[:1])
+    elif op == "Dropout":
+        ex.emit("Dropout", node, [_attr_float("ratio", float(a.get("p", 0.5)))])
+    elif op in ("identity", "_copy", "BlockGrad"):
+        ex.emit("Identity", node, [])
+    elif op == "LeakyReLU" and a.get("act_type", "leaky") == "leaky":
+        ex.emit("LeakyRelu", node,
+                [_attr_float("alpha", float(a.get("slope", 0.25)))])
+    else:
+        raise MXNetError(f"onnx export: unsupported op {op!r} "
+                         f"(node {node.name!r})")
+
+
+def export_model(sym, params, input_shape, input_type=_np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export symbol+params as an ONNX ModelProto file; returns the path.
+    (reference: mx2onnx/export_model.py signature)."""
+    from ...symbol.graph import topo_order
+
+    if isinstance(input_shape, (tuple, list)) and input_shape \
+            and isinstance(input_shape[0], int):
+        input_shapes = [tuple(input_shape)]
+    else:
+        input_shapes = [tuple(s) for s in input_shape]
+    param_arrays = {k: (v.asnumpy() if hasattr(v, "asnumpy")
+                        else _np.asarray(v)) for k, v in (params or {}).items()}
+
+    ex = _Exporter()
+    data_inputs = []
+    initializers = []
+    for node in topo_order(sym._entries):
+        if node.kind == "var":
+            if node.name in param_arrays:
+                initializers.append(_tensor(node.name,
+                                            param_arrays[node.name]))
+            elif "label" not in node.name:
+                data_inputs.append(node.name)
+            continue
+        _convert(ex, node)
+
+    out_names = [ex.out_name(e) for e in sym._entries]
+    graph = b"".join(P.w_msg(1, n) for n in ex.nodes)
+    graph += P.w_str(2, "mxnet_tpu_export")
+    graph += b"".join(P.w_msg(5, t) for t in initializers)
+    for name, shape in zip(data_inputs, input_shapes):
+        graph += P.w_msg(11, _value_info(name, shape))
+    for name in out_names:
+        graph += P.w_msg(12, _value_info(name, ()))
+    model = P.w_varint(1, 7)                       # ir_version
+    model += P.w_str(2, "mxnet_tpu")               # producer_name
+    model += P.w_msg(7, graph)
+    model += P.w_msg(8, P.w_str(1, "") + P.w_varint(2, 11))  # opset 11
+    with open(onnx_file_path, "wb") as f:
+        f.write(model)
+    return onnx_file_path

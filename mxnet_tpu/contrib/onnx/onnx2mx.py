@@ -1,0 +1,332 @@
+"""ONNX → Symbol import (reference: python/mxnet/contrib/onnx/onnx2mx/).
+
+Parses the ModelProto with the self-contained codec and rebuilds the graph
+as framework symbols; initializers become arg_params.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import _proto as P
+
+
+# ------------------------------------------------------------- proto parse
+
+def _parse_attr(buf) -> tuple:
+    name = atype = None
+    f = i = s = t = None
+    floats, ints, strings = [], [], []
+    import struct
+    for field, wire, v in P.iter_fields(buf):
+        if field == 1:
+            name = bytes(v).decode()
+        elif field == 2:
+            f = struct.unpack("<f", v)[0]
+        elif field == 3:
+            i = P.signed64(v)
+        elif field == 4:
+            s = bytes(v)
+        elif field == 5:
+            t = _parse_tensor(v)
+        elif field == 7:
+            floats.extend(struct.unpack(f"<{len(v)//4}f", bytes(v))
+                          if wire == 2 else [struct.unpack("<f", v)[0]])
+        elif field == 8:
+            ints.extend(P.signed64(x) for x in P.unpack_varints(v))
+        elif field == 9:
+            strings.append(bytes(v))
+        elif field == 20:
+            atype = v
+    if atype == P.ATTR_FLOAT:
+        return name, f
+    if atype == P.ATTR_INT:
+        return name, i
+    if atype == P.ATTR_STRING:
+        return name, s.decode() if s is not None else ""
+    if atype == P.ATTR_TENSOR:
+        return name, t
+    if atype == P.ATTR_FLOATS:
+        return name, list(floats)
+    if atype == P.ATTR_INTS:
+        return name, list(ints)
+    if atype == P.ATTR_STRINGS:
+        return name, [x.decode() for x in strings]
+    # untyped (older exporters): best effort by presence
+    for v2 in (i, f, s):
+        if v2 is not None:
+            return name, v2
+    return name, list(ints) or list(floats) or None
+
+
+def _parse_tensor(buf) -> _np.ndarray:
+    dims: List[int] = []
+    dtype = P.DT_FLOAT
+    raw = None
+    f32, i32, i64 = [], [], []
+    name = ""
+    import struct
+    for field, wire, v in P.iter_fields(buf):
+        if field == 1:
+            dims.extend(P.signed64(x) for x in P.unpack_varints(v))
+        elif field == 2:
+            dtype = v
+        elif field == 4:
+            f32.extend(struct.unpack(f"<{len(v)//4}f", bytes(v))
+                       if wire == 2 else [struct.unpack("<f", v)[0]])
+        elif field == 5:
+            i32.extend(P.unpack_varints(v))
+        elif field == 7:
+            i64.extend(P.signed64(x) for x in P.unpack_varints(v))
+        elif field == 8:
+            name = bytes(v).decode()
+        elif field == 9:
+            raw = bytes(v)
+    np_dtype = _np.dtype(P.datatype_to_np(dtype)) \
+        if dtype != P.DT_BFLOAT16 else _np.dtype("uint16")
+    if raw is not None:
+        arr = _np.frombuffer(raw, dtype=np_dtype)
+    elif f32:
+        arr = _np.asarray(f32, _np.float32)
+    elif i64:
+        arr = _np.asarray(i64, _np.int64)
+    elif i32:
+        arr = _np.asarray(i32, _np.int32).astype(np_dtype)
+    else:
+        arr = _np.zeros(0, np_dtype)
+    arr = arr.reshape(dims) if dims else arr
+    arr = _np.array(arr)  # own the buffer
+    arr.flags.writeable = True if arr.flags.owndata else arr.flags.writeable
+    return _Named(arr, name)
+
+
+class _Named:
+    __slots__ = ("array", "name")
+
+    def __init__(self, array, name):
+        self.array = array
+        self.name = name
+
+
+def _parse_value_info(buf):
+    name = ""
+    shape = []
+    for field, _, v in P.iter_fields(buf):
+        if field == 1:
+            name = bytes(v).decode()
+        elif field == 2:
+            for f2, _, v2 in P.iter_fields(v):
+                if f2 == 1:  # tensor_type
+                    for f3, _, v3 in P.iter_fields(v2):
+                        if f3 == 2:  # shape
+                            for f4, _, v4 in P.iter_fields(v3):
+                                if f4 == 1:  # dim
+                                    dv = 0
+                                    for f5, _, v5 in P.iter_fields(v4):
+                                        if f5 == 1:
+                                            dv = P.signed64(v5)
+                                    shape.append(dv)
+    return name, tuple(shape)
+
+
+def _parse_node(buf):
+    inputs, outputs, attrs = [], [], {}
+    name = op_type = ""
+    for field, _, v in P.iter_fields(buf):
+        if field == 1:
+            inputs.append(bytes(v).decode())
+        elif field == 2:
+            outputs.append(bytes(v).decode())
+        elif field == 3:
+            name = bytes(v).decode()
+        elif field == 4:
+            op_type = bytes(v).decode()
+        elif field == 5:
+            k, val = _parse_attr(v)
+            attrs[k] = val
+    return {"op": op_type, "name": name, "inputs": inputs,
+            "outputs": outputs, "attrs": attrs}
+
+
+def parse_model(path_or_bytes):
+    data = path_or_bytes if isinstance(path_or_bytes, (bytes, memoryview)) \
+        else open(path_or_bytes, "rb").read()
+    graph = None
+    meta = {"ir_version": None, "producer": "", "opset": None}
+    for field, _, v in P.iter_fields(memoryview(data)):
+        if field == 1:
+            meta["ir_version"] = v
+        elif field == 2:
+            meta["producer"] = bytes(v).decode()
+        elif field == 7:
+            graph = v
+        elif field == 8:
+            for f2, _, v2 in P.iter_fields(v):
+                if f2 == 2:
+                    meta["opset"] = v2
+    if graph is None:
+        raise MXNetError("not an ONNX ModelProto: no graph field")
+    nodes, inits, inputs, outputs = [], {}, [], []
+    for field, _, v in P.iter_fields(graph):
+        if field == 1:
+            nodes.append(_parse_node(v))
+        elif field == 5:
+            t = _parse_tensor(v)
+            inits[t.name] = t.array
+        elif field == 11:
+            inputs.append(_parse_value_info(v))
+        elif field == 12:
+            outputs.append(_parse_value_info(v))
+    return {"meta": meta, "nodes": nodes, "initializers": inits,
+            "inputs": inputs, "outputs": outputs}
+
+
+# ------------------------------------------------------------- graph build
+
+def _pads_to_pad(pads):
+    if not pads:
+        return (0, 0)
+    k = len(pads) // 2
+    begin, end = pads[:k], pads[k:]
+    if list(begin) != list(end):
+        raise MXNetError(f"asymmetric onnx pads {pads} unsupported")
+    return tuple(begin)
+
+
+def import_model(model_file):
+    """Load an ONNX model as (sym, arg_params, aux_params)
+    (reference: onnx2mx/import_model.py)."""
+    import mxnet_tpu as mx
+    from ...ndarray import array as nd_array
+
+    model = parse_model(model_file)
+    inits = model["initializers"]
+    env: Dict[str, object] = {}
+    for name, _ in model["inputs"]:
+        if name not in inits:
+            env[name] = mx.sym.Variable(name)
+    for name in inits:
+        env[name] = mx.sym.Variable(name)
+
+    aux_names = set()
+    reshape_shape_names = set()
+    # count non-Reshape-shape uses so shared shape initializers only leave
+    # arg_params when no other node consumes them
+    other_uses = {}
+    for nd_ in model["nodes"]:
+        for pos, iname in enumerate(nd_["inputs"]):
+            if not (nd_["op"] == "Reshape" and pos == 1):
+                other_uses[iname] = other_uses.get(iname, 0) + 1
+    for nd_ in model["nodes"]:
+        op = nd_["op"]
+        a = nd_["attrs"]
+        ins = [env[i] for i in nd_["inputs"] if i]
+        name = nd_["name"] or nd_["outputs"][0]
+        if op == "Conv":
+            pad = _pads_to_pad(a.get("pads"))
+            out = mx.sym.Convolution(
+                *ins, kernel=tuple(a.get("kernel_shape", (1, 1))),
+                stride=tuple(a.get("strides", (1, 1))),
+                dilate=tuple(a.get("dilations", (1, 1))), pad=pad,
+                num_filter=int(inits[nd_["inputs"][1]].shape[0]),
+                num_group=int(a.get("group", 1)),
+                no_bias=len(ins) < 3, name=name)
+        elif op == "Gemm":
+            if a.get("transA"):
+                raise MXNetError("onnx import: Gemm transA unsupported")
+            w = inits.get(nd_["inputs"][1])
+            if w is None:
+                raise MXNetError("onnx import: Gemm needs initializer weight")
+            if not a.get("transB"):
+                inits[nd_["inputs"][1]] = _np.ascontiguousarray(w.T)
+                w = inits[nd_["inputs"][1]]
+            out = mx.sym.FullyConnected(*ins, num_hidden=int(w.shape[0]),
+                                        no_bias=len(ins) < 3, name=name)
+        elif op == "MatMul":
+            w = inits.get(nd_["inputs"][1])
+            if w is None:
+                raise MXNetError("onnx import: MatMul needs initializer rhs")
+            inits[nd_["inputs"][1]] = _np.ascontiguousarray(w.T)
+            out = mx.sym.FullyConnected(*ins, num_hidden=int(w.shape[1]),
+                                        no_bias=True, flatten=False,
+                                        name=name)
+        elif op in ("Relu", "Sigmoid", "Tanh", "Softplus"):
+            act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+                   "Softplus": "softrelu"}[op]
+            out = mx.sym.Activation(*ins, act_type=act, name=name)
+        elif op == "LeakyRelu":
+            out = mx.sym.LeakyReLU(*ins, act_type="leaky",
+                                   slope=float(a.get("alpha", 0.01)),
+                                   name=name)
+        elif op in ("MaxPool", "AveragePool"):
+            kshape = tuple(a.get("kernel_shape", (2, 2)))
+            # ONNX spec defaults: strides = 1 per axis, count_include_pad = 0
+            out = mx.sym.Pooling(
+                *ins, kernel=kshape,
+                stride=tuple(a.get("strides", (1,) * len(kshape))),
+                pad=_pads_to_pad(a.get("pads")),
+                pool_type="max" if op == "MaxPool" else "avg",
+                count_include_pad=bool(a.get("count_include_pad", 0)),
+                name=name)
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            out = mx.sym.Pooling(*ins, global_pool=True, kernel=(1, 1),
+                                 pool_type="max" if "Max" in op else "avg",
+                                 name=name)
+        elif op == "BatchNormalization":
+            out = mx.sym.BatchNorm(*ins, eps=float(a.get("epsilon", 1e-5)),
+                                   momentum=float(a.get("momentum", 0.9)),
+                                   fix_gamma=False, name=name)
+            aux_names.update(nd_["inputs"][3:5])
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            fn = {"Add": mx.sym.broadcast_add, "Sub": mx.sym.broadcast_sub,
+                  "Mul": mx.sym.broadcast_mul, "Div": mx.sym.broadcast_div}
+            out = fn[op](*ins, name=name)
+        elif op == "Sum":
+            out = mx.sym.add_n(*ins, name=name)
+        elif op == "Concat":
+            out = mx.sym.Concat(*ins, dim=int(a.get("axis", 1)), name=name)
+        elif op == "Flatten":
+            out = mx.sym.Flatten(*ins, name=name)
+        elif op == "Reshape":
+            shape = inits.get(nd_["inputs"][1])
+            if shape is None:
+                raise MXNetError("onnx import: dynamic Reshape unsupported")
+            out = mx.sym.reshape(ins[0],
+                                 shape=tuple(int(x) for x in shape),
+                                 name=name)
+            # the shape tensor is consumed as an attr, not a graph input;
+            # recorded and excluded from arg_params after the node loop
+            # (it may be shared by several Reshape nodes)
+            reshape_shape_names.add(nd_["inputs"][1])
+        elif op == "Softmax":
+            # ONNX opset-11 default axis is 1 (coerce-to-2D semantics)
+            out = mx.sym.softmax(*ins, axis=int(a.get("axis", 1)),
+                                 name=name)
+        elif op in ("Dropout", "Identity"):
+            out = mx.sym.identity(ins[0], name=name)
+        else:
+            raise MXNetError(f"onnx import: unsupported op {op!r}")
+        outs = list(out) if len(nd_["outputs"]) > 1 and len(out) > 1 else [out]
+        for i, oname in enumerate(nd_["outputs"]):
+            if i < len(outs):
+                env[oname] = outs[i]
+
+    # BN moving stats are auxiliary states, not arguments
+    for name in aux_names:
+        if name in env and hasattr(env[name], "_entries"):
+            env[name]._entries[0].node.attr_dict["__is_aux__"] = "1"
+    heads = [env[name] for name, _ in model["outputs"] if name in env]
+    sym = mx.sym.Group(heads) if len(heads) > 1 else heads[0]
+    attr_only = {n for n in reshape_shape_names if not other_uses.get(n)}
+    arg_params = {k: nd_array(v) for k, v in inits.items()
+                  if k not in aux_names and k not in attr_only}
+    aux_params = {k: nd_array(inits[k]) for k in aux_names if k in inits}
+    return sym, arg_params, aux_params
+
+
+def get_model_metadata(model_file):
+    m = parse_model(model_file)
+    return {"input_tensor_data": m["inputs"],
+            "output_tensor_data": m["outputs"]}
