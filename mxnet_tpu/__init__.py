@@ -63,3 +63,8 @@ from .attribute import AttrScope
 from . import name
 from .name import NameManager
 from . import util
+
+# fork/crash handlers (reference: src/initialize.cc) — engine quiesce around
+# fork for process DataLoader workers, faulthandler backtraces on segfault
+from . import _fork
+_fork.install()

@@ -1,11 +1,16 @@
 """DataLoader (reference: python/mxnet/gluon/data/dataloader.py:26-74 —
 multiprocessing workers + shared-memory NDArray IPC).
 
-TPU-native: worker parallelism uses a thread pool rather than fork —
-host-side decode releases the GIL in numpy/PIL, and device upload is a single
-async jax transfer per batch, so threads reach the same overlap the
-reference's process pool + CPUSharedStorageManager achieves without the shm
-plumbing (src/storage/cpu_shared_storage_manager.h).
+Two worker modes, like the reference:
+- ``thread_pool=True`` (default): decode in threads — numpy/PIL release the
+  GIL, and device upload is one async jax transfer per batch.
+- ``thread_pool=False``: fork a process pool (GIL-bound Python datasets);
+  workers batchify to *numpy* (``default_mp_batchify_fn``) and return
+  batches through ``multiprocessing.shared_memory`` segments — the analogue
+  of the reference's CPUSharedStorageManager NDArray IPC
+  (src/storage/cpu_shared_storage_manager.h).  Fork safety is provided by
+  the ``_fork`` handlers (engine quiesce / child reseed, the
+  initialize.cc analogue); workers never touch jax.
 """
 from __future__ import annotations
 
@@ -20,7 +25,7 @@ from ... import ndarray as nd
 from ...ndarray.ndarray import NDArray
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
-__all__ = ["DataLoader", "default_batchify_fn"]
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
 
 
 def default_batchify_fn(data):
@@ -34,6 +39,79 @@ def default_batchify_fn(data):
     if arr.dtype == _np.float64:
         arr = arr.astype(_np.float32)
     return nd.array(arr)
+
+
+def default_mp_batchify_fn(data):
+    """Process-worker batchify: numpy only (no jax in forked children)
+    (reference: dataloader.py default_mp_batchify_fn builds shm NDArrays)."""
+    if isinstance(data[0], NDArray):
+        data = [d.asnumpy() for d in data]
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_mp_batchify_fn(list(i)) for i in data]
+    arr = _np.stack([_np.asarray(d) for d in data]) \
+        if isinstance(data[0], _np.ndarray) else _np.asarray(data)
+    if arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    return arr
+
+
+# ---- process-pool plumbing (module-level so fork inherits, no pickling) ----
+
+_mp_dataset = None
+_mp_batchify = None
+
+
+def _mp_init(dataset, batchify_fn):
+    global _mp_dataset, _mp_batchify
+    _mp_dataset = dataset
+    _mp_batchify = batchify_fn
+
+
+def _to_shm(obj):
+    """numpy (possibly nested) -> shm descriptors the parent reattaches."""
+    from multiprocessing import shared_memory
+
+    if isinstance(obj, _np.ndarray):
+        shm = shared_memory.SharedMemory(create=True, size=max(1, obj.nbytes))
+        view = _np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
+        view[...] = obj
+        name = shm.name
+        shm.close()  # parent unlinks after reattach
+        try:
+            # ownership transfers to the parent (which unlinks); drop the
+            # worker-side tracker registration so its exit doesn't race the
+            # parent's unlink with a spurious warning
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister("/" + name, "shared_memory")
+        except Exception:
+            pass
+        return ("shm", name, obj.shape, str(obj.dtype))
+    if isinstance(obj, (list, tuple)):
+        return ("list", [_to_shm(x) for x in obj])
+    return ("raw", obj)
+
+
+def _from_shm(desc):
+    from multiprocessing import shared_memory
+
+    kind = desc[0]
+    if kind == "shm":
+        _, name, shape, dtype = desc
+        shm = shared_memory.SharedMemory(name=name)
+        arr = _np.ndarray(shape, dtype, buffer=shm.buf).copy()
+        shm.close()
+        shm.unlink()
+        return nd.array(arr)
+    if kind == "list":
+        return [_from_shm(x) for x in desc[1]]
+    return desc[1]
+
+
+def _mp_fetch(indices):
+    batch = _mp_batchify([_mp_dataset[i] for i in indices])
+    return _to_shm(batch)
 
 
 class DataLoader:
@@ -55,11 +133,23 @@ class DataLoader:
             raise ValueError("batch_sampler is mutually exclusive with "
                              "batch_size/shuffle/sampler/last_batch")
         self._batch_sampler = batch_sampler
-        self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = max(0, int(num_workers))
+        self._thread_pool = thread_pool
+        self._pool = None
+        if self._num_workers and thread_pool:
+            self._batchify_fn = batchify_fn or default_batchify_fn
+            self._pool = ThreadPoolExecutor(self._num_workers)
+        elif self._num_workers:
+            import multiprocessing as _mp
+
+            self._batchify_fn = batchify_fn or default_mp_batchify_fn
+            ctx = _mp.get_context("fork")
+            self._pool = ctx.Pool(self._num_workers, initializer=_mp_init,
+                                  initargs=(dataset, self._batchify_fn))
+        else:
+            self._batchify_fn = batchify_fn or default_batchify_fn
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
-        self._pool = ThreadPoolExecutor(self._num_workers) if self._num_workers else None
 
     def __iter__(self):
         if self._pool is None:
@@ -69,22 +159,61 @@ class DataLoader:
         # pipelined: submit ahead, yield in order
         pending = []
         it = iter(self._batch_sampler)
+        if self._thread_pool:
+            def submit(batch_idx):
+                return self._pool.submit(
+                    lambda idx: self._batchify_fn(
+                        [self._dataset[i] for i in idx]), batch_idx)
 
-        def fetch(batch_idx):
-            return self._batchify_fn([self._dataset[i] for i in batch_idx])
+            def resolve(fut):
+                return fut.result()
+        else:
+            def submit(batch_idx):
+                return self._pool.apply_async(_mp_fetch, (list(batch_idx),))
+
+            def resolve(fut):
+                return _from_shm(fut.get())
 
         try:
-            for _ in range(self._prefetch + 1):
-                pending.append(self._pool.submit(fetch, next(it)))
-        except StopIteration:
-            pass
-        while pending:
-            fut = pending.pop(0)
             try:
-                pending.append(self._pool.submit(fetch, next(it)))
+                for _ in range(self._prefetch + 1):
+                    pending.append(submit(next(it)))
             except StopIteration:
                 pass
-            yield fut.result()
+            while pending:
+                fut = pending.pop(0)
+                try:
+                    pending.append(submit(next(it)))
+                except StopIteration:
+                    pass
+                yield resolve(fut)
+        finally:
+            # abandoned iteration (break/exception): drain outstanding
+            # futures so process-mode shm segments get unlinked instead of
+            # leaking in /dev/shm
+            for fut in pending:
+                try:
+                    resolve(fut)
+                except Exception:
+                    pass
+
+    def close(self):
+        """Shut down the worker pool (reference DataLoader reaps its
+        multiprocessing workers on deletion)."""
+        pool, self._pool = self._pool, None
+        if pool is None:
+            return
+        if self._thread_pool:
+            pool.shutdown(wait=False)
+        else:
+            pool.terminate()
+            pool.join()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -275,3 +275,25 @@ def test_gluon_contrib_blocks():
     xs = nd.array(np.random.rand(2, 5, 4).astype(np.float32))
     outs, _ = vd.unroll(5, xs, merge_outputs=True)
     assert outs.shape == (2, 5, 8)
+
+
+def test_dataloader_process_workers_shm():
+    """Fork-based worker pool returning batches through shared memory
+    (reference: gluon/data/dataloader.py multiprocessing + shm NDArrays,
+    src/storage/cpu_shared_storage_manager.h; fork safety via the
+    initialize.cc-analogue handlers in mxnet_tpu._fork)."""
+    X = np.arange(80, dtype=np.float32).reshape(20, 4)
+    Y = np.arange(20, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(X, Y)
+    dl = gluon.data.DataLoader(ds, batch_size=5, num_workers=2,
+                               thread_pool=False)
+    seen = []
+    for xb, yb in dl:
+        assert xb.shape == (5, 4) and yb.shape == (5,)
+        seen.extend(yb.asnumpy().tolist())
+    assert sorted(seen) == list(range(20))
+    # second epoch reuses the pool
+    n = sum(1 for _ in dl)
+    assert n == 4
+    # parent jax still healthy after forks (engine handlers did their job)
+    assert float(nd.array(np.ones(3)).sum().asnumpy()) == 3.0
