@@ -7,12 +7,44 @@ from .symbol.graph import topo_order
 __all__ = ["print_summary", "plot_network"]
 
 
-def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
-    """Print a layer-by-layer summary table (reference: visualization.py)."""
-    shape_info = {}
-    if shape is not None:
-        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
-        internals = symbol.get_internals()
+def _node_shapes(symbol, shape):
+    """name -> output shape for every op node (via get_internals infer)."""
+    if shape is None:
+        return {}
+    from .base import MXNetError
+    internals = symbol.get_internals()
+    try:
+        _, out_shapes, _ = internals.infer_shape(**shape)
+    except MXNetError:  # e.g. label shape not provided: skip shape column
+        return {}
+    out = {}
+    for name, s in zip(internals.list_outputs(), out_shapes):
+        base = name[:-len("_output")] if name.endswith("_output") else name
+        out[base] = tuple(s)
+        out[name] = tuple(s)
+    return out
+
+
+def _param_shapes(symbol, shape):
+    if shape is None:
+        return {}
+    from .base import MXNetError
+    try:
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape)
+    except MXNetError:
+        return {}
+    d = dict(zip(symbol.list_arguments(), arg_shapes))
+    d.update(zip(symbol.list_auxiliary_states(), aux_shapes))
+    return d
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(0.44, 0.64, 0.74, 1.0)):
+    """Layer-by-layer table: type, output shape, param count, predecessors
+    (reference: visualization.py print_summary)."""
+    out_shapes = _node_shapes(symbol, shape)
+    par_shapes = _param_shapes(symbol, shape)
+    data_names = set(shape or ()) or {"data"}
     nodes = topo_order(symbol._entries)
     header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
     positions = [int(line_length * p) for p in positions]
@@ -30,26 +62,72 @@ def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.
     for n in nodes:
         if n.kind == "var":
             continue
-        prev = ",".join(e.node.name for e in n.inputs if e.node.kind != "var")
-        print_row([f"{n.name} ({n.op.name})", "", "", prev])
+        prev = ",".join(e.node.name for e in n.inputs
+                        if e.node.kind != "var" or e.node.name in data_names)
+        params = 0
+        for e in n.inputs:
+            if e.node.kind == "var" and e.node.name not in data_names:
+                s = par_shapes.get(e.node.name)
+                if s:
+                    c = 1
+                    for d in s:
+                        c *= d
+                    params += c
+        total += params
+        oshape = out_shapes.get(n.name, "")
+        print_row([f"{n.name} ({n.op.name})", oshape, params, prev])
     print("=" * line_length)
+    print(f"Total params: {total}")
+    print("_" * line_length)
+    return total
+
+
+_OP_STYLE = {
+    "Convolution": "#fb8072", "Deconvolution": "#fb8072",
+    "FullyConnected": "#fb8072", "BatchNorm": "#bebada",
+    "Activation": "#ffffb3", "LeakyReLU": "#ffffb3",
+    "Pooling": "#80b1d3", "concat": "#fdb462", "flatten": "#fdb462",
+    "softmax": "#fccde5", "SoftmaxOutput": "#fccde5",
+}
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,
                  node_attrs=None, hide_weights=True):
-    """Emit a graphviz dot source string (graphviz binary optional)."""
-    lines = ["digraph plot {"]
+    """Graphviz dot source for the graph (reference: plot_network; returns
+    the dot string — the graphviz binary is optional in this image).  Edge
+    labels carry output shapes when ``shape`` is given."""
+    out_shapes = _node_shapes(symbol, shape)
+    lines = [f'digraph "{title}" {{', "  rankdir=BT;"]
     nodes = topo_order(symbol._entries)
     nid = {id(n): i for i, n in enumerate(nodes)}
     for n in nodes:
         if n.kind == "var" and hide_weights and n.name != "data":
             continue
-        shape_attr = "ellipse" if n.kind == "var" else "box"
-        lines.append(f'  n{nid[id(n)]} [label="{n.name}", shape={shape_attr}];')
+        if n.kind == "var":
+            lines.append(f'  n{nid[id(n)]} [label="{n.name}", '
+                         'shape=ellipse, style=filled, fillcolor="#8dd3c7"];')
+        else:
+            label = n.name
+            if n.op.name == "Convolution":
+                label += f"\\n{n.attrs.get('kernel')}/" \
+                         f"{n.attrs.get('stride') or 1}, " \
+                         f"{n.attrs.get('num_filter')}"
+            elif n.op.name == "FullyConnected":
+                label += f"\\n{n.attrs.get('num_hidden')}"
+            color = _OP_STYLE.get(n.op.name, "#d9d9d9")
+            lines.append(f'  n{nid[id(n)]} [label="{label}", shape=box, '
+                         f'style=filled, fillcolor="{color}"];')
     for n in nodes:
+        if n.kind == "var":
+            continue
         for e in n.inputs:
-            if e.node.kind == "var" and hide_weights and e.node.name != "data":
+            if e.node.kind == "var" and hide_weights \
+                    and e.node.name != "data":
                 continue
-            lines.append(f"  n{nid[id(e.node)]} -> n{nid[id(n)]};")
+            edge = f"  n{nid[id(e.node)]} -> n{nid[id(n)]}"
+            s = out_shapes.get(e.node.name) if e.node.kind != "var" else None
+            if s:
+                edge += f' [label="{"x".join(str(d) for d in s[1:])}"]'
+            lines.append(edge + ";")
     lines.append("}")
     return "\n".join(lines)
