@@ -489,6 +489,34 @@ class KVStoreDist(KVStore):
     def _request(self, *msg):
         return self._request_on(0, *msg)
 
+    def _request_many(self, reqs):
+        """Issue per-server requests concurrently (one thread per server,
+        each on its own socket+lock) and return replies in request order —
+        the ps-lite overlap of sliced ZPush/ZPull (kvstore_dist.h:532-584).
+        reqs: list of (server, msg_tuple)."""
+        if len(reqs) == 1:
+            s0, m0 = reqs[0]
+            return [self._request_on(s0, *m0)]
+        results = [None] * len(reqs)
+        errors = []
+
+        def run(i, srv, msg):
+            try:
+                results[i] = self._request_on(srv, *msg)
+            except Exception as e:  # propagate after join
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i, srv, msg),
+                                    daemon=True)
+                   for i, (srv, msg) in enumerate(reqs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return results
+
     # -- key -> server sharding (reference kvstore_dist.h:532-584) ---------------
 
     def _partition(self, key: str, size: int):
@@ -531,9 +559,10 @@ class KVStoreDist(KVStore):
         values = _as_list(value)
         for k, v in zip(keys, values):
             arr = v.asnumpy()
-            for s, lo, hi in self._partition(str(k), arr.size):
-                part = arr if lo is None else arr.reshape(-1)[lo:hi]
-                self._request_on(s, "init", str(k), part)
+            self._request_many([
+                (s, ("init", str(k),
+                     arr if lo is None else arr.reshape(-1)[lo:hi]))
+                for s, lo, hi in self._partition(str(k), arr.size)])
             self._pull_version[str(k)] = 0
         self.barrier()
 
@@ -565,9 +594,10 @@ class KVStoreDist(KVStore):
                     self._request_on(s, "push_c", str(k), self._rank,
                                      _np.asarray(packed), tuple(part.shape))
             else:
-                for s, lo, hi in self._partition(str(k), local.size):
-                    part = local if lo is None else local.reshape(-1)[lo:hi]
-                    self._request_on(s, "push", str(k), self._rank, part)
+                self._request_many([
+                    (s, ("push", str(k), self._rank,
+                         local if lo is None else local.reshape(-1)[lo:hi]))
+                    for s, lo, hi in self._partition(str(k), local.size)])
             if self._sync:
                 self._pull_version[str(k)] = \
                     self._pull_version.get(str(k), 0) + 1
@@ -584,12 +614,11 @@ class KVStoreDist(KVStore):
                 arr = self._request_on(parts[0][0], "pull", str(k),
                                        min_version)[1]
             else:
-                flat = _np.empty(dsts[0].size, dtype=_np.float32)
-                for s, lo, hi in parts:
-                    piece = self._request_on(s, "pull", str(k),
-                                             min_version)[1]
-                    flat = flat.astype(piece.dtype) if flat.dtype != piece.dtype else flat
-                    flat[lo:hi] = piece
+                reps = self._request_many([
+                    (s, ("pull", str(k), min_version)) for s, _, _ in parts])
+                flat = _np.empty(dsts[0].size, dtype=reps[0][1].dtype)
+                for (s, lo, hi), rep in zip(parts, reps):
+                    flat[lo:hi] = rep[1]
                 arr = flat.reshape(dsts[0].shape)
             for dst in dsts:
                 dst[:] = nd_array(arr)
@@ -611,13 +640,11 @@ class KVStoreDist(KVStore):
             else:
                 # sliced key: rows may straddle server boundaries, so
                 # reassemble the flat value and gather the requested rows
-                flat = None
-                for s, lo, hi in parts:
-                    piece = self._request_on(s, "pull", str(k),
-                                             min_version)[1]
-                    if flat is None:
-                        flat = _np.empty(dsts[0].size, dtype=piece.dtype)
-                    flat[lo:hi] = piece
+                reps = self._request_many([
+                    (s, ("pull", str(k), min_version)) for s, _, _ in parts])
+                flat = _np.empty(dsts[0].size, dtype=reps[0][1].dtype)
+                for (s, lo, hi), rep in zip(parts, reps):
+                    flat[lo:hi] = rep[1]
                 rows = flat.reshape(dsts[0].shape)[rid_np]
             for dst in dsts:
                 # local-kvstore semantics: full-shape out, requested rows

@@ -92,6 +92,23 @@ def main():
     dt = timed(jstep, params, momenta, x)
     results["full_step_bf16"] = bs / dt
 
+    # 6. K steps fused in one device program (lax.fori_loop): isolates
+    # per-execution dispatch/tunnel overhead from device compute
+    K = 8
+
+    def multi(p, m, xx):
+        def body(_, carry):
+            pp, mm = carry
+            _, pp, mm = step(pp, mm, xx)
+            return pp, mm
+
+        p, m = jax.lax.fori_loop(0, K, body, (p, m))
+        return p
+
+    jmulti = jax.jit(multi)
+    dt = timed(jmulti, params, momenta, x, steps=4)
+    results["fused_%d_steps" % K] = bs * K / dt
+
     # cost analysis of the full step
     comp = jstep.lower(params, momenta, x).compile()
     ca = comp.cost_analysis()
