@@ -43,9 +43,13 @@ def e2e_throughput(batch_size: int, batches: int = 30, warmup: int = 5):
     from symbols import resnet as resnet_sym
 
     num_examples = batch_size * (batches + warmup + 2)
+    # dataset dir is sized-keyed: a stale smaller .rec from a previous run
+    # would silently starve the measurement (get_rec_iter only synthesizes
+    # when the file is absent)
     args = argparse.Namespace(
         data_train=None, data_val=None,
-        data_dir=os.path.join(tempfile.gettempdir(), "bench_e2e_data"),
+        data_dir=os.path.join(tempfile.gettempdir(),
+                              f"bench_e2e_data_{num_examples}"),
         image_shape="3,224,224", num_classes=100, resize=256,
         data_nthreads=int(os.environ.get("BENCH_E2E_NTHREADS", "8")),
         rgb_mean="123.68,116.779,103.939", rgb_std="1,1,1",

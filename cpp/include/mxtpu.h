@@ -126,6 +126,51 @@ void mxtpu_pool_free(void *ptr, size_t size);
 void mxtpu_pool_stats(uint64_t out[4]);
 void mxtpu_pool_clear(void);
 
+/* --------------------------------------------------------------- ndarray */
+
+/* Host-side dense tensor: the bindings' data currency (reference:
+ * c_api.h MXNDArray*). dtype is a numpy dtype name ("float32", "uint8"...).
+ * Serialization is wire-compatible with the Python frontend's nd.save/load
+ * (TPMX0001 format), so C programs exchange checkpoints with Python. */
+int mxtpu_nd_create(const char *dtype, const uint64_t *shape, int ndim,
+                    void **out_handle);
+void mxtpu_nd_free(void *handle);
+int mxtpu_nd_ndim(void *handle);
+void mxtpu_nd_shape(void *handle, uint64_t *out_shape);
+const char *mxtpu_nd_dtype(void *handle);
+uint64_t mxtpu_nd_size(void *handle);
+void *mxtpu_nd_data(void *handle);
+uint64_t mxtpu_nd_nbytes(void *handle);
+int mxtpu_nd_copy_from(void *handle, const void *src, uint64_t nbytes);
+
+/* Save n arrays; keys == NULL writes a list file, else a dict file. */
+int mxtpu_nd_save(const char *path, void *const *handles,
+                  const char *const *keys, int n);
+/* Load a file into an opaque list; inspect with _list_get (borrowed) or
+ * detach with _list_take (owned, free with mxtpu_nd_free). */
+int mxtpu_nd_load(const char *path, void **out_list, int *out_count);
+void *mxtpu_nd_list_get(void *list_handle, int i, const char **out_key);
+void *mxtpu_nd_list_take(void *list_handle, int i);
+void mxtpu_nd_list_free(void *list_handle);
+
+/* ---------------------------------------------------------------- symbol */
+
+/* Graph inspection over the framework's symbol JSON (reference: c_api.h
+ * MXSymbolCreateFromFile/ListArguments/ListOutputs/SaveToJSON).  Handles
+ * are read-only views; execution belongs to the Python/XLA layer. */
+int mxtpu_sym_load_json(const char *json, void **out_handle);
+int mxtpu_sym_load_file(const char *path, void **out_handle);
+void mxtpu_sym_free(void *handle);
+int mxtpu_sym_num_args(void *handle);
+const char *mxtpu_sym_arg_name(void *handle, int i);
+int mxtpu_sym_num_outputs(void *handle);
+const char *mxtpu_sym_output_name(void *handle, int i);
+int mxtpu_sym_num_nodes(void *handle);
+const char *mxtpu_sym_node_op(void *handle, int i);
+const char *mxtpu_sym_node_name(void *handle, int i);
+const char *mxtpu_sym_to_json(void *handle);
+int mxtpu_sym_save_file(void *handle, const char *path);
+
 /* ----------------------------------------------------------------- misc */
 
 const char *mxtpu_last_error(void);

@@ -1,0 +1,251 @@
+// Native dense NDArray + wire-compatible save/load.
+//
+// Reference: the NDArray C API surface (include/mxnet/c_api.h MXNDArray*)
+// and the magic-numbered NDArray serialization (src/ndarray/ndarray.cc
+// Save/Load).  TPU-native position: device tensors are JAX buffers; this
+// native tensor is the *host* currency for bindings and IO — a typed dense
+// buffer with shape that round-trips the exact file format the Python
+// frontend writes (mxnet_tpu/ndarray/__init__.py TPMX0001), so C programs
+// and other language bindings can exchange checkpoints with Python.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "../include/mxtpu.h"
+
+namespace {
+
+struct NDArray {
+  std::string dtype;              // numpy dtype name ("float32", ...)
+  std::vector<uint64_t> shape;
+  std::vector<uint8_t> data;
+};
+
+size_t DtypeSize(const std::string &dt) {
+  if (dt == "float64" || dt == "int64" || dt == "uint64") return 8;
+  if (dt == "float32" || dt == "int32" || dt == "uint32") return 4;
+  if (dt == "float16" || dt == "bfloat16" || dt == "int16" ||
+      dt == "uint16")
+    return 2;
+  if (dt == "int8" || dt == "uint8" || dt == "bool") return 1;
+  return 0;
+}
+
+uint64_t NumElems(const std::vector<uint64_t> &shape) {
+  uint64_t n = 1;
+  for (uint64_t s : shape) n *= s;
+  return n;
+}
+
+constexpr char kMagic[] = "TPMX0001";
+
+bool ReadExact(FILE *f, void *dst, size_t n) {
+  return std::fread(dst, 1, n, f) == n;
+}
+
+struct NDList {
+  char kind;  // 'S' | 'L' | 'D'
+  std::vector<std::string> keys;
+  std::vector<NDArray *> arrays;
+  ~NDList() {
+    for (NDArray *a : arrays) delete a;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+int mxtpu_nd_create(const char *dtype, const uint64_t *shape, int ndim,
+                    void **out_handle) {
+  size_t esz = DtypeSize(dtype ? dtype : "");
+  if (esz == 0) {
+    mxtpu::SetError(std::string("unsupported dtype: ") +
+                    (dtype ? dtype : "(null)"));
+    return 1;
+  }
+  auto *a = new NDArray();
+  a->dtype = dtype;
+  a->shape.assign(shape, shape + ndim);
+  a->data.resize(NumElems(a->shape) * esz);
+  *out_handle = a;
+  return 0;
+}
+
+void mxtpu_nd_free(void *handle) { delete static_cast<NDArray *>(handle); }
+
+int mxtpu_nd_ndim(void *handle) {
+  return static_cast<int>(static_cast<NDArray *>(handle)->shape.size());
+}
+
+void mxtpu_nd_shape(void *handle, uint64_t *out_shape) {
+  auto *a = static_cast<NDArray *>(handle);
+  std::memcpy(out_shape, a->shape.data(),
+              a->shape.size() * sizeof(uint64_t));
+}
+
+const char *mxtpu_nd_dtype(void *handle) {
+  return static_cast<NDArray *>(handle)->dtype.c_str();
+}
+
+uint64_t mxtpu_nd_size(void *handle) {
+  return NumElems(static_cast<NDArray *>(handle)->shape);
+}
+
+void *mxtpu_nd_data(void *handle) {
+  return static_cast<NDArray *>(handle)->data.data();
+}
+
+uint64_t mxtpu_nd_nbytes(void *handle) {
+  return static_cast<NDArray *>(handle)->data.size();
+}
+
+int mxtpu_nd_copy_from(void *handle, const void *src, uint64_t nbytes) {
+  auto *a = static_cast<NDArray *>(handle);
+  if (nbytes != a->data.size()) {
+    mxtpu::SetError("copy_from: size mismatch");
+    return 1;
+  }
+  std::memcpy(a->data.data(), src, nbytes);
+  return 0;
+}
+
+// ---- serialization (wire-compatible with Python nd.save/nd.load) ----------
+
+int mxtpu_nd_save(const char *path, void *const *handles,
+                  const char *const *keys, int n) {
+  FILE *f = std::fopen(path, "wb");
+  if (!f) {
+    mxtpu::SetError(std::string("cannot open for write: ") + path);
+    return 1;
+  }
+  bool ok = true;
+  auto put = [&](const void *src, size_t sz) {
+    ok = ok && std::fwrite(src, 1, sz, f) == sz;
+  };
+  char kind = keys ? 'D' : 'L';
+  put(kMagic, 8);
+  put(&kind, 1);
+  uint64_t count = static_cast<uint64_t>(n);
+  put(&count, 8);
+  for (int i = 0; ok && i < n; ++i) {
+    auto *a = static_cast<NDArray *>(handles[i]);
+    std::string key = keys ? keys[i] : "";
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    put(&klen, 4);
+    put(key.data(), klen);
+    uint32_t dlen = static_cast<uint32_t>(a->dtype.size());
+    put(&dlen, 4);
+    put(a->dtype.data(), dlen);
+    uint32_t ndim = static_cast<uint32_t>(a->shape.size());
+    put(&ndim, 4);
+    for (uint64_t s : a->shape) put(&s, 8);
+    uint64_t nbytes = a->data.size();
+    put(&nbytes, 8);
+    put(a->data.data(), nbytes);
+  }
+  ok = (std::fclose(f) == 0) && ok;
+  if (!ok) {
+    mxtpu::SetError(std::string("short write (disk full?): ") + path);
+    return 1;
+  }
+  return 0;
+}
+
+int mxtpu_nd_load(const char *path, void **out_list, int *out_count) try {
+  FILE *f = std::fopen(path, "rb");
+  if (!f) {
+    mxtpu::SetError(std::string("cannot open: ") + path);
+    return 1;
+  }
+  // size-fields in the file are untrusted: everything must fit in what
+  // remains of the file, checked before any allocation
+  std::fseek(f, 0, SEEK_END);
+  long file_size_l = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  const uint64_t file_size =
+      file_size_l < 0 ? 0 : static_cast<uint64_t>(file_size_l);
+  char magic[8];
+  char kind;
+  uint64_t count = 0;
+  if (!ReadExact(f, magic, 8) || std::memcmp(magic, kMagic, 8) != 0 ||
+      !ReadExact(f, &kind, 1) || !ReadExact(f, &count, 8)) {
+    std::fclose(f);
+    mxtpu::SetError(std::string(path) + ": not a tpu-mx NDArray file");
+    return 1;
+  }
+  auto *list = new NDList();
+  list->kind = kind;
+  if (count > file_size) {  // each entry needs >= 1 byte
+    delete list;
+    std::fclose(f);
+    mxtpu::SetError(std::string(path) + ": corrupt count field");
+    return 1;
+  }
+  for (uint64_t i = 0; i < count; ++i) {
+    uint32_t klen = 0, dlen = 0, ndim = 0;
+    if (!ReadExact(f, &klen, 4) || klen > file_size) goto corrupt;
+    {
+      std::string key(klen, '\0');
+      if (klen && !ReadExact(f, &key[0], klen)) goto corrupt;
+      auto *a = new NDArray();
+      if (!ReadExact(f, &dlen, 4) || dlen > file_size) { delete a; goto corrupt; }
+      a->dtype.resize(dlen);
+      if (dlen && !ReadExact(f, &a->dtype[0], dlen)) { delete a; goto corrupt; }
+      if (!ReadExact(f, &ndim, 4) || ndim > file_size / 8) { delete a; goto corrupt; }
+      a->shape.resize(ndim);
+      for (uint32_t d = 0; d < ndim; ++d)
+        if (!ReadExact(f, &a->shape[d], 8)) { delete a; goto corrupt; }
+      uint64_t nbytes = 0;
+      if (!ReadExact(f, &nbytes, 8) || nbytes > file_size) {
+        delete a;
+        goto corrupt;
+      }
+      a->data.resize(nbytes);
+      if (nbytes && !ReadExact(f, a->data.data(), nbytes)) {
+        delete a;
+        goto corrupt;
+      }
+      list->keys.push_back(std::move(key));
+      list->arrays.push_back(a);
+    }
+  }
+  std::fclose(f);
+  *out_list = list;
+  *out_count = static_cast<int>(count);
+  return 0;
+corrupt:
+  std::fclose(f);
+  delete list;
+  mxtpu::SetError(std::string(path) + ": truncated NDArray file");
+  return 1;
+} catch (const std::exception &e) {
+  mxtpu::SetError(std::string("nd_load: ") + e.what());
+  return 1;
+}
+
+void *mxtpu_nd_list_get(void *list_handle, int i, const char **out_key) {
+  auto *list = static_cast<NDList *>(list_handle);
+  if (i < 0 || i >= static_cast<int>(list->arrays.size())) return nullptr;
+  if (out_key) *out_key = list->keys[i].c_str();
+  return list->arrays[i];
+}
+
+// Detach array i from the list (caller owns it; list slot becomes NULL).
+void *mxtpu_nd_list_take(void *list_handle, int i) {
+  auto *list = static_cast<NDList *>(list_handle);
+  if (i < 0 || i >= static_cast<int>(list->arrays.size())) return nullptr;
+  NDArray *a = list->arrays[i];
+  list->arrays[i] = nullptr;
+  return a;
+}
+
+void mxtpu_nd_list_free(void *list_handle) {
+  delete static_cast<NDList *>(list_handle);
+}
+
+}  // extern "C"

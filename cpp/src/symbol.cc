@@ -1,0 +1,315 @@
+// Native symbol handle over the framework's symbol-JSON format.
+//
+// Reference: the symbol half of the C API (include/mxnet/c_api.h
+// MXSymbolCreateFromFile/ListArguments/ListOutputs/SaveToJSON...).  The
+// TPU build's graph IR *is* JSON (mxnet_tpu/symbol/symbol.py tojson), so
+// the native surface is a small JSON reader exposing the graph structure —
+// enough for bindings to load, inspect, and re-save models without Python.
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "../include/mxtpu.h"
+
+namespace {
+
+// ---- minimal JSON ---------------------------------------------------------
+
+struct JValue;
+using JPtr = std::shared_ptr<JValue>;
+
+struct JValue {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<JPtr> arr;
+  std::vector<std::pair<std::string, JPtr>> obj;
+
+  const JValue *Get(const std::string &key) const {
+    for (const auto &kv : obj)
+      if (kv.first == key) return kv.second.get();
+    return nullptr;
+  }
+};
+
+struct Parser {
+  const char *p, *end;
+  bool fail = false;
+
+  explicit Parser(const std::string &s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void Skip() {
+    while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
+  }
+
+  JPtr Parse() {
+    Skip();
+    if (p >= end) return Err();
+    char c = *p;
+    if (c == '{') return Obj();
+    if (c == '[') return Arr();
+    if (c == '"') return Str();
+    if (c == 't' || c == 'f') return Bool();
+    if (c == 'n') { p += 4; auto v = std::make_shared<JValue>(); return v; }
+    return Num();
+  }
+
+  JPtr Err() {
+    fail = true;
+    return std::make_shared<JValue>();
+  }
+
+  JPtr Obj() {
+    auto v = std::make_shared<JValue>();
+    v->kind = JValue::kObj;
+    ++p;  // {
+    Skip();
+    if (p < end && *p == '}') { ++p; return v; }
+    while (p < end) {
+      Skip();
+      JPtr key = Str();
+      Skip();
+      if (p >= end || *p != ':') return Err();
+      ++p;
+      JPtr val = Parse();
+      v->obj.emplace_back(key->str, val);
+      Skip();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; return v; }
+      return Err();
+    }
+    return Err();
+  }
+
+  JPtr Arr() {
+    auto v = std::make_shared<JValue>();
+    v->kind = JValue::kArr;
+    ++p;  // [
+    Skip();
+    if (p < end && *p == ']') { ++p; return v; }
+    while (p < end) {
+      v->arr.push_back(Parse());
+      Skip();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; return v; }
+      return Err();
+    }
+    return Err();
+  }
+
+  JPtr Str() {
+    auto v = std::make_shared<JValue>();
+    v->kind = JValue::kStr;
+    if (p >= end || *p != '"') return Err();
+    ++p;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': v->str += '\n'; break;
+          case 't': v->str += '\t'; break;
+          case 'r': v->str += '\r'; break;
+          case 'b': v->str += '\b'; break;
+          case 'f': v->str += '\f'; break;
+          case 'u': {
+            if (p + 4 < end) {
+              unsigned code = std::strtoul(std::string(p + 1, p + 5).c_str(),
+                                           nullptr, 16);
+              if (code < 0x80) v->str += static_cast<char>(code);
+              else v->str += '?';  // structural use only
+              p += 4;
+            }
+            break;
+          }
+          default: v->str += *p;
+        }
+      } else {
+        v->str += *p;
+      }
+      ++p;
+    }
+    if (p < end) ++p;  // closing quote
+    return v;
+  }
+
+  JPtr Bool() {
+    auto v = std::make_shared<JValue>();
+    v->kind = JValue::kBool;
+    if (*p == 't') { v->b = true; p += 4; } else { p += 5; }
+    return v;
+  }
+
+  JPtr Num() {
+    auto v = std::make_shared<JValue>();
+    v->kind = JValue::kNum;
+    char *np = nullptr;
+    v->num = std::strtod(p, &np);
+    if (np == p) return Err();
+    p = np;
+    return v;
+  }
+};
+
+// ---- symbol view ----------------------------------------------------------
+
+struct Symbol {
+  std::string json;
+  JPtr root;
+  std::vector<std::string> args;      // var-node names (order of appearance)
+  std::vector<std::string> outputs;   // head names
+  std::vector<std::string> ops;       // per-node op name ("null" for vars)
+  std::vector<std::string> names;     // per-node name
+};
+
+}  // namespace
+
+extern "C" {
+
+int mxtpu_sym_load_json(const char *json, void **out_handle) {
+  const std::string text(json);  // must outlive the parser's raw pointers
+  Parser parser{text};
+  JPtr root = parser.Parse();
+  if (parser.fail || root->kind != JValue::kObj) {
+    mxtpu::SetError("symbol: invalid JSON");
+    return 1;
+  }
+  const JValue *nodes = root->Get("nodes");
+  const JValue *heads = root->Get("heads");
+  if (!nodes || nodes->kind != JValue::kArr || !heads) {
+    mxtpu::SetError("symbol: missing nodes/heads (not a symbol file?)");
+    return 1;
+  }
+  auto *sym = new Symbol();
+  sym->json = json;
+  sym->root = root;
+  for (const auto &n : nodes->arr) {
+    const JValue *op = n->Get("op");
+    const JValue *name = n->Get("name");
+    if (!op || !name) continue;
+    sym->ops.push_back(op->str);
+    sym->names.push_back(name->str);
+    if (op->str == "null") {
+      const JValue *ad = n->Get("attr_dict");
+      bool is_aux = ad && ad->Get("__is_aux__") != nullptr;
+      if (!is_aux) sym->args.push_back(name->str);
+    }
+  }
+  // output naming parity with Python list_outputs (symbol.py): op heads
+  // get a "_output" suffix ("_output<k>" when the node has several used
+  // outputs); var heads keep the bare name
+  std::map<int, int> head_max_idx;
+  for (const auto &h : heads->arr)
+    if (h->kind == JValue::kArr && h->arr.size() >= 2) {
+      int nid = static_cast<int>(h->arr[0]->num);
+      int oidx = static_cast<int>(h->arr[1]->num);
+      auto it = head_max_idx.find(nid);
+      if (it == head_max_idx.end() || oidx > it->second)
+        head_max_idx[nid] = oidx;
+    }
+  for (const auto &h : heads->arr) {
+    if (h->kind == JValue::kArr && !h->arr.empty()) {
+      int idx = static_cast<int>(h->arr[0]->num);
+      int oidx = h->arr.size() >= 2 ? static_cast<int>(h->arr[1]->num) : 0;
+      if (idx >= 0 && idx < static_cast<int>(sym->names.size())) {
+        std::string name = sym->names[idx];
+        if (sym->ops[idx] != "null") {
+          bool multi = head_max_idx[idx] > 0;
+          name += multi ? "_output" + std::to_string(oidx) : "_output";
+        }
+        sym->outputs.push_back(name);
+      }
+    }
+  }
+  *out_handle = sym;
+  return 0;
+}
+
+int mxtpu_sym_load_file(const char *path, void **out_handle) {
+  FILE *f = std::fopen(path, "rb");
+  if (!f) {
+    mxtpu::SetError(std::string("cannot open: ") + path);
+    return 1;
+  }
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  if (n < 0) {  // non-seekable (FIFO) or ftell failure
+    std::fclose(f);
+    mxtpu::SetError(std::string("cannot size (non-seekable?): ") + path);
+    return 1;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf(static_cast<size_t>(n), '\0');
+  size_t got = std::fread(&buf[0], 1, static_cast<size_t>(n), f);
+  std::fclose(f);
+  buf.resize(got);
+  return mxtpu_sym_load_json(buf.c_str(), out_handle);
+}
+
+void mxtpu_sym_free(void *handle) { delete static_cast<Symbol *>(handle); }
+
+int mxtpu_sym_num_args(void *handle) {
+  return static_cast<int>(static_cast<Symbol *>(handle)->args.size());
+}
+
+const char *mxtpu_sym_arg_name(void *handle, int i) {
+  auto *s = static_cast<Symbol *>(handle);
+  if (i < 0 || i >= static_cast<int>(s->args.size())) return nullptr;
+  return s->args[i].c_str();
+}
+
+int mxtpu_sym_num_outputs(void *handle) {
+  return static_cast<int>(static_cast<Symbol *>(handle)->outputs.size());
+}
+
+const char *mxtpu_sym_output_name(void *handle, int i) {
+  auto *s = static_cast<Symbol *>(handle);
+  if (i < 0 || i >= static_cast<int>(s->outputs.size())) return nullptr;
+  return s->outputs[i].c_str();
+}
+
+int mxtpu_sym_num_nodes(void *handle) {
+  return static_cast<int>(static_cast<Symbol *>(handle)->names.size());
+}
+
+const char *mxtpu_sym_node_op(void *handle, int i) {
+  auto *s = static_cast<Symbol *>(handle);
+  if (i < 0 || i >= static_cast<int>(s->ops.size())) return nullptr;
+  return s->ops[i].c_str();
+}
+
+const char *mxtpu_sym_node_name(void *handle, int i) {
+  auto *s = static_cast<Symbol *>(handle);
+  if (i < 0 || i >= static_cast<int>(s->names.size())) return nullptr;
+  return s->names[i].c_str();
+}
+
+const char *mxtpu_sym_to_json(void *handle) {
+  return static_cast<Symbol *>(handle)->json.c_str();
+}
+
+int mxtpu_sym_save_file(void *handle, const char *path) {
+  auto *s = static_cast<Symbol *>(handle);
+  FILE *f = std::fopen(path, "wb");
+  if (!f) {
+    mxtpu::SetError(std::string("cannot open for write: ") + path);
+    return 1;
+  }
+  bool ok = std::fwrite(s->json.data(), 1, s->json.size(), f)
+      == s->json.size();
+  ok = (std::fclose(f) == 0) && ok;
+  if (!ok) {
+    mxtpu::SetError(std::string("short write (disk full?): ") + path);
+    return 1;
+  }
+  return 0;
+}
+
+}  // extern "C"

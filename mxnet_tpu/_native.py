@@ -83,6 +83,48 @@ def _declare(lib):
     lib.mxtpu_pool_alloc.restype = p
     lib.mxtpu_pool_free.argtypes = [p, ctypes.c_size_t]
     lib.mxtpu_pool_stats.argtypes = [ctypes.POINTER(u64)]
+    lib.mxtpu_nd_create.argtypes = [ctypes.c_char_p, ctypes.POINTER(u64),
+                                    ctypes.c_int, ctypes.POINTER(p)]
+    lib.mxtpu_nd_free.argtypes = [p]
+    lib.mxtpu_nd_ndim.argtypes = [p]
+    lib.mxtpu_nd_shape.argtypes = [p, ctypes.POINTER(u64)]
+    lib.mxtpu_nd_dtype.argtypes = [p]
+    lib.mxtpu_nd_dtype.restype = ctypes.c_char_p
+    lib.mxtpu_nd_size.argtypes = [p]
+    lib.mxtpu_nd_size.restype = u64
+    lib.mxtpu_nd_data.argtypes = [p]
+    lib.mxtpu_nd_data.restype = p
+    lib.mxtpu_nd_nbytes.argtypes = [p]
+    lib.mxtpu_nd_nbytes.restype = u64
+    lib.mxtpu_nd_copy_from.argtypes = [p, p, u64]
+    lib.mxtpu_nd_save.argtypes = [ctypes.c_char_p, ctypes.POINTER(p),
+                                  ctypes.POINTER(ctypes.c_char_p),
+                                  ctypes.c_int]
+    lib.mxtpu_nd_load.argtypes = [ctypes.c_char_p, ctypes.POINTER(p),
+                                  ctypes.POINTER(ctypes.c_int)]
+    lib.mxtpu_nd_list_get.argtypes = [p, ctypes.c_int,
+                                      ctypes.POINTER(ctypes.c_char_p)]
+    lib.mxtpu_nd_list_get.restype = p
+    lib.mxtpu_nd_list_take.argtypes = [p, ctypes.c_int]
+    lib.mxtpu_nd_list_take.restype = p
+    lib.mxtpu_nd_list_free.argtypes = [p]
+    lib.mxtpu_sym_load_json.argtypes = [ctypes.c_char_p, ctypes.POINTER(p)]
+    lib.mxtpu_sym_load_file.argtypes = [ctypes.c_char_p, ctypes.POINTER(p)]
+    lib.mxtpu_sym_free.argtypes = [p]
+    lib.mxtpu_sym_num_args.argtypes = [p]
+    lib.mxtpu_sym_arg_name.argtypes = [p, ctypes.c_int]
+    lib.mxtpu_sym_arg_name.restype = ctypes.c_char_p
+    lib.mxtpu_sym_num_outputs.argtypes = [p]
+    lib.mxtpu_sym_output_name.argtypes = [p, ctypes.c_int]
+    lib.mxtpu_sym_output_name.restype = ctypes.c_char_p
+    lib.mxtpu_sym_num_nodes.argtypes = [p]
+    lib.mxtpu_sym_node_op.argtypes = [p, ctypes.c_int]
+    lib.mxtpu_sym_node_op.restype = ctypes.c_char_p
+    lib.mxtpu_sym_node_name.argtypes = [p, ctypes.c_int]
+    lib.mxtpu_sym_node_name.restype = ctypes.c_char_p
+    lib.mxtpu_sym_to_json.argtypes = [p]
+    lib.mxtpu_sym_to_json.restype = ctypes.c_char_p
+    lib.mxtpu_sym_save_file.argtypes = [p, ctypes.c_char_p]
     lib.mxtpu_last_error.restype = ctypes.c_char_p
     lib.mxtpu_version.restype = ctypes.c_char_p
     return lib

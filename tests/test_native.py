@@ -249,3 +249,91 @@ def test_pool_stats_reuse():
     lib.mxtpu_pool_free(p2, 10000)
     after = _native.pool_stats()
     assert after["reused_bytes"] > before["reused_bytes"]
+
+
+def test_c_api_ndarray_wire_compat(tmp_path):
+    """C-API NDArray save is byte-compatible with Python nd.load and vice
+    versa (reference: c_api.h MXNDArraySave/Load over the magic-numbered
+    format, src/ndarray/ndarray.cc)."""
+    import ctypes
+
+    from mxnet_tpu import _native, nd
+
+    lib = _native.lib()
+    if lib is None:
+        pytest.skip("native runtime unavailable")
+    # C writes -> Python reads
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint64 * 2)(3, 4)
+    assert lib.mxtpu_nd_create(b"float32", shape, 2, ctypes.byref(h)) == 0
+    vals = np.arange(12, dtype=np.float32).reshape(3, 4)
+    buf = vals.tobytes()
+    assert lib.mxtpu_nd_copy_from(h, buf, len(buf)) == 0
+    path = str(tmp_path / "c.params")
+    handles = (ctypes.c_void_p * 1)(h)
+    keys = (ctypes.c_char_p * 1)(b"w")
+    assert lib.mxtpu_nd_save(path.encode(), handles, keys, 1) == 0
+    lib.mxtpu_nd_free(h)
+    loaded = nd.load(path)
+    assert set(loaded) == {"w"}
+    assert np.allclose(loaded["w"].asnumpy(), vals)
+
+    # Python writes -> C reads
+    path2 = str(tmp_path / "py.params")
+    nd.save(path2, {"a": nd.array(vals), "b": nd.array(vals.T + 1)})
+    lst = ctypes.c_void_p()
+    cnt = ctypes.c_int()
+    assert lib.mxtpu_nd_load(path2.encode(), ctypes.byref(lst),
+                             ctypes.byref(cnt)) == 0
+    assert cnt.value == 2
+    key = ctypes.c_char_p()
+    got = {}
+    for i in range(2):
+        ah = lib.mxtpu_nd_list_get(lst, i, ctypes.byref(key))
+        n = lib.mxtpu_nd_size(ah)
+        ptr = lib.mxtpu_nd_data(ah)
+        arr = np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_float)), (n,)).copy()
+        ndim = lib.mxtpu_nd_ndim(ah)
+        shp = (ctypes.c_uint64 * ndim)()
+        lib.mxtpu_nd_shape(ah, shp)
+        got[key.value.decode()] = arr.reshape(tuple(shp))
+    lib.mxtpu_nd_list_free(lst)
+    assert np.allclose(got["a"], vals)
+    assert np.allclose(got["b"], vals.T + 1)
+
+
+def test_c_api_symbol_inspection(tmp_path):
+    """C-API symbol load/inspect over the framework's symbol JSON
+    (reference: c_api.h MXSymbolCreateFromFile/ListArguments/ListOutputs)."""
+    import ctypes
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import _native
+
+    lib = _native.lib()
+    if lib is None:
+        pytest.skip("native runtime unavailable")
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    path = str(tmp_path / "sym.json")
+    out.save(path)
+
+    h = ctypes.c_void_p()
+    assert lib.mxtpu_sym_load_file(path.encode(), ctypes.byref(h)) == 0
+    args = [lib.mxtpu_sym_arg_name(h, i).decode()
+            for i in range(lib.mxtpu_sym_num_args(h))]
+    assert args == out.list_arguments(), args
+    outs = [lib.mxtpu_sym_output_name(h, i).decode()
+            for i in range(lib.mxtpu_sym_num_outputs(h))]
+    assert outs == out.list_outputs() == ["softmax_output"]
+    ops = [lib.mxtpu_sym_node_op(h, i).decode()
+           for i in range(lib.mxtpu_sym_num_nodes(h))]
+    assert "FullyConnected" in ops and "SoftmaxOutput" in ops
+    # save back and reload through Python
+    path2 = str(tmp_path / "sym2.json")
+    assert lib.mxtpu_sym_save_file(h, path2.encode()) == 0
+    lib.mxtpu_sym_free(h)
+    again = mx.sym.load(path2)
+    assert again.list_arguments() == out.list_arguments()
