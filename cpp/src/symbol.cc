@@ -193,7 +193,12 @@ int mxtpu_sym_load_json(const char *json, void **out_handle) {
   for (const auto &n : nodes->arr) {
     const JValue *op = n->Get("op");
     const JValue *name = n->Get("name");
-    if (!op || !name) continue;
+    if (!op || !name) {
+      // heads index nodes by position: keep the slot so ids stay aligned
+      sym->ops.push_back("");
+      sym->names.push_back("");
+      continue;
+    }
     sym->ops.push_back(op->str);
     sym->names.push_back(name->str);
     if (op->str == "null") {

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Fast Gradient Sign Method adversarial examples (reference:
+example/adversary/adversary_generation.ipynb).
+
+Trains an MLP on synthetic MNIST, then perturbs inputs along the sign of
+the input gradient and reports the accuracy drop."""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def main(args):
+    it = mx.io.MNISTIter(image=None, batch_size=args.batch_size, flat=True)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(10))
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    for epoch in range(args.epochs):
+        it.reset()
+        for batch in it:
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(x.shape[0])
+
+    def accuracy(perturb=None):
+        it.reset()
+        correct = total = 0
+        for batch in it:
+            x, y = batch.data[0], batch.label[0]
+            if perturb is not None:
+                x = perturb(x, y)
+            pred = net(x).argmax(axis=1).asnumpy()
+            correct += int((pred == y.asnumpy()).sum())
+            total += x.shape[0]
+        return correct / total
+
+    def fgsm(x, y, eps=args.epsilon):
+        x = x.copy()
+        x.attach_grad()
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        return nd.clip(x + eps * nd.sign(x.grad), 0.0, 1.0)
+
+    clean = accuracy()
+    adv = accuracy(fgsm)
+    print(f"clean accuracy: {clean:.4f} | FGSM(eps={args.epsilon}) "
+          f"accuracy: {adv:.4f}")
+    assert clean > 0.9 and adv < clean, "attack should reduce accuracy"
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--epsilon", type=float, default=0.15)
+    main(p.parse_args())

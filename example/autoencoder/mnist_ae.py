@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Stacked autoencoder on (synthetic) MNIST (reference:
+example/autoencoder/ — encoder/decoder with reconstruction loss)."""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def main(args):
+    it = mx.io.MNISTIter(image=None, batch_size=args.batch_size, flat=True)
+    enc = gluon.nn.HybridSequential()
+    enc.add(gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(args.latent, activation="relu"))
+    dec = gluon.nn.HybridSequential()
+    dec.add(gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(784, activation="sigmoid"))
+    net = gluon.nn.HybridSequential()
+    net.add(enc, dec)
+    net.initialize()
+    l2 = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    first = last = None
+    for epoch in range(args.epochs):
+        it.reset()
+        total = n = 0.0
+        for batch in it:
+            x = batch.data[0]
+            with autograd.record():
+                loss = l2(net(x), x)
+            loss.backward()
+            trainer.step(x.shape[0])
+            total += float(loss.mean().asnumpy())
+            n += 1
+        avg = total / n
+        if first is None:
+            first = avg
+        last = avg
+        print(f"epoch {epoch}: reconstruction loss {avg:.5f}")
+    assert last < first, "reconstruction loss must decrease"
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--latent", type=int, default=32)
+    main(p.parse_args())

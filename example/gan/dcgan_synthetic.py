@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""GAN training loop (reference: example/gluon/dc_gan.py) on a synthetic
+2-D data distribution so the adversarial dynamics run without a dataset."""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def main(args):
+    rs = np.random.RandomState(0)
+    # real data: ring of gaussians
+    theta = rs.rand(args.n_real) * 2 * np.pi
+    real = np.stack([np.cos(theta), np.sin(theta)], 1).astype(np.float32)
+    real += rs.randn(args.n_real, 2).astype(np.float32) * 0.05
+
+    G = gluon.nn.HybridSequential()
+    G.add(gluon.nn.Dense(32, activation="relu"),
+          gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(2))
+    D = gluon.nn.HybridSequential()
+    D.add(gluon.nn.Dense(32, activation="relu"),
+          gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(1))
+    G.initialize()
+    D.initialize()
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    gt = gluon.Trainer(G.collect_params(), "adam", {"learning_rate": 2e-3})
+    dt = gluon.Trainer(D.collect_params(), "adam", {"learning_rate": 2e-3})
+    ones = nd.ones((args.batch_size,))
+    zeros = nd.zeros((args.batch_size,))
+    for step in range(args.steps):
+        idx = rs.randint(0, args.n_real, args.batch_size)
+        xb = nd.array(real[idx])
+        z = nd.array(rs.randn(args.batch_size, args.latent)
+                     .astype(np.float32))
+        with autograd.record():
+            fake = G(z)
+            d_loss = bce(D(xb), ones) + bce(D(fake.detach()), zeros)
+        d_loss.backward()
+        dt.step(args.batch_size)
+        with autograd.record():
+            g_loss = bce(D(G(z)), ones)
+        g_loss.backward()
+        gt.step(args.batch_size)
+        if step % 50 == 0:
+            print(f"step {step}: d_loss {float(d_loss.mean().asnumpy()):.4f} "
+                  f"g_loss {float(g_loss.mean().asnumpy()):.4f}")
+    # generated points should land near the unit ring
+    z = nd.array(rs.randn(512, args.latent).astype(np.float32))
+    r = np.linalg.norm(G(z).asnumpy(), axis=1)
+    print(f"generated radius mean {r.mean():.3f} (target 1.0)")
+    assert abs(r.mean() - 1.0) < 0.5
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--latent", type=int, default=8)
+    p.add_argument("--steps", type=int, default=400)
+    p.add_argument("--n-real", type=int, default=4096)
+    main(p.parse_args())

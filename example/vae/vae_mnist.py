@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Variational autoencoder (reference: example/vae/ — VAE with the
+reparameterization trick and KL regularizer) on synthetic MNIST."""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+class VAE(gluon.nn.HybridBlock):
+    def __init__(self, latent=8, **kwargs):
+        super().__init__(**kwargs)
+        self._latent = latent
+        with self.name_scope():
+            self.enc = gluon.nn.HybridSequential()
+            self.enc.add(gluon.nn.Dense(128, activation="relu"),
+                         gluon.nn.Dense(2 * latent))
+            self.dec = gluon.nn.HybridSequential()
+            self.dec.add(gluon.nn.Dense(128, activation="relu"),
+                         gluon.nn.Dense(784, activation="sigmoid"))
+
+    def hybrid_forward(self, F, x):
+        h = self.enc(x)
+        mu = F.slice_axis(h, axis=1, begin=0, end=self._latent)
+        logvar = F.slice_axis(h, axis=1, begin=self._latent,
+                              end=2 * self._latent)
+        eps = F.normal(loc=0.0, scale=1.0,
+                       shape=(x.shape[0], self._latent))
+        z = mu + F.exp(0.5 * logvar) * eps
+        return self.dec(z), mu, logvar
+
+
+def main(args):
+    it = mx.io.MNISTIter(image=None, batch_size=args.batch_size, flat=True)
+    net = VAE(args.latent)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    first = last = None
+    for epoch in range(args.epochs):
+        it.reset()
+        total = n = 0.0
+        for batch in it:
+            x = batch.data[0]
+            with autograd.record():
+                xr, mu, logvar = net(x)
+                rec = nd.sum(nd.square(xr - x), axis=1)
+                kl = -0.5 * nd.sum(1 + logvar - nd.square(mu)
+                                   - nd.exp(logvar), axis=1)
+                loss = rec + args.beta * kl
+            loss.backward()
+            trainer.step(x.shape[0])
+            total += float(loss.mean().asnumpy())
+            n += 1
+        avg = total / n
+        if first is None:
+            first = avg
+        last = avg
+        print(f"epoch {epoch}: ELBO loss {avg:.3f}")
+    assert last < first, "ELBO must improve"
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--latent", type=int, default=8)
+    p.add_argument("--beta", type=float, default=1.0)
+    main(p.parse_args())
