@@ -20,8 +20,14 @@ def worker_env(args, rank):
     env["MXTPU_COORDINATOR"] = args.coordinator
     env["MXTPU_NUM_PROCS"] = str(args.num_workers)
     env["MXTPU_PROC_ID"] = str(rank)
+    if args.num_servers:
+        # server tier size for dist_* kvstores (reference: launch.py -s);
+        # rank 0 hosts the servers on consecutive ports from the
+        # coordinator's (kvstore_dist.py)
+        env["MXTPU_NUM_SERVERS"] = str(args.num_servers)
     # reference env names kept for script compat (tools/launch.py DMLC_*)
     env["DMLC_NUM_WORKER"] = str(args.num_workers)
+    env["DMLC_NUM_SERVER"] = str(args.num_servers or 1)
     env["DMLC_ROLE"] = "worker"
     return env
 
@@ -71,6 +77,9 @@ def main():
     parser = argparse.ArgumentParser(
         description="launch a distributed mxnet_tpu job")
     parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="parameter-server tier size (reference -s); "
+                             "0 = one in-process server on rank 0")
     parser.add_argument("--launcher", choices=("local", "ssh"),
                         default="local")
     parser.add_argument("-H", "--hostfile", type=str, default=None)
