@@ -126,6 +126,14 @@ void mxtpu_pool_free(void *ptr, size_t size);
 void mxtpu_pool_stats(uint64_t out[4]);
 void mxtpu_pool_clear(void);
 
+/* Named POSIX shm segments for worker-process IPC (reference:
+ * src/storage/cpu_shared_storage_manager.h). Create in the producer,
+ * attach by name in the consumer, detach(unlink=1) once from the owner. */
+int mxtpu_shm_create(const char *name, size_t size, void **out_handle);
+int mxtpu_shm_attach(const char *name, void **out_handle, uint64_t *out_size);
+void *mxtpu_shm_data(void *handle);
+void mxtpu_shm_detach(void *handle, int unlink);
+
 /* --------------------------------------------------------------- ndarray */
 
 /* Host-side dense tensor: the bindings' data currency (reference:

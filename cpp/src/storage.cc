@@ -101,3 +101,96 @@ void mxtpu_pool_clear(void) {
 }
 
 }  // extern "C"
+
+// ---- POSIX shared-memory segments -----------------------------------------
+// Capability parity with CPUSharedStorageManager
+// (src/storage/cpu_shared_storage_manager.h): named shm segments for
+// zero-copy IPC between DataLoader worker processes and the trainer.
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "common.h"
+
+namespace {
+
+struct ShmSeg {
+  std::string name;
+  void *addr;
+  size_t size;
+};
+
+}  // namespace
+
+extern "C" {
+
+int mxtpu_shm_create(const char *name, size_t size, void **out_handle) {
+  std::string path = std::string("/") + name;
+  int fd = shm_open(path.c_str(), O_CREAT | O_RDWR | O_EXCL, 0600);
+  if (fd < 0) {
+    mxtpu::SetError(std::string("shm_open failed: ") + path);
+    return 1;
+  }
+  if (ftruncate(fd, static_cast<off_t>(size)) != 0) {
+    close(fd);
+    shm_unlink(path.c_str());
+    mxtpu::SetError("ftruncate failed (shm full?)");
+    return 1;
+  }
+  void *addr = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (addr == MAP_FAILED) {
+    shm_unlink(path.c_str());
+    mxtpu::SetError("mmap failed");
+    return 1;
+  }
+  *out_handle = new ShmSeg{path, addr, size};
+  return 0;
+}
+
+int mxtpu_shm_attach(const char *name, void **out_handle,
+                     uint64_t *out_size) {
+  std::string path = std::string("/") + name;
+  int fd = shm_open(path.c_str(), O_RDWR, 0600);
+  if (fd < 0) {
+    mxtpu::SetError(std::string("shm_open failed: ") + path);
+    return 1;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    mxtpu::SetError("fstat failed");
+    return 1;
+  }
+  void *addr = mmap(nullptr, static_cast<size_t>(st.st_size),
+                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (addr == MAP_FAILED) {
+    mxtpu::SetError("mmap failed");
+    return 1;
+  }
+  *out_handle = new ShmSeg{path, addr, static_cast<size_t>(st.st_size)};
+  if (out_size) *out_size = static_cast<uint64_t>(st.st_size);
+  return 0;
+}
+
+void *mxtpu_shm_data(void *handle) {
+  return static_cast<ShmSeg *>(handle)->addr;
+}
+
+/* Detach the mapping; unlink destroys the name too (call once, from the
+ * owner, after all attachments detached — reference shm lifecycle). */
+void mxtpu_shm_detach(void *handle, int unlink) {
+  auto *seg = static_cast<ShmSeg *>(handle);
+  munmap(seg->addr, seg->size);
+  if (unlink) shm_unlink(seg->name.c_str());
+  delete seg;
+}
+
+}  // extern "C"

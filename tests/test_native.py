@@ -337,3 +337,42 @@ def test_c_api_symbol_inspection(tmp_path):
     lib.mxtpu_sym_free(h)
     again = mx.sym.load(path2)
     assert again.list_arguments() == out.list_arguments()
+
+
+def test_c_api_shm_segments():
+    """Named shm create/attach/detach (reference:
+    src/storage/cpu_shared_storage_manager.h IPC segments)."""
+    import ctypes
+
+    from mxnet_tpu import _native
+
+    lib = _native.lib()
+    if lib is None:
+        pytest.skip("native runtime unavailable")
+    lib.mxtpu_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                     ctypes.POINTER(ctypes.c_void_p)]
+    lib.mxtpu_shm_attach.argtypes = [ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_void_p),
+                                     ctypes.POINTER(ctypes.c_uint64)]
+    lib.mxtpu_shm_data.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_shm_data.restype = ctypes.c_void_p
+    lib.mxtpu_shm_detach.argtypes = [ctypes.c_void_p, ctypes.c_int]
+
+    name = f"mxtpu_test_{os.getpid()}".encode()
+    h = ctypes.c_void_p()
+    assert lib.mxtpu_shm_create(name, 4096, ctypes.byref(h)) == 0
+    src = np.arange(16, dtype=np.float32)
+    ctypes.memmove(lib.mxtpu_shm_data(h), src.tobytes(), src.nbytes)
+    # attach by name (a second mapping, as a worker process would)
+    h2 = ctypes.c_void_p()
+    size2 = ctypes.c_uint64()
+    assert lib.mxtpu_shm_attach(name, ctypes.byref(h2),
+                                ctypes.byref(size2)) == 0
+    assert size2.value == 4096
+    back = np.frombuffer(ctypes.string_at(lib.mxtpu_shm_data(h2),
+                                          src.nbytes), dtype=np.float32)
+    assert np.allclose(back, src)
+    lib.mxtpu_shm_detach(h2, 0)
+    lib.mxtpu_shm_detach(h, 1)  # owner unlinks
+    h3 = ctypes.c_void_p()
+    assert lib.mxtpu_shm_attach(name, ctypes.byref(h3), None) != 0  # gone
