@@ -532,3 +532,12 @@ def bipartite_matching(data, is_ascend=False, threshold=0.0, topk=-1):
     r, c = jax.vmap(one)(flat)
     return (r.reshape(batch_shape + (N,)).astype(data.dtype),
             c.reshape(batch_shape + (M,)).astype(data.dtype))
+
+
+@register("_contrib_flash_attention", aliases=("flash_attention",))
+def contrib_flash_attention(q, k, v, causal=False, scale=None):
+    """Fused Pallas flash attention over (B, T, H, D) (new TPU-first
+    capability per SURVEY.md §5.7; kernel in ops/pallas_kernels.py)."""
+    from .pallas_kernels import flash_attention
+
+    return flash_attention(q, k, v, bool(causal), scale)

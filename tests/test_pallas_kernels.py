@@ -4,6 +4,7 @@ tests/nightly/dist_sync_kvstore.py:28-50)."""
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from mxnet_tpu.ops import pallas_kernels as pk
@@ -66,3 +67,39 @@ def test_gradient_compression_uses_pallas_backend():
     eff = np.asarray(g)
     expect = np.where(eff >= 0.5, 0.5, np.where(eff <= -0.5, -0.5, 0.0))
     np.testing.assert_allclose(np.asarray(out), expect, atol=1e-6)
+
+
+def test_flash_attention_matches_oracle():
+    from mxnet_tpu.ops.pallas_kernels import flash_attention
+    from mxnet_tpu.parallel.ring_attention import local_attention
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.rand(2, 64, 4, 16).astype(np.float32))
+    k = jnp.asarray(r.rand(2, 64, 4, 16).astype(np.float32))
+    v = jnp.asarray(r.rand(2, 64, 4, 16).astype(np.float32))
+    assert float(jnp.abs(flash_attention(q, k, v)
+                         - local_attention(q, k, v)).max()) < 1e-5
+    assert float(jnp.abs(flash_attention(q, k, v, True)
+                         - local_attention(q, k, v, causal=True)).max()) < 1e-5
+
+
+def test_flash_attention_multi_block_and_grad():
+    from mxnet_tpu.ops.pallas_kernels import flash_attention
+    from mxnet_tpu.parallel.ring_attention import local_attention
+    r = np.random.RandomState(1)
+    # T=256 > block 128: exercises the online-softmax accumulation
+    q = jnp.asarray(r.rand(1, 256, 2, 8).astype(np.float32))
+    k = jnp.asarray(r.rand(1, 256, 2, 8).astype(np.float32))
+    v = jnp.asarray(r.rand(1, 256, 2, 8).astype(np.float32))
+    assert float(jnp.abs(flash_attention(q, k, v, True)
+                         - local_attention(q, k, v, causal=True)).max()) < 1e-5
+    g1 = jax.grad(lambda q_: flash_attention(q_, k, v, True).sum())(q)
+    g2 = jax.grad(lambda q_: local_attention(q_, k, v, causal=True).sum())(q)
+    assert float(jnp.abs(g1 - g2).max()) < 1e-4
+
+
+def test_flash_attention_nd_op():
+    from mxnet_tpu import nd
+    r = np.random.RandomState(2)
+    q = nd.array(r.rand(1, 32, 2, 8).astype(np.float32))
+    out = nd.contrib.flash_attention(q, q, q, causal=True)
+    assert out.shape == (1, 32, 2, 8)
