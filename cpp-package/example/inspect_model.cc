@@ -1,0 +1,37 @@
+// Model inspector (reference: cpp-package examples): loads a symbol JSON and
+// a .params checkpoint written by the Python frontend and prints the graph +
+// parameter inventory — C++/Python checkpoint interchange in action.
+#include <cstdio>
+
+#include "../include/mxtpu.hpp"
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s symbol.json [model.params]\n", argv[0]);
+    return 2;
+  }
+  try {
+    auto sym = mxtpu::Symbol::LoadFile(argv[1]);
+    std::printf("nodes: %d\n", sym.NumNodes());
+    for (const auto &a : sym.ListArguments())
+      std::printf("arg: %s\n", a.c_str());
+    for (const auto &o : sym.ListOutputs())
+      std::printf("output: %s\n", o.c_str());
+    if (argc > 2) {
+      auto params = mxtpu::NDArray::Load(argv[2]);
+      uint64_t total = 0;
+      for (const auto &kv : params) {
+        std::printf("param %s: dtype=%s size=%llu\n", kv.first.c_str(),
+                    kv.second.dtype().c_str(),
+                    static_cast<unsigned long long>(kv.second.size()));
+        total += kv.second.size();
+      }
+      std::printf("total parameters: %llu\n",
+                  static_cast<unsigned long long>(total));
+    }
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

@@ -1,0 +1,174 @@
+// mxtpu C++ high-level API (header-only, over the C ABI in cpp/include/
+// mxtpu.h).  Reference: cpp-package/ — RAII wrappers so C++ programs load,
+// inspect, and exchange checkpoints with the Python frontend.
+#ifndef MXTPU_HPP_
+#define MXTPU_HPP_
+
+#include <cstdint>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../../cpp/include/mxtpu.h"
+
+namespace mxtpu {
+
+inline void Check(int rc, const char *what) {
+  if (rc != 0)
+    throw std::runtime_error(std::string(what) + ": " + mxtpu_last_error());
+}
+
+class NDArray {
+ public:
+  NDArray(const std::string &dtype, const std::vector<uint64_t> &shape) {
+    Check(mxtpu_nd_create(dtype.c_str(), shape.data(),
+                          static_cast<int>(shape.size()), &h_),
+          "nd_create");
+  }
+  explicit NDArray(void *owned_handle) : h_(owned_handle) {}
+  NDArray(NDArray &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  NDArray &operator=(NDArray &&o) noexcept {
+    if (this != &o) { reset(); h_ = o.h_; o.h_ = nullptr; }
+    return *this;
+  }
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+  ~NDArray() { reset(); }
+
+  std::vector<uint64_t> shape() const {
+    std::vector<uint64_t> s(mxtpu_nd_ndim(h_));
+    if (!s.empty()) mxtpu_nd_shape(h_, s.data());
+    return s;
+  }
+  std::string dtype() const { return mxtpu_nd_dtype(h_); }
+  uint64_t size() const { return mxtpu_nd_size(h_); }
+  uint64_t nbytes() const { return mxtpu_nd_nbytes(h_); }
+  void *data() { return mxtpu_nd_data(h_); }
+  const void *data() const { return mxtpu_nd_data(h_); }
+  template <typename T>
+  T *data_as() { return static_cast<T *>(mxtpu_nd_data(h_)); }
+  void copy_from(const void *src, uint64_t n) {
+    Check(mxtpu_nd_copy_from(h_, src, n), "nd_copy_from");
+  }
+  void *handle() const { return h_; }
+
+  // dict-file save/load, wire-compatible with Python mx.nd.save/load
+  static void Save(const std::string &path,
+                   const std::map<std::string, NDArray *> &arrays) {
+    std::vector<void *> hs;
+    std::vector<const char *> keys;
+    for (const auto &kv : arrays) {
+      keys.push_back(kv.first.c_str());
+      hs.push_back(kv.second->handle());
+    }
+    Check(mxtpu_nd_save(path.c_str(), hs.data(), keys.data(),
+                        static_cast<int>(hs.size())), "nd_save");
+  }
+  static std::map<std::string, NDArray> Load(const std::string &path) {
+    void *list = nullptr;
+    int count = 0;
+    Check(mxtpu_nd_load(path.c_str(), &list, &count), "nd_load");
+    std::map<std::string, NDArray> out;
+    for (int i = 0; i < count; ++i) {
+      const char *key = nullptr;
+      mxtpu_nd_list_get(list, i, &key);
+      out.emplace(key ? key : "", NDArray(mxtpu_nd_list_take(list, i)));
+    }
+    mxtpu_nd_list_free(list);
+    return out;
+  }
+
+ private:
+  void reset() {
+    if (h_) mxtpu_nd_free(h_);
+    h_ = nullptr;
+  }
+  void *h_ = nullptr;
+};
+
+class Symbol {
+ public:
+  static Symbol LoadFile(const std::string &path) {
+    void *h = nullptr;
+    Check(mxtpu_sym_load_file(path.c_str(), &h), "sym_load_file");
+    return Symbol(h);
+  }
+  static Symbol LoadJSON(const std::string &json) {
+    void *h = nullptr;
+    Check(mxtpu_sym_load_json(json.c_str(), &h), "sym_load_json");
+    return Symbol(h);
+  }
+  Symbol(Symbol &&o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Symbol(const Symbol &) = delete;
+  ~Symbol() {
+    if (h_) mxtpu_sym_free(h_);
+  }
+
+  std::vector<std::string> ListArguments() const {
+    std::vector<std::string> out;
+    for (int i = 0; i < mxtpu_sym_num_args(h_); ++i)
+      out.push_back(mxtpu_sym_arg_name(h_, i));
+    return out;
+  }
+  std::vector<std::string> ListOutputs() const {
+    std::vector<std::string> out;
+    for (int i = 0; i < mxtpu_sym_num_outputs(h_); ++i)
+      out.push_back(mxtpu_sym_output_name(h_, i));
+    return out;
+  }
+  int NumNodes() const { return mxtpu_sym_num_nodes(h_); }
+  std::string NodeOp(int i) const { return mxtpu_sym_node_op(h_, i); }
+  std::string NodeName(int i) const { return mxtpu_sym_node_name(h_, i); }
+  std::string ToJSON() const { return mxtpu_sym_to_json(h_); }
+  void Save(const std::string &path) const {
+    Check(mxtpu_sym_save_file(h_, path.c_str()), "sym_save_file");
+  }
+
+ private:
+  explicit Symbol(void *h) : h_(h) {}
+  void *h_ = nullptr;
+};
+
+// Sharded RecordIO reader with background prefetch.
+class RecordReader {
+ public:
+  explicit RecordReader(const std::string &path, int batch_records = 64,
+                        int queue_depth = 4, int shard_index = 0,
+                        int num_shards = 1) {
+    Check(mxtpu_rec_open(path.c_str(), batch_records, queue_depth,
+                         shard_index, num_shards, &h_),
+          "rec_open");
+  }
+  ~RecordReader() {
+    if (h_) mxtpu_rec_close(h_);
+  }
+
+  // Calls fn(data, len) per record; returns total records read this epoch.
+  template <typename Fn>
+  int64_t ForEach(Fn fn) {
+    int64_t total = 0;
+    for (;;) {
+      void *batch = nullptr;
+      int count = 0;
+      Check(mxtpu_rec_next_batch(h_, &batch, &count), "rec_next_batch");
+      if (!batch) break;
+      for (int i = 0; i < count; ++i) {
+        const uint8_t *data = nullptr;
+        uint64_t len = 0;
+        mxtpu_rec_get(batch, i, &data, &len);
+        fn(data, len);
+      }
+      total += count;
+      mxtpu_rec_free_batch(batch);
+    }
+    return total;
+  }
+
+ private:
+  void *h_ = nullptr;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_HPP_

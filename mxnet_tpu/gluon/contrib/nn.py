@@ -5,7 +5,8 @@ from ... import ndarray as nd
 from ..block import Block, HybridBlock
 from ..nn import Sequential, HybridSequential
 
-__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding"]
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm"]
 
 
 class Concurrent(Sequential):
@@ -64,3 +65,32 @@ class SparseEmbedding(Block):
     def __repr__(self):
         return (f"SparseEmbedding({self._kwargs['input_dim']} -> "
                 f"{self._kwargs['output_dim']})")
+
+
+
+class SyncBatchNorm(__import__("mxnet_tpu.gluon.nn.basic_layers",
+                               fromlist=["BatchNorm"]).BatchNorm):
+    """Cross-device BatchNorm (reference: gluon/contrib/nn/basic_layers.py
+    SyncBatchNorm over src/operator/contrib/sync_batch_norm-inl.h).
+
+    TPU-first: inside one pjit program the batch statistics already reduce
+    over the global (sharded) batch, so this subclass is the plain layer
+    with the reference's constructor surface; ``num_devices`` is accepted
+    for compatibility and unused.  Per-device programs (shard_map) should
+    call the ``_contrib_SyncBatchNorm`` op directly with ``axis_name``.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)

@@ -17,3 +17,34 @@ def test_native_library_builds_and_loads():
     r = subprocess.run(["make", "-C", cpp_dir], capture_output=True, text=True)
     assert r.returncode == 0, "native build failed:\n" + r.stderr[-4000:]
     assert _native.lib() is not None, "libmxtpu.so built but failed to load"
+
+
+@pytest.mark.skipif(bool(os.environ.get("MXTPU_NO_NATIVE")),
+                    reason="native runtime disabled explicitly")
+def test_cpp_package_builds_and_reads_python_checkpoint(tmp_path):
+    """The C++ high-level wrapper (cpp-package/) must build and exchange
+    models with the Python frontend (reference: cpp-package/ on the C API)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    root = os.path.dirname(os.path.dirname(_native.__file__))
+    pkg = os.path.join(root, "cpp-package")
+    r = subprocess.run(["make", "-C", pkg], capture_output=True, text=True)
+    assert r.returncode == 0, "cpp-package build failed:\n" + r.stderr[-4000:]
+
+    data = mx.sym.Variable("data")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc"), name="softmax")
+    sym_path = str(tmp_path / "m-symbol.json")
+    par_path = str(tmp_path / "m.params")
+    out.save(sym_path)
+    nd.save(par_path, {"fc_weight": nd.array(np.ones((4, 8), np.float32)),
+                       "fc_bias": nd.array(np.zeros(4, np.float32))})
+    r = subprocess.run([os.path.join(pkg, "build", "inspect_model"),
+                        sym_path, par_path], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "arg: fc_weight" in r.stdout
+    assert "output: softmax_output" in r.stdout
+    assert "total parameters: 36" in r.stdout
