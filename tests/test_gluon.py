@@ -297,3 +297,20 @@ def test_dataloader_process_workers_shm():
     assert n == 4
     # parent jax still healthy after forks (engine handlers did their job)
     assert float(nd.array(np.ones(3)).sum().asnumpy()) == 3.0
+
+
+def test_contrib_sync_batch_norm_layer():
+    """gluon.contrib.nn.SyncBatchNorm: reference constructor surface,
+    BatchNorm semantics under one program (global batch is implicit)."""
+    bn = gluon.contrib.nn.SyncBatchNorm(num_devices=8)
+    bn.initialize()
+    x = nd.array(np.random.RandomState(0).rand(4, 3, 5, 5)
+                 .astype(np.float32) * 2)
+    from mxnet_tpu import autograd
+    with autograd.record():
+        y = bn(x)
+    ref = gluon.nn.BatchNorm()
+    ref.initialize()
+    with autograd.record():
+        y2 = ref(x)
+    assert np.allclose(y.asnumpy(), y2.asnumpy(), atol=1e-5)
