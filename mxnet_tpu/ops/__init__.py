@@ -9,5 +9,6 @@ from . import detection  # noqa: F401
 from . import quantization_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import image_ops  # noqa: F401
+from . import spatial  # noqa: F401
 
 __all__ = ["Op", "register", "get_op", "list_ops", "OP_REGISTRY"]

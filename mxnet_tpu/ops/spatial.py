@@ -1,0 +1,408 @@
+"""Spatial-transform op family: grid sampling, STN, correlation, legacy crop.
+
+Reference semantics: ``src/operator/grid_generator-inl.h``,
+``src/operator/bilinear_sampler-inl.h``, ``src/operator/spatial_transformer-inl.h``,
+``src/operator/correlation-inl.h``, ``src/operator/crop-inl.h``,
+``src/operator/svm_output.cc:31-66``,
+``src/operator/contrib/deformable_psroi_pooling-inl.h``.
+
+TPU-first shapes: every op is a fixed-shape gather/reduce composition — the
+bilinear sample is four clipped gathers with in-bounds masks (XLA lowers each
+to one fused gather), and Correlation is a static python loop over the
+displacement grid producing one fused multiply+reduce_window per shift, so the
+whole neighborhood compiles into a single program with no dynamic shapes.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, OP_REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# grid generation + bilinear sampling
+# ---------------------------------------------------------------------------
+
+def _affine_grid(theta, height, width):
+    """theta (B, 6) -> normalized sampling grid (B, 2, H, W), chan 0=x, 1=y."""
+    xs = jnp.linspace(-1.0, 1.0, width, dtype=theta.dtype)
+    ys = jnp.linspace(-1.0, 1.0, height, dtype=theta.dtype)
+    gx, gy = jnp.meshgrid(xs, ys)  # (H, W) each
+    ones = jnp.ones_like(gx)
+    dst = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, H*W)
+    src = jnp.einsum("bij,jk->bik", theta.reshape(-1, 2, 3), dst)
+    return src.reshape(-1, 2, height, width)
+
+
+@register("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Sampling-grid producer for BilinearSampler.
+
+    affine: data (B, 6) affine params -> grid (B, 2, H, W) over target_shape.
+    warp:   data (B, 2, H, W) optical flow -> normalized (flow + identity).
+    """
+    if transform_type == "affine":
+        h, w = int(target_shape[0]), int(target_shape[1])
+        return _affine_grid(data, h, w)
+    if transform_type == "warp":
+        B, _, H, W = data.shape
+        gx = jnp.tile(jnp.arange(W, dtype=data.dtype), (H, 1))
+        gy = jnp.tile(jnp.arange(H, dtype=data.dtype)[:, None], (1, W))
+        ident = jnp.stack([gx, gy])[None]  # (1, 2, H, W)
+        denom = jnp.asarray([(W - 1) / 2.0, (H - 1) / 2.0],
+                            dtype=data.dtype).reshape(1, 2, 1, 1)
+        return (data + ident) / denom - 1.0
+    raise ValueError(f"unknown transform_type {transform_type!r}")
+
+
+def _bilinear_gather(data, x, y):
+    """Sample data (B, C, H, W) at real pixel coords x, y (B, Ho, Wo) with
+    bilinear weights and zero padding outside the image."""
+    B, C, H, W = data.shape
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    out = jnp.zeros(data.shape[:2] + x.shape[1:], dtype=data.dtype)
+    for yi in (y0, y0 + 1.0):
+        for xi in (x0, x0 + 1.0):
+            wgt = (1.0 - jnp.abs(x - xi)) * (1.0 - jnp.abs(y - yi))
+            inb = ((xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1))
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            g = jax.vmap(lambda d, yy, xx: d[:, yy, xx])(data, yc, xc)
+            out = out + (wgt * inb)[:, None] * g
+    return out
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid, cudnn_off=False):
+    """data (B, C, H, W) sampled at grid (B, 2, Ho, Wo); grid is normalized
+    to [-1, 1] with channel 0 = x, channel 1 = y (reference layout)."""
+    _, _, H, W = data.shape
+    x = (grid[:, 0] + 1.0) * (W - 1) / 2.0
+    y = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return _bilinear_gather(data, x, y)
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=False):
+    """STN: affine params from a localisation net warp the input feature map."""
+    assert transform_type == "affine" and sampler_type == "bilinear"
+    grid = _affine_grid(loc, int(target_shape[0]), int(target_shape[1]))
+    return bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet)
+# ---------------------------------------------------------------------------
+
+@register("Correlation", num_outputs=1)
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Cost-volume between two feature maps (B, C, H, W) -> (B, D*D, Ho, Wo)
+    where D = 2*(max_displacement//stride2) + 1.
+
+    Each displacement is one shifted elementwise product (or abs-diff) summed
+    over channels and a K x K window, normalized by K*K*C like the reference.
+    """
+    kernel_size = int(kernel_size)
+    max_displacement = int(max_displacement)
+    stride1, stride2, pad_size = int(stride1), int(stride2), int(pad_size)
+    B, C, H, W = data1.shape
+    kr = (kernel_size - 1) // 2
+    border = max_displacement + kr
+    Hp, Wp = H + 2 * pad_size, W + 2 * pad_size
+    Ho = -(-(Hp - 2 * border) // stride1)
+    Wo = -(-(Wp - 2 * border) // stride1)
+    rad = max_displacement // stride2
+    pa = jnp.pad(data1, ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size)))
+    pb = jnp.pad(data2, ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size)))
+    # second image further padded so every displacement is a static slice
+    pb2 = jnp.pad(pb, ((0, 0), (0, 0),
+                       (max_displacement, max_displacement),
+                       (max_displacement, max_displacement)))
+    norm = float(kernel_size * kernel_size * C)
+    planes = []
+    for dy in range(-rad, rad + 1):
+        for dx in range(-rad, rad + 1):
+            oy = max_displacement + dy * stride2
+            ox = max_displacement + dx * stride2
+            shifted = lax.dynamic_slice(pb2, (0, 0, oy, ox), pa.shape)
+            prod = pa * shifted if is_multiply else jnp.abs(pa - shifted)
+            chan = jnp.sum(prod, axis=1)  # (B, Hp, Wp)
+            win = lax.reduce_window(chan, 0.0, lax.add,
+                                    (1, kernel_size, kernel_size), (1, 1, 1),
+                                    "SAME")
+            centers = lax.slice(win, (0, border, border),
+                                (B, border + (Ho - 1) * stride1 + 1,
+                                 border + (Wo - 1) * stride1 + 1),
+                                (1, stride1, stride1))
+            planes.append(centers / norm)
+    return jnp.stack(planes, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# legacy Crop
+# ---------------------------------------------------------------------------
+
+@register("Crop")
+def crop_legacy(*args, offset=(0, 0), h_w=(0, 0), center_crop=False, num_args=1):
+    """Legacy Crop: crop data's spatial dims to h_w, or to the spatial dims of
+    a second ``crop_like`` input (reference: src/operator/crop-inl.h)."""
+    data = args[0]
+    if len(args) >= 2:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput
+# ---------------------------------------------------------------------------
+
+@register("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Hinge-loss output head: forward is identity, backward is the L1/L2 SVM
+    gradient (reference: src/operator/svm_output.cc:31-66)."""
+    return _svm_output_vjp(data, label, float(margin),
+                           float(regularization_coefficient), bool(use_linear))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_output_vjp(data, label, margin, reg_coef, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg_coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg_coef, use_linear, res, g):
+    data, label = res
+    x = data.reshape(data.shape[0], -1)
+    k = label.reshape(-1).astype(jnp.int32)
+    onehot = jax.nn.one_hot(k, x.shape[1], dtype=x.dtype)
+    if use_linear:  # L1-SVM subgradient
+        at_k = -(margin > x).astype(x.dtype) * reg_coef
+        rest = (margin > -x).astype(x.dtype) * reg_coef
+    else:  # L2-SVM gradient
+        at_k = -reg_coef * jnp.where(margin > x, 2.0 * (margin - x), 0.0)
+        rest = -reg_coef * jnp.where(margin > -x, -2.0 * (margin + x), 0.0)
+    grad = (onehot * at_k + (1.0 - onehot) * rest).reshape(data.shape)
+    return grad, jnp.zeros_like(label)
+
+
+_svm_output_vjp.defvjp(_svm_fwd, _svm_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Deformable PSROI pooling
+# ---------------------------------------------------------------------------
+
+def _sample_points(img, cidx, xx, yy):
+    """Clip-and-4-corner bilinear sample of img (C, H, W) at per-point channel
+    indices cidx and real coords xx/yy (all same-shaped int/float arrays)."""
+    H, W = img.shape[1], img.shape[2]
+    xc = jnp.clip(xx, 0.0, W - 1.0)
+    yc = jnp.clip(yy, 0.0, H - 1.0)
+    x0 = jnp.floor(xc)
+    y0 = jnp.floor(yc)
+    x1 = jnp.minimum(x0 + 1, W - 1.0)
+    y1 = jnp.minimum(y0 + 1, H - 1.0)
+    fx, fy = xc - x0, yc - y0
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    g00 = img[cidx, y0i, x0i]
+    g01 = img[cidx, y0i, x1i]
+    g10 = img[cidx, y1i, x0i]
+    g11 = img[cidx, y1i, x1i]
+    return ((1 - fy) * ((1 - fx) * g00 + fx * g01)
+            + fy * ((1 - fx) * g10 + fx * g11))
+
+
+@register("_contrib_DeformablePSROIPooling", num_outputs=2)
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=1, group_size=1, pooled_size=1,
+                             part_size=0, sample_per_part=1, trans_std=0.0,
+                             no_trans=False):
+    """Position-sensitive ROI pooling with learned per-part offsets
+    (reference: src/operator/contrib/deformable_psroi_pooling-inl.h).
+
+    data (B, output_dim*group_size^2, H, W); rois (R, 5) as
+    [batch_idx, x1, y1, x2, y2]; trans (R, 2*num_classes, part, part) offsets —
+    each output channel uses its class's (dx, dy) pair, where
+    num_classes = trans.shape[1] // 2 and channels are split evenly over
+    classes like the reference's channels_each_class.
+    Returns (out (R, output_dim, P, P), top_count) like the reference's two
+    outputs (top_count holds the per-bin sample counts used in backward; here
+    autograd differentiates the gather directly and top_count is informational).
+
+    The whole bin grid is one vectorized gather (static index tables built in
+    numpy), not a Python loop — keeps the HLO small at pooled_size=7.
+    """
+    import numpy as _np
+
+    P = int(pooled_size)
+    G = int(group_size)
+    OD = int(output_dim)
+    spp = int(sample_per_part)
+    part = int(part_size) or P
+    scale = float(spatial_scale)
+    tstd = float(trans_std)
+    B, C, H, W = data.shape
+
+    # static per-bin index tables (numpy; baked into the program as constants)
+    ii, jj = _np.meshgrid(_np.arange(P), _np.arange(P), indexing="ij")
+    ph = _np.minimum(ii * part // P, part - 1)          # (P, P)
+    pw = _np.minimum(jj * part // P, part - 1)
+    gh = _np.minimum(ii * G // P, G - 1)
+    gw = _np.minimum(jj * G // P, G - 1)
+    od = _np.arange(OD)[:, None, None]
+    cidx = jnp.asarray((od * G + gh) * G + gw)          # (OD, P, P)
+    use_trans = not (no_trans or trans is None)
+    if use_trans:
+        ncls = max(1, trans.shape[1] // 2)
+        cls = _np.arange(OD) * ncls // OD               # class of each channel
+        tx_idx = jnp.asarray(2 * cls)                   # (OD,)
+        ty_idx = jnp.asarray(2 * cls + 1)
+    # sub-sample offsets within a bin, stacked on a leading axis S = spp^2
+    sy, sx = _np.meshgrid(_np.arange(spp), _np.arange(spp), indexing="ij")
+    sx = jnp.asarray((sx.ravel() + 0.5)[:, None, None, None])   # (S,1,1,1)
+    sy = jnp.asarray((sy.ravel() + 0.5)[:, None, None, None])
+
+    def one_roi(roi, troi):
+        bidx = roi[0].astype(jnp.int32)
+        img = lax.dynamic_index_in_dim(data, bidx, axis=0, keepdims=False)
+        # reference rounds ROI corners before scaling (deformable_psroi_pooling-inl.h)
+        x1 = jnp.round(roi[1]) * scale - 0.5
+        y1 = jnp.round(roi[2]) * scale - 0.5
+        w = jnp.maximum((jnp.round(roi[3]) + 1.0) * scale - 0.5 - x1, 0.1)
+        h = jnp.maximum((jnp.round(roi[4]) + 1.0) * scale - 0.5 - y1, 0.1)
+        bin_w, bin_h = w / P, h / P
+        if use_trans:
+            dx = troi[tx_idx][:, ph, pw] * tstd * w      # (OD, P, P)
+            dy = troi[ty_idx][:, ph, pw] * tstd * h
+        else:
+            dx = dy = jnp.zeros((1, 1, 1), dtype=data.dtype)
+        # sample coords (S, OD, P, P); cidx broadcasts over S
+        full = (sx.shape[0], OD, P, P)
+        xx = jnp.broadcast_to(
+            x1 + jnp.asarray(jj) * bin_w + sx * (bin_w / spp) + dx, full)
+        yy = jnp.broadcast_to(
+            y1 + jnp.asarray(ii) * bin_h + sy * (bin_h / spp) + dy, full)
+        # reference skips samples outside [-0.5, size-0.5) and divides by the
+        # in-bounds count (bins with no valid sample pool to 0)
+        inb = ((xx >= -0.5) & (xx <= W - 0.5)
+               & (yy >= -0.5) & (yy <= H - 0.5)).astype(data.dtype)
+        vals = _sample_points(img, jnp.broadcast_to(cidx, full), xx, yy)
+        cnt = jnp.sum(inb, axis=0)                       # (OD, P, P)
+        pooled = jnp.where(cnt > 0, jnp.sum(vals * inb, axis=0)
+                           / jnp.maximum(cnt, 1.0), 0.0)
+        return pooled, cnt
+
+    if use_trans:
+        out, top_count = jax.vmap(one_roi)(rois, trans)
+    else:
+        out, top_count = jax.vmap(lambda r: one_roi(r, None))(rois)
+    return out, top_count
+
+
+# ---------------------------------------------------------------------------
+# legacy-version aliases + small registry completions
+# ---------------------------------------------------------------------------
+
+def _alias(new, existing):
+    if new not in OP_REGISTRY:
+        OP_REGISTRY[new] = OP_REGISTRY[existing]
+
+
+# v1 ops are the pre-NNVM forms of the same kernels (reference:
+# src/operator/batch_norm_v1.cc, convolution_v1.cc, pooling_v1.cc)
+_alias("BatchNorm_v1", "BatchNorm")
+_alias("Convolution_v1", "Convolution")
+_alias("Pooling_v1", "Pooling")
+_alias("_histogram", "histogram")
+_alias("_contrib_SparseEmbedding", "Embedding")  # dense grad; sparse grad is a
+#                                                  kvstore-side optimization here
+_alias("_rnn_param_concat", "concat")            # concat w/ rnn-param shape infer
+
+
+@register("_copyto")
+def _copyto(data, ctx=None):
+    """Cross-context copy; device placement is handled by the NDArray frontend
+    (reference: _copyto in src/ndarray/ndarray.cc)."""
+    return data
+
+
+@register("cast_storage")
+def cast_storage_op(data, stype="default"):
+    """Registry-level cast_storage is identity on the dense (traced) path; the
+    actual sparse<->dense conversion happens in the NDArray frontend
+    (ndarray/sparse.py cast_storage), because storage type is a host-side
+    concept while XLA traces only dense buffers."""
+    return data
+
+
+@register("_sparse_retain")
+def sparse_retain_op(data, indices):
+    """Zero all rows except `indices` (dense-masked form of the reference's
+    row_sparse retain, src/operator/tensor/sparse_retain.cc)."""
+    mask = jnp.zeros((data.shape[0],), dtype=data.dtype)
+    mask = mask.at[indices.astype(jnp.int32)].set(1.0)
+    return data * mask.reshape((-1,) + (1,) * (data.ndim - 1))
+
+
+@register("_scatter_plus_scalar")
+def scatter_plus_scalar(data, scalar=0.0):
+    """Scalar add applied only to stored (non-zero) elements in the reference's
+    sparse path; dense equivalent masks by the non-zero pattern."""
+    return jnp.where(data != 0, data + scalar, data)
+
+
+@register("_scatter_minus_scalar")
+def scatter_minus_scalar(data, scalar=0.0):
+    return jnp.where(data != 0, data - scalar, data)
+
+
+@register("_scatter_elemwise_div")
+def scatter_elemwise_div(lhs, rhs):
+    return jnp.where(lhs != 0, lhs / rhs, lhs)
+
+
+@register("_scatter_set_nd")
+def scatter_set_nd(lhs, rhs, indices):
+    """Scatter-write rhs into lhs at nd `indices` (reference:
+    src/operator/tensor/indexing_op.cc _scatter_set_nd)."""
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+@register("_cvcopyMakeBorder", aliases=("copyMakeBorder",))
+def cv_copy_make_border(data, top=0, bot=0, left=0, right=0, type=0, value=0.0):
+    """Pad an HWC image with a constant border (reference: plugin/opencv or
+    src/io's cvcopyMakeBorder)."""
+    pad = ((int(top), int(bot)), (int(left), int(right))) + \
+        (((0, 0),) if data.ndim == 3 else ())
+    return jnp.pad(data, pad, constant_values=float(value))
+
+
+@register("_cvimresize", aliases=("cv_imresize",))
+def cv_imresize(data, w=0, h=0, interp=1):
+    """Resize an HWC image with jax.image (bilinear default, like cv2's
+    INTER_LINEAR); reference: the opencv-backed imresize.  interp follows the
+    cv2 enum: 0 nearest, 1 linear, 2 cubic; 3 (area) has no jax.image
+    equivalent and falls back to linear."""
+    method = {0: "nearest", 1: "linear", 2: "cubic"}.get(int(interp), "linear")
+    shape = (int(h), int(w)) + tuple(data.shape[2:])
+    return jax.image.resize(data.astype(jnp.float32), shape, method=method).astype(data.dtype)

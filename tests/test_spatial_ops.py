@@ -1,0 +1,227 @@
+"""Spatial op family: GridGenerator / BilinearSampler / SpatialTransformer /
+Correlation / Crop / SVMOutput / DeformablePSROIPooling + legacy aliases.
+
+Reference semantics: src/operator/{grid_generator,bilinear_sampler,
+spatial_transformer,correlation,crop,svm_output}-inl.h and
+src/operator/contrib/deformable_psroi_pooling-inl.h.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ops.registry import get_op
+
+
+def _identity_theta(batch):
+    return np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (batch, 1))
+
+
+def test_grid_generator_affine_identity():
+    g = nd.GridGenerator(nd.array(_identity_theta(2)), transform_type="affine",
+                         target_shape=(4, 5)).asnumpy()
+    assert g.shape == (2, 2, 4, 5)
+    np.testing.assert_allclose(g[0, 0, 0], np.linspace(-1, 1, 5), atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], np.linspace(-1, 1, 4), atol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow_is_identity_grid():
+    flow = np.zeros((1, 2, 3, 4), np.float32)
+    g = nd.GridGenerator(nd.array(flow), transform_type="warp").asnumpy()
+    np.testing.assert_allclose(g[0, 0, 0], np.linspace(-1, 1, 4), atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], np.linspace(-1, 1, 3), atol=1e-6)
+
+
+def test_bilinear_sampler_identity_grid_reproduces_input():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 6, 7).astype(np.float32)
+    g = nd.GridGenerator(nd.array(_identity_theta(2)), transform_type="affine",
+                         target_shape=(6, 7))
+    out = nd.BilinearSampler(nd.array(x), g).asnumpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)
+
+
+def test_bilinear_sampler_translation_and_oob_zero():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # shift sampling one pixel right: x_src = x_dst + 1 -> theta tx in
+    # normalized units = 2/(W-1)
+    theta = np.array([[1, 0, 2.0 / 3.0, 0, 1, 0]], np.float32)
+    g = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                         target_shape=(4, 4))
+    out = nd.BilinearSampler(nd.array(x), g).asnumpy()[0, 0]
+    np.testing.assert_allclose(out[:, :3], x[0, 0, :, 1:], atol=1e-5)
+    np.testing.assert_allclose(out[:, 3], 0.0, atol=1e-5)  # zero padding
+
+
+def test_spatial_transformer_identity_and_grad():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 2, 5, 5).astype(np.float32)
+    out = nd.SpatialTransformer(nd.array(x), nd.array(_identity_theta(1)),
+                                target_shape=(5, 5)).asnumpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)
+
+    fn = get_op("SpatialTransformer").fn
+    gl = jax.grad(lambda loc: jnp.sum(
+        fn(jnp.asarray(x), loc, target_shape=(5, 5)) ** 2))(
+            jnp.asarray(_identity_theta(1)))
+    assert np.isfinite(np.asarray(gl)).all() and np.abs(np.asarray(gl)).sum() > 0
+
+
+def test_correlation_zero_displacement_is_channel_mean_product():
+    rng = np.random.RandomState(2)
+    a = rng.rand(2, 3, 5, 6).astype(np.float32)
+    b = rng.rand(2, 3, 5, 6).astype(np.float32)
+    out = nd.Correlation(nd.array(a), nd.array(b), kernel_size=1,
+                         max_displacement=0).asnumpy()
+    assert out.shape == (2, 1, 5, 6)
+    np.testing.assert_allclose(out[:, 0], (a * b).mean(axis=1), atol=1e-5)
+
+
+def test_correlation_finds_known_shift():
+    rng = np.random.RandomState(3)
+    a = rng.rand(1, 1, 8, 8).astype(np.float32)
+    b = np.zeros_like(a)
+    b[0, 0, :, :-2] = a[0, 0, :, 2:]  # content of b is a shifted left by 2
+    out = nd.Correlation(nd.array(a), nd.array(b), kernel_size=1,
+                         max_displacement=2, pad_size=2).asnumpy()
+    # displacement grid is 5x5 (dy,dx in [-2,2]); matching plane is dx=-2,dy=0
+    plane = np.argmax(out[0].reshape(25, -1).sum(axis=1))
+    dy, dx = divmod(plane, 5)
+    assert (dy - 2, dx - 2) == (0, -2)
+
+
+def test_crop_offset_center_and_croplike():
+    x = np.arange(2 * 1 * 6 * 6, dtype=np.float32).reshape(2, 1, 6, 6)
+    out = nd.Crop(nd.array(x), offset=(1, 2), h_w=(3, 3), num_args=1).asnumpy()
+    np.testing.assert_allclose(out, x[:, :, 1:4, 2:5])
+    out = nd.Crop(nd.array(x), h_w=(4, 4), center_crop=True, num_args=1).asnumpy()
+    np.testing.assert_allclose(out, x[:, :, 1:5, 1:5])
+    like = nd.zeros((2, 1, 2, 2))
+    out = nd.Crop(nd.array(x), like, num_args=2).asnumpy()
+    np.testing.assert_allclose(out, x[:, :, :2, :2])
+
+
+@pytest.mark.parametrize("use_linear", [True, False])
+def test_svm_output_forward_identity_backward_hinge(use_linear):
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 5).astype(np.float32)
+    lab = np.array([0, 2, 4, 1], np.float32)
+    margin, reg = 1.0, 0.7
+    fn = get_op("SVMOutput").fn
+    out = fn(jnp.asarray(x), jnp.asarray(lab), margin=margin,
+             regularization_coefficient=reg, use_linear=use_linear)
+    np.testing.assert_allclose(np.asarray(out), x, atol=1e-6)
+
+    g = jax.grad(lambda d: jnp.sum(fn(d, jnp.asarray(lab), margin=margin,
+                                      regularization_coefficient=reg,
+                                      use_linear=use_linear)))(jnp.asarray(x))
+    g = np.asarray(g)
+    # manual oracle (svm_output.cc L1_SVM / L2_SVM)
+    want = np.zeros_like(x)
+    for y in range(4):
+        k = int(lab[y])
+        for j in range(5):
+            if use_linear:
+                want[y, j] = (-float(margin > x[y, k]) * reg if j == k
+                              else float(margin > -x[y, j]) * reg)
+            else:
+                if j == k:
+                    want[y, j] = -reg * (2 * (margin - x[y, k])
+                                         if margin > x[y, k] else 0.0)
+                else:
+                    want[y, j] = -reg * (-2 * (margin + x[y, j])
+                                         if margin > -x[y, j] else 0.0)
+    np.testing.assert_allclose(g, want, atol=1e-5)
+
+
+def test_deformable_psroi_no_trans_constant_and_offset_shift():
+    import jax.numpy as jnp
+
+    fn = get_op("_contrib_DeformablePSROIPooling").fn
+    # constant image -> every bin pools the constant
+    data = np.full((1, 4, 8, 8), 3.5, np.float32)  # output_dim=4, group=1
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out, cnt = fn(jnp.asarray(data), jnp.asarray(rois), None, spatial_scale=1.0,
+                  output_dim=4, group_size=1, pooled_size=2, no_trans=True)
+    assert out.shape == (1, 4, 2, 2)
+    np.testing.assert_allclose(np.asarray(out), 3.5, atol=1e-5)
+
+    # data rises linearly in x; a positive x-offset must increase the pooled value
+    gx = np.tile(np.arange(8, dtype=np.float32), (8, 1))
+    data = gx[None, None].repeat(1, axis=0)
+    trans = np.zeros((1, 2, 1, 1), np.float32)
+    base, _ = fn(jnp.asarray(data), jnp.asarray(rois), jnp.asarray(trans),
+                 spatial_scale=1.0, output_dim=1, group_size=1, pooled_size=1,
+                 part_size=1, trans_std=0.5, no_trans=False)
+    trans[0, 0, 0, 0] = 1.0  # dx = 1 * trans_std * roi_w
+    shifted, _ = fn(jnp.asarray(data), jnp.asarray(rois), jnp.asarray(trans),
+                    spatial_scale=1.0, output_dim=1, group_size=1, pooled_size=1,
+                    part_size=1, trans_std=0.5, no_trans=False)
+    assert float(shifted[0, 0, 0, 0]) > float(base[0, 0, 0, 0])
+
+
+def test_deformable_psroi_oob_samples_pool_to_zero():
+    import jax.numpy as jnp
+
+    fn = get_op("_contrib_DeformablePSROIPooling").fn
+    data = np.full((1, 1, 4, 4), 7.0, np.float32)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    trans = np.zeros((1, 2, 1, 1), np.float32)
+    trans[0, 0, 0, 0] = 10.0  # dx = 10 * trans_std * roi_w -> all samples OOB
+    out, cnt = fn(jnp.asarray(data), jnp.asarray(rois), jnp.asarray(trans),
+                  spatial_scale=1.0, output_dim=1, group_size=1, pooled_size=1,
+                  part_size=1, sample_per_part=2, trans_std=1.0, no_trans=False)
+    # reference (deformable_psroi_pooling-inl.h): skip OOB samples, 0 when none
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cnt), 0.0, atol=1e-6)
+
+
+def test_legacy_aliases_and_registry_completions():
+    assert get_op("BatchNorm_v1") is get_op("BatchNorm")
+    assert get_op("Convolution_v1") is get_op("Convolution")
+    assert get_op("Pooling_v1") is get_op("Pooling")
+    assert get_op("_histogram") is get_op("histogram")
+    assert get_op("_contrib_SparseEmbedding") is get_op("Embedding")
+    assert get_op("_rnn_param_concat") is get_op("concat")
+    for name in ("cast_storage", "_copyto", "_sparse_retain",
+                 "_scatter_plus_scalar", "_scatter_minus_scalar",
+                 "_scatter_elemwise_div", "_scatter_set_nd",
+                 "_cvcopyMakeBorder", "_cvimresize"):
+        assert get_op(name) is not None
+
+
+def test_sparse_retain_and_scatter_ops_numeric():
+    import jax.numpy as jnp
+
+    x = np.array([[1, 2], [3, 4], [5, 6]], np.float32)
+    out = get_op("_sparse_retain").fn(jnp.asarray(x), jnp.asarray([0, 2]))
+    np.testing.assert_allclose(np.asarray(out),
+                               [[1, 2], [0, 0], [5, 6]])
+    y = np.array([0.0, 2.0, 0.0, -1.0], np.float32)
+    out = get_op("_scatter_plus_scalar").fn(jnp.asarray(y), scalar=5.0)
+    np.testing.assert_allclose(np.asarray(out), [0, 7, 0, 4])
+    out = get_op("_scatter_elemwise_div").fn(
+        jnp.asarray(y), jnp.asarray([1.0, 2.0, 3.0, 4.0]))
+    np.testing.assert_allclose(np.asarray(out), [0, 1, 0, -0.25])
+
+
+def test_cv_ops_numeric():
+    import jax.numpy as jnp
+
+    img = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+    out = get_op("_cvcopyMakeBorder").fn(jnp.asarray(img), top=1, bot=0,
+                                         left=0, right=2, value=9.0)
+    out = np.asarray(out)
+    assert out.shape == (3, 4, 3)
+    np.testing.assert_allclose(out[0], 9.0)
+    np.testing.assert_allclose(out[1:, :2], img)
+
+    big = get_op("_cvimresize").fn(jnp.asarray(img), w=4, h=4)
+    assert np.asarray(big).shape == (4, 4, 3)
+    np.testing.assert_allclose(np.asarray(big)[0, 0], img[0, 0], atol=1e-5)

@@ -14,6 +14,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax
+import jax.numpy as jnp
+
 
 def timed(fn, *args, steps=20):
     out = fn(*args)
@@ -131,7 +134,6 @@ def main():
             print(f"{k:20s} {v:10.1f} img/s")
 
     if args.trace:
-        import jax.profiler
         with jax.profiler.trace("/tmp/r50trace"):
             for _ in range(3):
                 out = jstep(params, momenta, x)
@@ -140,6 +142,4 @@ def main():
 
 
 if __name__ == "__main__":
-    import jax
-    import jax.numpy as jnp
     main()
