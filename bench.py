@@ -88,9 +88,13 @@ def main():
     from mxnet_tpu import gluon, nd
     from mxnet_tpu.parallel.data_parallel import block_apply_fn
 
-    net = gluon.model_zoo.vision.resnet50_v1(classes=1000)
+    # NHWC puts C on the TPU's 128-lane minor dim (BENCH_LAYOUT=NCHW for the
+    # reference-layout variant); parameters are stored OIHW either way
+    layout = os.environ.get("BENCH_LAYOUT", "NCHW")
+    ishape = (3, 224, 224) if layout == "NCHW" else (224, 224, 3)
+    net = gluon.model_zoo.vision.resnet50_v1(classes=1000, layout=layout)
     net.initialize()
-    net(nd.array(np.zeros((1, 3, 224, 224), np.float32)))  # materialize shapes
+    net(nd.array(np.zeros((1,) + ishape, np.float32)))  # materialize shapes
     apply_fn, params = block_apply_fn(net, is_train=True)
     momenta = {k: jnp.zeros_like(v) for k, v in params.items()}
 
@@ -132,7 +136,7 @@ def main():
     fused_img_per_sec = None
     for bs in batch_candidates:
         try:
-            x = jnp.asarray(np.random.rand(bs, 3, 224, 224).astype(np.float32))
+            x = jnp.asarray(np.random.rand(bs, *ishape).astype(np.float32))
             y = jnp.asarray(np.random.randint(0, 1000, (bs,)).astype(np.int32))
             # fresh copies — donation consumes them on every attempt
             p = jax.tree_util.tree_map(jnp.copy, params)

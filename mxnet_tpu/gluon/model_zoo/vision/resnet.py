@@ -13,10 +13,13 @@ either post- or pre-activation order, and one ``_ResNet`` trunk assembles
 stem/stages/head from a per-depth repeat table. The ten public constructors
 are generated from that table.
 
-TPU notes: the public layout is NCHW (XLA re-lays out to its preferred
-tiling under ``jit``); BatchNorm and ReLU are written as separate ops and
-left for XLA to fuse into the conv epilogues; run under ``hybridize()`` +
-bf16 for MXU-shaped throughput.
+TPU notes: the default layout is NCHW for reference-API compatibility, but
+every constructor takes ``layout="NHWC"`` to build the channels-last variant
+(TPU-preferred: C rides the 128-lane minor dimension, so BatchNorm reductions
+and conv tiling avoid relayouts).  Parameters are stored OIHW either way, so
+checkpoints swap freely between layouts.  BatchNorm and ReLU are written as
+separate ops and left for XLA to fuse into the conv epilogues; run under
+``hybridize()`` + bf16 for MXU-shaped throughput.
 """
 from __future__ import annotations
 
@@ -55,6 +58,12 @@ def _triple_plan(width, stride, preact):
             (width, 1, 1, 0, True))
 
 
+def _bn(layout, **kw):
+    from ....ops.nn import is_channels_last
+
+    return nn.BatchNorm(axis=-1 if is_channels_last(layout) else 1, **kw)
+
+
 class _ResidualUnit(HybridBlock):
     """y = act-arrangement(convs(x)) + shortcut(x).
 
@@ -67,34 +76,35 @@ class _ResidualUnit(HybridBlock):
     for a 1x1 projection (BN'd only in post-act form, as in the reference).
     """
 
-    def __init__(self, plan, preact, project, **kwargs):
+    def __init__(self, plan, preact, project, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         self._preact = preact
+        lo = layout
         with self.name_scope():
             if preact:
-                self.gate = nn.BatchNorm()
+                self.gate = _bn(lo)
                 self.trunk = nn.HybridSequential(prefix="")
                 for i, (w, k, s, p, b) in enumerate(plan):
                     if i:
-                        self.trunk.add(nn.BatchNorm())
+                        self.trunk.add(_bn(lo))
                         self.trunk.add(nn.Activation("relu"))
-                    self.trunk.add(nn.Conv2D(w, k, s, p, use_bias=b))
+                    self.trunk.add(nn.Conv2D(w, k, s, p, use_bias=b, layout=lo))
                 self.shortcut = (nn.Conv2D(project[0], 1, project[1],
-                                           use_bias=False)
+                                           use_bias=False, layout=lo)
                                  if project else None)
             else:
                 self.trunk = nn.HybridSequential(prefix="")
                 last = len(plan) - 1
                 for i, (w, k, s, p, b) in enumerate(plan):
-                    self.trunk.add(nn.Conv2D(w, k, s, p, use_bias=b))
-                    self.trunk.add(nn.BatchNorm())
+                    self.trunk.add(nn.Conv2D(w, k, s, p, use_bias=b, layout=lo))
+                    self.trunk.add(_bn(lo))
                     if i != last:
                         self.trunk.add(nn.Activation("relu"))
                 if project:
                     sc = nn.HybridSequential(prefix="")
                     sc.add(nn.Conv2D(project[0], 1, project[1],
-                                     use_bias=False))
-                    sc.add(nn.BatchNorm())
+                                     use_bias=False, layout=lo))
+                    sc.add(_bn(lo))
                     self.shortcut = sc
                 else:
                     self.shortcut = None
@@ -158,25 +168,26 @@ class _ResNet(HybridBlock):
     """
 
     def __init__(self, block, layers, channels, preact, classes=1000,
-                 thumbnail=False, **kwargs):
+                 thumbnail=False, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         if len(layers) != len(channels) - 1:
             raise ValueError("need one channel entry per stage plus the stem")
         self._preact = preact
+        lo = layout
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             if preact:
                 # un-affine BN on raw input: the v2 papers' input whitening
-                self.features.add(nn.BatchNorm(scale=False, center=False))
+                self.features.add(_bn(lo, scale=False, center=False))
             if thumbnail:
                 self.features.add(nn.Conv2D(channels[0], 3, 1, 1,
-                                            use_bias=False))
+                                            use_bias=False, layout=lo))
             else:
                 self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
+                                            use_bias=False, layout=lo))
+                self.features.add(_bn(lo))
                 self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
+                self.features.add(nn.MaxPool2D(3, 2, 1, layout=lo))
             width_in = channels[0]
             for stage, (reps, width) in enumerate(zip(layers, channels[1:])):
                 with self.features.name_scope():
@@ -184,16 +195,17 @@ class _ResNet(HybridBlock):
                     with run.name_scope():
                         run.add(block(width, 1 if stage == 0 else 2,
                                       downsample=width != width_in,
-                                      in_channels=width_in, prefix=""))
+                                      in_channels=width_in, layout=lo,
+                                      prefix=""))
                         for _ in range(reps - 1):
                             run.add(block(width, 1, in_channels=width,
-                                          prefix=""))
+                                          layout=lo, prefix=""))
                 self.features.add(run)
                 width_in = width
             if preact:
-                self.features.add(nn.BatchNorm())
+                self.features.add(_bn(lo))
                 self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
+            self.features.add(nn.GlobalAvgPool2D(layout=lo))
             self.features.add(nn.Flatten())
             self.output = nn.Dense(classes, in_units=width_in)
 

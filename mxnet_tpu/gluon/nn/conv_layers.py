@@ -37,6 +37,12 @@ class _Conv(HybridBlock):
         self._ndim = ndim
         self._transpose = transpose
         self._output_padding = _tuple(output_padding, ndim)
+        if transpose and self._channels_last:
+            # the Deconvolution op's weight flip/regroup is channels-first;
+            # refuse rather than silently mis-binding dimension numbers
+            raise NotImplementedError(
+                "channels-last layout is not supported for transpose convs; "
+                "use NC* layout")
         if transpose:
             wshape = (in_channels, channels // groups) + self._kernel \
                 if in_channels else (0, channels // groups) + self._kernel
@@ -53,8 +59,14 @@ class _Conv(HybridBlock):
             else:
                 self.bias = None
 
+    @property
+    def _channels_last(self):
+        from ...ops.nn import is_channels_last
+
+        return is_channels_last(self._layout)
+
     def _param_shape(self, param, args):
-        cin = args[0].shape[1]
+        cin = args[0].shape[-1 if self._channels_last else 1]
         if self._transpose:
             return (cin, self._channels // self._groups) + self._kernel
         return (self._channels, cin // self._groups) + self._kernel
@@ -66,6 +78,14 @@ class _Conv(HybridBlock):
                   num_group=self._groups, no_bias=bias is None)
         if self._transpose:
             kw["adj"] = self._output_padding
+        if self._channels_last and not self._transpose:
+            # parameters are stored layout-independent (OI<spatial>, so
+            # checkpoints swap freely between layouts); the conv op's
+            # channels-last kernel convention is O<spatial>I — transpose here,
+            # XLA folds it into its own layout assignment
+            kw["layout"] = self._layout
+            weight = F.transpose(
+                weight, axes=(0,) + tuple(range(2, 2 + self._ndim)) + (1,))
         args = [x, weight] + ([bias] if bias is not None else [])
         out = op(*args, **kw)
         if self._activation is not None:
@@ -151,6 +171,8 @@ class _Pooling(HybridBlock):
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
             "pooling_convention": "full" if ceil_mode else "valid"}
+        if layout is not None:
+            self._kwargs["layout"] = layout
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -165,21 +187,21 @@ class MaxPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, **kwargs):
         super().__init__(_tuple(pool_size, 1), strides and _tuple(strides, 1),
-                         _tuple(padding, 1), ceil_mode, False, "max", **kwargs)
+                         _tuple(padding, 1), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
                  ceil_mode=False, **kwargs):
         super().__init__(_tuple(pool_size, 2), strides and _tuple(strides, 2),
-                         _tuple(padding, 2), ceil_mode, False, "max", **kwargs)
+                         _tuple(padding, 2), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, **kwargs):
         super().__init__(_tuple(pool_size, 3), strides and _tuple(strides, 3),
-                         _tuple(padding, 3), ceil_mode, False, "max", **kwargs)
+                         _tuple(padding, 3), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
@@ -187,7 +209,8 @@ class AvgPool1D(_Pooling):
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tuple(pool_size, 1), strides and _tuple(strides, 1),
                          _tuple(padding, 1), ceil_mode, False, "avg",
-                         count_include_pad=count_include_pad, **kwargs)
+                         count_include_pad=count_include_pad,
+                         layout=layout, **kwargs)
 
 
 class AvgPool2D(_Pooling):
@@ -195,7 +218,8 @@ class AvgPool2D(_Pooling):
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tuple(pool_size, 2), strides and _tuple(strides, 2),
                          _tuple(padding, 2), ceil_mode, False, "avg",
-                         count_include_pad=count_include_pad, **kwargs)
+                         count_include_pad=count_include_pad,
+                         layout=layout, **kwargs)
 
 
 class AvgPool3D(_Pooling):
@@ -203,37 +227,38 @@ class AvgPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tuple(pool_size, 3), strides and _tuple(strides, 3),
                          _tuple(padding, 3), ceil_mode, False, "avg",
-                         count_include_pad=count_include_pad, **kwargs)
+                         count_include_pad=count_include_pad,
+                         layout=layout, **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), True, True, "max", **kwargs)
+        super().__init__((1,), None, (0,), True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), True, True, "max", **kwargs)
+        super().__init__((1, 1), None, (0, 0), True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max", **kwargs)
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max", layout=layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), True, True, "avg", **kwargs)
+        super().__init__((1,), None, (0,), True, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), True, True, "avg", **kwargs)
+        super().__init__((1, 1), None, (0, 0), True, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg", **kwargs)
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg", layout=layout, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

@@ -53,16 +53,24 @@ def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
 # Convolution / Deconvolution (src/operator/nn/convolution.cc, deconvolution.cc)
 # ---------------------------------------------------------------------------
 
+def is_channels_last(layout):
+    """True for channels-last layout strings ("NHWC"/"NWC"/"NDHWC"); False for
+    None or channels-first ("NC...")."""
+    return bool(layout) and layout[1] != "C"
+
+
 def _conv_dnums(ndim, layout):
+    # channels-last kernels follow the reference's convention for layout=N..C:
+    # weight is (num_filter, *k, channels/group), i.e. O<spatial>I
     if ndim == 3:  # NCW
-        return ("NCH", "OIH", "NCH") if layout in (None, "NCW") else ("NHC", "HIO", "NHC")
+        return ("NCH", "OIH", "NCH") if layout in (None, "NCW") else ("NHC", "OHI", "NHC")
     if ndim == 4:
         if layout in (None, "NCHW"):
             return ("NCHW", "OIHW", "NCHW")
-        return ("NHWC", "HWIO", "NHWC")
+        return ("NHWC", "OHWI", "NHWC")
     if layout in (None, "NCDHW"):
         return ("NCDHW", "OIDHW", "NCDHW")
-    return ("NDHWC", "DHWIO", "NDHWC")
+    return ("NDHWC", "ODHWI", "NDHWC")
 
 
 @register("Convolution")
@@ -158,7 +166,10 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
             pooling_convention="valid", cudnn_off=False, count_include_pad=True,
             layout=None):
     nd = data.ndim - 2
-    spatial = tuple(range(2, 2 + nd))
+    # channels-last layouts put spatial dims at 1..nd; channels-first at 2..nd+1
+    channels_last = is_channels_last(layout)
+    sp0 = 1 if channels_last else 2
+    spatial = tuple(range(sp0, sp0 + nd))
     if global_pool:
         if pool_type == "max":
             return jnp.max(data, axis=spatial, keepdims=True)
@@ -166,18 +177,25 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
     k = _pair(kernel, nd)
     s = _pair(stride, nd) if stride else k
     p = _pair(pad, nd) if pad else (0,) * nd
-    window = (1, 1) + k
-    strides = (1, 1) + s
+
+    def _full(vals, fill):
+        core = list(vals)
+        return ((fill,) + tuple(core) + (fill,)) if channels_last \
+            else ((fill, fill) + tuple(core))
+
+    window = _full(k, 1)
+    strides = _full(s, 1)
     if pooling_convention == "full":
         # ceil-mode: pad high side enough that ceil division is honored
-        pads = [(0, 0), (0, 0)]
+        sp_pads = []
         for i in range(nd):
-            in_sz = data.shape[2 + i] + 2 * p[i]
+            in_sz = data.shape[sp0 + i] + 2 * p[i]
             out_sz = -(-(in_sz - k[i]) // s[i]) + 1  # ceil
             needed = (out_sz - 1) * s[i] + k[i] - in_sz
-            pads.append((p[i], p[i] + max(0, needed)))
+            sp_pads.append((p[i], p[i] + max(0, needed)))
     else:
-        pads = [(0, 0), (0, 0)] + [(p[i], p[i]) for i in range(nd)]
+        sp_pads = [(p[i], p[i]) for i in range(nd)]
+    pads = list(_full(sp_pads, (0, 0)))
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, init, lax.max, window, strides, pads)
@@ -222,7 +240,7 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
     stateful frontends (NDArray/Gluon) rather than mutated here — see
     ndarray/__init__.py `_STATEFUL_BN` handling.
     """
-    ax = int(axis)
+    ax = int(axis) % data.ndim  # normalize axis=-1 (channels-last BN)
     red = tuple(i for i in range(data.ndim) if i != ax)
     shape = [1] * data.ndim
     shape[ax] = data.shape[ax]

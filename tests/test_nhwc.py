@@ -1,0 +1,88 @@
+"""Channels-last (NHWC) path: pooling-op axes, Conv2D layer, and the model-zoo
+ResNet layout option producing the same numbers as the NCHW build from the
+same parameters.
+
+TPU rationale: NHWC puts C on the 128-lane minor dim, avoiding relayouts for
+BN reductions and conv tiling (docs/perf_analysis.md).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_pooling_op_nhwc_matches_nchw():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    ref = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max").asnumpy()
+    out = nd.Pooling(nd.array(x.transpose(0, 2, 3, 1)), kernel=(2, 2),
+                     stride=(2, 2), pool_type="max", layout="NHWC").asnumpy()
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref, atol=1e-6)
+    # global + avg forms
+    ref = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg").asnumpy()
+    out = nd.Pooling(nd.array(x.transpose(0, 2, 3, 1)), global_pool=True,
+                     pool_type="avg", layout="NHWC").asnumpy()
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref, atol=1e-6)
+
+
+def test_conv2d_layer_nhwc_matches_nchw():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 5, 9, 9).astype(np.float32)
+
+    c1 = nn.Conv2D(7, 3, 2, 1, in_channels=5, use_bias=True)
+    c1.initialize()
+    y1 = c1(nd.array(x)).asnumpy()
+
+    c2 = nn.Conv2D(7, 3, 2, 1, in_channels=5, use_bias=True, layout="NHWC")
+    c2.initialize()
+    # same OIHW parameter storage in both layouts
+    c2.weight.set_data(c1.weight.data())
+    c2.bias.set_data(c1.bias.data())
+    y2 = c2(nd.array(x.transpose(0, 2, 3, 1))).asnumpy()
+    assert y2.shape == (2, 5, 5, 7)
+    np.testing.assert_allclose(y2.transpose(0, 3, 1, 2), y1, atol=1e-4)
+
+
+def test_resnet18_nhwc_matches_nchw_from_same_params(tmp_path):
+    rng = np.random.RandomState(2)
+    x = rng.rand(2, 3, 32, 32).astype(np.float32)
+
+    a = gluon.model_zoo.vision.resnet18_v1(classes=10)
+    a.initialize()
+    ya = a(nd.array(x)).asnumpy()
+    f = str(tmp_path / "params")
+    a.save_parameters(f)
+
+    b = gluon.model_zoo.vision.resnet18_v1(classes=10, layout="NHWC")
+    b.initialize()
+    b(nd.array(x.transpose(0, 2, 3, 1)))  # materialize deferred shapes
+    b.load_parameters(f)
+    yb = b(nd.array(x.transpose(0, 2, 3, 1))).asnumpy()
+    np.testing.assert_allclose(yb, ya, atol=1e-3)
+
+
+def test_resnet_nhwc_hybridized_train_step():
+    from mxnet_tpu import autograd
+
+    net = gluon.model_zoo.vision.resnet18_v1(classes=4, layout="NHWC")
+    net.initialize()
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(3)
+    x = nd.array(rng.rand(8, 16, 16, 3).astype(np.float32))
+    y = nd.array(rng.randint(0, 4, (8,)).astype(np.float32))
+    first = last = None
+    # BN batch statistics make the first couple of steps noisy; 8 steps is
+    # enough for this 8-sample problem to reach near-zero loss
+    for _ in range(8):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        tr.step(8)
+        v = float(loss.mean().asnumpy())
+        first = v if first is None else first
+        last = v
+    assert last < first, (first, last)
