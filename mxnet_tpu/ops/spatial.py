@@ -276,10 +276,12 @@ def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
         cls = _np.arange(OD) * ncls // OD               # class of each channel
         tx_idx = jnp.asarray(2 * cls)                   # (OD,)
         ty_idx = jnp.asarray(2 * cls + 1)
-    # sub-sample offsets within a bin, stacked on a leading axis S = spp^2
+    # sub-sample offsets within a bin, stacked on a leading axis S = spp^2;
+    # the reference samples at sub-bin origins (wstart + iw * sub_bin), not
+    # centers (deformable_psroi_pooling-inl.h)
     sy, sx = _np.meshgrid(_np.arange(spp), _np.arange(spp), indexing="ij")
-    sx = jnp.asarray((sx.ravel() + 0.5)[:, None, None, None])   # (S,1,1,1)
-    sy = jnp.asarray((sy.ravel() + 0.5)[:, None, None, None])
+    sx = jnp.asarray(sx.ravel().astype(_np.float32)[:, None, None, None])
+    sy = jnp.asarray(sy.ravel().astype(_np.float32)[:, None, None, None])
 
     def one_roi(roi, troi):
         bidx = roi[0].astype(jnp.int32)
