@@ -1,0 +1,69 @@
+"""Convolutional sentence classification (Kim 2014).
+
+Mirrors the reference ``example/cnn_text_classification/text_cnn.py``:
+embedding -> parallel conv branches with window sizes 3/4/5 -> max-over-time
+pooling -> concat -> dropout -> softmax.  Uses a deterministic synthetic
+sentiment corpus (no egress): the label is whether "positive" tokens outnumber
+"negative" ones.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_corpus(rng, n, seq_len, vocab):
+    """Presence task (what max-over-time pooling detects): the label is
+    whether any 'sentiment-bearing' token (a small reserved id range)
+    occurs anywhere in the sentence."""
+    k = max(2, vocab // 40)
+    x = rng.randint(0, vocab, (n, seq_len))
+    return x.astype(np.float32), (x < k).any(axis=1).astype(np.float32)
+
+
+def text_cnn(vocab, dim, seq_len, filter_sizes=(3, 4, 5), num_filter=100):
+    data = mx.sym.Variable("data")  # (B, T) ids
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=dim)
+    emb = mx.sym.Reshape(emb, shape=(-1, 1, seq_len, dim))  # (B, 1, T, D)
+    branches = []
+    for fs in filter_sizes:
+        conv = mx.sym.Convolution(emb, kernel=(fs, dim), num_filter=num_filter)
+        act = mx.sym.Activation(conv, act_type="relu")
+        pool = mx.sym.Pooling(act, kernel=(seq_len - fs + 1, 1),
+                              pool_type="max")  # max over time
+        branches.append(pool)
+    h = mx.sym.Concat(*branches, dim=1)
+    h = mx.sym.Flatten(h)
+    h = mx.sym.Dropout(h, p=0.5)
+    fc = mx.sym.FullyConnected(h, num_hidden=2)
+    return mx.sym.SoftmaxOutput(fc, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=2000)
+    ap.add_argument("--dim", type=int, default=48)
+    ap.add_argument("--num-epochs", type=int, default=4)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    xtr, ytr = make_corpus(rng, 4096, args.seq_len, args.vocab)
+    xva, yva = make_corpus(rng, 512, args.seq_len, args.vocab)
+    train = mx.io.NDArrayIter(xtr, ytr, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(xva, yva, args.batch_size)
+
+    mod = mx.mod.Module(text_cnn(args.vocab, args.dim, args.seq_len))
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="adam", optimizer_params={"learning_rate": 2e-3},
+            eval_metric="accuracy",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    val.reset()
+    print("final validation:", dict(mod.score(val, "accuracy")))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Deep Embedded Clustering (Xie et al. 2016).
+
+Mirrors the reference ``example/deep-embedded-clustering``: pretrain a
+stacked autoencoder, k-means the embeddings for initial centroids, then
+refine encoder + centroids jointly against the sharpened target distribution
+(the KL(P||Q) self-training loop), reporting cluster accuracy by Hungarian-free
+greedy matching.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, autograd
+from mxnet_tpu.gluon import nn
+
+
+def synth_clusters(rng, n, dim=32, k=6):
+    centers = rng.randn(k, dim) * 3.0
+    y = rng.randint(0, k, (n,))
+    x = centers[y] + rng.randn(n, dim) * 0.6
+    return x.astype(np.float32), y
+
+
+class AutoEncoder(gluon.HybridBlock):
+    def __init__(self, latent=8, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.enc = nn.HybridSequential(prefix="enc_")
+            for h in (64, 32):
+                self.enc.add(nn.Dense(h, activation="relu"))
+            self.enc.add(nn.Dense(latent))
+            self.dec = nn.HybridSequential(prefix="dec_")
+            for h in (32, 64):
+                self.dec.add(nn.Dense(h, activation="relu"))
+            self.dec.add(nn.Dense(32))
+
+    def hybrid_forward(self, F, x):
+        z = self.enc(x)
+        return self.dec(z), z
+
+
+def kmeans(z, k, iters=20, rng=None):
+    centers = z[rng.choice(len(z), k, replace=False)]
+    for _ in range(iters):
+        d = ((z[:, None] - centers[None]) ** 2).sum(-1)
+        a = d.argmin(1)
+        for j in range(k):
+            if (a == j).any():
+                centers[j] = z[a == j].mean(0)
+    return centers
+
+
+def cluster_acc(pred, y, k):
+    acc = 0
+    for j in range(k):   # greedy majority matching
+        m = pred == j
+        if m.any():
+            acc += np.bincount(y[m]).max()
+    return acc / len(y)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=6)
+    ap.add_argument("--pretrain-epochs", type=int, default=20)
+    ap.add_argument("--refine-iters", type=int, default=60)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    X, Y = synth_clusters(rng, 2048, k=args.k)
+    net = AutoEncoder()
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    l2 = gluon.loss.L2Loss()
+
+    # 1. reconstruction pretraining
+    B = 256
+    for epoch in range(args.pretrain_epochs):
+        for i in range(len(X) // B):
+            xb = nd.array(X[i * B:(i + 1) * B])
+            with autograd.record():
+                xr, _ = net(xb)
+                loss = l2(xr, xb)
+            loss.backward()
+            tr.step(B)
+    print("pretrain recon loss:", float(loss.mean().asnumpy()))
+
+    # 2. k-means init on embeddings
+    Z = net(nd.array(X))[1].asnumpy()
+    centers = kmeans(Z, args.k, rng=rng)
+    mu = nd.array(centers)
+    mu.attach_grad()
+
+    # 3. DEC refinement: soft assignment q (Student-t), target p = q^2/f
+    enc_params = [p for p in net.collect_params().values()
+                  if p.name.startswith("autoencoder0_enc")] or \
+        list(net.collect_params().values())
+    for it in range(args.refine_iters):
+        xb = nd.array(X[rng.choice(len(X), 512, replace=False)])
+        with autograd.record():
+            z = net(xb)[1]
+            d2 = nd.sum((nd.expand_dims(z, 1) - nd.expand_dims(mu, 0)) ** 2,
+                        axis=2)
+            q = 1.0 / (1.0 + d2)
+            q = q / nd.sum(q, axis=1, keepdims=True)
+            qn = q.asnumpy()
+            f = qn.sum(0)
+            p = (qn ** 2) / f
+            p = p / p.sum(1, keepdims=True)
+            loss = -nd.sum(nd.array(p) * nd.log(q + 1e-10)) / q.shape[0]
+        loss.backward()
+        tr.step(512)
+        mu._data = (mu - 0.01 * mu.grad)._data  # manual centroid step
+        mu.attach_grad()
+
+    Z = net(nd.array(X))[1].asnumpy()
+    d = ((Z[:, None] - mu.asnumpy()[None]) ** 2).sum(-1)
+    pred = d.argmin(1)
+    print(f"cluster accuracy: {cluster_acc(pred, Y, args.k):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Multi-task learning: one trunk, two softmax heads trained jointly.
+
+Mirrors the reference ``example/multi-task/example_multi_task.py`` — digit
+classification plus an auxiliary task (here: digit parity) sharing a trunk,
+trained through one Module over a grouped symbol, with a per-head accuracy
+metric.
+"""
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.INFO)
+
+
+class MultiTaskIter(mx.io.DataIter):
+    """Wraps MNISTIter, emitting (digit, parity) label pairs."""
+
+    def __init__(self, base):
+        super().__init__(base.batch_size)
+        self.base = base
+
+    @property
+    def provide_data(self):
+        return self.base.provide_data
+
+    @property
+    def provide_label(self):
+        d = self.base.provide_label[0]
+        return [mx.io.DataDesc("digit_label", d.shape, d.dtype),
+                mx.io.DataDesc("parity_label", d.shape, d.dtype)]
+
+    def reset(self):
+        self.base.reset()
+
+    def next(self):
+        batch = self.base.next()
+        digit = batch.label[0]
+        parity = mx.nd.array(np.asarray(digit.asnumpy()) % 2)
+        return mx.io.DataBatch(batch.data, [digit, parity], batch.pad,
+                               batch.index)
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    def __init__(self):
+        super().__init__("multi_acc")
+        self.task_hits = [0, 0]
+        self.task_n = [0, 0]
+
+    def update(self, labels, preds):
+        for i, (lab, pred) in enumerate(zip(labels, preds)):
+            hit = (np.argmax(pred.asnumpy(), axis=1)
+                   == lab.asnumpy().astype(int)).sum()
+            self.task_hits[i] += int(hit)
+            self.task_n[i] += lab.shape[0]
+        self.sum_metric = sum(self.task_hits)
+        self.num_inst = sum(self.task_n)
+
+    def reset(self):
+        super().reset()
+        self.task_hits = [0, 0]
+        self.task_n = [0, 0]
+
+
+def build_net():
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=256),
+                          act_type="relu")
+    h = mx.sym.Activation(mx.sym.FullyConnected(h, num_hidden=128),
+                          act_type="relu")
+    digit = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=10),
+                                 mx.sym.Variable("digit_label"), name="digit")
+    parity = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=2),
+                                  mx.sym.Variable("parity_label"), name="parity")
+    return mx.sym.Group([digit, parity])
+
+
+def main():
+    batch_size = 128
+    train = MultiTaskIter(mx.io.MNISTIter(batch_size=batch_size, flat=True,
+                                          seed=1))
+    mod = mx.mod.Module(build_net(),
+                        label_names=["digit_label", "parity_label"])
+    mod.fit(train, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric=MultiAccuracy(),
+            batch_end_callback=mx.callback.Speedometer(batch_size, 20))
+    m = MultiAccuracy()
+    train.reset()
+    score = mod.score(train, m)
+    print("joint accuracy:", dict(score))
+    print("digit acc:", m.task_hits[0] / max(m.task_n[0], 1),
+          "parity acc:", m.task_hits[1] / max(m.task_n[1], 1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Multivariate time-series forecasting (LSTNet-style).
+
+Mirrors the reference ``example/multivariate_time_series`` (LSTNet on
+electricity data): a 1-D conv over the lookback window feeds a GRU, and a
+parallel autoregressive linear highway stabilizes scale; trained to predict
+all series one horizon ahead, scored with RRSE (root relative squared error).
+Synthetic coupled-oscillator data keeps it hermetic.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, autograd
+from mxnet_tpu.gluon import nn, rnn
+
+
+def synth_series(rng, steps, series=8):
+    t = np.arange(steps)[:, None]
+    freqs = rng.uniform(0.01, 0.08, (1, series))
+    phase = rng.uniform(0, 6.28, (1, series))
+    base = np.sin(2 * np.pi * freqs * t + phase)
+    coupling = rng.rand(series, series) * 0.2
+    return (base + base @ coupling + rng.randn(steps, series) * 0.05).astype(np.float32)
+
+
+def windows(data, lookback, horizon):
+    xs, ys = [], []
+    for i in range(len(data) - lookback - horizon):
+        xs.append(data[i:i + lookback])
+        ys.append(data[i + lookback + horizon - 1])
+    return np.stack(xs), np.stack(ys)
+
+
+class LSTNet(gluon.HybridBlock):
+    def __init__(self, series, conv_ch=32, gru_h=64, ar_window=8, **kw):
+        super().__init__(**kw)
+        self.ar_window = ar_window
+        with self.name_scope():
+            self.conv = nn.Conv1D(conv_ch, kernel_size=5, activation="relu")
+            self.gru = rnn.GRU(gru_h, layout="NTC")
+            self.head = nn.Dense(series)
+            self.ar = nn.Dense(1, flatten=False)
+
+    def hybrid_forward(self, F, x):            # x: (B, T, S)
+        c = self.conv(x.transpose(axes=(0, 2, 1)))   # (B, C, T')
+        g = self.gru(c.transpose(axes=(0, 2, 1)))    # (B, T', H)
+        deep = self.head(F.SequenceLast(g.transpose(axes=(1, 0, 2))))
+        # autoregressive highway: linear per-series over the last ar_window
+        tail = F.slice_axis(x, axis=1, begin=-self.ar_window, end=None)
+        ar = self.ar(tail.transpose(axes=(0, 2, 1))).reshape((0, -1))
+        return deep + ar
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lookback", type=int, default=48)
+    ap.add_argument("--horizon", type=int, default=3)
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    data = synth_series(rng, 3000)
+    split = int(len(data) * 0.8)
+    Xtr, Ytr = windows(data[:split], args.lookback, args.horizon)
+    Xte, Yte = windows(data[split:], args.lookback, args.horizon)
+
+    net = LSTNet(series=data.shape[1])
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    l2 = gluon.loss.L2Loss()
+    B = args.batch_size
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(Xtr))
+        tot = 0.0
+        nb = len(Xtr) // B
+        for i in range(nb):
+            idx = perm[i * B:(i + 1) * B]
+            x, y = nd.array(Xtr[idx]), nd.array(Ytr[idx])
+            with autograd.record():
+                loss = l2(net(x), y)
+            loss.backward()
+            tr.step(B)
+            tot += float(loss.mean().asnumpy())
+        print(f"epoch {epoch}: mse {tot / nb:.5f}")
+
+    pred = net(nd.array(Xte)).asnumpy()
+    rrse = np.sqrt(((pred - Yte) ** 2).sum()) / \
+        np.sqrt(((Yte - Yte.mean()) ** 2).sum())
+    print(f"test RRSE: {rrse:.4f}  (naive-mean predictor = 1.0)")
+
+
+if __name__ == "__main__":
+    main()

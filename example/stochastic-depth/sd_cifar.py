@@ -1,0 +1,109 @@
+"""Stochastic-depth residual network.
+
+Mirrors the reference ``example/stochastic-depth``: residual units are
+skipped at random during training with a linearly-decaying survival
+probability (Huang et al. 2016); at inference every unit runs, scaled by its
+survival probability.  Written TPU-first: the death decision is a Bernoulli
+mask multiplied into the branch (no data-dependent Python control flow), so
+the jitted program is fixed-shape.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, autograd
+from mxnet_tpu.gluon import nn
+
+
+class StochasticResUnit(gluon.HybridBlock):
+    def __init__(self, channels, survival_p, stride=1, downsample=False, **kw):
+        super().__init__(**kw)
+        self.p = float(survival_p)
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="")
+            self.body.add(nn.Conv2D(channels, 3, stride, 1, use_bias=False))
+            self.body.add(nn.BatchNorm())
+            self.body.add(nn.Activation("relu"))
+            self.body.add(nn.Conv2D(channels, 3, 1, 1, use_bias=False))
+            self.body.add(nn.BatchNorm())
+            self.ds = (nn.Conv2D(channels, 1, stride, use_bias=False)
+                       if downsample else None)
+
+    def hybrid_forward(self, F, x):
+        skip = x if self.ds is None else self.ds(x)
+        branch = self.body(x)
+        if autograd.is_training():
+            # one Bernoulli draw per forward: multiply-by-mask keeps the
+            # program fixed-shape under jit (no lax.cond needed)
+            gate = F.random.uniform(0, 1, shape=(1, 1, 1, 1)) < self.p
+            branch = F.broadcast_mul(branch, gate.astype("float32"))
+        else:
+            branch = branch * self.p
+        return F.Activation(skip + branch, act_type="relu")
+
+
+def build(depth_per_stage=(3, 3, 3), channels=(16, 32, 64), p_final=0.5):
+    net = nn.HybridSequential()
+    total = sum(depth_per_stage)
+    k = 0
+    with net.name_scope():
+        net.add(nn.Conv2D(16, 3, 1, 1, use_bias=False))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        for s, (reps, ch) in enumerate(zip(depth_per_stage, channels)):
+            for r in range(reps):
+                k += 1
+                # linear decay: survival 1.0 at the stem -> p_final at the top
+                p = 1.0 - (k / total) * (1.0 - p_final)
+                net.add(StochasticResUnit(ch, p, stride=2 if (s and not r) else 1,
+                                          downsample=(s and not r)))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(10))
+    return net
+
+
+def synth_cifar(rng, n):
+    y = rng.randint(0, 10, (n,))
+    x = rng.rand(n, 3, 32, 32).astype(np.float32) * 0.15
+    for c in range(10):
+        m = y == c
+        x[m, c % 3, (c * 3) % 28:(c * 3) % 28 + 5, :] += 0.8
+    return x, y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=3)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    X, Y = synth_cifar(rng, 2048)
+    net = build()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    B = args.batch_size
+    for epoch in range(args.epochs):
+        tot = 0.0
+        nb = len(X) // B
+        for i in range(nb):
+            x = nd.array(X[i * B:(i + 1) * B])
+            y = nd.array(Y[i * B:(i + 1) * B])
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(B)
+            tot += float(loss.mean().asnumpy())
+        print(f"epoch {epoch}: loss {tot / nb:.4f}")
+    # eval-mode accuracy (all units active, scaled)
+    preds = np.argmax(net(nd.array(X[:512])).asnumpy(), axis=1)
+    print("train-set acc (first 512):", float((preds == Y[:512]).mean()))
+
+
+if __name__ == "__main__":
+    main()

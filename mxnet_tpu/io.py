@@ -370,7 +370,10 @@ class MNISTIter(NDArrayIter):
             imgs = imgs[part_index::num_parts]
             labs = labs[part_index::num_parts]
         data = imgs.reshape(-1, 784) if flat else imgs.reshape(-1, 1, 28, 28)
-        super().__init__(data, labs, batch_size=batch_size, shuffle=shuffle)
+        # forward naming kwargs (data_name/label_name) so custom-named heads
+        # (e.g. SVMOutput's svm_label) bind against this iterator
+        super().__init__(data, labs, batch_size=batch_size, shuffle=shuffle,
+                         **kwargs)
 
 
 def _exists_any(path):
