@@ -1,0 +1,149 @@
+// Train an MLP classifier from C++ through the embedded-runtime API.
+//
+// Reference analogue: the reference cpp-package's mlp.cpp / train_mnist —
+// symbol bind + forward/backward + KVStore-optimized updates, all via the C
+// API.  Here the executor and kvstore run on the XLA stack behind
+// libmxtpu_rt.so; this file is plain C++ with no Python in sight.
+//
+// Run from the repo root:  ./cpp-package/build/train_mlp
+
+#include <cmath>
+#include <cstdlib>
+#include <iostream>
+#include <random>
+#include <vector>
+
+#include "../include/mxtpu.hpp"
+
+static const char *kMlpJson = R"JSON(
+{"nodes": [
+  {"op": "null", "name": "data", "attrs": {}, "inputs": []},
+  {"op": "null", "name": "fc1_weight", "attrs": {}, "inputs": []},
+  {"op": "null", "name": "fc1_bias", "attrs": {}, "inputs": []},
+  {"op": "FullyConnected", "name": "fc1", "attrs": {"num_hidden": "64"},
+   "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+  {"op": "Activation", "name": "relu1", "attrs": {"act_type": "'relu'"},
+   "inputs": [[3, 0, 0]]},
+  {"op": "null", "name": "fc2_weight", "attrs": {}, "inputs": []},
+  {"op": "null", "name": "fc2_bias", "attrs": {}, "inputs": []},
+  {"op": "FullyConnected", "name": "fc2", "attrs": {"num_hidden": "10"},
+   "inputs": [[4, 0, 0], [5, 0, 0], [6, 0, 0]]},
+  {"op": "null", "name": "softmax_label", "attrs": {}, "inputs": []},
+  {"op": "SoftmaxOutput", "name": "softmax", "attrs": {},
+   "inputs": [[7, 0, 0], [8, 0, 0]]}],
+ "arg_nodes": [0, 1, 2, 5, 6, 8],
+ "heads": [[9, 0, 0]]}
+)JSON";
+
+struct Param {
+  std::string name;
+  std::vector<int64_t> shape;
+  std::vector<float> value;
+  std::vector<float> grad;
+  int64_t Size() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+};
+
+int main() {
+  // hermetic defaults; callers can override both in the environment
+  setenv("MXTPU_RT_PLATFORM", "cpu", 0);
+  setenv("MXTPU_RT_HOME", ".", 0);
+
+  const int B = 64, D = 32, C = 10, EPOCHS = 12, BATCHES = 24;
+
+  std::mt19937 rng(0);
+  std::normal_distribution<float> gauss(0.f, 1.f);
+  std::uniform_real_distribution<float> unif(0.f, 1.f);
+
+  // synthetic separable task: label = argmax(x . W*)
+  std::vector<float> wstar(D * C);
+  for (auto &v : wstar) v = gauss(rng);
+  std::vector<float> X(BATCHES * B * D);
+  std::vector<float> Y(BATCHES * B);
+  for (int i = 0; i < BATCHES * B; ++i) {
+    float best = -1e30f;
+    int arg = 0;
+    for (int d = 0; d < D; ++d) X[i * D + d] = unif(rng);
+    for (int c = 0; c < C; ++c) {
+      float s = 0.f;
+      for (int d = 0; d < D; ++d) s += X[i * D + d] * wstar[d * C + c];
+      if (s > best) { best = s; arg = c; }
+    }
+    Y[i] = static_cast<float>(arg);
+  }
+
+  std::vector<Param> params = {
+      {"fc1_weight", {64, D}, {}, {}},
+      {"fc1_bias", {64}, {}, {}},
+      {"fc2_weight", {10, 64}, {}, {}},
+      {"fc2_bias", {10}, {}, {}},
+  };
+  for (auto &p : params) {
+    p.value.resize(p.Size());
+    p.grad.resize(p.Size());
+    float scale = 1.f / std::sqrt(static_cast<float>(p.shape.back()));
+    for (auto &v : p.value)
+      v = (p.shape.size() > 1) ? gauss(rng) * scale : 0.f;
+  }
+
+  mxtpu::Executor exec(kMlpJson);
+  exec.SimpleBind({{"data", {B, D}},
+                   {"fc1_weight", {64, D}},
+                   {"fc1_bias", {64}},
+                   {"fc2_weight", {10, 64}},
+                   {"fc2_bias", {10}},
+                   {"softmax_label", {B}}});
+
+  mxtpu::KVStore kv("local");
+  kv.SetOptimizer("sgd", 0.2f);
+  for (size_t k = 0; k < params.size(); ++k)
+    kv.Init(static_cast<int>(k), params[k].value.data(), params[k].shape);
+
+  for (int epoch = 0; epoch < EPOCHS; ++epoch) {
+    int hits = 0;
+    for (int b = 0; b < BATCHES; ++b) {
+      exec.SetArg("data", &X[b * B * D], {B, D});
+      exec.SetArg("softmax_label", &Y[b * B], {B});
+      for (auto &p : params) exec.SetArg(p.name, p.value.data(), p.shape);
+      exec.Forward(/*is_train=*/true);
+      auto probs = exec.Output(0);
+      for (int i = 0; i < B; ++i) {
+        int arg = 0;
+        for (int c = 1; c < C; ++c)
+          if (probs[i * C + c] > probs[i * C + arg]) arg = c;
+        if (arg == static_cast<int>(Y[b * B + i])) ++hits;
+      }
+      exec.Backward();
+      for (size_t k = 0; k < params.size(); ++k) {
+        auto &p = params[k];
+        exec.Grad(p.name, p.grad.data(), p.Size());
+        kv.Push(static_cast<int>(k), p.grad.data(), p.shape);
+        kv.Pull(static_cast<int>(k), p.value.data(), p.Size());
+      }
+    }
+    std::cout << "epoch " << epoch << ": train acc "
+              << static_cast<float>(hits) / (BATCHES * B) << std::endl;
+  }
+  float acc = 0.f;
+  {
+    int hits = 0;
+    for (int b = 0; b < BATCHES; ++b) {
+      exec.SetArg("data", &X[b * B * D], {B, D});
+      for (auto &p : params) exec.SetArg(p.name, p.value.data(), p.shape);
+      exec.Forward(false);
+      auto probs = exec.Output(0);
+      for (int i = 0; i < B; ++i) {
+        int arg = 0;
+        for (int c = 1; c < C; ++c)
+          if (probs[i * C + c] > probs[i * C + arg]) arg = c;
+        if (arg == static_cast<int>(Y[b * B + i])) ++hits;
+      }
+    }
+    acc = static_cast<float>(hits) / (BATCHES * B);
+  }
+  std::cout << "final train accuracy: " << acc << std::endl;
+  return acc > 0.85f ? 0 : 1;
+}

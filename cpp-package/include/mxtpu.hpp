@@ -169,6 +169,127 @@ class RecordReader {
   void *h_ = nullptr;
 };
 
+// ---------------------------------------------------------------------------
+// Embedded-runtime surfaces (libmxtpu_rt.so): full train/infer loop from C++.
+// Reference analogue: cpp-package's Executor/KVStore over the C API.
+// ---------------------------------------------------------------------------
+
+class Executor {
+ public:
+  explicit Executor(const std::string &symbol_json) {
+    if (mxtpu_rt_init() != 0)
+      throw std::runtime_error(std::string("rt_init: ") +
+                               mxtpu_rt_last_error());
+    h_ = mxtpu_exec_create(symbol_json.c_str());
+    if (h_ <= 0)
+      throw std::runtime_error(std::string("exec_create: ") +
+                               mxtpu_rt_last_error());
+  }
+  ~Executor() {
+    if (h_ > 0) mxtpu_rt_free(h_);
+  }
+  Executor(const Executor &) = delete;
+  Executor &operator=(const Executor &) = delete;
+
+  // shapes: one entry per argument, e.g. {{"data", {32, 784}}, ...}
+  void SimpleBind(
+      const std::vector<std::pair<std::string, std::vector<int64_t>>> &shapes) {
+    std::vector<const char *> names;
+    std::vector<int64_t> flat;
+    std::vector<int> ndims;
+    for (auto &kv : shapes) {
+      names.push_back(kv.first.c_str());
+      ndims.push_back(static_cast<int>(kv.second.size()));
+      flat.insert(flat.end(), kv.second.begin(), kv.second.end());
+    }
+    Check(mxtpu_exec_simple_bind(h_, names.data(), flat.data(), ndims.data(),
+                                 static_cast<int>(names.size())),
+          "simple_bind");
+  }
+
+  void SetArg(const std::string &name, const float *data,
+              const std::vector<int64_t> &shape) {
+    Check(mxtpu_exec_set_arg(h_, name.c_str(), data, shape.data(),
+                             static_cast<int>(shape.size())),
+          "set_arg");
+  }
+
+  void Forward(bool is_train) { Check(mxtpu_exec_forward(h_, is_train), "forward"); }
+  void Backward() { Check(mxtpu_exec_backward(h_), "backward"); }
+  int NumOutputs() { return mxtpu_exec_num_outputs(h_); }
+
+  std::vector<int64_t> OutputShape(int i) {
+    int64_t shape[8];
+    int ndim = 0;
+    Check(mxtpu_exec_output_shape(h_, i, shape, &ndim, 8), "output_shape");
+    return std::vector<int64_t>(shape, shape + ndim);
+  }
+
+  std::vector<float> Output(int i) {
+    auto s = OutputShape(i);
+    int64_t n = 1;
+    for (auto d : s) n *= d;
+    std::vector<float> out(n);
+    Check(mxtpu_exec_output(h_, i, out.data(), n), "output");
+    return out;
+  }
+
+  void Grad(const std::string &name, float *buf, int64_t nelem) {
+    Check(mxtpu_exec_grad(h_, name.c_str(), buf, nelem), "grad");
+  }
+
+ private:
+  static void Check(int rc, const char *what) {
+    if (rc != 0)
+      throw std::runtime_error(std::string(what) + ": " +
+                               mxtpu_rt_last_error());
+  }
+  int64_t h_ = 0;
+};
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string &kind = "local") {
+    if (mxtpu_rt_init() != 0)
+      throw std::runtime_error(std::string("rt_init: ") +
+                               mxtpu_rt_last_error());
+    h_ = mxtpu_kv_create(kind.c_str());
+    if (h_ <= 0)
+      throw std::runtime_error(std::string("kv_create: ") +
+                               mxtpu_rt_last_error());
+  }
+  ~KVStore() {
+    if (h_ > 0) mxtpu_rt_free(h_);
+  }
+  KVStore(const KVStore &) = delete;
+  KVStore &operator=(const KVStore &) = delete;
+
+  void SetOptimizer(const std::string &name, float lr) {
+    Check(mxtpu_kv_set_optimizer(h_, name.c_str(), lr), "set_optimizer");
+  }
+  void Init(int key, const float *data, const std::vector<int64_t> &shape) {
+    Check(mxtpu_kv_init(h_, key, data, shape.data(),
+                        static_cast<int>(shape.size())),
+          "kv_init");
+  }
+  void Push(int key, const float *grad, const std::vector<int64_t> &shape) {
+    Check(mxtpu_kv_push(h_, key, grad, shape.data(),
+                        static_cast<int>(shape.size())),
+          "kv_push");
+  }
+  void Pull(int key, float *buf, int64_t nelem) {
+    Check(mxtpu_kv_pull(h_, key, buf, nelem), "kv_pull");
+  }
+
+ private:
+  static void Check(int rc, const char *what) {
+    if (rc != 0)
+      throw std::runtime_error(std::string(what) + ": " +
+                               mxtpu_rt_last_error());
+  }
+  int64_t h_ = 0;
+};
+
 }  // namespace mxtpu
 
 #endif  // MXTPU_HPP_

@@ -179,6 +179,39 @@ const char *mxtpu_sym_node_name(void *handle, int i);
 const char *mxtpu_sym_to_json(void *handle);
 int mxtpu_sym_save_file(void *handle, const char *path);
 
+/* ------------------------------------------------------- embedded runtime */
+
+/* Executor + kvstore surfaces (reference: c_api.h MXExecutor* / MXKVStore*).
+ * Implemented in libmxtpu_rt.so (built when Python dev headers are present):
+ * the runtime embeds a CPython interpreter and drives the public mxnet_tpu
+ * executor/kvstore through it, so foreign bindings get the full XLA-backed
+ * train/infer loop without a second runtime implementation.
+ * Env: MXTPU_RT_HOME (sys.path entry for the mxnet_tpu package, default "."),
+ * MXTPU_RT_PLATFORM (force jax platform, e.g. "cpu").  All buffers f32. */
+int mxtpu_rt_init(void);
+const char *mxtpu_rt_last_error(void);
+int64_t mxtpu_exec_create(const char *symbol_json);
+int mxtpu_exec_simple_bind(int64_t h, const char **arg_names,
+                           const int64_t *shapes_concat, const int *ndims,
+                           int n_args);
+int mxtpu_exec_set_arg(int64_t h, const char *name, const float *data,
+                       const int64_t *shape, int ndim);
+int mxtpu_exec_forward(int64_t h, int is_train);
+int mxtpu_exec_backward(int64_t h);
+int mxtpu_exec_num_outputs(int64_t h);
+int mxtpu_exec_output_shape(int64_t h, int idx, int64_t *shape, int *ndim,
+                            int cap);
+int mxtpu_exec_output(int64_t h, int idx, float *buf, int64_t nelem);
+int mxtpu_exec_grad(int64_t h, const char *name, float *buf, int64_t nelem);
+int64_t mxtpu_kv_create(const char *kind);
+int mxtpu_kv_init(int64_t h, int key, const float *data, const int64_t *shape,
+                  int ndim);
+int mxtpu_kv_push(int64_t h, int key, const float *data, const int64_t *shape,
+                  int ndim);
+int mxtpu_kv_pull(int64_t h, int key, float *buf, int64_t nelem);
+int mxtpu_kv_set_optimizer(int64_t h, const char *name, float lr);
+int mxtpu_rt_free(int64_t h);
+
 /* ----------------------------------------------------------------- misc */
 
 const char *mxtpu_last_error(void);

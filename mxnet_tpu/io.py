@@ -370,10 +370,13 @@ class MNISTIter(NDArrayIter):
             imgs = imgs[part_index::num_parts]
             labs = labs[part_index::num_parts]
         data = imgs.reshape(-1, 784) if flat else imgs.reshape(-1, 1, 28, 28)
-        # forward naming kwargs (data_name/label_name) so custom-named heads
-        # (e.g. SVMOutput's svm_label) bind against this iterator
+        # forward ONLY the naming kwargs so custom-named heads (e.g.
+        # SVMOutput's svm_label) bind, while other reference-config kwargs
+        # (prefetch_buffer etc.) stay ignored as before
+        naming = {k: kwargs[k] for k in ("data_name", "label_name")
+                  if k in kwargs}
         super().__init__(data, labs, batch_size=batch_size, shuffle=shuffle,
-                         **kwargs)
+                         **naming)
 
 
 def _exists_any(path):

@@ -1,0 +1,128 @@
+"""Embedded-runtime C API (libmxtpu_rt.so): executor + kvstore driven through
+the C ABI via ctypes — the same calls a C/C++ binding would make.
+
+Reference parity: c_api.h MXExecutorSimpleBind/Forward/Backward/Outputs and
+MXKVStoreCreate/Init/Push/Pull/SetOptimizer.
+"""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+_RT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "cpp", "build", "libmxtpu_rt.so")
+
+
+@pytest.fixture(scope="module")
+def rt():
+    if not os.path.exists(_RT):
+        pytest.skip("libmxtpu_rt.so not built")
+    lib = ctypes.CDLL(_RT)
+    lib.mxtpu_rt_init.restype = ctypes.c_int
+    lib.mxtpu_rt_last_error.restype = ctypes.c_char_p
+    lib.mxtpu_exec_create.restype = ctypes.c_int64
+    lib.mxtpu_exec_create.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_kv_create.restype = ctypes.c_int64
+    lib.mxtpu_kv_create.argtypes = [ctypes.c_char_p]
+    assert lib.mxtpu_rt_init() == 0, lib.mxtpu_rt_last_error()
+    return lib
+
+
+def _f32(arr):
+    a = np.ascontiguousarray(arr, dtype=np.float32)
+    return a, a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _shape(shape):
+    return (ctypes.c_int64 * len(shape))(*shape)
+
+
+def test_exec_forward_backward_through_c_abi(rt):
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, no_bias=True, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    h = rt.mxtpu_exec_create(out.tojson().encode())
+    assert h > 0, rt.mxtpu_rt_last_error()
+
+    names = (ctypes.c_char_p * 3)(b"data", b"fc_weight", b"softmax_label")
+    shapes = (ctypes.c_int64 * 7)(2, 3,   4, 3,   2)
+    ndims = (ctypes.c_int * 3)(2, 2, 1)
+    assert rt.mxtpu_exec_simple_bind(ctypes.c_int64(h), names, shapes, ndims,
+                                     3) == 0, rt.mxtpu_rt_last_error()
+
+    rng = np.random.RandomState(0)
+    x, xp = _f32(rng.rand(2, 3))
+    w, wp = _f32(rng.randn(4, 3) * 0.3)
+    y, yp = _f32([1, 3])
+    assert rt.mxtpu_exec_set_arg(ctypes.c_int64(h), b"data", xp,
+                                 _shape((2, 3)), 2) == 0
+    assert rt.mxtpu_exec_set_arg(ctypes.c_int64(h), b"fc_weight", wp,
+                                 _shape((4, 3)), 2) == 0
+    assert rt.mxtpu_exec_set_arg(ctypes.c_int64(h), b"softmax_label", yp,
+                                 _shape((2,)), 1) == 0
+    assert rt.mxtpu_exec_forward(ctypes.c_int64(h), 1) == 0
+    assert rt.mxtpu_exec_num_outputs(ctypes.c_int64(h)) == 1
+
+    oshape = (ctypes.c_int64 * 8)()
+    ondim = ctypes.c_int()
+    assert rt.mxtpu_exec_output_shape(ctypes.c_int64(h), 0, oshape,
+                                      ctypes.byref(ondim), 8) == 0
+    assert list(oshape[:ondim.value]) == [2, 4]
+
+    buf = np.zeros(8, np.float32)
+    _, bp = _f32(buf)
+    assert rt.mxtpu_exec_output(ctypes.c_int64(h), 0, bp, 8) == 0
+    probs = buf.reshape(2, 4)
+    # oracle: plain softmax of x @ w.T
+    logits = x @ w.T
+    want = np.exp(logits - logits.max(1, keepdims=True))
+    want /= want.sum(1, keepdims=True)
+    np.testing.assert_allclose(probs, want, atol=1e-5)
+
+    assert rt.mxtpu_exec_backward(ctypes.c_int64(h)) == 0
+    g = np.zeros(12, np.float32)
+    _, gp = _f32(g)
+    assert rt.mxtpu_exec_grad(ctypes.c_int64(h), b"fc_weight", gp, 12) == 0
+    # oracle: (p - onehot)^T x / .. (SoftmaxOutput grad, unnormalized)
+    onehot = np.eye(4, dtype=np.float32)[y.astype(int)]
+    want_g = (probs - onehot).T @ x
+    np.testing.assert_allclose(g.reshape(4, 3), want_g, atol=1e-4)
+    assert rt.mxtpu_rt_free(ctypes.c_int64(h)) == 0
+
+
+def test_kvstore_through_c_abi(rt):
+    h = rt.mxtpu_kv_create(b"local")
+    assert h > 0, rt.mxtpu_rt_last_error()
+    v0, v0p = _f32(np.arange(6).reshape(2, 3))
+    assert rt.mxtpu_kv_init(ctypes.c_int64(h), 7, v0p, _shape((2, 3)), 2) == 0
+
+    out = np.zeros(6, np.float32)
+    _, op = _f32(out)
+    assert rt.mxtpu_kv_pull(ctypes.c_int64(h), 7, op, 6) == 0
+    np.testing.assert_allclose(out.reshape(2, 3), v0)
+
+    # push without optimizer aggregates the gradient into the value
+    g, gp = _f32(np.ones((2, 3)))
+    assert rt.mxtpu_kv_push(ctypes.c_int64(h), 7, gp, _shape((2, 3)), 2) == 0
+    assert rt.mxtpu_kv_pull(ctypes.c_int64(h), 7, op, 6) == 0
+    assert np.isfinite(out).all()
+    assert rt.mxtpu_rt_free(ctypes.c_int64(h)) == 0
+
+
+def test_kvstore_sgd_optimizer_through_c_abi(rt):
+    h = rt.mxtpu_kv_create(b"local")
+    assert rt.mxtpu_kv_set_optimizer(ctypes.c_int64(h), b"sgd",
+                                     ctypes.c_float(0.5)) == 0
+    w0, wp = _f32(np.full((4,), 2.0))
+    assert rt.mxtpu_kv_init(ctypes.c_int64(h), 1, wp, _shape((4,)), 1) == 0
+    g, gp = _f32(np.ones((4,)))
+    assert rt.mxtpu_kv_push(ctypes.c_int64(h), 1, gp, _shape((4,)), 1) == 0
+    out = np.zeros(4, np.float32)
+    _, op = _f32(out)
+    assert rt.mxtpu_kv_pull(ctypes.c_int64(h), 1, op, 4) == 0
+    # sgd: w <- w - lr * grad = 2.0 - 0.5
+    np.testing.assert_allclose(out, 1.5, atol=1e-6)

