@@ -1,0 +1,71 @@
+"""Quantized-op micro-benchmark: int8 vs fp32 conv / FC throughput.
+
+Reference: benchmark/python/quantization/benchmark_op.py (quantized_conv
+speedup table).  Prints op, shape, fp32 ms, int8 ms, speedup.
+"""
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def timed(fn, iters=20):
+    fn().wait_to_read()
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn()
+    out.wait_to_read()
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_conv(batch, cin, hw, cout, kernel):
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(batch, cin, hw, hw).astype(np.float32))
+    w = nd.array(rng.rand(cout, cin, kernel, kernel).astype(np.float32) * 0.1)
+    qx, xmin, xmax = nd.contrib.quantize(x, nd.array([0.0]), nd.array([1.0]))
+    qw, wmin, wmax = nd.contrib.quantize(w, nd.array([0.0]), nd.array([0.1]))
+
+    t_fp = timed(lambda: nd.Convolution(
+        x, w, kernel=(kernel, kernel), num_filter=cout, no_bias=True))
+    t_q = timed(lambda: nd.contrib.quantized_conv(
+        qx, qw, xmin, xmax, wmin, wmax, kernel=(kernel, kernel),
+        num_filter=cout, no_bias=True)[0])
+    print(f"conv {batch}x{cin}x{hw}x{hw} -> {cout} k{kernel}: "
+          f"fp32 {t_fp*1e3:7.2f} ms  int8 {t_q*1e3:7.2f} ms  "
+          f"speedup {t_fp/t_q:4.2f}x")
+
+
+def bench_fc(batch, cin, cout):
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(batch, cin).astype(np.float32))
+    w = nd.array(rng.rand(cout, cin).astype(np.float32) * 0.1)
+    qx, xmin, xmax = nd.contrib.quantize(x, nd.array([0.0]), nd.array([1.0]))
+    qw, wmin, wmax = nd.contrib.quantize(w, nd.array([0.0]), nd.array([0.1]))
+
+    t_fp = timed(lambda: nd.FullyConnected(x, w, num_hidden=cout,
+                                           no_bias=True))
+    t_q = timed(lambda: nd.contrib.quantized_fully_connected(
+        qx, qw, xmin, xmax, wmin, wmax, num_hidden=cout, no_bias=True)[0])
+    print(f"fc   {batch}x{cin} -> {cout}: "
+          f"fp32 {t_fp*1e3:7.2f} ms  int8 {t_q*1e3:7.2f} ms  "
+          f"speedup {t_fp/t_q:4.2f}x")
+
+
+if __name__ == "__main__":
+    import jax
+
+    print("device:", mx.context.current_context())
+    if jax.default_backend() == "tpu":
+        conv_shapes = [(32, 64, 56, 64, 3), (32, 128, 28, 128, 3),
+                       (32, 256, 14, 256, 3)]
+        fc_shapes = [(64, 512, 512), (64, 1024, 1024)]
+    else:  # CPU smoke sizes: the numbers only matter on the chip
+        conv_shapes = [(4, 16, 14, 16, 3)]
+        fc_shapes = [(16, 128, 128)]
+    for shape in conv_shapes:
+        bench_conv(*shape)
+    for shape in fc_shapes:
+        bench_fc(*shape)

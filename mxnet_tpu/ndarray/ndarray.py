@@ -92,6 +92,40 @@ class NDArray:
     def asnumpy(self) -> np.ndarray:
         return np.asarray(self._data)
 
+    def to_dlpack_for_read(self):
+        """DLPack handle for read-only consumers (reference:
+        MXNDArrayToDLPack / ndarray.to_dlpack_for_read).
+
+        Zero-copy when the buffer lives on a DLPack-capable device
+        (cpu/cuda/rocm); TPU-resident buffers are copied to host first (the
+        protocol has no TPU device type), matching MXNet's copy-on-context-
+        mismatch semantics.  Returns a DLPack-protocol object (torch/numpy/
+        jax ``from_dlpack`` take these directly)."""
+        return self._dlpack_provider()
+
+    def _dlpack_provider(self):
+        try:
+            self._data.__dlpack_device__()
+            return self._data
+        except (BufferError, RuntimeError):
+            return np.asarray(self._data)
+
+    def to_dlpack_for_write(self):
+        """Unsupported by design: XLA buffers are immutable, so there is no
+        way to honor DLPack's writer contract (external writes visible in
+        this array).  Mutate via the framework's own ops, or take a copy with
+        ``to_dlpack_for_read``/``asnumpy``."""
+        raise MXNetError(
+            "to_dlpack_for_write is not supported on the XLA buffer model "
+            "(buffers are immutable); use to_dlpack_for_read for a readable "
+            "view or asnumpy() for a mutable host copy")
+
+    def __dlpack__(self, **kwargs):
+        return self._dlpack_provider().__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._dlpack_provider().__dlpack_device__()
+
     def asscalar(self):
         if self.size != 1:
             raise ValueError("The current array is not a scalar")

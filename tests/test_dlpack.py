@@ -1,0 +1,49 @@
+"""DLPack interchange (reference: MXNDArrayToDLPack/FromDLPack,
+python/mxnet ndarray to_dlpack_for_read/from_dlpack): zero-copy exchange
+with other frameworks; exercised against numpy and torch (CPU)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+
+
+def test_dlpack_roundtrip_self():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    y = nd.from_dlpack(x.to_dlpack_for_read())
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+
+
+def test_dlpack_protocol_numpy():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    # numpy >= 1.23 consumes the __dlpack__ protocol directly
+    arr = np.from_dlpack(x)
+    np.testing.assert_allclose(arr, x.asnumpy())
+
+
+def test_dlpack_torch_interop():
+    torch = pytest.importorskip("torch")
+    x = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    t = torch.from_dlpack(x)
+    assert t.shape == (2, 4)
+    np.testing.assert_allclose(t.numpy(), x.asnumpy())
+
+    t2 = torch.arange(10, dtype=torch.float32).reshape(5, 2) * 1.5
+    y = nd.from_dlpack(t2)
+    assert y.shape == (5, 2)
+    np.testing.assert_allclose(y.asnumpy(), t2.numpy())
+
+
+def test_dlpack_legacy_capsule_from_torch():
+    torch = pytest.importorskip("torch")
+    t = torch.arange(6, dtype=torch.float32).reshape(3, 2)
+    cap = torch.utils.dlpack.to_dlpack(t)  # the classic raw-capsule idiom
+    y = nd.from_dlpack(cap)
+    np.testing.assert_allclose(y.asnumpy(), t.numpy())
+
+
+def test_dlpack_for_write_refuses():
+    from mxnet_tpu.base import MXNetError
+
+    x = nd.array(np.ones((2, 2), np.float32))
+    with pytest.raises(MXNetError, match="immutable"):
+        x.to_dlpack_for_write()

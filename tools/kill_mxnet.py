@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Kill stray distributed-training processes on this host.
+
+Reference: tools/kill-mxnet.py (pdsh-kills python processes by program name
+across a host file).  This version scans /proc locally, matches worker /
+server / scheduler processes by the framework's env markers or a
+program-name substring, and SIGTERMs (then SIGKILLs) them.
+
+Usage:
+    python tools/kill_mxnet.py                 # kill by DMLC_ROLE env marker
+    python tools/kill_mxnet.py train_mnist.py  # also match by cmdline substr
+"""
+import os
+import signal
+import sys
+import time
+
+
+def _procs():
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == os.getpid():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env = f.read().decode(errors="replace")
+        except (FileNotFoundError, PermissionError, ProcessLookupError):
+            continue
+        yield int(pid), cmd, env
+
+
+def main():
+    pattern = sys.argv[1] if len(sys.argv) > 1 else None
+    victims = []
+    for pid, cmd, env in _procs():
+        is_dist = "DMLC_ROLE=" in env or "MXTPU_ROLE=" in env
+        is_named = pattern is not None and pattern in cmd
+        if is_dist or is_named:
+            victims.append((pid, cmd.strip()[:100]))
+    if not victims:
+        print("no matching processes")
+        return
+    for pid, cmd in victims:
+        print(f"SIGTERM {pid}: {cmd}")
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+    time.sleep(2.0)
+    for pid, _ in victims:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            continue
+        print(f"SIGKILL {pid}")
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+
+if __name__ == "__main__":
+    main()
