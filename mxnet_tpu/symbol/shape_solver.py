@@ -74,6 +74,28 @@ def _param_shape_rule(op_name: str, slot: str, attrs: dict,
     raise MXNetError(f"no shape rule for {op_name}.{slot}")
 
 
+def _label_shape(op_name: str, attrs: dict,
+                 data: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Label shape of a loss-head op from its data shape (the reference's
+    FInferShape for these ops runs backward from data, so binding without
+    label shapes works — e.g. Module.bind(for_training=False))."""
+    if op_name in ("SoftmaxOutput", "Softmax"):
+        if attrs.get("multi_output"):
+            return (data[0],) + tuple(data[2:])
+        if attrs.get("preserve_shape"):
+            return tuple(data[:-1])
+        return (data[0],)
+    if op_name == "SVMOutput":
+        return (data[0],)
+    # regression heads: label congruent with data
+    return tuple(data)
+
+
+_LABEL_OPS = ("SoftmaxOutput", "Softmax", "SVMOutput",
+              "LinearRegressionOutput", "MAERegressionOutput",
+              "LogisticRegressionOutput")
+
+
 def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]]):
     """Returns (arg_shapes, out_shapes, aux_shapes) in listing order."""
     from ..ndarray.ndarray import _op_accepts_training
@@ -97,9 +119,17 @@ def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]]):
         extra = list(params) + list(aux)
         n_data = len(node.inputs) - len(extra)
         in_shapes: List[Tuple[int, ...]] = []
-        # data inputs must be known
-        for e in node.inputs[:n_data]:
+        # data inputs must be known — except a loss head's label variable,
+        # which is inferred from the data shape like the reference does
+        for i, e in enumerate(node.inputs[:n_data]):
             if id(e.node) not in shapes:
+                if (i == n_data - 1 and op.name in _LABEL_OPS
+                        and e.node.kind == "var" and in_shapes):
+                    sh = _label_shape(op.name, node.attrs, in_shapes[0])
+                    var_shape[e.node.name] = sh
+                    shapes[id(e.node)] = (sh,)
+                    in_shapes.append(sh)
+                    continue
                 raise MXNetError(
                     f"infer_shape: input {e.node.name!r} of op {node.name!r} has unknown shape")
             in_shapes.append(shapes[id(e.node)][e.index])

@@ -172,3 +172,36 @@ def test_symbol_grad():
     gx, gw = outs[0].asnumpy(), outs[1].asnumpy()
     assert np.allclose(gx, wv + 2 * xv)   # d/dx (xw + x^2)
     assert np.allclose(gw, xv)            # d/dw
+
+
+def test_label_shape_inferred_for_loss_heads():
+    """Binding without label shapes works: the solver infers the label from
+    the data shape like the reference's FInferShape (symbol.py simple_bind
+    without softmax_label; Module.bind(for_training=False))."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    exe = out.simple_bind(ctx=mx.cpu(), data=(8, 6))  # no label shape given
+    assert exe.arg_dict["softmax_label"].shape == (8,)
+    exe.arg_dict["data"][:] = mx.nd.array(
+        np.random.RandomState(0).rand(8, 6).astype(np.float32))
+    y = exe.forward(is_train=False)[0]
+    assert y.shape == (8, 4)
+
+    # regression head: label congruent with data
+    lro = mx.sym.LinearRegressionOutput(fc, mx.sym.Variable("lro_label"),
+                                        name="lro")
+    exe2 = lro.simple_bind(ctx=mx.cpu(), data=(8, 6))
+    assert exe2.arg_dict["lro_label"].shape == (8, 4)
+
+    # multi-output softmax (FCN-style): label drops the channel axis
+    conv = mx.sym.Convolution(data, kernel=(1, 1), num_filter=3, name="c")
+    sm = mx.sym.SoftmaxOutput(conv, mx.sym.Variable("softmax_label"),
+                              multi_output=True, name="softmax2")
+    exe3 = sm.simple_bind(ctx=mx.cpu(), data=(2, 5, 7, 7))
+    assert exe3.arg_dict["softmax_label"].shape == (2, 7, 7)
