@@ -15,12 +15,41 @@ from .io import DataBatch, DataDesc, DataIter
 from .ndarray import array as nd_array
 from . import recordio as _recordio
 
-__all__ = ["imresize", "resize_short", "fixed_crop", "random_crop", "center_crop",
+__all__ = ["imdecode", "imread",
+           "imresize", "resize_short", "fixed_crop", "random_crop", "center_crop",
            "color_normalize", "random_size_crop", "Augmenter", "ResizeAug",
            "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
            "HorizontalFlipAug", "CastAug", "ColorNormalizeAug", "BrightnessJitterAug",
            "ContrastJitterAug", "SaturationJitterAug", "LightingAug", "ColorJitterAug",
            "CreateAugmenter", "ImageIter", "ImageDetIter", "ImageRecordIterImpl"]
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode a compressed image buffer to an HWC uint8 NDArray.
+
+    Reference: mx.image.imdecode (opencv-backed, python/mxnet/image/image.py)
+    — flag=0 grayscale, 1 color; to_rgb converts the reference's BGR decode
+    order (PIL already yields RGB, so to_rgb=False flips to BGR for parity
+    with code expecting the raw cv2 order).
+    """
+    import io as _io
+
+    from PIL import Image as _PILImage
+
+    img = _PILImage.open(_io.BytesIO(bytes(buf)))
+    img = img.convert("L" if int(flag) == 0 else "RGB")
+    arr = _np.asarray(img, dtype=_np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if int(flag) != 0 and not to_rgb:
+        arr = arr[:, :, ::-1]
+    return nd_array(arr)
+
+
+def imread(filename, flag=1, to_rgb=True, **kwargs):
+    """Read + decode an image file (reference: mx.image.imread)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb, **kwargs)
 
 
 def _resize_np(img, h, w, interp=1):

@@ -213,3 +213,26 @@ def test_image_det_iter(tmp_path):
     except StopIteration:
         pass
     assert batches == 2
+
+
+def test_image_imdecode_imread(tmp_path):
+    """mx.image.imdecode/imread (reference: python/mxnet/image/image.py)."""
+    from PIL import Image
+
+    import mxnet_tpu as mx
+
+    rgb = np.zeros((8, 10, 3), np.uint8)
+    rgb[:, :, 0] = 200  # red-dominant so channel order is observable
+    p = str(tmp_path / "t.png")
+    Image.fromarray(rgb).save(p)
+
+    img = mx.image.imread(p)
+    assert img.shape == (8, 10, 3)
+    assert img.asnumpy()[0, 0, 0] == 200 and img.asnumpy()[0, 0, 2] == 0
+
+    with open(p, "rb") as f:
+        buf = f.read()
+    bgr = mx.image.imdecode(buf, to_rgb=False)
+    assert bgr.asnumpy()[0, 0, 2] == 200  # channel order flipped
+    gray = mx.image.imdecode(buf, flag=0)
+    assert gray.shape == (8, 10, 1)
