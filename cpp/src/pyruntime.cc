@@ -17,6 +17,8 @@
 
 #include <Python.h>
 
+#include <dlfcn.h>
+
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -50,6 +52,19 @@ const char* mxtpu_rt_last_error(void) { return g_err; }
 // The Python-side helper layer: a handle registry over the public API.
 static const char kPrelude[] = R"PY(
 import os
+import sys
+
+# Embedded CPython resolves its prefix from the host program's environment;
+# when the caller's Python lives in a venv (VIRTUAL_ENV), its site-packages
+# must be added by hand or numpy/jax resolve to the bare system install.
+_venv = os.environ.get("VIRTUAL_ENV")
+if _venv:
+    _site = os.path.join(_venv, "lib",
+                         "python%d.%d" % sys.version_info[:2],
+                         "site-packages")
+    if os.path.isdir(_site) and _site not in sys.path:
+        sys.path.insert(0, _site)
+
 import numpy as _np
 
 if os.environ.get("MXTPU_RT_PLATFORM"):
@@ -160,8 +175,50 @@ int mxtpu_rt_init(void) {
   if (g_ns) return 0;
   int we_initialized = 0;
   if (!Py_IsInitialized()) {
+    // When the host (e.g. perl, or any dlopen-based embedder) loaded this
+    // library RTLD_LOCAL, libpython's symbols are invisible to the extension
+    // modules numpy/jax dlopen later (they expect the interpreter to export
+    // them globally).  Promote the already-mapped libpython to global scope.
+    char soname[64];
+    snprintf(soname, sizeof(soname), "libpython%d.%d.so.1.0",
+             PY_MAJOR_VERSION, PY_MINOR_VERSION);
+    if (!dlopen(soname, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD)) {
+      dlopen(soname, RTLD_NOW | RTLD_GLOBAL);
+    }
+    // Embedded CPython may resolve its prefix outside the caller's venv, and
+    // sitecustomize (which can import numpy/jax) runs during Py_Initialize —
+    // so the venv's site-packages must lead PYTHONPATH BEFORE init.  The
+    // mutation is undone right after init so child processes the host spawns
+    // later see their original environment.
+    const char* venv = getenv("VIRTUAL_ENV");
+    char* saved_pp = nullptr;
+    int had_pp = 0;
+    if (venv) {
+      const char* old = getenv("PYTHONPATH");
+      had_pp = old != nullptr;
+      if (old) saved_pp = strdup(old);
+      size_t n = strlen(venv) + 64 + (old ? strlen(old) + 1 : 0);
+      char* merged = (char*)malloc(n);
+      if (old && old[0]) {
+        snprintf(merged, n, "%s/lib/python%d.%d/site-packages:%s", venv,
+                 PY_MAJOR_VERSION, PY_MINOR_VERSION, old);
+      } else {
+        snprintf(merged, n, "%s/lib/python%d.%d/site-packages", venv,
+                 PY_MAJOR_VERSION, PY_MINOR_VERSION);
+      }
+      setenv("PYTHONPATH", merged, 1);
+      free(merged);
+    }
     Py_InitializeEx(0);
     we_initialized = 1;
+    if (venv) {
+      if (had_pp) {
+        setenv("PYTHONPATH", saved_pp, 1);
+      } else {
+        unsetenv("PYTHONPATH");
+      }
+      free(saved_pp);
+    }
   }
   PyGILState_STATE gil = PyGILState_Ensure();
   int rc = -1;

@@ -1,0 +1,294 @@
+/* Perl XS binding over the embedded-runtime C ABI (cpp/include/mxtpu.h).
+ *
+ * Reference analogue: perl-package/AI-MXNet (37k LoC over the C API).  This
+ * binding is deliberately thin: executor + kvstore train/infer loop, with
+ * tensors exchanged as pack("f*")-style scalars and shapes as array refs —
+ * the full runtime stays the one XLA-backed implementation in libmxtpu_rt.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include "../../cpp/include/mxtpu.h"
+
+static void av_to_shape(pTHX_ AV *av, int64_t *shape, int *ndim, int cap) {
+    int n = av_len(av) + 1;
+    if (n > cap) n = cap;
+    *ndim = n;
+    for (int i = 0; i < n; ++i) {
+        SV **e = av_fetch(av, i, 0);
+        shape[i] = e ? (int64_t)SvIV(*e) : 0;
+    }
+}
+
+/* packed-f32 buffer whose length must match prod(shape)*4; croaks on
+ * mismatch so a short pack() cannot cause an out-of-bounds read */
+static const float *checked_f32(pTHX_ SV *data_sv, const int64_t *shape,
+                                int ndim, const char *what) {
+    STRLEN len;
+    const float *data = (const float *)SvPV(data_sv, len);
+    int64_t n = 1;
+    for (int i = 0; i < ndim; ++i) n *= shape[i];
+    if ((int64_t)len != n * (int64_t)sizeof(float))
+        croak("%s: packed buffer is %ld bytes but shape wants %ld",
+              what, (long)len, (long)(n * sizeof(float)));
+    return data;
+}
+
+MODULE = MXTPU  PACKAGE = MXTPU
+
+PROTOTYPES: DISABLE
+
+int
+rt_init()
+  CODE:
+    RETVAL = mxtpu_rt_init();
+  OUTPUT:
+    RETVAL
+
+const char *
+last_error()
+  CODE:
+    RETVAL = mxtpu_rt_last_error();
+  OUTPUT:
+    RETVAL
+
+double
+exec_create(json)
+    const char *json
+  CODE:
+    RETVAL = (double)mxtpu_exec_create(json);
+  OUTPUT:
+    RETVAL
+
+int
+exec_simple_bind(h, names_av, shapes_av)
+    double h
+    AV *names_av
+    AV *shapes_av
+  PREINIT:
+    int n, i;
+    const char **names;
+    int64_t *flat;
+    int *ndims;
+    int total;
+  CODE:
+    n = av_len(names_av) + 1;
+    if (av_len(shapes_av) + 1 != n)
+        croak("exec_simple_bind: %d names but %d shapes",
+              n, (int)(av_len(shapes_av) + 1));
+    names = (const char **)malloc(n * sizeof(char *));
+    ndims = (int *)malloc(n * sizeof(int));
+    total = 0;
+    for (i = 0; i < n; ++i) {
+        SV **e = av_fetch(shapes_av, i, 0);
+        if (!e || !SvROK(*e) || SvTYPE(SvRV(*e)) != SVt_PVAV) {
+            free(names); free(ndims);
+            croak("exec_simple_bind: shapes[%d] is not an array ref", i);
+        }
+        ndims[i] = av_len((AV *)SvRV(*e)) + 1;
+        total += ndims[i];
+    }
+    flat = (int64_t *)malloc(total * sizeof(int64_t));
+    total = 0;
+    for (i = 0; i < n; ++i) {
+        SV **nm = av_fetch(names_av, i, 0);
+        if (!nm) {
+            free(names); free(flat); free(ndims);
+            croak("exec_simple_bind: names[%d] missing", i);
+        }
+        names[i] = SvPV_nolen(*nm);
+        SV **e = av_fetch(shapes_av, i, 0);
+        AV *sh = (AV *)SvRV(*e);
+        int nd;
+        av_to_shape(aTHX_ sh, flat + total, &nd, ndims[i]);
+        total += ndims[i];
+    }
+    RETVAL = mxtpu_exec_simple_bind((int64_t)h, names, flat, ndims, n);
+    free(names); free(flat); free(ndims);
+  OUTPUT:
+    RETVAL
+
+int
+exec_set_arg(h, name, data_sv, shape_av)
+    double h
+    const char *name
+    SV *data_sv
+    AV *shape_av
+  PREINIT:
+    const float *data;
+    int64_t shape[8];
+    int ndim;
+  CODE:
+    av_to_shape(aTHX_ shape_av, shape, &ndim, 8);
+    data = checked_f32(aTHX_ data_sv, shape, ndim, "exec_set_arg");
+    RETVAL = mxtpu_exec_set_arg((int64_t)h, name, data, shape, ndim);
+  OUTPUT:
+    RETVAL
+
+int
+exec_forward(h, is_train)
+    double h
+    int is_train
+  CODE:
+    RETVAL = mxtpu_exec_forward((int64_t)h, is_train);
+  OUTPUT:
+    RETVAL
+
+int
+exec_backward(h)
+    double h
+  CODE:
+    RETVAL = mxtpu_exec_backward((int64_t)h);
+  OUTPUT:
+    RETVAL
+
+int
+exec_num_outputs(h)
+    double h
+  CODE:
+    RETVAL = mxtpu_exec_num_outputs((int64_t)h);
+  OUTPUT:
+    RETVAL
+
+SV *
+exec_output_shape(h, idx)
+    double h
+    int idx
+  PREINIT:
+    int64_t shape[8];
+    int ndim, i;
+    AV *av;
+  CODE:
+    if (mxtpu_exec_output_shape((int64_t)h, idx, shape, &ndim, 8) != 0)
+        XSRETURN_UNDEF;
+    av = newAV();
+    for (i = 0; i < ndim; ++i)
+        av_push(av, newSViv((IV)shape[i]));
+    RETVAL = newRV_noinc((SV *)av);
+  OUTPUT:
+    RETVAL
+
+SV *
+exec_output(h, idx, nelem)
+    double h
+    int idx
+    double nelem
+  PREINIT:
+    SV *out;
+    float *buf;
+  CODE:
+    out = newSV((STRLEN)(nelem * sizeof(float)));
+    SvPOK_on(out);
+    buf = (float *)SvPVX(out);
+    if (mxtpu_exec_output((int64_t)h, idx, buf, (int64_t)nelem) != 0) {
+        SvREFCNT_dec(out);
+        XSRETURN_UNDEF;
+    }
+    SvCUR_set(out, (STRLEN)(nelem * sizeof(float)));
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+SV *
+exec_grad(h, name, nelem)
+    double h
+    const char *name
+    double nelem
+  PREINIT:
+    SV *out;
+    float *buf;
+  CODE:
+    out = newSV((STRLEN)(nelem * sizeof(float)));
+    SvPOK_on(out);
+    buf = (float *)SvPVX(out);
+    if (mxtpu_exec_grad((int64_t)h, name, buf, (int64_t)nelem) != 0) {
+        SvREFCNT_dec(out);
+        XSRETURN_UNDEF;
+    }
+    SvCUR_set(out, (STRLEN)(nelem * sizeof(float)));
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+double
+kv_create(kind)
+    const char *kind
+  CODE:
+    RETVAL = (double)mxtpu_kv_create(kind);
+  OUTPUT:
+    RETVAL
+
+int
+kv_set_optimizer(h, name, lr)
+    double h
+    const char *name
+    double lr
+  CODE:
+    RETVAL = mxtpu_kv_set_optimizer((int64_t)h, name, (float)lr);
+  OUTPUT:
+    RETVAL
+
+int
+kv_init(h, key, data_sv, shape_av)
+    double h
+    int key
+    SV *data_sv
+    AV *shape_av
+  PREINIT:
+    const float *data;
+    int64_t shape[8];
+    int ndim;
+  CODE:
+    av_to_shape(aTHX_ shape_av, shape, &ndim, 8);
+    data = checked_f32(aTHX_ data_sv, shape, ndim, "kv_init");
+    RETVAL = mxtpu_kv_init((int64_t)h, key, data, shape, ndim);
+  OUTPUT:
+    RETVAL
+
+int
+kv_push(h, key, data_sv, shape_av)
+    double h
+    int key
+    SV *data_sv
+    AV *shape_av
+  PREINIT:
+    const float *data;
+    int64_t shape[8];
+    int ndim;
+  CODE:
+    av_to_shape(aTHX_ shape_av, shape, &ndim, 8);
+    data = checked_f32(aTHX_ data_sv, shape, ndim, "kv_push");
+    RETVAL = mxtpu_kv_push((int64_t)h, key, data, shape, ndim);
+  OUTPUT:
+    RETVAL
+
+SV *
+kv_pull(h, key, nelem)
+    double h
+    int key
+    double nelem
+  PREINIT:
+    SV *out;
+    float *buf;
+  CODE:
+    out = newSV((STRLEN)(nelem * sizeof(float)));
+    SvPOK_on(out);
+    buf = (float *)SvPVX(out);
+    if (mxtpu_kv_pull((int64_t)h, key, buf, (int64_t)nelem) != 0) {
+        SvREFCNT_dec(out);
+        XSRETURN_UNDEF;
+    }
+    SvCUR_set(out, (STRLEN)(nelem * sizeof(float)));
+    RETVAL = out;
+  OUTPUT:
+    RETVAL
+
+int
+rt_free(h)
+    double h
+  CODE:
+    RETVAL = mxtpu_rt_free((int64_t)h);
+  OUTPUT:
+    RETVAL

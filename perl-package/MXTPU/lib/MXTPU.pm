@@ -1,0 +1,28 @@
+package MXTPU;
+
+# Perl binding for the TPU-native framework's embedded runtime.
+#
+# Reference analogue: perl-package/AI-MXNet over the MX* C API.  This module
+# exposes the executor + kvstore train/infer loop; tensors are exchanged as
+# pack("f*", ...) scalars, shapes as array refs.
+#
+#   use MXTPU;
+#   MXTPU::rt_init() == 0 or die MXTPU::last_error();
+#   my $exec = MXTPU::exec_create($symbol_json);
+#   MXTPU::exec_simple_bind($exec, ["data"], [[4, 8]]);
+#   MXTPU::exec_set_arg($exec, "data", pack("f*", @values), [4, 8]);
+#   MXTPU::exec_forward($exec, 0);
+#   my @probs = unpack("f*", MXTPU::exec_output($exec, 0, 4 * 10));
+#
+# Environment: set MXTPU_RT_HOME to the repo root and MXTPU_RT_PLATFORM=cpu
+# for hermetic use (see docs/env_vars.md).
+
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load('MXTPU', $VERSION);
+
+1;

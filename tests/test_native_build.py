@@ -70,3 +70,35 @@ def test_cpp_trains_mlp_through_embedded_runtime():
     assert r.returncode == 0, \
         f"train_mlp failed (rc={r.returncode}):\n{r.stdout}\n{r.stderr}"
     assert "final train accuracy" in r.stdout
+
+
+@pytest.mark.skipif(bool(os.environ.get("MXTPU_NO_NATIVE")),
+                    reason="native runtime disabled explicitly")
+def test_perl_binding_builds_and_passes():
+    """The Perl XS binding (perl-package/) must build against the embedded
+    runtime and pass its own test suite (reference: perl-package/AI-MXNet)."""
+    import shutil
+
+    if shutil.which("perl") is None:
+        pytest.skip("perl not installed")
+    root = os.path.dirname(os.path.dirname(_native.__file__))
+    pkg = os.path.join(root, "perl-package", "MXTPU")
+    if not os.path.exists(os.path.join(root, "cpp", "build",
+                                       "libmxtpu_rt.so")):
+        r = subprocess.run(["make", "-C", os.path.join(root, "cpp")],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-3000:]
+    env = dict(os.environ, MXTPU_RT_PLATFORM="cpu", MXTPU_RT_HOME=root)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(["perl", "Makefile.PL"], capture_output=True,
+                       text=True, cwd=pkg, env=env)
+    if r.returncode != 0:
+        pytest.skip(f"ExtUtils::MakeMaker unavailable: {r.stderr[-200:]}")
+    r = subprocess.run(["make"], capture_output=True, text=True, cwd=pkg,
+                       env=env)
+    assert r.returncode == 0, "perl binding build failed:\n" + r.stderr[-3000:]
+    r = subprocess.run(["make", "test"], capture_output=True, text=True,
+                       cwd=pkg, env=env, timeout=500)
+    assert r.returncode == 0, \
+        f"perl tests failed:\n{r.stdout[-3000:]}\n{r.stderr[-1000:]}"
+    assert "All tests successful" in r.stdout
