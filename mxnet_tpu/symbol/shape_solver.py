@@ -34,11 +34,16 @@ def _param_shape_rule(op_name: str, slot: str, attrs: dict,
             flat = data[-1]
         return (nh, flat) if slot == "weight" else (nh,)
     if op_name == "Convolution":
+        from ..ops.nn import is_channels_last
+
         nf = int(attrs["num_filter"])
         kernel = tuple(int(k) for k in attrs["kernel"])
         ng = int(attrs.get("num_group", 1))
-        cin = data[1]
+        cin = data[-1] if is_channels_last(attrs.get("layout")) else data[1]
         if slot == "weight":
+            # channels-last convs take the reference's O<spatial>I weights
+            if is_channels_last(attrs.get("layout")):
+                return (nf,) + kernel + (cin // ng,)
             return (nf, cin // ng) + kernel
         return (nf,)
     if op_name == "Deconvolution":

@@ -86,3 +86,34 @@ def test_resnet_nhwc_hybridized_train_step():
         first = v if first is None else first
         last = v
     assert last < first, (first, last)
+
+
+def test_symbol_conv_nhwc_bind_and_run():
+    """Symbol-level NHWC Convolution: the solver infers O<spatial>I weights
+    from the channels-last data shape, and the bound executor matches the
+    NCHW program from the same (transposed) weights."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 7, 7, 3).astype(np.float32)
+    w = rng.randn(5, 3, 3, 3).astype(np.float32) * 0.2  # OIHW
+
+    d = mx.sym.Variable("d")
+    conv = mx.sym.Convolution(d, kernel=(3, 3), num_filter=5, pad=(1, 1),
+                              layout="NHWC", no_bias=True, name="c")
+    exe = conv.simple_bind(ctx=mx.cpu(), d=(2, 7, 7, 3))
+    assert exe.arg_dict["c_weight"].shape == (5, 3, 3, 3)  # OHWI
+    exe.arg_dict["d"][:] = mx.nd.array(x)
+    exe.arg_dict["c_weight"][:] = mx.nd.array(w.transpose(0, 2, 3, 1))
+    out = exe.forward()[0].asnumpy()
+
+    d2 = mx.sym.Variable("d")
+    conv2 = mx.sym.Convolution(d2, kernel=(3, 3), num_filter=5, pad=(1, 1),
+                               no_bias=True, name="c")
+    exe2 = conv2.simple_bind(ctx=mx.cpu(), d=(2, 3, 7, 7))
+    exe2.arg_dict["d"][:] = mx.nd.array(x.transpose(0, 3, 1, 2))
+    exe2.arg_dict["c_weight"][:] = mx.nd.array(w)
+    ref = exe2.forward()[0].asnumpy()
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref, atol=1e-4)
