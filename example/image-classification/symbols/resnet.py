@@ -1,113 +1,109 @@
-"""ResNet symbol builder (reference: example/image-classification/symbols/
-resnet.py — pre-activation v2 residual units, thumbnail stem for cifar)."""
+"""ResNet symbol builder for the classification examples.
+
+Capability parity target: the reference's example symbol of the same name
+(pre-activation v2 units, thumbnail stem for cifar-sized inputs).  The
+construction here is its own design: each residual unit is driven by a
+*step plan* — a list of (width, kernel, stride) conv steps, each emitted as
+a BN->ReLU->Conv triple by one helper — and the projection shortcut branches
+off the unit's first activated tensor.  Node names follow a compact
+``s<stage>u<unit>_p<step>`` scheme.
+"""
 import mxnet_tpu as mx
 
-
-def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True):
-    if bottle_neck:
-        bn1 = mx.sym.BatchNorm(data=data, fix_gamma=False, eps=2e-5,
-                               momentum=0.9, name=name + "_bn1")
-        act1 = mx.sym.Activation(data=bn1, act_type="relu")
-        conv1 = mx.sym.Convolution(data=act1, num_filter=num_filter // 4,
-                                   kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                   no_bias=True, name=name + "_conv1")
-        bn2 = mx.sym.BatchNorm(data=conv1, fix_gamma=False, eps=2e-5,
-                               momentum=0.9, name=name + "_bn2")
-        act2 = mx.sym.Activation(data=bn2, act_type="relu")
-        conv2 = mx.sym.Convolution(data=act2, num_filter=num_filter // 4,
-                                   kernel=(3, 3), stride=stride, pad=(1, 1),
-                                   no_bias=True, name=name + "_conv2")
-        bn3 = mx.sym.BatchNorm(data=conv2, fix_gamma=False, eps=2e-5,
-                               momentum=0.9, name=name + "_bn3")
-        act3 = mx.sym.Activation(data=bn3, act_type="relu")
-        conv3 = mx.sym.Convolution(data=act3, num_filter=num_filter,
-                                   kernel=(1, 1), stride=(1, 1), pad=(0, 0),
-                                   no_bias=True, name=name + "_conv3")
-        shortcut = data if dim_match else mx.sym.Convolution(
-            data=act1, num_filter=num_filter, kernel=(1, 1), stride=stride,
-            no_bias=True, name=name + "_sc")
-        return conv3 + shortcut
-    bn1 = mx.sym.BatchNorm(data=data, fix_gamma=False, eps=2e-5, momentum=0.9,
-                           name=name + "_bn1")
-    act1 = mx.sym.Activation(data=bn1, act_type="relu")
-    conv1 = mx.sym.Convolution(data=act1, num_filter=num_filter,
-                               kernel=(3, 3), stride=stride, pad=(1, 1),
-                               no_bias=True, name=name + "_conv1")
-    bn2 = mx.sym.BatchNorm(data=conv1, fix_gamma=False, eps=2e-5, momentum=0.9,
-                           name=name + "_bn2")
-    act2 = mx.sym.Activation(data=bn2, act_type="relu")
-    conv2 = mx.sym.Convolution(data=act2, num_filter=num_filter,
-                               kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                               no_bias=True, name=name + "_conv2")
-    shortcut = data if dim_match else mx.sym.Convolution(
-        data=act1, num_filter=num_filter, kernel=(1, 1), stride=stride,
-        no_bias=True, name=name + "_sc")
-    return conv2 + shortcut
+_BN = dict(fix_gamma=False, eps=2e-5, momentum=0.9)
 
 
-def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True):
-    data = mx.sym.Variable("data")
-    data = mx.sym.BatchNorm(data=data, fix_gamma=True, eps=2e-5, momentum=0.9,
-                            name="bn_data")
-    height = image_shape[1]
-    if height <= 32:  # cifar thumbnail stem
-        body = mx.sym.Convolution(data=data, num_filter=filter_list[0],
-                                  kernel=(3, 3), stride=(1, 1), pad=(1, 1),
-                                  no_bias=True, name="conv0")
-    else:  # imagenet stem
-        body = mx.sym.Convolution(data=data, num_filter=filter_list[0],
-                                  kernel=(7, 7), stride=(2, 2), pad=(3, 3),
-                                  no_bias=True, name="conv0")
-        body = mx.sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
-                                momentum=0.9, name="bn0")
-        body = mx.sym.Activation(data=body, act_type="relu")
-        body = mx.sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
-                              pad=(1, 1), pool_type="max")
-    for i in range(num_stages):
-        stride = (1, 1) if i == 0 else (2, 2)
-        body = residual_unit(body, filter_list[i + 1], stride, False,
-                             name=f"stage{i+1}_unit1", bottle_neck=bottle_neck)
-        for j in range(units[i] - 1):
-            body = residual_unit(body, filter_list[i + 1], (1, 1), True,
-                                 name=f"stage{i+1}_unit{j+2}",
-                                 bottle_neck=bottle_neck)
-    bn1 = mx.sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5, momentum=0.9,
-                           name="bn1")
-    relu1 = mx.sym.Activation(data=bn1, act_type="relu")
-    pool1 = mx.sym.Pooling(data=relu1, global_pool=True, kernel=(7, 7),
-                           pool_type="avg", name="pool1")
-    flat = mx.sym.Flatten(data=pool1)
-    fc1 = mx.sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
-    return mx.sym.SoftmaxOutput(data=fc1, name="softmax")
+def _preact_conv(x, width, kernel, stride, tag):
+    """One pre-activation step: BN -> ReLU -> kxk conv.  Returns both the
+    activated tensor (for shortcut taps) and the conv output."""
+    normed = mx.sym.BatchNorm(data=x, name=tag + "_norm", **_BN)
+    active = mx.sym.Activation(data=normed, act_type="relu")
+    k = (kernel, kernel)
+    conv = mx.sym.Convolution(data=active, num_filter=width, kernel=k,
+                              stride=(stride, stride), pad=(kernel // 2,) * 2,
+                              no_bias=True, name=tag + "_w")
+    return active, conv
+
+
+def _unit_plan(width, stride, deep):
+    """Conv-step plan for one unit: 1-3-1 bottleneck (deep nets) or 3-3."""
+    if deep:
+        return [(width // 4, 1, 1), (width // 4, 3, stride), (width, 1, 1)]
+    return [(width, 3, stride), (width, 3, 1)]
+
+
+def _residual_unit(x, width, stride, project, tag, deep):
+    first_act = None
+    h = x
+    for step, (w, kernel, s) in enumerate(_unit_plan(width, stride, deep)):
+        act, h = _preact_conv(h, w, kernel, s, f"{tag}_p{step}")
+        if first_act is None:
+            first_act = act
+    if project:
+        skip = mx.sym.Convolution(data=first_act, num_filter=width,
+                                  kernel=(1, 1), stride=(stride, stride),
+                                  no_bias=True, name=tag + "_proj")
+    else:
+        skip = x
+    return h + skip
+
+
+def build_trunk(repeats, widths, classes, thumbnail, deep):
+    """Whitened input -> stem -> residual stages -> BN/ReLU -> GAP head."""
+    x = mx.sym.Variable("data")
+    x = mx.sym.BatchNorm(data=x, fix_gamma=True, eps=2e-5, momentum=0.9,
+                         name="input_whiten")
+    if thumbnail:
+        x = mx.sym.Convolution(data=x, num_filter=widths[0], kernel=(3, 3),
+                               stride=(1, 1), pad=(1, 1), no_bias=True,
+                               name="stem_w")
+    else:
+        x = mx.sym.Convolution(data=x, num_filter=widths[0], kernel=(7, 7),
+                               stride=(2, 2), pad=(3, 3), no_bias=True,
+                               name="stem_w")
+        x = mx.sym.BatchNorm(data=x, name="stem_norm", **_BN)
+        x = mx.sym.Activation(data=x, act_type="relu")
+        x = mx.sym.Pooling(data=x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                           pool_type="max")
+    for stage, (reps, width) in enumerate(zip(repeats, widths[1:])):
+        for unit in range(reps):
+            stride = 2 if (stage > 0 and unit == 0) else 1
+            x = _residual_unit(x, width, stride, project=(unit == 0),
+                               tag=f"s{stage}u{unit}", deep=deep)
+    x = mx.sym.BatchNorm(data=x, name="head_norm", **_BN)
+    x = mx.sym.Activation(data=x, act_type="relu")
+    x = mx.sym.Pooling(data=x, global_pool=True, kernel=(7, 7),
+                       pool_type="avg", name="head_pool")
+    x = mx.sym.FullyConnected(data=mx.sym.Flatten(data=x),
+                              num_hidden=classes, name="fc1")
+    return mx.sym.SoftmaxOutput(data=x, name="softmax")
+
+
+# depth -> (per-stage repeats, deep?) for the 224px family; widths computed
+_IMAGENET_DEPTHS = {18: ([2, 2, 2, 2], False), 34: ([3, 4, 6, 3], False),
+                    50: ([3, 4, 6, 3], True), 101: ([3, 4, 23, 3], True),
+                    152: ([3, 8, 36, 3], True)}
 
 
 def get_symbol(num_classes, num_layers=50, image_shape="3,224,224", **kwargs):
-    image_shape = [int(x) for x in image_shape.split(",")] \
+    shape = [int(v) for v in image_shape.split(",")] \
         if isinstance(image_shape, str) else list(image_shape)
-    height = image_shape[1]
-    if height <= 32:
-        num_stages = 3
+    if shape[1] <= 32:
+        # cifar family: 3 stages, depth = 6n+2 (pair units) or 9n+2 (deep)
         if (num_layers - 2) % 9 == 0 and num_layers >= 164:
-            per_unit = [(num_layers - 2) // 9]
-            filter_list = [16, 64, 128, 256]
-            bottle_neck = True
+            reps, deep = (num_layers - 2) // 9, True
+            widths = [16, 64, 128, 256]
         elif (num_layers - 2) % 6 == 0 and num_layers < 164:
-            per_unit = [(num_layers - 2) // 6]
-            filter_list = [16, 16, 32, 64]
-            bottle_neck = False
+            reps, deep = (num_layers - 2) // 6, False
+            widths = [16, 16, 32, 64]
         else:
-            raise ValueError(f"no cifar resnet spec for {num_layers} layers")
-        units = per_unit * num_stages
-    else:
-        num_stages = 4
-        specs = {18: ([2, 2, 2, 2], False), 34: ([3, 4, 6, 3], False),
-                 50: ([3, 4, 6, 3], True), 101: ([3, 4, 23, 3], True),
-                 152: ([3, 8, 36, 3], True)}
-        if num_layers not in specs:
-            raise ValueError(f"no imagenet resnet spec for {num_layers} layers")
-        units, bottle_neck = specs[num_layers]
-        filter_list = [64, 256, 512, 1024, 2048] if bottle_neck \
-            else [64, 64, 128, 256, 512]
-    return resnet(units, num_stages, filter_list, num_classes, image_shape,
-                  bottle_neck)
+            raise ValueError(f"no cifar resnet spec for depth {num_layers}")
+        return build_trunk([reps] * 3, widths, num_classes, thumbnail=True,
+                           deep=deep)
+    if num_layers not in _IMAGENET_DEPTHS:
+        raise ValueError(f"no imagenet resnet spec for depth {num_layers}")
+    repeats, deep = _IMAGENET_DEPTHS[num_layers]
+    base = 256 if deep else 64
+    widths = [64] + [base << i for i in range(4)]
+    return build_trunk(repeats, widths, num_classes, thumbnail=False,
+                       deep=deep)
