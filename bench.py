@@ -30,10 +30,12 @@ import numpy as np
 NORTH_STAR = 1200.0  # img/s/chip (BASELINE.json)
 
 
-def e2e_throughput(batch_size: int, batches: int = 30, warmup: int = 5):
+def e2e_throughput(batch_size: int, batches: int = 10, warmup: int = 3):
     """images/sec through Module.fit + native ImageRecordIter + tpu_sync —
     the north-star path itself (train_imagenet.py, common/fit.py)."""
     import argparse
+    import glob
+    import shutil
     import tempfile
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -43,13 +45,18 @@ def e2e_throughput(batch_size: int, batches: int = 30, warmup: int = 5):
     from symbols import resnet as resnet_sym
 
     num_examples = batch_size * (batches + warmup + 2)
-    # dataset dir is sized-keyed: a stale smaller .rec from a previous run
+    # dataset dir is size-keyed: a stale smaller .rec from a previous run
     # would silently starve the measurement (get_rec_iter only synthesizes
-    # when the file is absent)
+    # when the file is absent).  Stale sibling sizes are multi-GB — sweep them.
+    data_dir = os.path.join(tempfile.gettempdir(),
+                            f"bench_e2e_data_{num_examples}")
+    for stale in glob.glob(os.path.join(tempfile.gettempdir(),
+                                        "bench_e2e_data_*")):
+        if stale != data_dir:
+            shutil.rmtree(stale, ignore_errors=True)
     args = argparse.Namespace(
         data_train=None, data_val=None,
-        data_dir=os.path.join(tempfile.gettempdir(),
-                              f"bench_e2e_data_{num_examples}"),
+        data_dir=data_dir,
         image_shape="3,224,224", num_classes=100, resize=256,
         data_nthreads=int(os.environ.get("BENCH_E2E_NTHREADS", "8")),
         rgb_mean="123.68,116.779,103.939", rgb_std="1,1,1",
@@ -121,7 +128,7 @@ def main():
     # values are tried and the best wins; comma-separated env to override.
     K_CANDIDATES = [int(k) for k in
                     os.environ.get("BENCH_FUSED_STEPS", "8,16").split(",")
-                    if int(k) > 1]
+                    if k.strip().isdigit() and int(k) > 1]
 
     def make_multi(K):
         def multi_step(params, momenta, x, y, rng):

@@ -118,6 +118,12 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=
                   adj=(), num_filter=0, num_group=1, no_bias=False, layout=None,
                   target_shape=None, cudnn_tune=None, cudnn_off=False, workspace=1024):
     """Transposed convolution (reference: src/operator/nn/deconvolution.cc)."""
+    if is_channels_last(layout):
+        # the flip/swap/regroup below is channels-first math; refuse rather
+        # than silently mis-binding axes (same guard as gluon's Conv*Transpose)
+        raise NotImplementedError(
+            "channels-last layout is not supported for Deconvolution; "
+            "use NC* layout")
     nd = data.ndim - 2
     k = len(kernel) if kernel else nd
     stride = _pair(stride, k) if stride else (1,) * k

@@ -4,6 +4,10 @@
 the TPU tunnel returns (see docs/perf_analysis.md round-4 status).
 
 Usage: python tools/perf_sweep.py [--quick]
+
+The step construction intentionally mirrors bench.py's (bf16 cast,
+log_softmax loss, momentum SGD, fold_in rng, donated carries) — if either
+changes, change both, or the sweep stops measuring the reported path.
 """
 from __future__ import annotations
 
