@@ -350,7 +350,12 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     st = _st()
     heads = list(heads) if isinstance(heads, (list, tuple)) else [heads]
     variables = list(variables) if isinstance(variables, (list, tuple)) else [variables]
-    tape = st.tape
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]  # documented 'NDArray or list' form
+    # snapshot: the create_graph entry appended below must not be part of the
+    # tape its own replay closure iterates (self-reference -> infinite
+    # recursion on second-order backward)
+    tape = list(st.tape)
     var_ids = [id(v) for v in variables]
     var_vals = [v._data for v in variables]
     head_ids = [id(h) for h in heads]
@@ -368,9 +373,12 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
                else head_grads[i]._data for i, p in enumerate(primals)]
         (grads,) = vjp_fn(cts)
         outs = [NDArray(g) for g in grads]
-        # record a tape entry so a further backward can differentiate through
+        # record a tape entry so a further backward can differentiate through;
+        # the replay must seed with the SAME cotangents as the first-order
+        # result, else the recorded graph is a different function
+        cts_const = [jax.lax.stop_gradient(c) for c in cts]
         _grad_of = lambda *vals, **kw: tuple(jax.vjp(f, list(vals))[1](  # noqa: E731
-            [jnp.ones_like(p) for p in jax.eval_shape(f, list(vals))])[0])
+            cts_const)[0])
         _grad_of._mxtpu_custom = True  # per-call closure; skip backward jit cache
         entry = _TapeEntry(
             _grad_of,

@@ -180,10 +180,13 @@ class Block:
         params = self._collect_params_with_prefix()
         if not loaded and not params:
             return
-        # support both this format and full-name format
-        if loaded and not any("." in k for k in loaded.keys()) and \
-                params and all("." in k or k in loaded for k in params):
-            pass
+        # support the full-name format too (keys are Parameter.name values,
+        # optionally "arg:"/"aux:"-prefixed as written by export): if the
+        # dotted-prefix match fails but full names cover the block, remap
+        if loaded and params and not all(k in loaded for k in params):
+            stripped = {k.split(":", 1)[-1]: v for k, v in loaded.items()}
+            if all(p.name in stripped for p in params.values()):
+                loaded = {key: stripped[p.name] for key, p in params.items()}
         for name in params:
             if name not in loaded:
                 if not allow_missing:
@@ -276,11 +279,13 @@ class HybridBlock(Block):
             f"{self.name}: cannot infer shape for {param.name}")
 
     def __call__(self, *args, **kwargs):
-        if self._active:
+        # kwargs are not part of the cache key — run them through the eager
+        # path rather than silently dropping them from a cached program
+        if self._active and not kwargs:
             return self._call_cached(*args)
         return super().__call__(*args, **kwargs)
 
-    def forward(self, x, *args):
+    def forward(self, x, *args, **kwargs):
         params = {}
         for name, p in self._reg_params.items():
             try:
@@ -288,7 +293,7 @@ class HybridBlock(Block):
             except DeferredInitializationError:
                 self._infer_param_shapes((x,) + args)
                 params[name] = p.data()
-        return self.hybrid_forward(_NDF, x, *args, **params)
+        return self.hybrid_forward(_NDF, x, *args, **kwargs, **params)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError

@@ -470,6 +470,11 @@ class KVStoreDist(KVStore):
         while True:
             try:
                 sock = socket.create_connection((host, port), timeout=10)
+                # connect probes fast, but established-channel reads must
+                # outlast server-side BSP parks (server deadline 600 s) —
+                # a 10 s recv timeout would kill workers waiting at a barrier
+                # behind a slow peer
+                sock.settimeout(630)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 return sock
             except OSError:
@@ -558,11 +563,16 @@ class KVStoreDist(KVStore):
         keys = _as_list(key)
         values = _as_list(value)
         for k, v in zip(keys, values):
-            arr = v.asnumpy()
-            self._request_many([
-                (s, ("init", str(k),
-                     arr if lo is None else arr.reshape(-1)[lo:hi]))
-                for s, lo, hi in self._partition(str(k), arr.size)])
+            # rank-0 broadcast like the reference (kvstore_dist.h Init):
+            # if every rank sent its own values, first-arrival could commit
+            # a different rank's slice PER SERVER — a nondeterministic
+            # patchwork no rank ever initialized
+            if self._rank == 0:
+                arr = v.asnumpy()
+                self._request_many([
+                    (s, ("init", str(k),
+                         arr if lo is None else arr.reshape(-1)[lo:hi]))
+                    for s, lo, hi in self._partition(str(k), arr.size)])
             self._pull_version[str(k)] = 0
         self.barrier()
 
@@ -585,14 +595,17 @@ class KVStoreDist(KVStore):
                 # per-slice so error feedback composes with sharding.
                 import jax.numpy as jnp
 
+                reqs = []
                 for s, lo, hi in self._partition(str(k), local.size):
                     part = local if lo is None else local.reshape(-1)[lo:hi]
                     rkey = f"{k}@{s}"
                     packed, new_res = gc.quantize(
                         jnp.asarray(part), self._residuals.get(rkey))
                     self._residuals[rkey] = new_res
-                    self._request_on(s, "push_c", str(k), self._rank,
-                                     _np.asarray(packed), tuple(part.shape))
+                    reqs.append((s, ("push_c", str(k), self._rank,
+                                     _np.asarray(packed), tuple(part.shape))))
+                # overlap per-server pushes like the uncompressed sliced path
+                self._request_many(reqs)
             else:
                 self._request_many([
                     (s, ("push", str(k), self._rank,

@@ -139,6 +139,12 @@ class DataParallelTrainer:
                 for k, v in self.params.items()}
         if self._mesh is not None:
             self._place_params()
+        elif donate:
+            # no mesh -> _place_params made no copies, so params/aux still
+            # alias the gluon block's live buffers; donation would delete
+            # them out from under the block on the first step
+            self.params = {k: jnp.copy(v) for k, v in self.params.items()}
+            self.aux = {k: jnp.copy(v) for k, v in self.aux.items()}
 
     def _place_params(self):
         repl = NamedSharding(self._mesh, PartitionSpec())

@@ -218,14 +218,19 @@ class GroupedProgram:
 
         # seed head cotangents: caller-provided or ones (d of summed outputs)
         cts: Dict[str, object] = {}
-        for i, (e, o) in enumerate(zip(self._entries, outs)):
-            if e.node.kind != "var":
-                k = f"__x_{e.node._uid}_{e.index}"
-                c = (jnp.asarray(out_cts[i]).astype(o.dtype)
-                     if out_cts is not None else ones_like(o))
-                cts[k] = self._acc(cts.get(k), c)
-
         grads: Dict[str, object] = {}
+        for i, (e, o) in enumerate(zip(self._entries, outs)):
+            c = (jnp.asarray(out_cts[i]).astype(o.dtype)
+                 if out_cts is not None else ones_like(o))
+            if e.node.kind == "var":
+                # a variable that IS an output contributes its head cotangent
+                # directly (identity path) — dropping it loses gradient
+                if (e.node.name in self._grad_names
+                        and getattr(c, "dtype", None) != jax.dtypes.float0):
+                    grads[e.node.name] = self._acc(grads.get(e.node.name), c)
+            else:
+                k = f"__x_{e.node._uid}_{e.index}"
+                cts[k] = self._acc(cts.get(k), c)
         for vjp, g, produced, aux in reversed(records):
             dev = self._devs[g]
             out_ct = {k: (jax.device_put(cts.pop(k), dev)
