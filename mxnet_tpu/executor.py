@@ -216,6 +216,8 @@ class Executor:
                 raise MXNetError("backward called before forward(is_train=True)")
             grads = self._cached_grads
         else:
+            if getattr(self, "_last_rng", None) is None:
+                raise MXNetError("backward called before forward(is_train=True)")
             if not isinstance(out_grads, (list, tuple)):
                 out_grads = [out_grads]
             arg_vals, aux_vals = self._collect_vals()

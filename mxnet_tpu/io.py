@@ -129,7 +129,13 @@ class NDArrayIter(DataIter):
     def reset(self):
         if self.shuffle:
             _np.random.shuffle(self.idx)
-        self.cursor = -self.batch_size
+        # roll_over: the final batch of the previous epoch wrapped around and
+        # already consumed the first `_rolled` samples — start past them
+        # (reference io.py:699-703 cursor rollover)
+        start = getattr(self, "_rolled", 0) \
+            if self.last_batch_handle == "roll_over" else 0
+        self._rolled = 0
+        self.cursor = -self.batch_size + start
 
     def iter_next(self):
         self.cursor += self.batch_size
@@ -139,12 +145,12 @@ class NDArrayIter(DataIter):
         out = []
         for k, v in arrays:
             if self.cursor + self.batch_size <= self.num_data:
-                sel = self.idx[self.cursor:self.cursor + self.batch_size]
+                sel = self.idx[max(self.cursor, 0):self.cursor + self.batch_size]
             else:
-                if self.last_batch_handle == "roll_over":
-                    return None
                 pad = self.batch_size - (self.num_data - self.cursor)
                 sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+                if self.last_batch_handle == "roll_over":
+                    self._rolled = pad
             out.append(nd_array(v[sel]))
         return out
 
@@ -237,21 +243,28 @@ class PrefetchingIter(DataIter):
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
+        self._depth = prefetch_depth
         self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
         self._thread = None
+        self._gen = 0          # epoch generation: stale puts are discarded
+        self._exhausted = False
         self.current_batch = None
         self._start()
 
     def _start(self):
+        gen = self._gen
+        q = self._queue
+        stop = self._stop
+
         def worker():
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
                     batches = [it.next() for it in self.iters]
                 except StopIteration:
-                    self._queue.put(None)
+                    q.put((gen, None))
                     return
-                self._queue.put(batches)
+                q.put((gen, batches))
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -273,23 +286,34 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
+        # stop the worker FOR REAL before touching the underlying iterators:
+        # a short join would race it.reset() against an in-flight it.next()
+        # and let a pre-reset batch leak into the new epoch
         self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        if self._thread is not None:
-            self._thread.join(timeout=1.0)
+        while self._thread is not None and self._thread.is_alive():
+            try:
+                while True:
+                    self._queue.get_nowait()  # unblock a worker stuck in put()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
         for it in self.iters:
             it.reset()
+        self._gen += 1
+        self._exhausted = False
         self._stop = threading.Event()
-        self._queue = queue.Queue(maxsize=2)
+        self._queue = queue.Queue(maxsize=self._depth)
         self._start()
 
     def iter_next(self):
-        batches = self._queue.get()
+        if self._exhausted:
+            return False  # worker already exited; get() would hang forever
+        while True:
+            gen, batches = self._queue.get()
+            if gen == self._gen:
+                break  # discard stale entries from a pre-reset worker
         if batches is None:
+            self._exhausted = True
             return False
         self.current_batch = batches[0] if len(batches) == 1 else DataBatch(
             sum([b.data for b in batches], []),

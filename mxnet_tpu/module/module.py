@@ -170,6 +170,13 @@ class Module(BaseModule):
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
                 arr._data = arg_params[name]._data.astype(arr._data.dtype)
+            elif arg_params is not None and not allow_missing:
+                # a partial checkpoint with allow_missing=False must raise,
+                # not silently fall through to the initializer (reference
+                # module.py init_params)
+                raise MXNetError(
+                    f"parameter {name} not present in arg_params "
+                    "(pass allow_missing=True to initialize it instead)")
             elif initializer is not None:
                 desc = InitDesc(name, attrs.get(name))
                 initializer(desc, arr)
@@ -180,6 +187,10 @@ class Module(BaseModule):
             arr = self._exec.aux_dict[name]
             if aux_params is not None and name in aux_params:
                 arr._data = aux_params[name]._data.astype(arr._data.dtype)
+            elif aux_params is not None and not allow_missing:
+                raise MXNetError(
+                    f"aux state {name} not present in aux_params "
+                    "(pass allow_missing=True to initialize it instead)")
             elif initializer is not None:
                 desc = InitDesc(name, attrs.get(name))
                 initializer(desc, arr)
@@ -212,7 +223,13 @@ class Module(BaseModule):
         rescale_grad = 1.0 / batch_size
 
         if isinstance(optimizer, str):
-            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            # the non-kvstore updater indexes params as i*num_device+k
+            # (model._update_params), so idx2name must cover every device
+            # slot or lr_mult/wd_mult and per-param state misroute
+            ndev = len(self._context)
+            idx2name = {i * ndev + k: n
+                        for i, n in enumerate(self._param_names)
+                        for k in range(ndev)}
             optimizer_params = dict(optimizer_params)
             if "rescale_grad" not in optimizer_params:
                 optimizer_params["rescale_grad"] = rescale_grad

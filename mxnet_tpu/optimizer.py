@@ -56,6 +56,7 @@ class Optimizer:
         self.param_dict = param_dict or {}
         self.lr_mult: Dict[str, float] = {}
         self.wd_mult: Dict[str, float] = {}
+        self._sym_wd_mult: Dict[str, float] = {}
         if sym is not None:
             attrs = sym.attr_dict()
             for name, a in attrs.items():
@@ -63,6 +64,7 @@ class Optimizer:
                     self.lr_mult[name] = float(a["__lr_mult__"])
                 if "__wd_mult__" in a:
                     self.wd_mult[name] = float(a["__wd_mult__"])
+                    self._sym_wd_mult[name] = float(a["__wd_mult__"])
 
     # -- bookkeeping --------------------------------------------------------------
     def set_learning_rate(self, lr):
@@ -82,6 +84,9 @@ class Optimizer:
         for n in self.idx2name.values():
             if not (n.endswith("_weight") or n.endswith("_gamma")):
                 self.wd_mult[n] = 0.0
+        # symbol-declared __wd_mult__ attrs survive a set_wd_mult call
+        # (reference optimizer.py set_wd_mult re-reads sym attrs)
+        self.wd_mult.update(self._sym_wd_mult)
         self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):
@@ -92,10 +97,16 @@ class Optimizer:
 
     def _get_lr(self, index):
         lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        # gluon Parameters (Trainer wires them in via param_dict) take
+        # precedence, like the reference's _get_lrs
+        if index in self.param_dict:
+            return lr * getattr(self.param_dict[index], "lr_mult", 1.0)
         name = self.idx2name.get(index, index if isinstance(index, str) else None)
         return lr * self.lr_mult.get(name, 1.0)
 
     def _get_wd(self, index):
+        if index in self.param_dict:
+            return self.wd * getattr(self.param_dict[index], "wd_mult", 1.0)
         name = self.idx2name.get(index, index if isinstance(index, str) else None)
         return self.wd * self.wd_mult.get(name, 1.0)
 
@@ -364,9 +375,13 @@ class AdaGrad(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        g = self._preprocess_grad(grad) + wd * weight._data
+        # reference semantics (adagrad in optimizer.py): the history
+        # accumulates the bare gradient; weight decay applies OUTSIDE it
+        g = self._preprocess_grad(grad)
         state._data = state._data + g * g
-        weight._data = weight._data - lr * g / jnp.sqrt(state._data + self.float_stable_eps)
+        weight._data = weight._data - lr * (
+            g / jnp.sqrt(state._data + self.float_stable_eps)
+            + wd * weight._data)
 
 
 @register

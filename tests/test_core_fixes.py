@@ -105,3 +105,115 @@ def test_load_parameters_full_name_format(tmp_path):
     b.load_parameters(path)
     np.testing.assert_allclose(b.weight.data().asnumpy(),
                                a.weight.data().asnumpy())
+
+
+def test_gluon_parameter_lr_mult_freezes_layer():
+    net = nn.Dense(3, in_units=2, prefix="frz_")
+    net.initialize()
+    net.weight.lr_mult = 0.0
+    w0 = net.weight.data().asnumpy().copy()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 1.0})
+    x = nd.array(np.ones((4, 2), np.float32))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(4)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w0)
+    assert not np.allclose(net.bias.data().asnumpy(), 0.0)  # bias trained
+
+
+def test_adagrad_wd_outside_history():
+    import mxnet_tpu as mx
+
+    opt = mx.optimizer.AdaGrad(learning_rate=0.1, wd=0.1)
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.5, 0.5])
+    st = opt.create_state(0, w)
+    opt.update(0, w, g, st)
+    # history accumulates the bare gradient only (reference adagrad)
+    np.testing.assert_allclose(st.asnumpy(), [0.25, 0.25], atol=1e-6)
+
+
+def test_set_wd_mult_preserves_sym_attrs():
+    import mxnet_tpu as mx
+
+    d = mx.sym.Variable("data")
+    w = mx.sym.Variable("fcm_weight", wd_mult=0.5)
+    fc = mx.sym.FullyConnected(d, w, num_hidden=2, name="fcm")
+    o = mx.optimizer.SGD(sym=fc, param_idx2name={0: "fcm_weight"})
+    o.set_wd_mult({})
+    assert o.wd_mult.get("fcm_weight") == 0.5
+
+
+def test_ndarrayiter_roll_over_carries_remainder():
+    import mxnet_tpu as mx
+
+    it = mx.io.NDArrayIter(np.arange(10).reshape(10, 1).astype(np.float32),
+                           None, batch_size=3, last_batch_handle="roll_over")
+    e1 = [b.data[0].asnumpy().ravel().tolist() for b in it]
+    assert len(e1) == 4 and e1[-1] == [9.0, 0.0, 1.0]  # wrapped final batch
+    it.reset()
+    e2 = [b.data[0].asnumpy().ravel().tolist() for b in it]
+    assert e2[0] == [2.0, 3.0, 4.0]  # next epoch starts past rolled samples
+
+
+def test_prefetching_iter_exhaustion_and_reset():
+    import time
+
+    import mxnet_tpu as mx
+
+    base = mx.io.NDArrayIter(np.arange(4).reshape(4, 1).astype(np.float32),
+                             None, batch_size=2)
+    pf = mx.io.PrefetchingIter(base, prefetch_depth=5)
+    assert sum(1 for _ in pf) == 2
+    t0 = time.time()
+    assert pf.iter_next() is False  # must not hang after exhaustion
+    assert time.time() - t0 < 2.0
+    pf.reset()
+    assert pf._queue.maxsize == 5  # user depth survives reset
+    assert sum(1 for _ in pf) == 2
+
+
+def test_module_multi_device_lr_mult_and_strict_init():
+    import mxnet_tpu as mx
+
+    d = mx.sym.Variable("data")
+    w2 = mx.sym.Variable("mdf2_weight", lr_mult=0.0)
+    h = mx.sym.Activation(mx.sym.FullyConnected(d, num_hidden=4, name="mdf1"),
+                          act_type="relu")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, w2, num_hidden=3, name="mdf2"),
+        name="softmax")
+    X = np.random.RandomState(0).rand(32, 5).astype(np.float32)
+    Y = np.random.RandomState(1).randint(0, 3, (32,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    mod = mx.mod.Module(out, context=[mx.cpu(0), mx.cpu(1)])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    frozen = mod._exec.arg_dict["mdf2_weight"].asnumpy().copy()
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    for batch in it:
+        mod.forward(batch)
+        mod.backward()
+        mod.update()
+    np.testing.assert_allclose(mod._exec.arg_dict["mdf2_weight"].asnumpy(),
+                               frozen)
+
+    mod2 = mx.mod.Module(out)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    with pytest.raises(Exception, match="not present"):
+        mod2.init_params(mx.init.Xavier(),
+                         arg_params={"mdf1_weight": nd.ones((4, 5))},
+                         allow_missing=False)
+
+
+def test_executor_backward_with_out_grads_before_forward_raises():
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+
+    d = mx.sym.Variable("d")
+    s = mx.sym.FullyConnected(d, num_hidden=2, name="ebf")
+    exe = s.simple_bind(ctx=mx.cpu(), d=(2, 3))
+    with pytest.raises(MXNetError, match="before forward"):
+        exe.backward(out_grads=nd.ones((2, 2)))
