@@ -106,6 +106,33 @@ def predict_mode() -> _Scope:
     return _Scope(training=False)
 
 
+def _is_on_tape(arr) -> bool:
+    """True if `arr` participates in the current tape (as input or output)."""
+    st = _st()
+    i = id(arr)
+    for e in st.tape:
+        if i in e.in_ids or i in e.out_ids:
+            return True
+    return False
+
+
+def check_inplace(arr) -> None:
+    """Raise if an in-place write on `arr` would corrupt a recorded graph.
+
+    The reference forbids in-place ops under autograd recording outright
+    (imperative autograd 'Inplace operations are not supported when
+    recording'); here only writes to arrays already ON the tape are fatal —
+    the replay would silently recompute from the post-write buffer."""
+    st = _st()
+    if st.recording and _is_on_tape(arr):
+        from .base import MXNetError
+
+        raise MXNetError(
+            "in-place write to an array that is part of the recorded graph; "
+            "gradients would be computed from the overwritten value. Use "
+            "out-of-place ops inside autograd.record()")
+
+
 def is_recording() -> bool:
     return _st().recording
 

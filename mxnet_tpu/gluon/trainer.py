@@ -29,7 +29,12 @@ class Trainer:
             self._params.append(param)
         self._compression_params = compression_params
         optimizer_params = dict(optimizer_params or {})
-        self._scale = optimizer_params.get("rescale_grad", 1.0)
+        # an Optimizer instance carries its own rescale_grad; honor it
+        # (reference trainer.py: self._scale = optimizer.rescale_grad)
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._scale = optimizer.rescale_grad
+        else:
+            self._scale = optimizer_params.get("rescale_grad", 1.0)
         self._init_optimizer(optimizer, optimizer_params)
         self._kvstore_arg = kvstore
         self._update_on_kvstore_arg = update_on_kvstore

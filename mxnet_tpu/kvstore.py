@@ -214,10 +214,27 @@ class KVStoreLocal(KVStore):
                 raise MXNetError(f"kvstore: key {k!r} not initialized")
             for dst in _as_list(o):
                 if isinstance(src, _sparse.BaseSparseNDArray):
-                    src.copyto(dst) if isinstance(dst, _sparse.BaseSparseNDArray) \
-                        else dst.__setattr__("_data", src._to_dense_jax())
+                    if isinstance(dst, _sparse.BaseSparseNDArray):
+                        src.copyto(dst)
+                    else:
+                        dst._data = self._to_dst_device(
+                            src._to_dense_jax(), dst)
                 else:
-                    dst._data = src._data
+                    # copy INTO the destination's device (reference
+                    # CopyFromTo keeps dst context); rebinding to the
+                    # store's buffer would collapse per-device placement
+                    dst._data = self._to_dst_device(src._data, dst)
+
+    @staticmethod
+    def _to_dst_device(buf, dst):
+        try:
+            dst_devs = (None if dst._data is None
+                        else list(dst._data.devices()))
+        except Exception:
+            dst_devs = None
+        if dst_devs and list(buf.devices()) != dst_devs:
+            buf = jax.device_put(buf, dst_devs[0])
+        return buf
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only requested rows (reference: kvstore.h:209-223). On TPU this
@@ -228,11 +245,14 @@ class KVStoreLocal(KVStore):
         if len(keys) == 1:
             outs = [outs] if not isinstance(out, (list, tuple)) or \
                 not isinstance(out[0], (list, tuple)) else outs
-            rids = [rids] if not isinstance(row_ids, (list, tuple)) else [row_ids] \
-                if isinstance(row_ids, NDArray) else rids
+            rids = [rids]  # group ALL row-id sets with the single key
         for k, o, r in zip(keys, outs, rids):
             src = self._store.get(k)
-            for dst, rid in zip(_as_list(o), _as_list(r) * len(_as_list(o))):
+            dsts = _as_list(o)
+            rlist = _as_list(r)
+            if len(rlist) == 1 and len(dsts) > 1:
+                rlist = rlist * len(dsts)  # one shared id set, many outs
+            for dst, rid in zip(dsts, rlist):
                 retained = _sparse.retain(
                     src if isinstance(src, _sparse.RowSparseNDArray)
                     else _sparse.cast_storage(src, "row_sparse"), rid)

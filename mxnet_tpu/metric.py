@@ -160,6 +160,12 @@ class F1(EvalMetric):
         else:
             self.reset_stats()
 
+    @staticmethod
+    def _f1_of(tp, fp, fn):
+        prec = tp / (tp + fp) if tp + fp else 0.0
+        rec = tp / (tp + fn) if tp + fn else 0.0
+        return 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             p = _to_np(pred)
@@ -167,25 +173,36 @@ class F1(EvalMetric):
             if p.ndim > 1:
                 p = _np.argmax(p, axis=-1)
             p = p.astype(_np.int64).flatten()
-            self._tp += float(((p == 1) & (l == 1)).sum())
-            self._fp += float(((p == 1) & (l == 0)).sum())
-            self._fn += float(((p == 0) & (l == 1)).sum())
-            prec = self._tp / (self._tp + self._fp) if self._tp + self._fp else 0.0
-            rec = self._tp / (self._tp + self._fn) if self._tp + self._fn else 0.0
-            f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
-            self.sum_metric = f1
-            self.num_inst = 1
+            tp = float(((p == 1) & (l == 1)).sum())
+            fp = float(((p == 1) & (l == 0)).sum())
+            fn = float(((p == 0) & (l == 1)).sum())
+            if self.average == "macro":
+                # reference semantics: per-update F1 values, averaged
+                self.sum_metric += self._f1_of(tp, fp, fn)
+                self.num_inst += 1
+            else:  # micro: pooled cumulative counts
+                self._tp += tp
+                self._fp += fp
+                self._fn += fn
+                self.sum_metric = self._f1_of(self._tp, self._fp, self._fn)
+                self.num_inst = 1
 
 
 @register("mcc")
 class MCC(EvalMetric):
     def __init__(self, name="mcc", output_names=None, label_names=None, average="macro"):
         super().__init__(name, output_names, label_names)
+        self.average = average
         self._tp = self._fp = self._tn = self._fn = 0.0
 
     def reset(self):
         super().reset()
         self._tp = self._fp = self._tn = self._fn = 0.0
+
+    @staticmethod
+    def _mcc_of(tp, fp, tn, fn):
+        denom = math.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return ((tp * tn - fp * fn) / denom) if denom else 0.0
 
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
@@ -194,15 +211,22 @@ class MCC(EvalMetric):
             if p.ndim > 1:
                 p = _np.argmax(p, axis=-1)
             p = p.astype(_np.int64).flatten()
-            self._tp += float(((p == 1) & (l == 1)).sum())
-            self._fp += float(((p == 1) & (l == 0)).sum())
-            self._tn += float(((p == 0) & (l == 0)).sum())
-            self._fn += float(((p == 0) & (l == 1)).sum())
-            denom = math.sqrt((self._tp + self._fp) * (self._tp + self._fn)
-                              * (self._tn + self._fp) * (self._tn + self._fn))
-            mcc = ((self._tp * self._tn - self._fp * self._fn) / denom) if denom else 0.0
-            self.sum_metric = mcc
-            self.num_inst = 1
+            tp = float(((p == 1) & (l == 1)).sum())
+            fp = float(((p == 1) & (l == 0)).sum())
+            tn = float(((p == 0) & (l == 0)).sum())
+            fn = float(((p == 0) & (l == 1)).sum())
+            if self.average == "macro":
+                # reference semantics: per-update MCC values, averaged
+                self.sum_metric += self._mcc_of(tp, fp, tn, fn)
+                self.num_inst += 1
+            else:  # micro: pooled cumulative counts
+                self._tp += tp
+                self._fp += fp
+                self._tn += tn
+                self._fn += fn
+                self.sum_metric = self._mcc_of(self._tp, self._fp,
+                                               self._tn, self._fn)
+                self.num_inst = 1
 
 
 @register("perplexity")
@@ -219,8 +243,16 @@ class Perplexity(EvalMetric):
         for label, pred in zip(labels, preds):
             p = _to_np(pred)
             l = _to_np(label).astype(_np.int64).flatten()
+            # honor the class axis (reference picks along self.axis); move it
+            # last, flatten the rest
+            ax = self.axis % p.ndim
+            if ax != p.ndim - 1:
+                p = _np.moveaxis(p, ax, -1)
             p = p.reshape(-1, p.shape[-1])
-            probs = p[_np.arange(l.size), l]
+            # clip indices like the reference's pick(mode='clip'): ignored
+            # labels may be out of class range (e.g. pad id == num classes)
+            lc = _np.clip(l, 0, p.shape[1] - 1)
+            probs = p[_np.arange(l.size), lc]
             num = l.size
             if self.ignore_label is not None:
                 ignore = (l == self.ignore_label)

@@ -422,23 +422,35 @@ class NDArray:
     def __hash__(self):
         return id(self)
 
-    # in-place: rebind the handle (old readers keep the old immutable buffer)
+    # in-place: rebind the handle (old readers keep the old immutable buffer).
+    # Under autograd recording, writes to on-tape arrays raise — the replay
+    # would silently recompute from the overwritten buffer (reference forbids
+    # in-place ops under recording entirely).
+    def _guard_inplace(self):
+        from .. import autograd
+
+        autograd.check_inplace(self)
+
     def __iadd__(self, other):
+        self._guard_inplace()
         out = self.__add__(other)
         self._data = out._data
         return self
 
     def __isub__(self, other):
+        self._guard_inplace()
         out = self.__sub__(other)
         self._data = out._data
         return self
 
     def __imul__(self, other):
+        self._guard_inplace()
         out = self.__mul__(other)
         self._data = out._data
         return self
 
     def __itruediv__(self, other):
+        self._guard_inplace()
         out = self.__truediv__(other)
         self._data = out._data
         return self
@@ -458,6 +470,7 @@ class NDArray:
         return NDArray(self._data[jkey])
 
     def __setitem__(self, key, value):
+        self._guard_inplace()
         if isinstance(value, NDArray):
             v = value._data
         elif isinstance(value, (int, float)):
@@ -586,14 +599,19 @@ def invoke(op: Op, inputs: Sequence[NDArray], attrs: dict, out=None):
     results = list(result) if multi else [result]
     outputs = [NDArray(r) for r in results]
 
-    if autograd.is_recording():
-        autograd._record_op(op, kwargs, list(inputs), outputs)
-
     if out is not None:
+        # write into the caller's handles FIRST and tape those — recording
+        # the temporaries would make backward through `out` see a constant
         outs = out if isinstance(out, (tuple, list)) else [out]
         for dst, src in zip(outs, outputs):
             dst._data = src._data
+        if autograd.is_recording():
+            autograd._record_op(op, kwargs, list(inputs), list(outs))
         return out
+
+    if autograd.is_recording():
+        autograd._record_op(op, kwargs, list(inputs), outputs)
+
     if multi:
         return tuple(outputs)
     return outputs[0]

@@ -361,13 +361,17 @@ class FusedRNNCell(BaseRNNCell):
     def unroll(self, length, inputs=None, begin_state=None, layout="NTC",
                merge_outputs=None, input_prefix=""):
         self.reset()
+        # `layout` names the CALLER's layout for tensor inputs and the output
+        # format either way; a list of per-step inputs is assembled time-major
+        # internally without changing the requested output layout
+        inputs_are_tnc = False
         if inputs is None:
             inputs = symbol.Variable(f"{input_prefix}data")
         elif not isinstance(inputs, symbol.Symbol):
             inputs = [symbol.expand_dims(s, axis=0) for s in inputs]
-            inputs = symbol.Concat(*inputs, dim=0)  # → TNC
-            layout = "TNC"
-        if layout == "NTC":
+            inputs = symbol.Concat(*inputs, dim=0)  # already TNC
+            inputs_are_tnc = True
+        if layout == "NTC" and not inputs_are_tnc:
             inputs = symbol.swapaxes(inputs, dim1=0, dim2=1)
         states = begin_state or [None] * len(self.state_info)
 

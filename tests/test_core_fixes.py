@@ -217,3 +217,115 @@ def test_executor_backward_with_out_grads_before_forward_raises():
     exe = s.simple_bind(ctx=mx.cpu(), d=(2, 3))
     with pytest.raises(MXNetError, match="before forward"):
         exe.backward(out_grads=nd.ones((2, 2)))
+
+
+def test_kvstore_pull_preserves_destination_device():
+    import jax
+
+    import mxnet_tpu as mx
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >=2 devices")
+    kv = mx.kv.create("local")
+    kv.init(100, nd.array(np.arange(3, dtype=np.float32)))
+    import jax.numpy as jnp
+
+    dst = nd.NDArray(jax.device_put(jnp.zeros(3), devs[1]))
+    kv.pull(100, out=[dst])
+    assert list(dst._data.devices())[0] == devs[1]
+    np.testing.assert_allclose(dst.asnumpy(), [0, 1, 2])
+
+
+def test_inplace_write_on_taped_array_raises():
+    from mxnet_tpu.base import MXNetError
+
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with pytest.raises(MXNetError, match="in-place"):
+        with autograd.record():
+            y = x * 2  # noqa: F841 — puts x on the tape
+            x += 1
+
+
+def test_invoke_out_kwarg_is_differentiable():
+    from mxnet_tpu.ndarray.ndarray import invoke
+    from mxnet_tpu.ops.registry import get_op
+
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    out = nd.zeros(3)
+    with autograd.record():
+        invoke(get_op("square"), [x], {}, out=out)
+    out.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_trainer_honors_optimizer_instance_rescale():
+    import mxnet_tpu as mx
+
+    p = gluon.Parameter("trsc_w", shape=(2,))
+    p.initialize(init=mx.init.Constant(0.0))
+    tr = gluon.Trainer([p], mx.optimizer.SGD(learning_rate=1.0,
+                                             rescale_grad=0.5), kvstore=None)
+    with autograd.record():
+        loss = (p.data() * nd.array([1.0, 1.0])).sum()
+    loss.backward()
+    tr.step(1)
+    np.testing.assert_allclose(p.data().asnumpy(), [-0.5, -0.5], atol=1e-6)
+
+
+def test_f1_mcc_macro_average_per_batch():
+    import mxnet_tpu as mx
+
+    f1 = mx.metric.F1(average="macro")
+    f1.update([nd.array([1, 0])], [nd.array([[0.1, 0.9], [0.9, 0.1]])])
+    f1.update([nd.array([1, 1])], [nd.array([[0.9, 0.1], [0.9, 0.1]])])
+    assert abs(f1.get()[1] - 0.5) < 1e-6
+
+    mcc = mx.metric.MCC(average="macro")
+    mcc.update([nd.array([1, 0])], [nd.array([[0.1, 0.9], [0.9, 0.1]])])
+    assert abs(mcc.get()[1] - 1.0) < 1e-6
+
+
+def test_perplexity_axis_and_out_of_range_ignore():
+    import math
+
+    import mxnet_tpu as mx
+
+    m = mx.metric.Perplexity(ignore_label=2)  # pad id == num classes
+    m.update([nd.array([1, 2])], [nd.array([[0.5, 0.5], [0.3, 0.7]])])
+    assert math.isfinite(m.get()[1])
+
+    m2 = mx.metric.Perplexity(axis=0)
+    m2.update([nd.array([2, 0])],
+              [nd.array([[0.2, 0.5], [0.3, 0.2], [0.5, 0.3]])])
+    want = math.exp(-(math.log(0.5) + math.log(0.5)) / 2)
+    assert abs(m2.get()[1] - want) < 1e-6
+
+
+def test_row_sparse_pull_per_output_row_ids():
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("local")
+    kv.init(101, nd.array(np.arange(9, dtype=np.float32).reshape(3, 3)))
+    o1, o2 = nd.zeros((3, 3)), nd.zeros((3, 3))
+    kv.row_sparse_pull(101, out=[o1, o2],
+                       row_ids=[nd.array([0]), nd.array([2])])
+    np.testing.assert_allclose(o1.asnumpy()[0], [0, 1, 2])
+    np.testing.assert_allclose(o2.asnumpy()[2], [6, 7, 8])
+
+
+def test_fused_rnn_list_inputs_respect_ntc_layout():
+    import mxnet_tpu as mx
+    from mxnet_tpu import rnn as mrnn
+
+    cell = mrnn.FusedRNNCell(5, num_layers=1, mode="lstm", prefix="frcfix_")
+    steps = [mx.sym.Variable(f"frcs{i}") for i in range(3)]
+    outs, _ = cell.unroll(3, inputs=steps, layout="NTC", merge_outputs=True)
+    exe = outs.simple_bind(ctx=mx.cpu(), **{f"frcs{i}": (2, 4)
+                                            for i in range(3)})
+    for i in range(3):
+        exe.arg_dict[f"frcs{i}"][:] = nd.array(
+            np.random.RandomState(i).rand(2, 4).astype(np.float32))
+    assert exe.forward()[0].shape == (2, 3, 5)
