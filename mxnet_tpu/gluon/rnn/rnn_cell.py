@@ -46,12 +46,27 @@ class RecurrentCell(HybridBlock):
             begin_state = self.begin_state(batch_size)
         states = begin_state
         outputs = []
+        all_states = [] if valid_length is not None else None
         steps = [inputs.take(nd.array([i]), axis=axis).squeeze(axis)
                  for i in range(length)] if axis != 0 else \
             [inputs[i] for i in range(length)]
         for i in range(length):
             output, states = self(steps[i], states)
             outputs.append(output)
+            if all_states is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            # reference rnn_cell.py: mask padded outputs to zero and take each
+            # sequence's state at t = valid_length-1 (not t = length-1)
+            vl = valid_length if isinstance(valid_length, nd.NDArray) \
+                else nd.array(valid_length)
+            stacked = nd.stack(*outputs, axis=0)          # (T, N, ...)
+            masked = nd.SequenceMask(stacked, vl, use_sequence_length=True)
+            outputs = [masked[i] for i in range(length)]
+            n_state = len(all_states[0])
+            states = [nd.SequenceLast(
+                nd.stack(*[st[j] for st in all_states], axis=0), vl,
+                use_sequence_length=True) for j in range(n_state)]
         if merge_outputs is None or merge_outputs:
             outputs = nd.stack(*outputs, axis=axis)
         return outputs, states
@@ -252,6 +267,13 @@ class ZoneoutCell(RecurrentCell):
 
     def begin_state(self, batch_size=0, **kwargs):
         return self.base_cell.begin_state(batch_size, **kwargs)
+
+    def reset(self):
+        super().reset()
+        # reset() runs from the base __init__ before base_cell is assigned
+        if getattr(self, "base_cell", None) is not None:
+            self.base_cell.reset()
+        self._prev_output = None  # a stale output must not leak across seqs
 
     def hybrid_forward(self, F, inputs, states):
         next_output, next_states = self.base_cell(inputs, states)

@@ -137,7 +137,7 @@ def _binary_impl(f, cmp):
     def impl(a, b):
         r = f(a, b)
         if cmp:
-            r = r.astype(a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32)
+            r = r.astype(a.dtype)  # reference keeps the input dtype
         return r
 
     return impl
@@ -259,13 +259,28 @@ def sort(x, axis=-1, is_ascend=True):
 @register("topk", differentiable=False, num_outputs=lambda attrs: 2 if attrs.get("ret_typ") == "both" else 1)
 def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
     """Reference: src/operator/tensor/ordered_op. lax.top_k rides the TPU sort unit."""
-    ax = int(axis) if axis is not None else -1
-    xm = jnp.moveaxis(x, ax, -1)
+    if axis is None:
+        xm = jnp.reshape(x, (-1,))  # reference: flattened array when no axis
+        ax = 0
+    else:
+        ax = int(axis)
+        xm = jnp.moveaxis(x, ax, -1)
     vals, idx = lax.top_k(-xm if is_ascend else xm, k)
     if is_ascend:
         vals = -vals
-    vals = jnp.moveaxis(vals, -1, ax)
-    idx = jnp.moveaxis(idx, -1, ax).astype(jnp.float32)
+    if ret_typ == "mask":
+        # 0/1 mask of the input shape marking the top-k entries
+        mask = jnp.zeros(xm.shape, x.dtype)
+        mask = jnp.put_along_axis(mask, idx, jnp.ones_like(
+            vals, dtype=x.dtype), axis=-1, inplace=False)
+        if axis is None:
+            return jnp.reshape(mask, x.shape)
+        return jnp.moveaxis(mask, -1, ax)
+    if axis is not None:
+        vals = jnp.moveaxis(vals, -1, ax)
+        idx = jnp.moveaxis(idx, -1, ax).astype(jnp.float32)
+    else:
+        idx = idx.astype(jnp.float32)
     if ret_typ == "value":
         return vals
     if ret_typ == "both":
@@ -285,6 +300,22 @@ def reshape(x, shape=None, reverse=False):
     if not any(s in (0, -2, -3, -4) for s in shape):
         return jnp.reshape(x, shape)
     src = list(x.shape)[::-1] if reverse else list(x.shape)
+    if reverse:
+        # the reference reverses BOTH the source shape and the target spec,
+        # computes left-to-right, then reverses the result (matrix_op.cc:166).
+        # -4 split groups travel as (-4, a, b): re-order each reversed
+        # (b, a, -4) window and swap its pair so splits stay adjacent.
+        rev = list(reversed(shape))
+        fixed = []
+        j = 0
+        while j < len(rev):
+            if j + 2 < len(rev) and rev[j + 2] == -4:
+                fixed.extend([-4, rev[j + 1], rev[j]])
+                j += 3
+            else:
+                fixed.append(rev[j])
+                j += 1
+        shape = tuple(fixed)
     out = []
     i = 0
     it = iter(range(len(shape)))
@@ -553,7 +584,9 @@ def take(a, indices, axis=0, mode="clip"):
 
 @register("pick")
 def pick(x, index, axis=-1, keepdims=False, mode="clip"):
-    idx = jnp.clip(index.astype(jnp.int32), 0, x.shape[int(axis)] - 1)
+    n = x.shape[int(axis)]
+    idx = index.astype(jnp.int32)
+    idx = idx % n if mode == "wrap" else jnp.clip(idx, 0, n - 1)
     r = jnp.take_along_axis(x, jnp.expand_dims(idx, int(axis)), axis=int(axis))
     if not keepdims:
         r = jnp.squeeze(r, int(axis))

@@ -42,7 +42,10 @@ def _active_extra_inputs(opname: str, attrs: dict) -> Tuple[Tuple[str, ...], Tup
     if opname == "LeakyReLU" and attrs.get("act_type", "leaky") != "prelu":
         params = ()
     if opname == "RNN":
-        if attrs.get("mode") != "lstm":
+        # the RNN op's own default mode is "lstm" (ops/rnn.py), so a missing
+        # attr must keep the state_cell slot or the kernel runs an LSTM with
+        # a silently-zero cell state
+        if attrs.get("mode", "lstm") != "lstm":
             params = ("parameters", "state")
     return params, aux
 

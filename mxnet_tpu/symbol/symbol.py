@@ -141,6 +141,12 @@ class Symbol:
         for i, a in enumerate(args):
             repl[arg_names[i]] = a._entries[0]
         for k, v in kwargs.items():
+            if k not in arg_names:
+                # silent no-op on a typo'd name leaves the free variable in
+                # the graph; the reference's SymbolCompose raises
+                raise MXNetError(
+                    f"compose: {k!r} is not an argument of this symbol "
+                    f"(arguments: {arg_names})")
             repl[k] = v._entries[0]
         for n in topo_order(self._entries):
             n.inputs = [repl[e.node.name] if (e.node.kind == "var" and e.node.name in repl)
@@ -277,11 +283,40 @@ class Symbol:
             return None, None, None
 
     def infer_type(self, *args, **kwargs):
-        args_t = [np_dtype(a) if a is not None else _np.float32 for a in args] or None
-        dt = args_t[0] if args_t else _np.float32
-        n_args = len(self.list_arguments())
-        n_aux = len(self.list_auxiliary_states())
-        return ([dt] * n_args, [dt] * len(self._entries), [dt] * n_aux)
+        """Propagate dtypes through the graph (reference: InferType pass).
+
+        Rules: `cast` produces its dtype attr; comparisons keep the input
+        dtype; arg-index producers report float32 (reference convention);
+        everything else takes its first input's dtype."""
+        from .graph import topo_order as _topo
+
+        default = _np.float32
+        var_t: Dict[str, _np.dtype] = {}
+        arg_names = self.list_arguments()
+        for name, a in zip(arg_names, args):
+            if a is not None:
+                var_t[name] = np_dtype(a)
+        for k, v in kwargs.items():
+            var_t[k] = np_dtype(v)
+        node_t: Dict[int, _np.dtype] = {}
+        for n in _topo(self._entries):
+            if n.kind == "var":
+                var_t.setdefault(n.name, default)
+                node_t[id(n)] = var_t[n.name]
+                continue
+            in_ts = [node_t[id(e.node)] for e in n.inputs]
+            opn = n.op.name
+            if opn in ("cast", "Cast", "amp_cast"):
+                t = np_dtype(n.attrs.get("dtype", "float32"))
+            elif opn in ("argmax", "argmin", "argsort", "topk", "one_hot"):
+                t = _np.dtype(_np.float32)
+            else:
+                t = in_ts[0] if in_ts else default
+            node_t[id(n)] = t
+        return ([var_t.get(nm, default) for nm in arg_names],
+                [node_t[id(e.node)] for e in self._entries],
+                [var_t.get(nm, default)
+                 for nm in self.list_auxiliary_states()])
 
     # -- binding ------------------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,

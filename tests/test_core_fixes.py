@@ -329,3 +329,75 @@ def test_fused_rnn_list_inputs_respect_ntc_layout():
         exe.arg_dict[f"frcs{i}"][:] = nd.array(
             np.random.RandomState(i).rand(2, 4).astype(np.float32))
     assert exe.forward()[0].shape == (2, 3, 5)
+
+
+def test_reshape_reverse_matches_reference():
+    x = nd.array(np.arange(200, dtype=np.float32).reshape(10, 5, 4))
+    assert nd.reshape(x, shape=(-1, 0), reverse=True).shape == (50, 4)
+
+
+def test_pick_wrap_mode():
+    out = nd.pick(nd.array([[0.0, 1, 2], [3, 4, 5]]), nd.array([-1.0, 4]),
+                  axis=1, mode="wrap")
+    np.testing.assert_allclose(out.asnumpy(), [2.0, 4.0])
+
+
+def test_topk_mask_and_flattened_axis():
+    x = nd.array([[1.0, 3, 2], [6, 4, 5]])
+    m = nd.topk(x, k=2, ret_typ="mask")
+    np.testing.assert_allclose(m.asnumpy(), [[0, 1, 1], [1, 0, 1]])
+    g = nd.topk(x, axis=None, k=2)
+    np.testing.assert_allclose(sorted(g.asnumpy().tolist()), [3.0, 5.0])
+
+
+def test_comparison_preserves_integer_dtype():
+    a = nd.array(np.array([1, 2], np.int32))
+    b = nd.array(np.array([1, 3], np.int32))
+    assert nd.broadcast_equal(a, b).dtype == np.int32
+
+
+def test_infer_type_propagates_cast():
+    import mxnet_tpu as mx
+
+    c = mx.sym.cast(mx.sym.Variable("data"), dtype="int32")
+    _, out_types, _ = c.infer_type(np.float32)
+    assert np.dtype(out_types[0]) == np.int32
+
+
+def test_compose_unknown_kwarg_raises():
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+
+    fc = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                               name="cmpfix")
+    with pytest.raises(MXNetError, match="not an argument"):
+        fc(bogus=mx.sym.Variable("x"))
+
+
+def test_unroll_valid_length_masks_and_selects_states():
+    from mxnet_tpu.gluon import rnn as grnn
+
+    cell = grnn.RNNCell(4, prefix="vlfix_")
+    cell.initialize()
+    x = nd.array(np.random.RandomState(0).rand(3, 2, 5).astype(np.float32))
+    o_m, s_m = cell.unroll(3, x, layout="TNC",
+                           valid_length=nd.array([2.0, 3.0]),
+                           merge_outputs=True)
+    cell.reset()
+    o_u, _ = cell.unroll(3, x, layout="TNC", merge_outputs=True)
+    assert np.allclose(o_m.asnumpy()[2, 0], 0.0)          # padded step zeroed
+    assert not np.allclose(o_u.asnumpy()[2, 0], 0.0)
+    # sequence 0's final state comes from t=1 (vl=2), not t=2
+    np.testing.assert_allclose(s_m[0].asnumpy()[0], o_u.asnumpy()[1, 0],
+                               atol=1e-5)
+
+
+def test_zoneout_reset_clears_prev_output():
+    from mxnet_tpu.gluon import rnn as grnn
+
+    z = grnn.ZoneoutCell(grnn.RNNCell(4, prefix="zo_"), zoneout_outputs=0.5)
+    z.initialize()
+    x = nd.array(np.random.RandomState(0).rand(2, 2, 5).astype(np.float32))
+    z.unroll(2, x, layout="TNC")
+    z.reset()
+    assert z._prev_output is None
