@@ -127,6 +127,26 @@ class CIFAR100(CIFAR10):
         self._fine_label = fine_label
         super().__init__(root, train, transform)
 
+    def _get_data(self):
+        # CIFAR-100 binaries are train.bin/test.bin with 3074-byte rows:
+        # [coarse_label, fine_label, 3072 pixels] (reference datasets.py)
+        f = os.path.join(self._root,
+                         "train.bin" if self._train else "test.bin")
+        if os.path.exists(f):
+            raw = _np.fromfile(f, dtype=_np.uint8).reshape(-1, 3074)
+            self._label = raw[:, 1 if self._fine_label else 0] \
+                .astype(_np.int32)
+            self._data = raw[:, 2:].reshape(-1, 3, 32, 32) \
+                .transpose(0, 2, 3, 1)
+        else:
+            n = 5000 if self._train else 1000
+            classes = self._n_classes if self._fine_label else 20
+            imgs, labels = _synthetic_images(
+                n, (32, 32), classes,
+                self._seed + (0 if self._train else 1))
+            self._data = _np.repeat(imgs[..., None], 3, axis=-1)
+            self._label = labels
+
 
 class ImageRecordDataset(Dataset):
     """Dataset over a RecordIO of packed images (reference: datasets.py)."""

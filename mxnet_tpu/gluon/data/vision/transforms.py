@@ -55,13 +55,25 @@ class Resize(Block):
     def __init__(self, size, keep_ratio=False, interpolation=1):
         super().__init__()
         self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._keep = keep_ratio and not isinstance(size, (tuple, list))
         self._interp = interpolation
 
     def forward(self, x):
         from .... import image as img_mod
 
         arr = x.asnumpy() if isinstance(x, NDArray) else x
-        out = img_mod._resize_np(arr, self._size[1], self._size[0], self._interp)
+        if self._keep:
+            # scalar size + keep_ratio: scale the SHORT side to size
+            # (reference transforms.Resize keep_ratio semantics)
+            h, w = arr.shape[0], arr.shape[1]
+            if h < w:
+                new_h, new_w = self._size[0], max(1, round(w * self._size[0] / h))
+            else:
+                new_h, new_w = max(1, round(h * self._size[1] / w)), self._size[1]
+            out = img_mod._resize_np(arr, new_h, new_w, self._interp)
+        else:
+            out = img_mod._resize_np(arr, self._size[1], self._size[0],
+                                     self._interp)
         return nd.array(out)
 
 
@@ -103,5 +115,7 @@ class RandomFlipLeftRight(Block):
 class RandomFlipTopBottom(Block):
     def forward(self, x):
         if _np.random.rand() < 0.5:
-            return x[::-1]
+            # height is axis 0 for HWC, axis 1 for NHWC — flipping axis 0 of
+            # a batch would permute samples, not pixels
+            return x[::-1] if x.ndim == 3 else x[:, ::-1]
         return x

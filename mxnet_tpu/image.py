@@ -11,6 +11,7 @@ from typing import List, Optional
 
 import numpy as _np
 
+from .base import MXNetError
 from .io import DataBatch, DataDesc, DataIter
 from .ndarray import array as nd_array
 from . import recordio as _recordio
@@ -233,7 +234,9 @@ class ContrastJitterAug(Augmenter):
     def __call__(self, src):
         alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
         gray = (src * self.coef).sum()
-        gray = (1.0 - alpha) / src.size * gray
+        # reference image.py:717: 3.0 * (1-alpha) / gray.size — the 3 undoes
+        # the channel dimension folded into gray.size
+        gray = (3.0 * (1.0 - alpha) / src.size) * gray
         return src * alpha + gray
 
 
@@ -337,13 +340,42 @@ class ImageIter(DataIter):
                         "pca_noise", "inter_method")})
         self.shuffle = shuffle
         self.record = None
+        self.imglist = None
+        self.path_root = path_root
         self.imgkeys = []
         if path_imgrec:
             idx_path = path_imgrec[:-4] + ".idx"
             self.record = _recordio.MXIndexedRecordIO(idx_path, path_imgrec, "r")
             self.imgkeys = list(self.record.keys)
-            if num_parts > 1:
-                self.imgkeys = self.imgkeys[part_index::num_parts]
+        elif path_imglist or imglist is not None:
+            # reference image.py: .lst lines are "idx \t label... \t relpath";
+            # in-memory imglist entries are [label(s)..., path]
+            entries = []
+            if path_imglist:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) < 3:
+                            continue
+                        entries.append(([float(v) for v in parts[1:-1]],
+                                        parts[-1]))
+            else:
+                for item in imglist:
+                    item = list(item) if isinstance(item, (list, tuple)) \
+                        else [item]
+                    labs = item[:-1]
+                    if len(labs) == 1 and hasattr(labs[0], "__len__") and \
+                            not isinstance(labs[0], str):
+                        labs = [float(v) for v in labs[0]]
+                    else:
+                        labs = [float(v) for v in labs]
+                    entries.append((labs, item[-1]))
+            if not entries:
+                raise MXNetError("ImageIter: empty image list")
+            self.imglist = entries
+            self.imgkeys = list(range(len(entries)))
+        if num_parts > 1:
+            self.imgkeys = self.imgkeys[part_index::num_parts]
         self.data_name = data_name
         self.label_name = label_name
         self.cursor = 0
@@ -364,19 +396,32 @@ class ImageIter(DataIter):
             _pyrandom.shuffle(self.imgkeys)
         self.cursor = 0
 
+    def _read(self, key):
+        """(label, HWC float image) from the rec file or the image list."""
+        import os as _os
+
+        if self.record is not None:
+            header, img = _recordio.unpack_img(self.record.read_idx(key))
+            return header.label, img
+        labs, path = self.imglist[key]
+        img = imread(_os.path.join(self.path_root, path)).asnumpy() \
+            .astype(_np.float32)
+        lab = labs[0] if len(labs) == 1 else _np.asarray(labs, _np.float32)
+        return lab, img
+
     def next(self):
-        if self.record is None or self.cursor + self.batch_size > len(self.imgkeys):
+        if (self.record is None and self.imglist is None) or \
+                self.cursor + self.batch_size > len(self.imgkeys):
             raise StopIteration
         imgs, labels = [], []
         for i in range(self.batch_size):
             key = self.imgkeys[self.cursor + i]
-            header, img = _recordio.unpack_img(self.record.read_idx(key))
+            lab, img = self._read(key)
             for aug in self.auglist:
                 img = aug(img)
             if img.ndim == 2:
                 img = img[:, :, None]
             imgs.append(_np.transpose(img, (2, 0, 1)))  # HWC→CHW
-            lab = header.label
             labels.append(float(lab) if _np.isscalar(lab) or getattr(lab, "size", 1) == 1
                           else _np.asarray(lab)[:self.label_width])
         self.cursor += self.batch_size

@@ -125,7 +125,10 @@ class BucketingModule(BaseModule):
             module.bind(data_shapes, label_shapes, self._curr_module.for_training,
                         self._curr_module.inputs_need_grad, force_rebind=False,
                         grad_req=self._grad_req)
-            arg_params, aux_params = self._buckets[self._default_bucket_key].get_params()
+            # the CURRENT module holds the live training state; the default
+            # bucket's copy is stale once training ran on any other bucket
+            # (reference shares arrays across buckets via shared_module)
+            arg_params, aux_params = self._curr_module.get_params()
             module.init_params(arg_params=arg_params, aux_params=aux_params,
                                allow_missing=False, force_init=True)
             if self.optimizer_initialized:

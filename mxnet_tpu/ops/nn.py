@@ -141,14 +141,16 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=
         lo = eff_k - 1 - pad[i]
         hi = eff_k - 1 - pad[i] + adj[i]
         padding.append((lo, hi))
-    # flip spatial dims and swap in/out channels to express transpose as conv
+    # flip spatial dims and swap in/out channels to express transpose as conv.
+    # weight is (in_total, out/group, *k); the group split must happen on the
+    # IN axis before the per-group transpose, else the (out, in) channel
+    # pairing scrambles for num_group > 1
+    num_group = int(num_group)
     wt = jnp.flip(weight, axis=tuple(range(2, 2 + k)))
-    wt = jnp.swapaxes(wt, 0, 1)  # (out/group? , in, *k) — reference stores (in, out/g, *k)
-    # regroup for grouped deconv
-    if num_group > 1:
-        ci = data.shape[1]
-        wt = wt.reshape(num_group, wt.shape[0], ci // num_group, *kernel_dims)
-        wt = wt.reshape(num_group * wt.shape[1], ci // num_group, *kernel_dims)
+    ci, og = weight.shape[0], weight.shape[1]
+    wt = wt.reshape(num_group, ci // num_group, og, *kernel_dims)
+    wt = jnp.swapaxes(wt, 1, 2)                  # (g, out/g, in/g, *k)
+    wt = wt.reshape(num_group * og, ci // num_group, *kernel_dims)
     out = lax.conv_general_dilated(
         data, wt,
         window_strides=(1,) * k,
@@ -181,7 +183,9 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
             return jnp.max(data, axis=spatial, keepdims=True)
         return jnp.mean(data, axis=spatial, keepdims=True)
     k = _pair(kernel, nd)
-    s = _pair(stride, nd) if stride else k
+    # reference PoolingParamParser defaults stride to 1 (pooling.cc:43-54);
+    # gluon layers pass their own stride=pool_size default explicitly
+    s = _pair(stride, nd) if stride else (1,) * nd
     p = _pair(pad, nd) if pad else (0,) * nd
 
     def _full(vals, fill):
@@ -292,7 +296,8 @@ def lrn(data, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
     padded = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
     win = lax.reduce_window(padded, 0.0, lax.add, (1, int(nsize), 1, 1), (1, 1, 1, 1),
                             [(0, 0)] * 4)
-    norm = jnp.power(knorm + alpha * win, beta)
+    # reference lrn-inl.h:103: salpha = alpha / nsize
+    norm = jnp.power(knorm + (alpha / int(nsize)) * win, beta)
     return data / norm
 
 
@@ -363,16 +368,25 @@ def _so_fwd(data, label, grad_scale, ignore_label, multi_output, use_ignore,
 def _so_bwd(grad_scale, ignore_label, multi_output, use_ignore, normalization,
             smooth_alpha, res, g):
     out, label = res
+    # probability labels (label.shape == data.shape): grad = scale*(p - label),
+    # no ignore/normalization (softmax_output-inl.h:154-160)
+    if tuple(label.shape) == tuple(out.shape):
+        grad = (out - label.astype(out.dtype)) * grad_scale
+        return (grad.astype(out.dtype), jnp.zeros_like(label))
     if multi_output and out.ndim > 2:
         nclass = out.shape[1]
         lab = label.astype(jnp.int32)
         onehot = jax.nn.one_hot(lab, nclass, axis=1, dtype=out.dtype)
+        spatial = 1
+        for d in out.shape[2:]:
+            spatial *= d
     else:
         nclass = out.shape[-1]
         lab = label.astype(jnp.int32)
         onehot = jax.nn.one_hot(lab, nclass, dtype=out.dtype)
         if onehot.ndim < out.ndim:
             onehot = onehot.reshape(out.shape)
+        spatial = 1
     if smooth_alpha:
         onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / nclass
     grad = out - onehot
@@ -384,13 +398,24 @@ def _so_bwd(grad_scale, ignore_label, multi_output, use_ignore, normalization,
             mask = (label != ignore_label).astype(out.dtype)
             mask = mask.reshape(mask.shape + (1,) * (grad.ndim - mask.ndim))
         grad = grad * mask
-    scale = grad_scale
+    # reference denominator (softmax_output-inl.h:174-201): valid_cnt is N for
+    # 'batch', the (non-ignored) label count for 'valid', 1 for 'null'; the
+    # multi-output path additionally divides by the spatial size except under
+    # 'valid' (whose count already includes it)
     if normalization == "batch":
-        scale = scale / out.shape[0]
-    elif normalization == "valid" and use_ignore:
-        valid = jnp.maximum(jnp.sum(label != ignore_label), 1).astype(out.dtype)
-        grad = grad / valid
-    grad = grad * scale
+        denom = float(label.shape[0]) * spatial
+    elif normalization == "valid":
+        label_count = 1
+        for d in label.shape:
+            label_count *= d
+        if use_ignore:
+            denom = jnp.maximum(jnp.sum(label != ignore_label),
+                                1).astype(out.dtype)
+        else:
+            denom = float(label_count)
+    else:  # 'null'
+        denom = float(spatial)
+    grad = grad * (grad_scale / denom)
     return (grad.astype(out.dtype), jnp.zeros_like(label))
 
 

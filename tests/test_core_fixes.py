@@ -401,3 +401,148 @@ def test_zoneout_reset_clears_prev_output():
     z.unroll(2, x, layout="TNC")
     z.reset()
     assert z._prev_output is None
+
+
+def test_grouped_deconvolution_matches_per_group():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn import deconvolution
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(1, 4, 5, 5).astype(np.float32))
+    w = jnp.asarray(rng.rand(4, 3, 3, 3).astype(np.float32))
+    full = deconvolution(x, w, kernel=(3, 3), num_filter=6, num_group=2)
+    g0 = deconvolution(x[:, :2], w[:2], kernel=(3, 3), num_filter=3)
+    g1 = deconvolution(x[:, 2:], w[2:], kernel=(3, 3), num_filter=3)
+    np.testing.assert_allclose(np.asarray(full),
+                               np.asarray(jnp.concatenate([g0, g1], axis=1)),
+                               atol=1e-5)
+
+
+def test_softmax_output_normalization_and_soft_labels():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import get_op
+
+    fn = get_op("SoftmaxOutput").fn
+    rng = np.random.RandomState(0)
+    d = jnp.asarray(rng.rand(4, 5).astype(np.float32))
+    lab = jnp.asarray(np.array([0, 1, 2, 3], np.float32))
+    _, v_valid = jax.vjp(lambda x: fn(x, lab, normalization="valid"), d)
+    _, v_null = jax.vjp(lambda x: fn(x, lab, normalization="null"), d)
+    # 'valid' without use_ignore divides by the label count (reference)
+    np.testing.assert_allclose(np.asarray(v_valid(jnp.ones((4, 5)))[0]) * 4,
+                               np.asarray(v_null(jnp.ones((4, 5)))[0]),
+                               atol=1e-6)
+    # probability labels: grad = p - label
+    soft = jnp.asarray(rng.rand(4, 5).astype(np.float32))
+    _, v_soft = jax.vjp(lambda x: fn(x, soft), d)
+    p = np.asarray(fn(d, soft))
+    np.testing.assert_allclose(np.asarray(v_soft(jnp.ones((4, 5)))[0]),
+                               p - np.asarray(soft), atol=1e-5)
+
+
+def test_pooling_default_stride_is_one():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn import pooling
+
+    out = pooling(jnp.zeros((1, 1, 6, 6)), kernel=(2, 2), pool_type="max")
+    assert out.shape == (1, 1, 5, 5)  # reference PoolingParamParser default
+
+
+def test_lrn_alpha_over_nsize():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn import lrn
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(1, 8, 4, 4).astype(np.float32))
+    got = np.asarray(lrn(x, nsize=5, alpha=1e-2))
+    sq = np.asarray(x) ** 2
+    pad = np.pad(sq, ((0, 0), (2, 2), (0, 0), (0, 0)))
+    win = np.stack([pad[:, i:i + 8] for i in range(5)]).sum(0)
+    want = np.asarray(x) / (2.0 + (1e-2 / 5) * win) ** 0.75
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_image_iter_from_imglist(tmp_path):
+    from PIL import Image
+
+    import mxnet_tpu as mx
+
+    for i in range(4):
+        Image.fromarray((np.ones((8, 8, 3)) * i * 60).astype(np.uint8)).save(
+            str(tmp_path / f"im{i}.png"))
+    il = [[float(i % 2), f"im{i}.png"] for i in range(4)]
+    it = mx.image.ImageIter(batch_size=2, data_shape=(3, 8, 8), imglist=il,
+                            path_root=str(tmp_path))
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (2, 3, 8, 8)
+
+
+def test_cifar100_binary_format_and_fine_label(tmp_path):
+    from mxnet_tpu.gluon.data.vision import datasets
+
+    raw = np.zeros((10, 3074), np.uint8)
+    raw[:, 0] = np.arange(10) % 20
+    raw[:, 1] = np.arange(10)
+    raw.tofile(str(tmp_path / "train.bin"))
+    fine = datasets.CIFAR100(root=str(tmp_path), fine_label=True)
+    coarse = datasets.CIFAR100(root=str(tmp_path), fine_label=False)
+    assert [int(fine[i][1]) for i in range(3)] == [0, 1, 2]
+    assert [int(coarse[i][1]) for i in range(3)] == [0, 1, 2]
+    assert int(fine[5][1]) == 5 and int(coarse[5][1]) == 5
+
+
+def test_random_flip_top_bottom_batch_axis():
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    t = transforms.RandomFlipTopBottom()
+    x = nd.array(np.arange(32, dtype=np.float32).reshape(2, 4, 4, 1))
+    for _ in range(20):
+        y = t(x).asnumpy()
+        # per-sample content stays with its slot (no batch permutation)
+        assert np.allclose(y[0].sum(), x.asnumpy()[0].sum())
+
+
+def test_bucketing_switch_keeps_training_progress():
+    import mxnet_tpu as mx
+
+    def gen(key):
+        d = mx.sym.Variable("data")
+        pooled = mx.sym.sum(d, axis=1, keepdims=True)  # width-independent
+        fc = mx.sym.FullyConnected(pooled, num_hidden=2, name="bkt_fc")
+        return mx.sym.SoftmaxOutput(fc, name="softmax"), ["data"], \
+            ["softmax_label"]
+
+    mod = mx.mod.BucketingModule(gen, default_bucket_key=10)
+    mod.bind([("data", (4, 10))], [("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    rng = np.random.RandomState(0)
+    batch6 = mx.io.DataBatch([nd.array(rng.rand(4, 6).astype(np.float32))],
+                             [nd.array(np.array([0, 1, 0, 1], np.float32))],
+                             bucket_key=6,
+                             provide_data=[mx.io.DataDesc("data", (4, 6))],
+                             provide_label=[mx.io.DataDesc("softmax_label",
+                                                           (4,))])
+    for _ in range(3):
+        mod.forward(batch6)
+        mod.backward()
+        mod.update()
+    trained, _ = mod._curr_module.get_params()
+    # a NEW bucket must inherit the trained params, not the stale default's
+    batch8 = mx.io.DataBatch([nd.array(rng.rand(4, 8).astype(np.float32))],
+                             [nd.array(np.array([0, 1, 0, 1], np.float32))],
+                             bucket_key=8,
+                             provide_data=[mx.io.DataDesc("data", (4, 8))],
+                             provide_label=[mx.io.DataDesc("softmax_label",
+                                                           (4,))])
+    mod.forward(batch8)
+    now, _ = mod._curr_module.get_params()
+    np.testing.assert_allclose(now["bkt_fc_bias"].asnumpy(),
+                               trained["bkt_fc_bias"].asnumpy())
