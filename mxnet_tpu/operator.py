@@ -174,24 +174,37 @@ def make_custom_symbol_fn(op_type: str, kwargs: dict):
     prop = _get_prop(op_type, kwargs)
     n_out = len(prop.list_outputs())
 
+    def _out_dtypes(in_dtypes):
+        # honor the prop's infer_type (reference Custom bridge); fall back to
+        # the first input's dtype
+        try:
+            _, out_t, _ = prop.infer_type(list(in_dtypes))
+            return [_np.dtype(t) for t in out_t]
+        except Exception:
+            return [_np.dtype(in_dtypes[0])] * n_out
+
     def run_forward(*arrays):
         ins = [NDArray(jnp.asarray(a)) for a in arrays]
         in_shapes = [list(i.shape) for i in ins]
         _, out_shapes, _ = prop.infer_shape(in_shapes)
+        out_types = _out_dtypes([i.dtype for i in ins])
         op = prop.create_operator(None, in_shapes, [i.dtype for i in ins])
-        outs = [NDArray(jnp.zeros(tuple(s), ins[0]._data.dtype))
-                for s in out_shapes]
-        op.forward(is_train=False, req=["write"] * n_out, in_data=ins,
-                   out_data=outs, aux=[])
+        outs = [NDArray(jnp.zeros(tuple(s), t))
+                for s, t in zip(out_shapes, out_types)]
+        from . import autograd as _ag
+
+        op.forward(is_train=_ag.is_recording(), req=["write"] * n_out,
+                   in_data=ins, out_data=outs, aux=[])
         return tuple(_np.asarray(o._data) for o in outs)
 
     @jax.custom_vjp
     def fn(*arrays):
         in_shapes = [list(a.shape) for a in arrays]
         _, out_shapes, _ = prop.infer_shape(in_shapes)
+        out_types = _out_dtypes([a.dtype for a in arrays])
         result_shapes = tuple(
-            jax.ShapeDtypeStruct(tuple(s), arrays[0].dtype)
-            for s in out_shapes)
+            jax.ShapeDtypeStruct(tuple(s), t)
+            for s, t in zip(out_shapes, out_types))
         out = jax.pure_callback(run_forward, result_shapes, *arrays,
                                 vmap_method="sequential")
         return out if n_out > 1 else out[0]

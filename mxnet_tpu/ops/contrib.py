@@ -72,6 +72,25 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
         keep = lax.fori_loop(0, k, body, keep0)
         out = jnp.where(keep[:, None], sorted_img,
                         jnp.full_like(sorted_img, -1.0))
+        # reference compacts survivors to the FRONT with -1 rows after
+        # (bounding_box-inl.h:348-370) so `out[:k]`-style consumers work:
+        # stable-sort on the keep flag preserves the score order
+        comp = jnp.argsort(~keep, stable=True)
+        out = out[comp]
+        if out_format != in_format:
+            bx = out[:, coord_start:coord_start + 4]
+            if out_format == "center":   # corner -> center
+                x1, y1, x2, y2 = jnp.split(bx, 4, -1)
+                bx = jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2,
+                                      x2 - x1, y2 - y1], -1)
+            else:                        # center -> corner
+                cx, cy, w, h = jnp.split(bx, 4, -1)
+                bx = jnp.concatenate([cx - w / 2, cy - h / 2,
+                                      cx + w / 2, cy + h / 2], -1)
+            valid_rows = out[:, score_index:score_index + 1] >= 0
+            out = out.at[:, coord_start:coord_start + 4].set(
+                jnp.where(valid_rows, bx,
+                          out[:, coord_start:coord_start + 4]))
         return out
 
     out = jax.vmap(one)(x)
@@ -138,10 +157,20 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=2,
     """ROIAlign with bilinear sampling (reference: src/operator/contrib/roi_align.cc)."""
     ph, pw = int(pooled_size[0]), int(pooled_size[1])
     N, C, H, W = data.shape
-    sr = max(int(sample_ratio), 1)
+    if int(sample_ratio) > 0:
+        sry = srx = int(sample_ratio)
+    else:
+        # reference uses the adaptive per-roi ceil(bin_size) grid
+        # (roi_align.cc:185-187); XLA needs static counts, so bound it by the
+        # whole-map bin size (oversampling only refines the average)
+        sry = max(1, -(-H // ph))
+        srx = max(1, -(-W // pw))
     offset = 0.5 if aligned else 0.0
 
     def bilinear(img, y, x):
+        # reference zeroes samples outside [-1, size] (roi_align.cc:74)
+        inb = ((y >= -1.0) & (y <= H) & (x >= -1.0) & (x <= W)) \
+            .astype(img.dtype)
         y = jnp.clip(y, 0.0, H - 1.0)
         x = jnp.clip(x, 0.0, W - 1.0)
         y0 = jnp.floor(y).astype(jnp.int32)
@@ -151,7 +180,7 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=2,
         ly, lx = y - y0, x - x0
         v = (img[:, y0, x0] * (1 - ly) * (1 - lx) + img[:, y1, x0] * ly * (1 - lx)
              + img[:, y0, x1] * (1 - ly) * lx + img[:, y1, x1] * ly * lx)
-        return v
+        return v * inb
 
     def one_roi(roi):
         b = roi[0].astype(jnp.int32)
@@ -166,12 +195,12 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=2,
 
         def cell(py, px):
             acc = 0.0
-            for iy in range(sr):
-                for ix in range(sr):
-                    y = y1 + py * bh + (iy + 0.5) * bh / sr
-                    x = x1 + px * bw + (ix + 0.5) * bw / sr
+            for iy in range(sry):
+                for ix in range(srx):
+                    y = y1 + py * bh + (iy + 0.5) * bh / sry
+                    x = x1 + px * bw + (ix + 0.5) * bw / srx
                     acc = acc + bilinear(img, y, x)
-            return acc / (sr * sr)
+            return acc / (sry * srx)
 
         grid = jax.vmap(lambda py: jax.vmap(lambda px: cell(py, px))(jnp.arange(pw)))(jnp.arange(ph))
         return jnp.transpose(grid, (2, 0, 1))
@@ -328,10 +357,13 @@ def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0, pooled_size=7,
 
     def one_roi(roi):
         b = roi[0].astype(jnp.int32)
-        x1 = roi[1] * spatial_scale - 0.5
-        y1 = roi[2] * spatial_scale - 0.5
-        x2 = (roi[3] + 1.0) * spatial_scale - 0.5
-        y2 = (roi[4] + 1.0) * spatial_scale - 0.5
+        # reference rounds ROI corners and uses NO -0.5 offset
+        # (psroi_pooling.cu:72-78) — that offset belongs to the deformable
+        # variant only
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
         rh = jnp.maximum(y2 - y1, 0.1)
         rw = jnp.maximum(x2 - x1, 0.1)
         bh, bw = rh / P, rw / P

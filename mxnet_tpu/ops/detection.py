@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from jax import lax
 
 from .registry import register
@@ -43,21 +44,24 @@ def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
     cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
     cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (H, W, 2)
 
-    # reference layout: (size[0], r) for all ratios + (size[i], 1) for i>0
-    ws, hs = [], []
-    for r in ratios:
-        sr = jnp.sqrt(r)
-        ws.append(sizes[0] * sr)
-        hs.append(sizes[0] / sr)
-    for s in sizes[1:]:
-        ws.append(s)
-        hs.append(s)
-    ws = jnp.asarray(ws, jnp.float32)  # (A,)
-    hs = jnp.asarray(hs, jnp.float32)
-    A = ws.shape[0]
+    # reference layout (multibox_prior.cc:48-66): ALL sizes first (ratio 1),
+    # then ratios[1:] at size[0]; widths carry the in_height/in_width aspect
+    # correction so anchors are square in pixel space
+    aspect = H / W
+    half_ws, half_hs = [], []
+    for s in sizes:
+        half_ws.append(s * aspect / 2)
+        half_hs.append(s / 2)
+    for r in ratios[1:]:
+        sr = float(_np.sqrt(r))
+        half_ws.append(sizes[0] * aspect * sr / 2)
+        half_hs.append(sizes[0] / sr / 2)
+    half_ws = jnp.asarray(half_ws, jnp.float32)  # (A,)
+    half_hs = jnp.asarray(half_hs, jnp.float32)
+    A = half_ws.shape[0]
     cyx = jnp.broadcast_to(cyx[:, :, None, :], (H, W, A, 2))
-    half_w = jnp.broadcast_to(ws / 2, (H, W, A))
-    half_h = jnp.broadcast_to(hs / 2, (H, W, A))
+    half_w = jnp.broadcast_to(half_ws, (H, W, A))
+    half_h = jnp.broadcast_to(half_hs, (H, W, A))
     anchors = jnp.stack([cyx[..., 1] - half_w, cyx[..., 0] - half_h,
                          cyx[..., 1] + half_w, cyx[..., 0] + half_h], axis=-1)
     anchors = anchors.reshape(1, H * W * A, 4)
@@ -85,21 +89,41 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
     anchors = anchor[0]                      # (N, 4)
     N = anchors.shape[0]
 
-    def one(lab):  # (M, 5)
+    def one(lab, pred):  # lab (M, 5), pred (C, N)
         valid = lab[:, 0] >= 0               # (M,)
         gt = lab[:, 1:5]
         iou = _iou_matrix(anchors, gt)       # (N, M)
         iou = jnp.where(valid[None, :], iou, -1.0)
         best_gt = jnp.argmax(iou, axis=1)    # (N,)
         best_iou = jnp.max(iou, axis=1)
-        # force-match: each gt claims its best anchor
-        best_anchor = jnp.argmax(iou, axis=0)          # (M,)
-        forced = jnp.zeros((N,), bool).at[best_anchor].set(valid)
+        # force-match: each VALID gt claims its best anchor (padded label
+        # rows must not scatter — their argmax lands on anchor 0 and would
+        # clobber a real match; mode="drop" discards their writes)
+        best_anchor = jnp.where(valid, jnp.argmax(iou, axis=0),
+                                N).astype(jnp.int32)   # (M,)
+        forced = jnp.zeros((N,), bool).at[best_anchor].set(
+            True, mode="drop")
         forced_gt = jnp.zeros((N,), jnp.int32).at[best_anchor].set(
-            jnp.arange(gt.shape[0], dtype=jnp.int32))
+            jnp.arange(gt.shape[0], dtype=jnp.int32), mode="drop")
         matched = forced | (best_iou >= overlap_threshold)
         gt_idx = jnp.where(forced, forced_gt, best_gt.astype(jnp.int32))
         cls_t = jnp.where(matched, lab[gt_idx, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # reference multibox_target: unmatched anchors start at
+            # ignore_label; the hardest num_pos*ratio negatives (largest
+            # non-background prob, overlap below thresh) become background
+            prob = jax.nn.softmax(pred, axis=0)
+            neg_score = jnp.max(prob[1:], axis=0)           # (N,)
+            candidate = (~matched) & (best_iou < negative_mining_thresh)
+            num_pos = jnp.sum(matched)
+            num_neg = jnp.maximum(float(minimum_negative_samples),
+                                  num_pos * float(negative_mining_ratio))
+            order = jnp.argsort(-jnp.where(candidate, neg_score, -jnp.inf))
+            rank = jnp.zeros((N,), jnp.float32).at[order].set(
+                jnp.arange(N, dtype=jnp.float32))
+            chosen = candidate & (rank < num_neg)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(chosen, 0.0, float(ignore_label)))
 
         # regression targets in center form with variances
         ax, ay, aw, ah = _center_form(anchors)
@@ -115,7 +139,7 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         return (loc_t * mask).reshape(-1), \
             jnp.tile(mask, (1, 4)).reshape(-1), cls_t
 
-    loc_t, loc_m, cls_t = jax.vmap(one)(label)
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
     return loc_t, loc_m, cls_t
 
 
@@ -173,24 +197,29 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     B, _, H, W = cls_prob.shape
     A = len(scales) * len(ratios)
 
-    # base anchors around (0,0) at feature stride
+    # base anchors: the reference's floor/round arithmetic over the
+    # [0, 0, stride-1, stride-1] base box (proposal-inl.h:184-223)
     base = float(feature_stride)
+    ctr = 0.5 * (base - 1.0)
     ws, hs = [], []
     for r in ratios:
-        size = base * base / r
-        w0 = jnp.sqrt(size)
+        size_r = _np.floor(base * base / r)
+        new_w0 = _np.floor(_np.sqrt(size_r) + 0.5)
         for s in scales:
-            ws.append(w0 * s)
-            hs.append(w0 * r * s)
+            new_w = new_w0 * s
+            new_h = _np.floor(new_w0 * r + 0.5) * s
+            ws.append(new_w)
+            hs.append(new_h)
     ws = jnp.asarray(ws, jnp.float32)
     hs = jnp.asarray(hs, jnp.float32)
     shift_x = jnp.arange(W, dtype=jnp.float32) * feature_stride
     shift_y = jnp.arange(H, dtype=jnp.float32) * feature_stride
     cy, cx = jnp.meshgrid(shift_y, shift_x, indexing="ij")
-    ctr = base / 2.0
     anchors = jnp.stack([
-        cx[..., None] + ctr - ws / 2, cy[..., None] + ctr - hs / 2,
-        cx[..., None] + ctr + ws / 2, cy[..., None] + ctr + hs / 2],
+        cx[..., None] + ctr - 0.5 * (ws - 1.0),
+        cy[..., None] + ctr - 0.5 * (hs - 1.0),
+        cx[..., None] + ctr + 0.5 * (ws - 1.0),
+        cy[..., None] + ctr + 0.5 * (hs - 1.0)],
         axis=-1).reshape(-1, 4)                        # (H*W*A, 4)
 
     def one(probs, deltas, info):
@@ -198,13 +227,17 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         d = deltas.transpose(1, 2, 0).reshape(-1, 4)
         l, t, r, b = jnp.split(anchors, 4, -1)
         aw, ah = (r - l + 1.0), (b - t + 1.0)
-        acx, acy = l + aw / 2, t + ah / 2
+        # reference decode (proposal.cc:56-72): ctr at +0.5*(w-1), corners at
+        # pred_ctr +- 0.5*(pred_w - 1)
+        acx = l + 0.5 * (aw - 1.0)
+        acy = t + 0.5 * (ah - 1.0)
         px = d[:, 0:1] * aw + acx
         py = d[:, 1:2] * ah + acy
         pw = jnp.exp(jnp.clip(d[:, 2:3], -10, 10)) * aw
         ph = jnp.exp(jnp.clip(d[:, 3:4], -10, 10)) * ah
-        boxes = jnp.concatenate([px - pw / 2, py - ph / 2,
-                                 px + pw / 2, py + ph / 2], -1)
+        boxes = jnp.concatenate([px - 0.5 * (pw - 1.0), py - 0.5 * (ph - 1.0),
+                                 px + 0.5 * (pw - 1.0), py + 0.5 * (ph - 1.0)],
+                                -1)
         boxes = jnp.stack([
             jnp.clip(boxes[:, 0], 0, info[1] - 1.0),
             jnp.clip(boxes[:, 1], 0, info[0] - 1.0),

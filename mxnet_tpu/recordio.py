@@ -51,8 +51,14 @@ class MXRecordIO:
         self.open()
 
     def _check_pid(self):
-        # fork-safety: reopen in child (reference: recordio.py _check_pid)
+        # fork-safety (reference: recordio.py _check_pid): readers reopen in
+        # the child; a forked WRITER must raise — reopening 'wb' would
+        # truncate everything the parent already wrote
         if self.pid != os.getpid():
+            if self.flag == "w":
+                raise RuntimeError(
+                    "MXRecordIO writer is not fork-safe: the parent holds the "
+                    "file; create the writer inside the child process instead")
             self.open()
 
     def _write_part(self, buf: bytes, cflag: int):

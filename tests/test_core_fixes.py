@@ -546,3 +546,103 @@ def test_bucketing_switch_keeps_training_progress():
     now, _ = mod._curr_module.get_params()
     np.testing.assert_allclose(now["bkt_fc_bias"].asnumpy(),
                                trained["bkt_fc_bias"].asnumpy())
+
+
+def test_multibox_prior_reference_layout_and_aspect():
+    import mxnet_tpu as mx
+
+    a = mx.nd.contrib.MultiBoxPrior(nd.zeros((1, 3, 2, 4)),
+                                    sizes=(0.5, 0.25),
+                                    ratios=(1.0, 2.0)).asnumpy()
+    # cell (0,0): all sizes first, widths carry the H/W aspect correction
+    np.testing.assert_allclose(a[0, 0], [0.0, 0.0, 0.25, 0.5], atol=1e-6)
+    assert a.shape[1] == 2 * 4 * 3  # S + R - 1 anchors per cell
+
+
+def test_multibox_target_padded_labels_dont_clobber():
+    import mxnet_tpu as mx
+
+    anchors = nd.array(np.array([[[0, 0, .4, .4], [.5, .5, 1, 1]]],
+                                np.float32))
+    label = nd.array(np.array([[[1, 0, 0, .2, .2]] + [[-1] * 5] * 2],
+                              np.float32))
+    pred = nd.zeros((1, 3, 2))
+    _, _, ct = mx.nd.contrib.MultiBoxTarget(anchors, label, pred,
+                                            overlap_threshold=0.5)
+    np.testing.assert_allclose(ct.asnumpy(), [[2.0, 0.0]])
+
+
+def test_multibox_target_negative_mining():
+    import mxnet_tpu as mx
+
+    anchors = nd.array(np.array(
+        [[[0, 0, .4, .4], [.5, .5, 1, 1], [0, .5, .4, 1], [.5, 0, 1, .4]]],
+        np.float32))
+    label = nd.array(np.array([[[1, 0, 0, .4, .4]]], np.float32))
+    pred = nd.array(np.zeros((1, 3, 4), np.float32))
+    _, _, ct = mx.nd.contrib.MultiBoxTarget(
+        anchors, label, pred, overlap_threshold=0.5,
+        negative_mining_ratio=1.0, ignore_label=-1.0)
+    vals = ct.asnumpy()[0]
+    assert (vals == 2.0).sum() == 1          # one positive
+    assert (vals == 0.0).sum() == 1          # ratio 1 -> one mined negative
+    assert (vals == -1.0).sum() == 2         # rest ignored
+
+
+def test_box_nms_compacts_survivors():
+    import mxnet_tpu as mx
+
+    data = nd.array(np.array([[.9, .8, 0, 0, 1, 1],
+                              [.9, .7, 0, 0, 1, 1],
+                              [.9, .6, 2, 2, 3, 3]], np.float32))
+    out = mx.nd.contrib.box_nms(data, overlap_thresh=0.5, coord_start=2,
+                                score_index=1, id_index=-1).asnumpy()
+    np.testing.assert_allclose(out[:, 1], [0.8, 0.6, -1.0], atol=1e-6)
+
+
+def test_recordio_forked_writer_raises(tmp_path):
+    import mxnet_tpu as mx
+
+    rec = mx.recordio.MXRecordIO(str(tmp_path / "t.rec"), "w")
+    rec.write(b"abcd")
+    rec.pid = rec.pid + 1  # simulate a fork without os.fork (jax threads)
+    with pytest.raises(RuntimeError, match="fork"):
+        rec.write(b"efgh")
+
+
+def test_custom_op_output_dtype_from_infer_type():
+    import mxnet_tpu as mx
+    from mxnet_tpu import operator as op_mod
+
+    class RoundOp(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0],
+                        nd.array(np.round(in_data[0].asnumpy())
+                                 .astype(np.int32)))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], nd.zeros(in_data[0].shape))
+
+    @op_mod.register("roundint_fix")
+    class RoundProp(op_mod.CustomOpProp):
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["out"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def infer_type(self, in_type):
+            return in_type, [np.int32], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return RoundOp()
+
+    fn = op_mod.make_custom_symbol_fn("roundint_fix", {})
+    import jax.numpy as jnp
+
+    out = fn(jnp.asarray([[1.4, 2.6]], np.float32))
+    assert np.asarray(out).dtype == np.int32
+    np.testing.assert_allclose(np.asarray(out), [[1, 3]])
