@@ -146,8 +146,15 @@ class CTCLoss(Loss):
         if self._batch_axis == 1:
             label = F.transpose(label, axes=(1, 0))
         args = [pred, label]
-        kwargs = {}
-        if pred_lengths is not None:
+        # gluon convention (reference gluon/loss.py CTCLoss): the BLANK is the
+        # LAST class; labels are 0-based over the real classes
+        kwargs = {"blank_label": "last"}
+        if pred_lengths is None and label_lengths is not None:
+            # the op's inputs are positional (data_lengths before
+            # label_lengths): hold the slot with an ignored placeholder
+            args.append(F.sum(F.zeros_like(label), axis=1))
+            kwargs["use_data_lengths"] = False
+        elif pred_lengths is not None:
             args.append(pred_lengths)
             kwargs["use_data_lengths"] = True
         if label_lengths is not None:

@@ -241,7 +241,13 @@ class InstanceNorm(HybridBlock):
         return (args[0].shape[self._axis],)
 
     def hybrid_forward(self, F, x, gamma, beta):
-        return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+        if self._axis == 1:
+            return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+        # the op normalizes with channels at axis 1 (reference swaps around
+        # the op call for any other axis)
+        x = F.swapaxes(x, dim1=1, dim2=self._axis)
+        out = F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+        return F.swapaxes(out, dim1=1, dim2=self._axis)
 
 
 class LayerNorm(HybridBlock):

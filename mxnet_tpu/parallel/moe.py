@@ -34,8 +34,10 @@ def top1_routing(x, router_w, num_experts, capacity):
     expert = jnp.argmax(probs, axis=-1)                # (B,)
     gate = jnp.max(probs, axis=-1)                     # (B,)
     onehot = jax.nn.one_hot(expert, num_experts, dtype=x.dtype)  # (B, E)
-    # position of each token within its expert's queue
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0    # (B, E), -1 elsewhere
+    # position of each token within its expert's queue — accumulate in int32:
+    # a bf16 cumsum saturates above 256 tokens and collides capacity slots
+    pos_i = jnp.cumsum(onehot.astype(jnp.int32), axis=0)
+    pos = (pos_i * onehot.astype(jnp.int32) - 1).astype(jnp.float32)
     kept = (pos < capacity) & (onehot > 0)
     pos_clip = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
     slot = jax.nn.one_hot(pos_clip, capacity, dtype=x.dtype)     # (B, E, C)

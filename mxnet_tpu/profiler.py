@@ -49,10 +49,13 @@ profiler_set_state = set_state
 
 
 def start(profile_process="worker"):
+    already = _state["running"]
     _state["running"] = True
     _state["t0"] = time.perf_counter()
     trace_dir = os.environ.get("TPUMX_JAX_TRACE_DIR")
-    if trace_dir:
+    # idempotent like the reference (set_state('run') twice is legal): a
+    # second start must not re-enter jax.profiler.start_trace
+    if trace_dir and not (already and _state.get("jax_trace_dir")):
         import jax
 
         _state["jax_trace_dir"] = trace_dir
@@ -142,6 +145,7 @@ class Task:
         if self._t0 is not None:
             _emit("X", self.name, self.domain.name, ts=self._t0,
                   dur=time.perf_counter() * 1e6 - self._t0)
+            self._t0 = None  # a second stop() must not emit a phantom span
 
 
 Frame = Task
@@ -189,8 +193,18 @@ class scope:
 
     def __enter__(self):
         self._t0 = time.perf_counter() * 1e6
-        return self
+        self._active = _state["running"]  # capture at entry: a span that ran
+        return self                        # under a live profiler is recorded
+                                           # even if stop() lands inside it
 
     def __exit__(self, *exc):
-        _emit("X", self._name, self._cat, ts=self._t0,
-              dur=time.perf_counter() * 1e6 - self._t0)
+        if self._active and not _state["running"]:
+            _state["running"] = True
+            try:
+                _emit("X", self._name, self._cat, ts=self._t0,
+                      dur=time.perf_counter() * 1e6 - self._t0)
+            finally:
+                _state["running"] = False
+        else:
+            _emit("X", self._name, self._cat, ts=self._t0,
+                  dur=time.perf_counter() * 1e6 - self._t0)

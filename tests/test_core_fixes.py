@@ -646,3 +646,61 @@ def test_custom_op_output_dtype_from_infer_type():
     out = fn(jnp.asarray([[1.4, 2.6]], np.float32))
     assert np.asarray(out).dtype == np.int32
     np.testing.assert_allclose(np.asarray(out), [[1, 3]])
+
+
+def test_gluon_ctc_loss_blank_last_and_label_lengths():
+    loss = gluon.loss.CTCLoss()
+    rng = np.random.RandomState(0)
+    pred = nd.array(rng.rand(1, 10, 5).astype(np.float32))
+    lab = nd.array(np.array([[0.0, 1, 2]], np.float32))
+    v = float(loss(pred, lab).asnumpy()[0])
+    ref = float(nd.ctc_loss(nd.transpose(pred, axes=(1, 0, 2)), lab,
+                            blank_label="last").asnumpy()[0])
+    assert abs(v - ref) < 1e-4  # gluon convention: blank is the LAST class
+    labj = nd.array(np.array([[0.0, 1, 2, 7, 7]], np.float32))  # junk pad
+    v2 = float(loss(pred, labj, None, nd.array([3.0])).asnumpy()[0])
+    assert abs(v2 - v) < 1e-4   # explicit label_lengths must be honored
+
+
+def test_instance_norm_axis():
+    inorm = gluon.nn.InstanceNorm(axis=2, in_channels=4)
+    inorm.initialize()
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(2, 3, 4).astype(np.float32))
+    out = inorm(x).asnumpy()
+    xa = x.asnumpy()
+    want = (xa - xa.mean(axis=1, keepdims=True)) / \
+        np.sqrt(xa.var(axis=1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, want, atol=1e-4)
+
+
+def test_moe_top1_routing_bf16_slot_positions():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel.moe import top1_routing
+
+    x = jnp.ones((400, 8), jnp.bfloat16)
+    rw = jnp.zeros((8, 2), jnp.bfloat16).at[:, 0].set(1.0)
+    disp, _ = top1_routing(x, rw, num_experts=2, capacity=400)
+    d = np.asarray(disp.astype(jnp.float32))
+    assert d.sum() == 400            # every token kept
+    assert d.sum(axis=2).max() <= 1  # no slot collisions (bf16 cumsum bug)
+
+
+def test_profiler_idempotent_and_span_semantics():
+    from mxnet_tpu import profiler
+
+    profiler.start()
+    profiler.start()  # must be a no-op, not a crash
+    d = profiler.Domain("pfx")
+    t = profiler.Task(d, "pfx_task")
+    t.start()
+    t.stop()
+    t.stop()  # second stop must not emit a phantom span
+    with profiler.scope("pfx_scope"):
+        profiler.pause()  # span opened under a live profiler still records
+    profiler.resume()
+    profiler.stop()
+    names = [e["name"] for e in profiler._events]
+    assert names.count("pfx_task") == 1
+    assert "pfx_scope" in names
