@@ -110,10 +110,19 @@ class CustomEmbedding:
         dim = None
         if pretrained_file_path is not None:
             with open(pretrained_file_path, encoding=encoding) as f:
-                for line in f:
+                for lineno, line in enumerate(f):
                     parts = line.rstrip().split(elem_delim)
                     if len(parts) < 2:
                         continue
+                    if lineno == 0 and len(parts) == 2:
+                        try:
+                            # .vec header line "<count> <dim>": skip it, or
+                            # it would lock dim to 1 and every real vector
+                            # gets discarded (reference warns and skips too)
+                            int(parts[0]), int(parts[1])
+                            continue
+                        except ValueError:
+                            pass
                     token, vec = parts[0], [float(x) for x in parts[1:]]
                     if dim is None:
                         dim = len(vec)

@@ -16,7 +16,7 @@ from .registry import register
 
 def _rescale_clip(grad, rescale_grad, clip_gradient):
     g = grad * rescale_grad
-    if clip_gradient is not None and clip_gradient > 0:
+    if clip_gradient is not None and clip_gradient >= 0:  # reference: >= 0
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     return g
 
@@ -103,7 +103,7 @@ def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
                       clip_gradient)
     new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
     new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
-    if clip_weights is not None and clip_weights > 0:
+    if clip_weights is not None and clip_weights >= 0:  # reference: >= 0
         new_w = jnp.clip(new_w, -clip_weights, clip_weights)
     return new_w, new_n
 
@@ -119,7 +119,7 @@ def rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
     new_delta = gamma2 * delta - lr * gr / jnp.sqrt(
         new_n - jnp.square(new_g) + epsilon)
     new_w = weight + new_delta
-    if clip_weights is not None and clip_weights > 0:
+    if clip_weights is not None and clip_weights >= 0:  # reference: >= 0
         new_w = jnp.clip(new_w, -clip_weights, clip_weights)
     return new_w, new_n, new_g, new_delta
 

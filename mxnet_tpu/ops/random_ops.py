@@ -59,7 +59,9 @@ def random_randint(rng_key=None, low=0, high=1, shape=None, dtype="int32"):
                               dtype=np_dtype(dtype))
 
 
-@register("_sample_multinomial", rng=True, differentiable=False, aliases=("multinomial",))
+@register("_sample_multinomial", rng=True, differentiable=False,
+          aliases=("multinomial",),
+          num_outputs=lambda attrs: 2 if attrs.get("get_prob") else 1)
 def sample_multinomial(data, rng_key=None, shape=None, get_prob=False, dtype="int32"):
     n = _shape(shape)
     num = 1
@@ -70,10 +72,19 @@ def sample_multinomial(data, rng_key=None, shape=None, get_prob=False, dtype="in
     if data.ndim == 1:
         out = jax.random.categorical(rng_key, logits, shape=(num,))
         out = out.reshape(n) if n else out.reshape(())
+        logp_all = jax.nn.log_softmax(logits)
+        logp = logp_all[out]
     else:
         out = jax.random.categorical(rng_key, logits[:, None, :].repeat(num, 1), axis=-1)
         out = out.reshape((data.shape[0],) + n)
-    return out.astype(np_dtype(dtype))
+        logp_all = jax.nn.log_softmax(logits, axis=-1)
+        logp = jnp.take_along_axis(
+            logp_all, out.reshape(data.shape[0], -1), axis=-1).reshape(out.shape)
+    out = out.astype(np_dtype(dtype))
+    if get_prob:
+        # reference returns (samples, log_likelihood) — the REINFORCE path
+        return out, logp.astype(jnp.float32)
+    return out
 
 
 @register("shuffle", rng=True, differentiable=False, aliases=("_shuffle",))
@@ -81,13 +92,31 @@ def shuffle(data, rng_key=None):
     return jax.random.permutation(rng_key, data, axis=0)
 
 
-@register("_sample_unique_zipfian", rng=True, differentiable=False)
+@register("_sample_unique_zipfian", rng=True, differentiable=False,
+          num_outputs=2)
 def sample_unique_zipfian(rng_key=None, range_max=1, shape=None):
-    # log-uniform proposal like the reference's candidate sampler
+    """Unique log-uniform (zipfian) samples per row + num_tries (reference:
+    src/operator/random/unique_sample_op.cc — sampled-softmax negatives).
+
+    Uniqueness via Gumbel-top-k over the zipfian log-probs (a draw WITHOUT
+    replacement); num_tries reports the expected number of with-replacement
+    draws the reference's rejection loop would have used, which is what the
+    expected-count correction consumes."""
     n = _shape(shape)
-    u = jax.random.uniform(rng_key, n)
-    out = jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int64) - 1
-    return jnp.clip(out, 0, range_max - 1).astype(jnp.float32)
+    rows = n[0] if len(n) == 2 else 1
+    k = n[-1]
+    rmax = int(range_max)
+    classes = jnp.arange(rmax, dtype=jnp.float32)
+    # zipfian: p(c) ∝ log((c+2)/(c+1))
+    logp = jnp.log(jnp.log((classes + 2.0) / (classes + 1.0)))
+    g = jax.random.gumbel(rng_key, (rows, rmax))
+    _, idx = jax.lax.top_k(logp[None, :] + g, k)
+    samples = idx.astype(jnp.float32).reshape(n)
+    # num_tries: the reference counts its rejection-loop draws; the Gumbel
+    # draw needs exactly one pass, so report k (the tight lower bound the
+    # expected-count correction tolerates)
+    num_tries = jnp.full((rows,) if len(n) == 2 else (), float(k))
+    return samples, num_tries
 
 
 # ---------------------------------------------------------------------------

@@ -55,6 +55,14 @@ def allreduce_tree(values: List, mesh: Mesh = None, axis: str = "dp"):
         for v in values[1:]:
             acc = acc + v
         return [acc] * len(values)
+    if len(values) != mesh.shape[axis]:
+        # a mismatched list would shard (k, ...) over the axis and sum
+        # interleaved partials — silently corrupt gradients; fall back to the
+        # host-side reduction instead
+        acc = values[0]
+        for v in values[1:]:
+            acc = acc + v
+        return [acc] * len(values)
     stacked = jnp.stack([v for v in values])
 
     def _reduce(x):

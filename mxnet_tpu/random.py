@@ -16,11 +16,27 @@ __all__ = ["seed", "next_key", "fold_in"]
 
 _state = threading.local()
 _DEFAULT_SEED = 0
+# global base: seed() updates it so threads created afterwards derive their
+# stream from it; per-thread keys stay thread-local (swap_key temporarily
+# installs TRACED keys during jit, which must never leak across threads)
+_base = {"key": None, "gen": 0}
+_base_lock = threading.Lock()
+
+
+def _base_key():
+    with _base_lock:
+        if _base["key"] is None:
+            _base["key"] = jax.random.PRNGKey(_DEFAULT_SEED)
+        return _base["key"], _base["gen"]
 
 
 def _get_key():
-    if not hasattr(_state, "key"):
-        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    base, gen = _base_key()
+    if not hasattr(_state, "key") or getattr(_state, "gen", None) != gen:
+        # derive a distinct per-thread stream from the seeded base — without
+        # the fold_in, every worker thread would replay the identical stream
+        _state.key = jax.random.fold_in(base, threading.get_ident() & 0x7FFFFFFF)
+        _state.gen = gen
     return _state.key
 
 
@@ -35,8 +51,15 @@ def ensure_key() -> None:
 
 
 def seed(seed_state: int, ctx=None) -> None:
-    """Seed the global stream (reference: ``mx.random.seed`` in python/mxnet/random.py)."""
+    """Seed the global stream (reference: ``mx.random.seed`` in
+    python/mxnet/random.py).  Applies to this thread immediately and to every
+    thread's NEXT draw (each derives a distinct stream from the new base)."""
+    with _base_lock:
+        _base["key"] = jax.random.PRNGKey(int(seed_state))
+        _base["gen"] += 1
+        gen = _base["gen"]
     _state.key = jax.random.PRNGKey(int(seed_state))
+    _state.gen = gen
 
 
 def next_key():

@@ -704,3 +704,62 @@ def test_profiler_idempotent_and_span_semantics():
     names = [e["name"] for e in profiler._events]
     assert names.count("pfx_task") == 1
     assert "pfx_scope" in names
+
+
+def test_random_seed_spans_threads_with_distinct_streams():
+    import threading
+
+    import mxnet_tpu as mx
+
+    mx.random.seed(42)
+    res = {}
+
+    def draw(i):
+        res[i] = nd.random.uniform(shape=(3,)).asnumpy()
+
+    ts = [threading.Thread(target=draw, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not np.allclose(res[0], res[1])  # distinct per-thread streams
+    a = nd.random.uniform(shape=(3,)).asnumpy()
+    mx.random.seed(42)
+    a2 = nd.random.uniform(shape=(3,)).asnumpy()
+    mx.random.seed(42)
+    a3 = nd.random.uniform(shape=(3,)).asnumpy()
+    np.testing.assert_allclose(a2, a3)  # reproducible after re-seed
+    del a
+
+
+def test_multinomial_get_prob_two_outputs():
+    out = nd.random.multinomial(nd.array([0.1, 0.2, 0.7]), shape=(4,),
+                                get_prob=True)
+    assert isinstance(out, (list, tuple)) and len(out) == 2
+    samples, logp = out
+    assert logp.shape == (4,)
+    assert (logp.asnumpy() <= 0).all()
+
+
+def test_sample_unique_zipfian_no_replacement():
+    s, tries = nd._sample_unique_zipfian(range_max=50, shape=(1, 10))
+    row = s.asnumpy()[0]
+    assert len(set(row.tolist())) == 10
+    assert tries.shape == (1,)
+
+
+def test_fused_updates_clip_gradient_zero():
+    out = nd.sgd_update(nd.array([1.0, 1.0]), nd.array([1.0, -2.0]),
+                        lr=0.1, clip_gradient=0.0)
+    np.testing.assert_allclose(out.asnumpy(), [1.0, 1.0])  # reference: >= 0
+
+
+def test_custom_embedding_skips_vec_header(tmp_path):
+    from mxnet_tpu.contrib import text
+
+    p = str(tmp_path / "e.vec")
+    with open(p, "w") as f:
+        f.write("3 4\nhello 1 2 3 4\nworld 5 6 7 8\n")
+    emb = text.CustomEmbedding(p)
+    assert emb.vec_len == 4
+    assert "hello" in emb.token_to_idx and "world" in emb.token_to_idx
