@@ -127,13 +127,21 @@ class NDArrayIter(DataIter):
                 for k, v in self.label]
 
     def reset(self):
+        # roll_over: the final batch of the previous epoch wrapped around and
+        # consumed some samples from the FRONT of the old order; remember
+        # which ones BEFORE reshuffling, else the skip lands on different
+        # samples and epochs stop being permutations of the dataset
+        consumed = None
+        if self.last_batch_handle == "roll_over" and \
+                getattr(self, "_rolled", 0):
+            consumed = self.idx[:self._rolled].copy()
         if self.shuffle:
             _np.random.shuffle(self.idx)
-        # roll_over: the final batch of the previous epoch wrapped around and
-        # already consumed the first `_rolled` samples — start past them
-        # (reference io.py:699-703 cursor rollover)
-        start = getattr(self, "_rolled", 0) \
-            if self.last_batch_handle == "roll_over" else 0
+        start = 0
+        if consumed is not None:
+            mask = _np.isin(self.idx, consumed)
+            self.idx = _np.concatenate([self.idx[mask], self.idx[~mask]])
+            start = len(consumed)
         self._rolled = 0
         self.cursor = -self.batch_size + start
 
@@ -346,14 +354,21 @@ class CSVIter(NDArrayIter):
                  batch_size=1, round_batch=True, **kwargs):
         data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
         data = data.reshape((-1,) + tuple(data_shape))
-        label = None
         if label_csv is not None:
             label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
             label = label.reshape((-1,) + tuple(label_shape))
             if label.shape[1:] == (1,):
                 label = label[:, 0]
+        else:
+            # reference iter_csv.cc: "If NULL, all labels will be returned
+            # as 0" — a dummy zero label per instance
+            label = _np.zeros((data.shape[0],), _np.float32)
+        # reference BatchLoader semantics: round_batch=True carries the
+        # wrap-around overflow into the next epoch (roll_over); False emits
+        # the final partial batch with padding (pad), never discards
         super().__init__(data, label, batch_size=batch_size,
-                         last_batch_handle="pad" if round_batch else "discard",
+                         last_batch_handle="roll_over" if round_batch
+                         else "pad",
                          label_name="label")
 
 

@@ -1,4 +1,10 @@
-"""`mx.nd.linalg` namespace (reference: src/operator/tensor/la_op.cc)."""
+"""`mx.nd.linalg` namespace (reference: src/operator/tensor/la_op.cc).
+
+Precision note: the reference supports float64 throughout; here float64
+compute requires JAX's x64 mode (set ``JAX_ENABLE_X64=1`` before import, or
+``jax.config.update("jax_enable_x64", True)``) — without it, float64 inputs
+are computed in float32 (JAX's default truncation, with a warning).
+"""
 from __future__ import annotations
 
 from ..ops.registry import get_op
