@@ -106,8 +106,9 @@ class Engine {
 
     if (sync) {
       // NaiveEngine semantics: the pushed op (and everything it depends on)
-      // has completed before Push returns.
-      WaitIdleOf(op);
+      // has completed before Push returns.  Pass the ctx VALUE — the Opr is
+      // deleted by Execute before this wait returns.
+      WaitIdleOf(reinterpret_cast<uint64_t>(ctx));
     }
   }
 
@@ -298,12 +299,19 @@ class Engine {
     }
   }
 
-  void WaitIdleOf(Opr * /*op*/) {
+  void WaitIdleOf(uint64_t own_ctx) {
     // Sync push: per-var ordering means "engine idle" is a sound (stronger)
     // stand-in for "this op done" and keeps naive mode fully serial, matching
-    // the reference NaiveEngine.
-    uint64_t ignored;
-    WaitAll(&ignored);
+    // the reference NaiveEngine.  A recorded failure of some OTHER op must
+    // SURVIVE this wait (WaitAll exchange-clears it) so a later
+    // mxtpu_engine_wait_all still reports it; the sync op's own failure is
+    // consumed by the caller via its return/error channel.
+    uint64_t failed = 0;
+    if (WaitAll(&failed) && failed != 0 && failed != own_ctx) {
+      uint64_t expected = 0;
+      first_failed_.compare_exchange_strong(expected, failed,
+                                            std::memory_order_acq_rel);
+    }
   }
 
   std::vector<std::thread> workers_;

@@ -36,6 +36,10 @@ Pool &pool() {
 
 size_t RoundSize(size_t size) {
   if (size <= 4096) return size;
+  // guard the doubling loop: past 2^63 the shift wraps to 0 and the loop
+  // would spin forever; such sizes can only come from corrupted/negative
+  // lengths, so just return them unrounded (the allocation will fail).
+  if (size > (size_t{1} << 62)) return size;
   size_t r = 4096;
   while (r < size) r <<= 1;
   return r;

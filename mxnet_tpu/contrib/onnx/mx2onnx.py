@@ -97,6 +97,14 @@ def _convert(ex: _Exporter, node):
         attrs.append(_attr_ints("pads", pads + pads))
         ex.emit("Conv", node, attrs)
     elif op == "FullyConnected":
+        if a.get("flatten") in (False, "False", "0"):
+            # flatten=False applies the weight to the last axis only — Gemm
+            # cannot express the leading batch dims; MatMul(x, W^T)+bias can,
+            # but keep it simple and reject loudly rather than exporting a
+            # wrong Flatten->Gemm graph
+            raise MXNetError(
+                "onnx export: FullyConnected(flatten=False) is not "
+                "supported; reshape to 2-D before the layer for export")
         # onnx Gemm needs 2-D input; FullyConnected flattens implicitly
         flat = f"{node.name}_flat"
         ex.nodes.append(_node("Flatten", [ex.out_name(node.inputs[0])],
@@ -120,9 +128,9 @@ def _convert(ex: _Exporter, node):
             ex.emit("GlobalMaxPool" if ptype == "max"
                     else "GlobalAveragePool", node, [])
         else:
+            # the runtime (and reference parser) default stride is 1
             attrs = [_attr_ints("kernel_shape", _pair(a.get("kernel", (2, 2)))),
-                     _attr_ints("strides", _pair(a.get("stride")
-                                                 or a.get("kernel", (2, 2))))]
+                     _attr_ints("strides", _pair(a.get("stride") or 1))]
             pads = _pair(a.get("pad") or 0)
             attrs.append(_attr_ints("pads", pads + pads))
             if ptype == "avg":
@@ -151,7 +159,13 @@ def _convert(ex: _Exporter, node):
         ex.emit("Flatten", node, [_attr_int("axis", 1)])
     elif op in ("softmax", "SoftmaxOutput", "SoftmaxActivation"):
         # SoftmaxOutput's label input is a training artifact: drop it
-        ex.emit("Softmax", node, [_attr_int("axis", -1)],
+        if op == "softmax":
+            axis = int(a.get("axis", -1))
+        elif op == "SoftmaxActivation":
+            axis = 1 if a.get("mode") == "channel" else -1
+        else:
+            axis = -1
+        ex.emit("Softmax", node, [_attr_int("axis", axis)],
                 inputs=node.inputs[:1])
     elif op == "Dropout":
         ex.emit("Dropout", node, [_attr_float("ratio", float(a.get("p", 0.5)))])

@@ -77,7 +77,8 @@ def _parse_tensor(buf) -> _np.ndarray:
             f32.extend(struct.unpack(f"<{len(v)//4}f", bytes(v))
                        if wire == 2 else [struct.unpack("<f", v)[0]])
         elif field == 5:
-            i32.extend(P.unpack_varints(v))
+            # int32_data: negatives are sign-extended 64-bit varints
+            i32.extend(P.signed64(x) for x in P.unpack_varints(v))
         elif field == 7:
             i64.extend(P.signed64(x) for x in P.unpack_varints(v))
         elif field == 8:
@@ -203,6 +204,7 @@ def import_model(model_file):
 
     model = parse_model(model_file)
     inits = model["initializers"]
+    transposed = set()  # initializers already transposed for Gemm/MatMul
     env: Dict[str, object] = {}
     for name, _ in model["inputs"]:
         if name not in inits:
@@ -236,20 +238,33 @@ def import_model(model_file):
         elif op == "Gemm":
             if a.get("transA"):
                 raise MXNetError("onnx import: Gemm transA unsupported")
+            if float(a.get("alpha", 1.0)) != 1.0 or \
+                    float(a.get("beta", 1.0)) != 1.0:
+                raise MXNetError("onnx import: Gemm alpha/beta != 1 "
+                                 "unsupported")
             w = inits.get(nd_["inputs"][1])
             if w is None:
                 raise MXNetError("onnx import: Gemm needs initializer weight")
             if not a.get("transB"):
-                inits[nd_["inputs"][1]] = _np.ascontiguousarray(w.T)
-                w = inits[nd_["inputs"][1]]
+                # transpose ONCE per initializer even when shared by several
+                # nodes (in-place retransposition corrupted tied weights)
+                wname = nd_["inputs"][1]
+                if wname not in transposed:
+                    inits[wname] = _np.ascontiguousarray(w.T)
+                    transposed.add(wname)
+                w = inits[wname]
             out = mx.sym.FullyConnected(*ins, num_hidden=int(w.shape[0]),
                                         no_bias=len(ins) < 3, name=name)
         elif op == "MatMul":
             w = inits.get(nd_["inputs"][1])
             if w is None:
                 raise MXNetError("onnx import: MatMul needs initializer rhs")
-            inits[nd_["inputs"][1]] = _np.ascontiguousarray(w.T)
-            out = mx.sym.FullyConnected(*ins, num_hidden=int(w.shape[1]),
+            wname = nd_["inputs"][1]
+            if wname not in transposed:
+                inits[wname] = _np.ascontiguousarray(w.T)
+                transposed.add(wname)
+            w = inits[wname]
+            out = mx.sym.FullyConnected(*ins, num_hidden=int(w.shape[0]),
                                         no_bias=True, flatten=False,
                                         name=name)
         elif op in ("Relu", "Sigmoid", "Tanh", "Softplus"):
@@ -288,7 +303,19 @@ def import_model(model_file):
         elif op == "Concat":
             out = mx.sym.Concat(*ins, dim=int(a.get("axis", 1)), name=name)
         elif op == "Flatten":
-            out = mx.sym.Flatten(*ins, name=name)
+            ax = int(a.get("axis", 1))
+            if ax == 1:
+                out = mx.sym.Flatten(*ins, name=name)
+            elif ax == 0:
+                out = mx.sym.reshape(ins[0], shape=(1, -1), name=name)
+            else:
+                # ONNX Flatten(axis=k): (d0*..*dk-1, dk*..*dn). Collapse the
+                # trailing dims first (keep the leading k), then merge the
+                # leading k into one with reverse special-code matching.
+                tail = mx.sym.reshape(ins[0], shape=(0,) * ax + (-1,),
+                                      name=name + "_pre")
+                out = mx.sym.reshape(tail, shape=(-1, 0), reverse=True,
+                                     name=name)
         elif op == "Reshape":
             shape = inits.get(nd_["inputs"][1])
             if shape is None:

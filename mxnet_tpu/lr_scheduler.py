@@ -95,7 +95,10 @@ class WarmupScheduler(LRScheduler):
         self.warmup_begin_lr = warmup_begin_lr
 
     def __call__(self, num_update):
+        # the optimizer assigns base_lr on the WRAPPER; forward it to the
+        # wrapped schedule or the optimizer's learning_rate is ignored
+        self.scheduler.base_lr = self.base_lr
         if num_update < self.warmup_steps:
-            return self.warmup_begin_lr + (self.scheduler.base_lr - self.warmup_begin_lr) \
+            return self.warmup_begin_lr + (self.base_lr - self.warmup_begin_lr) \
                 * num_update / max(self.warmup_steps, 1)
         return self.scheduler(num_update)

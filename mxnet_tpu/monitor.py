@@ -46,8 +46,9 @@ class Monitor:
     def toc(self):
         if not self.activated:
             return []
-        self.activated = False
         res = []
+        # collect output stats BEFORE deactivating — stat_helper no-ops when
+        # inactive, so the old order silently dropped every output row
         for exe in self.exes:
             for name, array in zip(exe._out_names, exe.outputs):
                 self.stat_helper(name, array)
