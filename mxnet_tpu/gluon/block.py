@@ -25,6 +25,25 @@ from .parameter import DeferredInitializationError, Parameter, ParameterDict
 __all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_scope"]
 
 
+def _flatten_nd(obj, acc):
+    """Replace every NDArray in a (possibly nested) structure with a
+    placeholder, appending the arrays to acc in traversal order."""
+    if isinstance(obj, NDArray):
+        acc.append(obj)
+        return "__nd__"
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_flatten_nd(o, acc) for o in obj)
+    return obj
+
+
+def _unflatten_nd(struct, it):
+    if struct == "__nd__":
+        return next(it)
+    if isinstance(struct, (list, tuple)):
+        return type(struct)(_unflatten_nd(o, it) for o in struct)
+    return struct
+
+
 class _BlockScope:
     _tls = threading.local()
 
@@ -302,8 +321,11 @@ class HybridBlock(Block):
     def _call_cached(self, *args):
         import jax
 
-        # make sure all deferred params are materialized
-        flat_args = [a for a in args if isinstance(a, NDArray)]
+        # make sure all deferred params are materialized.  NDArrays may sit
+        # inside nested lists/tuples (e.g. RNN state lists) — flatten them so
+        # they become TRACED inputs, never constants baked into the program
+        flat_args = []
+        arg_struct = _flatten_nd(list(args), flat_args)
         pd = self.collect_params()
         try:
             param_list = [(name, p) for name, p in pd.items()]
@@ -319,11 +341,12 @@ class HybridBlock(Block):
         _random.ensure_key()  # never let a trace first-create the global key
         is_train = autograd.is_training()
         key = (tuple((a.shape, str(a.dtype)) for a in flat_args), is_train,
-               tuple(repr(a) for a in args if not isinstance(a, NDArray)))
+               repr(arg_struct))
         if key not in self._cached_ops:
             self._cached_ops[key] = self._build_cached_op(
-                args, [name for name, _ in param_list], is_train)
-        op, n_out, updated_idx = self._cached_ops[key]
+                arg_struct, flat_args, [name for name, _ in param_list],
+                is_train)
+        op, n_out, out_struct, updated_idx = self._cached_ops[key]
         rng = NDArray(_random.next_key())
         outs = invoke(op, param_vals + flat_args + [rng], {})
         if isinstance(outs, NDArray):
@@ -334,10 +357,9 @@ class HybridBlock(Block):
         if updated_idx:
             for j, pi in enumerate(updated_idx):
                 param_vals[pi]._data = outs[n_out + j]._data
-        outs = outs[:n_out]
-        return outs[0] if n_out == 1 else list(outs)
+        return _unflatten_nd(out_struct, iter(outs[:n_out]))
 
-    def _build_cached_op(self, example_args, param_names, is_train):
+    def _build_cached_op(self, arg_struct, flat_args, param_names, is_train):
         """Trace hybrid_forward into a pure jitted function (the CachedOp)."""
         import jax
 
@@ -359,10 +381,8 @@ class HybridBlock(Block):
                 p._data._data = v
             saved_key = _random.swap_key(rng)
             try:
-                wrapped = [NDArray(v) for v in avals]
-                it = iter(wrapped)
-                call_args = [next(it) if isinstance(a, NDArray) else a
-                             for a in example_args]
+                wrapped = iter([NDArray(v) for v in avals])
+                call_args = _unflatten_nd(arg_struct, wrapped)
                 with autograd.pause(train_mode=is_train):
                     out = Block.__call__(block, *call_args)
                 # stateful writes during the trace (BatchNorm running stats):
@@ -377,9 +397,11 @@ class HybridBlock(Block):
                 _random.swap_key(saved_key)
                 for (name, p), s in zip(pd.items(), saved):
                     p._data._data = s
-            outs = tuple(o._data for o in out) if isinstance(out, (list, tuple)) \
-                else (out._data,)
+            out_handles = []
+            out_struct = _flatten_nd(out, out_handles)
+            outs = tuple(o._data for o in out_handles)
             structure["n"] = len(outs)
+            structure["out_struct"] = out_struct
             structure["updated"] = tuple(i for i, _ in updated)
             return outs + tuple(v for _, v in updated)
 
@@ -387,13 +409,14 @@ class HybridBlock(Block):
         # probe structure once via eval_shape (no device compute)
         pd = self.collect_params()
         pvals_probe = [p.data()._data for p in pd.values()]
-        avals = [a._data for a in example_args if isinstance(a, NDArray)]
-        jax.eval_shape(pure_fn, *pvals_probe, *avals, jax.random.PRNGKey(0))
+        jax.eval_shape(pure_fn, *pvals_probe,
+                       *[a._data for a in flat_args],
+                       jax.random.PRNGKey(0))
         n_out = structure["n"]
         updated_idx = structure["updated"]
         op = Op(f"CachedOp_{self.name}", jitted,
                 num_outputs=n_out + len(updated_idx))
-        return op, n_out, updated_idx
+        return op, n_out, structure["out_struct"], updated_idx
 
     def export(self, path, epoch=0, remove_amp_cast=True):
         """Export symbol+params for deployment (reference: block.py:869)."""
@@ -438,11 +461,15 @@ class SymbolBlock(HybridBlock):
         self._input_names = [i.name for i in inputs]
         arg_names = outputs.list_arguments()
         aux_names = set(outputs.list_auxiliary_states())
+        # register in _reg_params too — load_parameters/save_parameters walk
+        # _collect_params_with_prefix, which only sees registered params
         for name in arg_names:
             if name not in self._input_names:
-                self.params.get(name, allow_deferred_init=True)
+                self._reg_params[name] = self.params.get(
+                    name, allow_deferred_init=True)
         for name in outputs.list_auxiliary_states():
-            self.params.get(name, allow_deferred_init=True, grad_req="null")
+            self._reg_params[name] = self.params.get(
+                name, allow_deferred_init=True, grad_req="null")
 
     def forward(self, *args):
         from ..executor import Executor

@@ -130,13 +130,16 @@ def eval_node(node: Node, ins: List[object], is_train: bool, rng_key=None,
         kwargs["_training"] = is_train
     if op.name == "BatchNorm" and collect_aux is not None and is_train \
             and not kwargs.get("use_global_stats"):
+        user_wants_stats = bool(node.attrs.get("output_mean_var"))
         kwargs["output_mean_var"] = True
         y, mean, var = op.fn(*ins, **kwargs)
         aux_names = [e.node.name for e in node.inputs[-2:]]
         momentum = float(kwargs.get("momentum", 0.9))
         collect_aux[aux_names[0]] = momentum * ins[-2] + (1 - momentum) * mean
         collect_aux[aux_names[1]] = momentum * ins[-1] + (1 - momentum) * var
-        return (y,)
+        # if the symbol itself declared output_mean_var, it has 3 outputs —
+        # keep them or downstream indexing hits a 1-tuple
+        return (y, mean, var) if user_wants_stats else (y,)
     out = op.fn(*ins, **kwargs)
     return tuple(out) if isinstance(out, (tuple, list)) else (out,)
 

@@ -575,7 +575,13 @@ def _make_sym_wrapper(opname):
         inputs = []
         for slot in order:
             if slot in slots:
-                inputs.append(slots[slot])
+                s = slots[slot]
+                if slot in aux and s._entries[0].node.kind == "var":
+                    # an explicitly supplied variable feeding an aux slot IS
+                    # an auxiliary state (reference: BatchNorm moving stats
+                    # are aux regardless of how the var was created)
+                    s._entries[0].node.attr_dict["__is_aux__"] = "1"
+                inputs.append(s)
             elif slot in aux:
                 v = Variable(f"{node_name}_{slot}")
                 v._entries[0].node.attr_dict["__is_aux__"] = "1"
