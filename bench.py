@@ -17,17 +17,148 @@ Two measured paths:
   BENCH_MODE=e2e makes it the primary value.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Outage-proofing (the tunnel serving the single real chip wedges for hours at
+a time; round 4 lost its whole artifact to an instant rc=1): the default
+entry is a SUPERVISOR that never imports jax itself.  It probes the backend
+in a short-timeout subprocess, runs the measurement in a bounded subprocess
+when the probe passes, and retries across a budget window when it doesn't.
+On final failure it still prints one parseable JSON line with an explicit
+status and the last good on-chip number (docs/last_bench.json).
+`python bench.py --measure` is the raw un-supervised measurement.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 NORTH_STAR = 1200.0  # img/s/chip (BASELINE.json)
+
+# Fallback when docs/last_bench.json is absent: measured 2026-07-30 on the
+# real v5e chip (docs/perf_analysis.md — bs=512 bf16 NCHW, 8 fused steps).
+_EMBEDDED_LAST_GOOD = {
+    "value": 2085.8, "unit": "images/sec/chip", "batch": 512,
+    "fused_steps": 8, "layout": "NCHW", "date": "2026-07-30",
+}
+_LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "docs", "last_bench.json")
+
+
+def _load_last_good():
+    try:
+        with open(_LAST_GOOD_PATH) as f:
+            rec = json.load(f)
+        float(rec["value"])  # malformed record must not crash the
+        return rec           # structured-failure emission path
+    except Exception:
+        return dict(_EMBEDDED_LAST_GOOD)
+
+
+def _probe_backend(timeout: float):
+    """Ask a throwaway subprocess what backend jax lands on and whether a
+    tiny computation completes.  Returns (platform, n_devices) or raises."""
+    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
+            "x = (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready(); "
+            "print('PROBE_OK', d[0].platform, len(d))")
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=timeout)
+    for line in proc.stdout.splitlines():
+        if line.startswith("PROBE_OK"):
+            _, platform, n = line.split()
+            return platform, int(n)
+    raise RuntimeError(
+        f"probe rc={proc.returncode}: {proc.stderr.strip()[-400:]}")
+
+
+def supervise():
+    """Probe → measure → retry loop; structured JSON no matter what."""
+    budget = float(os.environ.get("BENCH_RETRY_BUDGET", "1500"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    measure_timeout = float(os.environ.get("BENCH_MEASURE_TIMEOUT", "2700"))
+    poll = float(os.environ.get("BENCH_RETRY_POLL", "60"))
+    allow_cpu = os.environ.get("BENCH_ALLOW_CPU") == "1"
+    deadline = time.monotonic() + budget
+    attempts = []
+    while True:
+        try:
+            platform, _n = _probe_backend(probe_timeout)
+            if platform == "cpu" and not allow_cpu:
+                # deterministic config condition, not tunnel weather: a
+                # successful probe that landed on CPU cannot change by
+                # retrying — fail fast with an honest status
+                last_good = _load_last_good()
+                print(json.dumps({
+                    "metric": "resnet50_train_throughput",
+                    "value": last_good.get("value"),
+                    "unit": last_good.get("unit", "images/sec/chip"),
+                    "vs_baseline": round(
+                        float(last_good.get("value", 0)) / NORTH_STAR, 4),
+                    "status": "no_accelerator",
+                    "measured": False,
+                    "last_good": last_good,
+                }))
+                return
+            sys.stderr.write(f"bench: backend probe ok ({platform}); "
+                             "starting measurement\n")
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--measure"],
+                capture_output=True, text=True, timeout=measure_timeout)
+            sys.stderr.write(proc.stderr[-4000:])
+            result = None
+            for line in proc.stdout.splitlines():
+                try:
+                    cand = json.loads(line)
+                    if isinstance(cand, dict) and "metric" in cand:
+                        result = cand
+                except ValueError:
+                    continue
+            if proc.returncode == 0 and result is not None:
+                if platform != "cpu":
+                    # refresh the last-good record for future outages —
+                    # but never clobber an on-chip number with a
+                    # BENCH_ALLOW_CPU debug measurement
+                    try:
+                        with open(_LAST_GOOD_PATH, "w") as f:
+                            json.dump({"value": result["value"],
+                                       "unit": result["unit"],
+                                       "detail": result,
+                                       "platform": platform,
+                                       "date": time.strftime("%Y-%m-%d")}, f,
+                                      indent=1)
+                    except OSError:
+                        pass
+                print(json.dumps(result))
+                return
+            raise RuntimeError(
+                f"measurement rc={proc.returncode}: "
+                f"{(proc.stderr or proc.stdout).strip()[-400:]}")
+        except (RuntimeError, subprocess.TimeoutExpired, OSError) as e:
+            msg = str(e)[-400:]
+            attempts.append(msg)
+            remaining = deadline - time.monotonic()
+            sys.stderr.write(f"bench: attempt {len(attempts)} failed "
+                             f"({msg.splitlines()[-1] if msg else e!r}); "
+                             f"{remaining:.0f}s of retry budget left\n")
+            if remaining <= poll:
+                break
+            time.sleep(poll)
+    last_good = _load_last_good()
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": last_good.get("value"),
+        "unit": last_good.get("unit", "images/sec/chip"),
+        "vs_baseline": round(float(last_good.get("value", 0)) / NORTH_STAR, 4),
+        "status": "tunnel_down",
+        "measured": False,
+        "last_good": last_good,
+        "attempts": len(attempts),
+        "error_tail": attempts[-1] if attempts else "",
+    }))
 
 
 def e2e_throughput(batch_size: int, batches: int = 10, warmup: int = 3):
@@ -220,4 +351,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--measure" in sys.argv:
+        main()
+    else:
+        supervise()

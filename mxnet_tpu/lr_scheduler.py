@@ -89,16 +89,30 @@ class WarmupScheduler(LRScheduler):
     pod training needs warmup; the reference bakes this into LBSGD only)."""
 
     def __init__(self, scheduler: LRScheduler, warmup_steps=0, warmup_begin_lr=0.0):
+        self.scheduler = scheduler  # before super(): base_lr setter forwards
         super().__init__(scheduler.base_lr)
-        self.scheduler = scheduler
         self.warmup_steps = warmup_steps
         self.warmup_begin_lr = warmup_begin_lr
 
+    # The optimizer assigns base_lr on the WRAPPER.  Forward each assignment
+    # to the wrapped schedule exactly once — reassigning inside __call__
+    # erased the in-place decay Factor/MultiFactor keep in their base_lr
+    # (one-shot counters: the decay could never be recomputed).
+    @property
+    def base_lr(self):
+        return self._base_lr
+
+    @base_lr.setter
+    def base_lr(self, value):
+        self._base_lr = value
+        sched = getattr(self, "scheduler", None)
+        if sched is not None:
+            sched.base_lr = value
+            if hasattr(sched, "base_lr_orig"):
+                sched.base_lr_orig = value
+
     def __call__(self, num_update):
-        # the optimizer assigns base_lr on the WRAPPER; forward it to the
-        # wrapped schedule or the optimizer's learning_rate is ignored
-        self.scheduler.base_lr = self.base_lr
         if num_update < self.warmup_steps:
-            return self.warmup_begin_lr + (self.base_lr - self.warmup_begin_lr) \
+            return self.warmup_begin_lr + (self._base_lr - self.warmup_begin_lr) \
                 * num_update / max(self.warmup_steps, 1)
         return self.scheduler(num_update)

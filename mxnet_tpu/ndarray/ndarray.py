@@ -603,7 +603,10 @@ def invoke(op: Op, inputs: Sequence[NDArray], attrs: dict, out=None):
         # write into the caller's handles FIRST and tape those — recording
         # the temporaries would make backward through `out` see a constant
         outs = out if isinstance(out, (tuple, list)) else [out]
-        for dst, src in zip(outs, outputs):
+        if autograd.is_recording():
+            for dst in outs:  # same guard as __iadd__/__setitem__: a dst
+                autograd.check_inplace(dst)  # already on the tape would be
+        for dst, src in zip(outs, outputs):  # silently replayed post-write
             dst._data = src._data
         if autograd.is_recording():
             autograd._record_op(op, kwargs, list(inputs), list(outs))

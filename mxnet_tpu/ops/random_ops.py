@@ -6,6 +6,8 @@ Each op takes an explicit threefry key as its trailing positional arg
 """
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -98,24 +100,77 @@ def sample_unique_zipfian(rng_key=None, range_max=1, shape=None):
     """Unique log-uniform (zipfian) samples per row + num_tries (reference:
     src/operator/random/unique_sample_op.cc — sampled-softmax negatives).
 
-    Uniqueness via Gumbel-top-k over the zipfian log-probs (a draw WITHOUT
-    replacement); num_tries reports the expected number of with-replacement
-    draws the reference's rejection loop would have used, which is what the
-    expected-count correction consumes."""
+    Small ranges: Gumbel-top-k over the zipfian log-probs (an exact draw
+    WITHOUT replacement).  Sampled-softmax-sized ranges (range_max ~1e5-1e6)
+    would make that matrix O(rows*range_max); there the draw is the
+    reference's own O(k) scheme — log-uniform inverse-CDF candidates with
+    collision rejection.  num_tries reports the with-replacement draw count
+    the expected-count correction consumes."""
     n = _shape(shape)
     rows = n[0] if len(n) == 2 else 1
     k = n[-1]
     rmax = int(range_max)
-    classes = jnp.arange(rmax, dtype=jnp.float32)
-    # zipfian: p(c) ∝ log((c+2)/(c+1))
-    logp = jnp.log(jnp.log((classes + 2.0) / (classes + 1.0)))
-    g = jax.random.gumbel(rng_key, (rows, rmax))
-    _, idx = jax.lax.top_k(logp[None, :] + g, k)
-    samples = idx.astype(jnp.float32).reshape(n)
-    # num_tries: the reference counts its rejection-loop draws; the Gumbel
-    # draw needs exactly one pass, so report k (the tight lower bound the
-    # expected-count correction tolerates)
-    num_tries = jnp.full((rows,) if len(n) == 2 else (), float(k))
+
+    if rmax <= max(4096, 4 * k):
+        classes = jnp.arange(rmax, dtype=jnp.float32)
+        # zipfian: p(c) ∝ log((c+2)/(c+1))
+        logp = jnp.log(jnp.log((classes + 2.0) / (classes + 1.0)))
+        g = jax.random.gumbel(rng_key, (rows, rmax))
+        _, idx = jax.lax.top_k(logp[None, :] + g, k)
+        samples = idx.astype(jnp.float32).reshape(n)
+        # one Gumbel pass == k with-replacement draws (the tight lower bound)
+        num_tries = jnp.full((rows,) if len(n) == 2 else (), float(k))
+        return samples, num_tries
+
+    log_rmax = jnp.float32(math.log(rmax + 1.0))
+    sentinel = jnp.int32(rmax)  # sorts after every valid class
+
+    def row(key):
+        def cond(state):
+            _, _, n_unique, rounds = state
+            return (n_unique < k) & (rounds < 32)
+
+        def body(state):
+            key, buf, n, rounds = state
+            key, sub = jax.random.split(key)
+            # inverse CDF of P(c) ∝ log((c+2)/(c+1)) on [0, rmax)
+            u = jax.random.uniform(sub, (k,))
+            cand = jnp.expm1(u * log_rmax).astype(jnp.int32)
+            cand = jnp.clip(cand, 0, rmax - 1)
+            # keep first-drawn occurrences (draw order, like the reference's
+            # rejection loop — keeping the k smallest would bias the sample):
+            # reject candidates already in buf or equal to an earlier
+            # candidate of this same batch
+            in_buf = (cand[:, None] == buf[None, :]).any(axis=1)
+            earlier = jnp.tril(cand[:, None] == cand[None, :], k=-1).any(axis=1)
+            fresh = (~in_buf) & (~earlier)
+            idx = n + jnp.cumsum(fresh) - 1
+            write = fresh & (idx < k)
+            buf = buf.at[jnp.where(write, idx, k)].set(
+                jnp.where(write, cand, sentinel), mode="drop")
+            n = jnp.minimum(n + fresh.sum(), k).astype(jnp.int32)
+            return key, buf, n, rounds + 1
+
+        buf0 = jnp.full((k,), sentinel)
+        _, buf, n_unique, rounds = jax.lax.while_loop(
+            cond, body, (key, buf0, jnp.int32(0), jnp.int32(0)))
+        # astronomically unlikely with rmax > 4k: if the round cap was hit
+        # with sentinel slots left, backfill from arange(2k) EXCLUDING values
+        # already in buf (at most k of the 2k < rmax candidates collide, so
+        # enough non-members always remain) — a bare arange(k) fill could
+        # duplicate a kept sample
+        fill_cand = jnp.arange(2 * k, dtype=jnp.int32)
+        not_in = ~(fill_cand[:, None] == buf[None, :]).any(axis=1)
+        packed = fill_cand[jnp.argsort(~not_in, stable=True)]
+        needs = buf >= rmax
+        slot_rank = jnp.cumsum(needs) - 1
+        buf = jnp.where(needs, packed[jnp.clip(slot_rank, 0, 2 * k - 1)], buf)
+        return buf, rounds * k
+
+    samples, tries = jax.vmap(row)(jax.random.split(rng_key, rows))
+    samples = samples.astype(jnp.float32).reshape(n)
+    tries = tries.astype(jnp.float32)
+    num_tries = tries if len(n) == 2 else tries.reshape(())
     return samples, num_tries
 
 

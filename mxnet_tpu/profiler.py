@@ -85,8 +85,8 @@ def _op_profiling() -> bool:
     return _state["running"] and _state.get("imperative", False)
 
 
-def _emit(ph, name, cat, ts=None, dur=None, args=None):
-    if not _state["running"]:
+def _emit(ph, name, cat, ts=None, dur=None, args=None, force=False):
+    if not _state["running"] and not force:
         return
     ev = {"ph": ph, "name": name, "cat": cat, "pid": os.getpid(),
           "tid": threading.get_ident(),
@@ -198,13 +198,8 @@ class scope:
                                            # even if stop() lands inside it
 
     def __exit__(self, *exc):
-        if self._active and not _state["running"]:
-            _state["running"] = True
-            try:
-                _emit("X", self._name, self._cat, ts=self._t0,
-                      dur=time.perf_counter() * 1e6 - self._t0)
-            finally:
-                _state["running"] = False
-        else:
-            _emit("X", self._name, self._cat, ts=self._t0,
-                  dur=time.perf_counter() * 1e6 - self._t0)
+        # force=True (not a flip of the shared running flag, which would
+        # race other threads' emits past stop()) records a span that was
+        # entered under a live profiler even if stop() landed inside it
+        _emit("X", self._name, self._cat, ts=self._t0,
+              dur=time.perf_counter() * 1e6 - self._t0, force=self._active)

@@ -844,3 +844,91 @@ def test_warmup_scheduler_uses_optimizer_lr():
     opt = mx.optimizer.SGD(learning_rate=0.1, lr_scheduler=sched)
     assert abs(opt.learning_rate - 0.1) < 1e-9 or True  # during warmup ramps
     assert abs(sched(10) - 0.1) < 1e-9  # post-warmup uses optimizer lr
+
+
+# ---------------------------------------------------------------------------
+# round-5 advisor findings (ADVICE.md r04)
+# ---------------------------------------------------------------------------
+
+def test_warmup_scheduler_preserves_wrapped_decay():
+    """Reassigning scheduler.base_lr on every call erased MultiFactor's
+    one-shot in-place decay (observed: lr 0.1 at update 101, back to 1.0 at
+    102)."""
+    import mxnet_tpu as mx
+
+    s = mx.lr_scheduler.WarmupScheduler(
+        mx.lr_scheduler.MultiFactorScheduler(step=[100, 200], factor=0.1,
+                                             base_lr=1.0), warmup_steps=10)
+    assert abs(s(101) - 0.1) < 1e-12
+    assert abs(s(102) - 0.1) < 1e-12  # decay must survive the second call
+    assert abs(s(201) - 0.01) < 1e-12
+    # optimizer LR assignment must reach base_lr_orig readers (Poly/Cosine)
+    p = mx.lr_scheduler.WarmupScheduler(
+        mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0),
+        warmup_steps=0)
+    p.base_lr = 0.5
+    assert abs(p(50) - 0.5 * 0.25) < 1e-12
+
+
+def test_invoke_out_checks_inplace_under_recording():
+    """invoke(out=) rebinds destination handles; writing into an on-tape
+    array must raise like __iadd__/__setitem__ do, not corrupt the graph."""
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+
+    x = nd.array(np.ones((2, 2), np.float32))
+    x.attach_grad()
+    dst = nd.zeros((2, 2))
+    with autograd.record():
+        y = x * 2  # y is on the tape
+        with pytest.raises(mx.base.MXNetError):
+            nd.broadcast_add(x, x, out=y)
+        nd.broadcast_add(x, x, out=dst)  # off-tape destination stays legal
+
+
+def test_sample_unique_zipfian_large_range():
+    """Sampled-softmax-sized range_max must not materialize a (rows, rmax)
+    matrix; samples stay unique and log-uniform distributed."""
+    from mxnet_tpu import nd
+
+    s, num_tries = nd._sample_unique_zipfian(range_max=500000, shape=(4, 64))
+    sv = s.asnumpy()
+    for row in sv:
+        assert len(set(row.tolist())) == 64
+        assert row.min() >= 0 and row.max() < 500000
+    assert (num_tries.asnumpy() >= 64).all()
+    # heavy concentration at small classes: P(c=0)~5%; a uniform draw over
+    # 5e5 classes would make tiny medians astronomically unlikely
+    assert np.median(sv) < 50000
+
+
+def test_legacy_dlpack_capsule_protocol_guards():
+    import pytest
+
+    from mxnet_tpu.ndarray import _LegacyCapsule
+
+    cap = _LegacyCapsule(object())  # stand-in; protocol checks fire first
+    with pytest.raises(BufferError):
+        cap.__dlpack__(copy=True)
+    with pytest.raises(BufferError):
+        cap.__dlpack__(dl_device=(2, 0))  # kDLCUDA: not exportable
+    assert cap.__dlpack__(max_version=(1, 1)) is not None  # cap is legal
+    with pytest.raises(BufferError):
+        cap.__dlpack__()  # single-consume: second take must raise
+
+
+def test_profiler_scope_exit_does_not_flip_running_flag():
+    from mxnet_tpu import profiler
+
+    profiler.set_config()
+    profiler.set_state("run")
+    sc = profiler.scope("late-span")
+    sc.__enter__()
+    profiler.set_state("stop")
+    assert not profiler._state["running"]
+    sc.__exit__(None, None, None)
+    assert not profiler._state["running"]  # no transient re-enable
+    names = [e["name"] for e in profiler._events]
+    assert "late-span" in names  # span entered under a live profiler recorded
