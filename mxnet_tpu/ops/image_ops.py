@@ -102,3 +102,141 @@ def crop(data, x=0, y=0, width=0, height=0):
                     int(x):int(x) + int(width)]
     return data[:, :, int(y):int(y) + int(height),
                 int(x):int(x) + int(width)]
+
+
+# ---------------------------------------------------------------------------
+# color jitter tail (reference: src/operator/image/image_random-inl.h:497-686
+# — AdjustHue/RandomColorJitter/AdjustLighting/RandomLighting).  This
+# namespace is CHW (channel axis -3), RGB order, float values in [0, 255].
+# ---------------------------------------------------------------------------
+
+_LUMA = (0.299, 0.587, 0.114)  # reference AdjustContrast/SaturationImpl coef
+# eigvec * eigval of ImageNet RGB covariance (AlexNet PCA lighting),
+# reference AdjustLightingImpl eig[3][3]
+_LIGHTING_EIG = (
+    (55.46 * -0.5675, 4.794 * 0.7192, 1.148 * 0.4009),
+    (55.46 * -0.5808, 4.794 * -0.0045, 1.148 * -0.8140),
+    (55.46 * -0.5836, 4.794 * -0.6948, 1.148 * 0.4203),
+)
+
+
+def _split_rgb(data):
+    return data[..., 0, :, :], data[..., 1, :, :], data[..., 2, :, :]
+
+
+def _cast_like(out_f, data):
+    """Float result → the input's dtype with saturation for integer images
+    (reference saturate_cast<DType>; a bare astype would wrap/zero a uint8
+    shift and silently no-op the augmentation)."""
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        info = jnp.iinfo(data.dtype)
+        return jnp.clip(jnp.round(out_f), info.min, info.max).astype(data.dtype)
+    return out_f.astype(data.dtype)
+
+
+def _adjust_hue(data, alpha):
+    """Hue rotation via RGB→HLS→RGB with h += alpha*360 (reference
+    AdjustHueImpl); values in [0, 255]."""
+    r, g, b = (c / 255.0 for c in _split_rgb(data))
+    cmax = jnp.maximum(jnp.maximum(r, g), b)
+    cmin = jnp.minimum(jnp.minimum(r, g), b)
+    c = cmax - cmin
+    safe_c = jnp.where(c == 0, 1.0, c)
+    hp = jnp.where(cmax == r, ((g - b) / safe_c) % 6.0,
+                   jnp.where(cmax == g, (b - r) / safe_c + 2.0,
+                             (r - g) / safe_c + 4.0))
+    hp = jnp.where(c == 0, 0.0, hp)
+    lum = (cmax + cmin) / 2.0
+    sat = jnp.where(c == 0, 0.0,
+                    c / jnp.maximum(1.0 - jnp.abs(2.0 * lum - 1.0), 1e-12))
+    # rotate: h' in [0, 6)
+    hp = (hp + alpha * 6.0) % 6.0
+    cc = (1.0 - jnp.abs(2.0 * lum - 1.0)) * sat
+    xx = cc * (1.0 - jnp.abs(hp % 2.0 - 1.0))
+    m = lum - cc / 2.0
+    sector = jnp.clip(hp.astype(jnp.int32), 0, 5)
+    zeros = jnp.zeros_like(cc)
+    r1 = jnp.select([sector == 0, sector == 1, sector == 2,
+                     sector == 3, sector == 4, sector == 5],
+                    [cc, xx, zeros, zeros, xx, cc])
+    g1 = jnp.select([sector == 0, sector == 1, sector == 2,
+                     sector == 3, sector == 4, sector == 5],
+                    [xx, cc, cc, xx, zeros, zeros])
+    b1 = jnp.select([sector == 0, sector == 1, sector == 2,
+                     sector == 3, sector == 4, sector == 5],
+                    [zeros, zeros, xx, cc, cc, xx])
+    out = jnp.stack([r1 + m, g1 + m, b1 + m], axis=-3) * 255.0
+    return _cast_like(out, data)
+
+
+@register("_image_random_hue", rng=True, differentiable=False)
+def random_hue(data, min_factor=-0.1, max_factor=0.1, rng_key=None):
+    alpha = jax.random.uniform(rng_key, (), minval=float(min_factor),
+                               maxval=float(max_factor))
+    return _adjust_hue(data, alpha)
+
+
+@register("_image_adjust_lighting")
+def adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
+    """AlexNet-style PCA lighting shift per RGB channel (reference
+    AdjustLightingImpl)."""
+    a = jnp.asarray(alpha, jnp.float32)
+    eig = jnp.asarray(_LIGHTING_EIG, jnp.float32)
+    pca = eig @ a  # (3,) shift for R, G, B
+    return _cast_like(data.astype(jnp.float32) + pca.reshape((3, 1, 1)), data)
+
+
+@register("_image_random_lighting", rng=True, differentiable=False)
+def random_lighting(data, alpha_std=0.05, rng_key=None):
+    alpha = jax.random.normal(rng_key, (3,)) * float(alpha_std)
+    eig = jnp.asarray(_LIGHTING_EIG, jnp.float32)
+    pca = eig @ alpha
+    return _cast_like(data.astype(jnp.float32) + pca.reshape((3, 1, 1)), data)
+
+
+@register("_image_random_color_jitter", rng=True, differentiable=False)
+def random_color_jitter(data, brightness=0.0, contrast=0.0, saturation=0.0,
+                        hue=0.0, rng_key=None):
+    """Brightness/contrast/saturation/hue jitter applied in RANDOM order
+    (reference RandomColorJitter: std::shuffle over the four adjusters;
+    contrast/saturation gray means use the 0.299/0.587/0.114 luma)."""
+    keys = jax.random.split(rng_key, 5)
+    order = jax.random.permutation(keys[0], 4)
+    alpha_b = 1.0 + jax.random.uniform(
+        keys[1], (), minval=-float(brightness), maxval=float(brightness) or 1e-9)
+    alpha_c = 1.0 + jax.random.uniform(
+        keys[2], (), minval=-float(contrast), maxval=float(contrast) or 1e-9)
+    alpha_s = 1.0 + jax.random.uniform(
+        keys[3], (), minval=-float(saturation), maxval=float(saturation) or 1e-9)
+    alpha_h = jax.random.uniform(
+        keys[4], (), minval=-float(hue), maxval=float(hue) or 1e-9)
+    luma = jnp.asarray(_LUMA, jnp.float32).reshape((3, 1, 1))
+
+    def do_brightness(x):
+        if float(brightness) <= 0:
+            return x
+        return x * alpha_b
+
+    def do_contrast(x):
+        if float(contrast) <= 0:
+            return x
+        gray_mean = jnp.mean(jnp.sum(x * luma, axis=-3), axis=(-1, -2),
+                             keepdims=True)[..., None, :, :]
+        return x * alpha_c + (1.0 - alpha_c) * gray_mean
+
+    def do_saturation(x):
+        if float(saturation) <= 0:
+            return x
+        gray = jnp.sum(x * luma, axis=-3, keepdims=True)
+        return x * alpha_s + (1.0 - alpha_s) * gray
+
+    def do_hue(x):
+        if float(hue) <= 0:
+            return x
+        return _adjust_hue(x, alpha_h)
+
+    branches = [do_brightness, do_contrast, do_saturation, do_hue]
+    out = data.astype(jnp.float32)
+    for i in range(4):
+        out = jax.lax.switch(order[i], branches, out)
+    return out
