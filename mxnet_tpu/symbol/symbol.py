@@ -463,9 +463,32 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
     return Symbol(entries)
 
 
+def _parse_attr_value(v):
+    """Attr values arrive as strings both from our tojson (reprs) and from
+    reference-MXNet graph JSON (bare strings like "relu", "(1, 1)", "True",
+    "2.0e-05").  literal_eval covers both; anything else stays a string."""
+    if not isinstance(v, str):
+        return v
+    import ast
+
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
 def load_json(json_str: str) -> Symbol:
+    """Parse graph JSON — ours or a reference ``prefix-symbol.json``
+    (nnvm::Graph JSON: same nodes/arg_nodes/heads layout; reference writes
+    op params under "attrs" (1.x) or "param" (pre-1.0), may carry
+    "node_row_ptr" (ignored), and emits 2-element entries in old files)."""
     data = json.loads(json_str)
     nodes: List[Node] = []
+
+    def entry_of(spec):
+        nid, idx = spec[0], spec[1] if len(spec) > 1 else 0
+        return SymbolEntry(nodes[nid], idx)
+
     for spec in data["nodes"]:
         attr_dict = spec.get("attr_dict", {})
         if spec["op"] == "null":
@@ -478,11 +501,12 @@ def load_json(json_str: str) -> Symbol:
                 op = _cf.op_from_spec(attr_dict["__control_flow__"])
             else:
                 op = get_op(spec["op"])
-            attrs = {k: eval(v) for k, v in spec.get("attrs", {}).items()}  # noqa: S307 — own format
-            inputs = [SymbolEntry(nodes[i], idx) for i, idx, _ in spec["inputs"]]
+            raw_attrs = spec.get("attrs", spec.get("param", {}))
+            attrs = {k: _parse_attr_value(v) for k, v in raw_attrs.items()}
+            inputs = [entry_of(e) for e in spec["inputs"]]
             n = Node("op", spec["name"], op, attrs, inputs, attr_dict)
         nodes.append(n)
-    heads = [SymbolEntry(nodes[i], idx) for i, idx, _ in data["heads"]]
+    heads = [entry_of(e) for e in data["heads"]]
     return Symbol(heads)
 
 
