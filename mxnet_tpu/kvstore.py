@@ -173,7 +173,10 @@ class KVStoreLocal(KVStore):
         if any(isinstance(v, _sparse.RowSparseNDArray) for v in vals):
             idx = jnp.concatenate([v.indices_ for v in vals])
             values = jnp.concatenate([v.values_ for v in vals])
-            return _sparse.RowSparseNDArray(values, idx, vals[0].shape)
+            # compact: the merged gradient's capacity stays the number of
+            # distinct touched rows, however many devices/pushes contribute
+            # (overflow semantics in ndarray/sparse.py module docs)
+            return _sparse.RowSparseNDArray(values, idx, vals[0].shape).compact()
         # one fused XLA reduction; inputs migrate to the first buffer's device
         datas = [v._data for v in vals]
         if compress:

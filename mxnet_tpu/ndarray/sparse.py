@@ -10,6 +10,17 @@ values[K, ...cols]) for a capacity K fixed at construction; csr holds
 (data[NNZ], indices[NNZ], indptr[R+1]).  Kernels are masked dense ops
 (gather/scatter/segment-sum), which XLA lowers well; storage fallback to dense
 mirrors the reference's dispatch-mode fallback.
+
+Capacity-overflow semantics (defined; the reference grows dynamically,
+include/mxnet/ndarray.h:61-66 + CheckAndAllocData):
+- EAGER ops GROW ON HOST: ``elemwise_add`` and the kvstore reduce produce a
+  duplicate-merged ("compacted") result, so K stays bounded by the number of
+  distinct nonzero rows no matter how many accumulations run — never by the
+  number of adds.  Dense write-back re-sparsifies from the written value, so
+  rows outside the old pattern are kept, not dropped.
+- TRACED contexts (inside jit) keep the static capacity they were traced
+  with; growth there is impossible by construction, and write-back falls
+  back to the fixed-pattern update.
 """
 from __future__ import annotations
 
@@ -49,9 +60,37 @@ class RowSparseNDArray(BaseSparseNDArray):
     def _data(self, v):
         if v is None:
             return
-        # dense write-back: re-sparsify over existing capacity
-        idx = jnp.clip(self.indices_, 0, self._shape_full[0] - 1)
-        self.values_ = jnp.take(v, idx, axis=0)
+        if isinstance(v, jax.core.Tracer):
+            # in-trace write-back: shapes are static — keep the traced
+            # sparsity pattern (capacity cannot grow under jit)
+            idx = jnp.clip(self.indices_, 0, self._shape_full[0] - 1)
+            self.values_ = jnp.take(v, idx, axis=0)
+            return
+        # eager dense write-back: re-sparsify from the value itself so rows
+        # outside the old pattern GROW the capacity instead of being dropped.
+        # The nonzero-row reduce runs ON DEVICE; only the (rows,) bool mask
+        # crosses to host (a full dense pull here would serialize every
+        # backward-accumulation step over the tunnel)
+        flat = v.reshape(v.shape[0], -1)
+        mask = _np.asarray(jnp.any(flat != 0, axis=1))
+        nz = _np.where(mask)[0].astype(_np.int32)
+        self.indices_ = jnp.asarray(nz)
+        self.values_ = jnp.take(v, jnp.asarray(nz), axis=0)
+
+    def compact(self):
+        """Merge duplicate indices and drop invalid (-1) slots in place;
+        after this, indices are sorted unique and K == distinct nonzero
+        rows.  The growth bound for every eager accumulation path."""
+        idx = _np.asarray(self.indices_)
+        valid = _np.where(idx >= 0)[0]
+        uniq, inv = _np.unique(idx[valid], return_inverse=True)
+        out = jnp.zeros((len(uniq),) + tuple(self.values_.shape[1:]),
+                        self.values_.dtype)
+        out = out.at[jnp.asarray(inv)].add(
+            jnp.take(self.values_, jnp.asarray(valid), axis=0))
+        self.values_ = out
+        self.indices_ = jnp.asarray(uniq.astype(_np.int32))
+        return self
 
     def _to_dense_jax(self):
         out = jnp.zeros(self._shape_full, dtype=self.values_.dtype)
@@ -288,7 +327,10 @@ def elemwise_add(lhs, rhs):
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
         idx = jnp.concatenate([lhs.indices_, rhs.indices_])
         vals = jnp.concatenate([lhs.values_, rhs.values_])
-        return RowSparseNDArray(vals, idx, lhs.shape)
+        out = RowSparseNDArray(vals, idx, lhs.shape)
+        if isinstance(idx, jax.core.Tracer):
+            return out  # traced: static concat capacity (see module docs)
+        return out.compact()  # eager: K bounded by distinct rows, not #adds
     a = lhs._to_dense_jax() if isinstance(lhs, BaseSparseNDArray) else lhs._data
     b = rhs._to_dense_jax() if isinstance(rhs, BaseSparseNDArray) else rhs._data
     return NDArray(a + b)

@@ -932,3 +932,71 @@ def test_profiler_scope_exit_does_not_flip_running_flag():
     assert not profiler._state["running"]  # no transient re-enable
     names = [e["name"] for e in profiler._events]
     assert "late-span" in names  # span entered under a live profiler recorded
+
+
+def test_row_sparse_overflow_semantics():
+    """Defined capacity semantics (ndarray/sparse.py module docs): eager
+    accumulation grows-then-compacts, so capacity is bounded by distinct
+    rows; dense write-back keeps rows outside the old pattern (reference
+    grows dynamically, include/mxnet/ndarray.h:61-66)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ndarray import sparse
+
+    # N accumulations over the same 2 rows: K must stay 2, values must sum
+    acc = sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([1, 4])), shape=(6, 3))
+    one = sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([4, 1])), shape=(6, 3))
+    for _ in range(10):
+        acc = sparse.elemwise_add(acc, one)
+    assert acc.indices_.shape[0] == 2, "capacity must not grow with #adds"
+    dense = acc.asnumpy()
+    assert np.allclose(dense[1], 11.0) and np.allclose(dense[4], 11.0)
+    assert np.allclose(np.delete(dense, [1, 4], axis=0), 0.0)
+
+    # duplicate indices inside one array still sum once compacted
+    dup = sparse.RowSparseNDArray(
+        jnp.asarray(np.ones((3, 2), np.float32)),
+        jnp.asarray(np.array([2, 2, 0], np.int32)), (4, 2))
+    dup.compact()
+    assert dup.indices_.shape[0] == 2
+    assert np.allclose(dup.asnumpy()[2], 2.0)
+
+    # dense write-back with NEW rows must not silently drop them
+    r = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), np.array([0])), shape=(4, 2))
+    newdense = np.zeros((4, 2), np.float32)
+    newdense[3] = 7.0
+    r._data = jnp.asarray(newdense)
+    assert np.allclose(r.asnumpy(), newdense), "write-back dropped row 3"
+
+
+def test_kvstore_row_sparse_accumulation_bounded():
+    """kvstore local reduce over row_sparse contributions: merged gradient
+    equals the dense oracle and its capacity equals the distinct touched
+    rows (VERDICT r04 weak #7)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import sparse
+
+    kv = mx.kv.create("local")
+    kv.init("emb", sparse.zeros("row_sparse", (10, 4)))
+    contributions = [
+        sparse.row_sparse_array((np.full((2, 4), float(i + 1), np.float32),
+                                 np.array([1, 5 + i])), shape=(10, 4))
+        for i in range(3)
+    ]
+    kv.push("emb", contributions)
+    # the regression itself: merged capacity == distinct touched rows
+    # ({1, 5, 6, 7}), not the 6 concatenated contributions
+    merged = kv._store["emb"]
+    assert isinstance(merged, sparse.RowSparseNDArray)
+    assert merged.indices_.shape[0] == 4
+    out = sparse.zeros("row_sparse", (10, 4))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array(np.arange(10)))
+    dense = out.asnumpy()
+    oracle = np.zeros((10, 4), np.float32)
+    for i in range(3):
+        oracle[1] += i + 1
+        oracle[5 + i] += i + 1
+    assert np.allclose(dense, oracle)
