@@ -33,3 +33,22 @@ def _seed():
 
     mx.random.seed(0)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: example-family smoke runs too slow for the default tier "
+        "(run with `pytest -m slow tests/test_examples_smoke.py`)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("-m"):
+        return  # explicit marker expression given — let it rule
+    import pytest as _pytest
+
+    skip_slow = _pytest.mark.skip(
+        reason="slow tier: run with -m slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)
