@@ -1,0 +1,10 @@
+# Runtime bootstrap.  Reference analogue: R-package/R/zzz.R dyn.loads the
+# mxnet C API; here the runtime is libmxtpu_rt.so (cpp/src/pyruntime.cc).
+
+mx.init <- function(lib.path = "") {
+  invisible(.Call("mxtpu_r_init", as.character(lib.path)))
+}
+
+mx.version <- function() {
+  .Call("mxtpu_r_version")
+}

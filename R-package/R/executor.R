@@ -1,0 +1,34 @@
+# Executor surface over mxtpu_exec_* (reference analogue:
+# R-package/R/executor.R mx.simple.bind / mx.exec.*).
+
+mx.simple.bind <- function(symbol, shapes) {
+  h <- .Call("mxtpu_r_exec_create", mx.symbol.tojson(symbol))
+  .Call("mxtpu_r_exec_simple_bind", h, names(shapes),
+        lapply(shapes, as.numeric))
+  structure(list(handle = h, symbol = symbol), class = "mx.executor")
+}
+
+mx.exec.set.arg <- function(exec, name, nd) {
+  .Call("mxtpu_r_exec_set_arg", exec$handle, name,
+        nd$data, nd$shape)
+  invisible(exec)
+}
+
+mx.exec.forward <- function(exec, is.train = TRUE) {
+  .Call("mxtpu_r_exec_forward", exec$handle, is.train)
+  invisible(exec)
+}
+
+mx.exec.backward <- function(exec) {
+  .Call("mxtpu_r_exec_backward", exec$handle)
+  invisible(exec)
+}
+
+mx.exec.output <- function(exec, idx = 0L) {
+  out <- .Call("mxtpu_r_exec_output", exec$handle, as.integer(idx))
+  structure(list(data = out[[1]], shape = out[[2]]), class = "mx.ndarray")
+}
+
+mx.exec.grad <- function(exec, name, nelem) {
+  .Call("mxtpu_r_exec_grad", exec$handle, name, as.numeric(nelem))
+}

@@ -1,0 +1,29 @@
+# KVStore surface over mxtpu_kv_* (reference analogue:
+# R-package/R/kvstore.R; the store runs the optimizer server-side the way
+# kv.set.optimizer does in the reference).
+
+mx.kv.create <- function(kind = "local") {
+  structure(list(handle = .Call("mxtpu_r_kv_create", kind)),
+            class = "mx.kvstore")
+}
+
+mx.kv.init <- function(kv, key, nd) {
+  .Call("mxtpu_r_kv_init", kv$handle, as.integer(key), nd$data, nd$shape)
+  invisible(kv)
+}
+
+mx.kv.push <- function(kv, key, data, shape) {
+  .Call("mxtpu_r_kv_push", kv$handle, as.integer(key),
+        as.numeric(data), as.numeric(shape))
+  invisible(kv)
+}
+
+mx.kv.pull <- function(kv, key, nelem) {
+  .Call("mxtpu_r_kv_pull", kv$handle, as.integer(key), as.numeric(nelem))
+}
+
+mx.kv.set.optimizer <- function(kv, name = "sgd", learning.rate = 0.05) {
+  .Call("mxtpu_r_kv_set_optimizer", kv$handle, name,
+        as.numeric(learning.rate))
+  invisible(kv)
+}

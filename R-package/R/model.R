@@ -1,0 +1,103 @@
+# FeedForward-style training loop (reference analogue:
+# R-package/R/model.R mx.model.FeedForward.create — the kv-optimized
+# update loop of python model.py:145-177, in R).
+
+mx.model..param.shapes <- function(symbol, data.shape, hidden) {
+  # shapes for the fc params of an MLP built with mx.symbol.FullyConnected
+  args <- mx.symbol.arguments(symbol)
+  shapes <- list()
+  prev <- data.shape[2]
+  h.i <- 1
+  for (a in args) {
+    if (grepl("_weight$", a)) {
+      shapes[[a]] <- c(hidden[h.i], prev)
+      prev <- hidden[h.i]
+      h.i <- h.i + 1
+    } else if (grepl("_bias$", a)) {
+      shapes[[a]] <- prev
+    }
+  }
+  shapes
+}
+
+mx.model.FeedForward.create <- function(symbol, X, y, batch.size,
+                                        hidden, num.round = 10,
+                                        learning.rate = 0.1,
+                                        kv.type = "local", verbose = TRUE) {
+  n <- nrow(X)
+  d <- ncol(X)
+  shapes <- mx.model..param.shapes(symbol, c(batch.size, d), hidden)
+  params <- list()
+  set.seed(0)
+  for (nm in names(shapes)) {
+    sh <- shapes[[nm]]
+    if (length(sh) > 1) {
+      params[[nm]] <- mx.nd.array(matrix(
+        rnorm(prod(sh), sd = 1 / sqrt(sh[length(sh)])), sh[1], sh[2]))
+    } else {
+      params[[nm]] <- mx.nd.zeros(sh)
+    }
+  }
+
+  bind.shapes <- c(list(data = c(batch.size, d)), shapes,
+                   list(softmax_label = batch.size))
+  exec <- mx.simple.bind(symbol, bind.shapes)
+
+  kv <- mx.kv.create(kv.type)
+  mx.kv.set.optimizer(kv, "sgd", learning.rate)
+  keys <- seq_along(params)
+  for (i in keys) mx.kv.init(kv, i - 1, params[[i]])
+
+  batches <- floor(n / batch.size)
+  for (round in seq_len(num.round)) {
+    hits <- 0
+    for (b in seq_len(batches)) {
+      rows <- ((b - 1) * batch.size + 1):(b * batch.size)
+      xb <- mx.nd.array(X[rows, , drop = FALSE])
+      yb <- mx.nd.array(y[rows])
+      mx.exec.set.arg(exec, "data", xb)
+      mx.exec.set.arg(exec, "softmax_label", yb)
+      for (i in keys) {
+        mx.exec.set.arg(exec, names(params)[i], params[[i]])
+      }
+      mx.exec.forward(exec, TRUE)
+      probs <- mx.exec.output(exec, 0L)
+      pred <- max.col(matrix(probs$data, batch.size, probs$shape[2],
+                             byrow = TRUE)) - 1
+      hits <- hits + sum(pred == y[rows])
+      mx.exec.backward(exec)
+      for (i in keys) {
+        nm <- names(params)[i]
+        gr <- mx.exec.grad(exec, nm, length(params[[nm]]$data))
+        mx.kv.push(kv, i - 1, gr, params[[nm]]$shape)
+        params[[nm]]$data <- mx.kv.pull(kv, i - 1,
+                                        length(params[[nm]]$data))
+      }
+    }
+    if (verbose) {
+      cat(sprintf("round %d: train acc %.4f\n", round,
+                  hits / (batches * batch.size)))
+    }
+  }
+  structure(list(symbol = symbol, params = params, exec = exec,
+                 batch.size = batch.size), class = "mx.model")
+}
+
+mx.model.predict <- function(model, X) {
+  bs <- model$batch.size
+  n <- nrow(X)
+  preds <- integer(0)
+  for (b in seq_len(floor(n / bs))) {
+    rows <- ((b - 1) * bs + 1):(b * bs)
+    mx.exec.set.arg(model$exec, "data",
+                    mx.nd.array(X[rows, , drop = FALSE]))
+    for (nm in names(model$params)) {
+      mx.exec.set.arg(model$exec, nm, model$params[[nm]])
+    }
+    mx.exec.forward(model$exec, FALSE)
+    probs <- mx.exec.output(model$exec, 0L)
+    preds <- c(preds, max.col(matrix(probs$data, bs, probs$shape[2],
+                                     byrow = TRUE)) - 1)
+  }
+  preds
+}

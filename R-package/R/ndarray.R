@@ -1,0 +1,24 @@
+# NDArray surface.  The embedded runtime exchanges flat f32 buffers
+# (row-major, C order); on the R side an mx.ndarray is a numeric vector
+# with a C-order shape attribute.  R matrices are column-major, so
+# converting transposes at the boundary — same convention as the
+# reference R binding's mx.nd.array.
+
+mx.nd.array <- function(src) {
+  if (is.matrix(src)) {
+    shape <- dim(src)
+    data <- as.numeric(t(src))          # to C order
+  } else {
+    shape <- length(src)
+    data <- as.numeric(src)
+  }
+  structure(list(data = data, shape = as.numeric(shape)),
+            class = "mx.ndarray")
+}
+
+mx.nd.zeros <- function(shape) {
+  structure(list(data = numeric(prod(shape)), shape = as.numeric(shape)),
+            class = "mx.ndarray")
+}
+
+mx.nd.shape <- function(nd) nd$shape

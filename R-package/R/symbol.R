@@ -1,0 +1,110 @@
+# Symbol composition: an mx.symbol is a graph fragment (list of node
+# specs + head index) that serializes to the framework's graph JSON
+# (mxnet_tpu/symbol/symbol.py tojson format — same nodes/arg_nodes/heads
+# layout as the reference nnvm JSON).  Thin by design: ops compose JSON,
+# the executor runtime does everything else.
+
+mx.symbol..new <- function(nodes, head) {
+  structure(list(nodes = nodes, head = head), class = "mx.symbol")
+}
+
+mx.symbol.Variable <- function(name) {
+  mx.symbol..new(list(list(op = "null", name = name, attrs = list(),
+                           inputs = list())), 1L)
+}
+
+# merge rhs graph into lhs node list, return (nodes, index map for rhs)
+mx.symbol..merge <- function(nodes, sym) {
+  offset <- length(nodes)
+  remap <- integer(length(sym$nodes))
+  for (i in seq_along(sym$nodes)) {
+    node <- sym$nodes[[i]]
+    # dedup identical variable nodes by name (shared inputs)
+    hit <- 0L
+    if (node$op == "null") {
+      for (j in seq_along(nodes)) {
+        if (nodes[[j]]$op == "null" && nodes[[j]]$name == node$name) {
+          hit <- j
+          break
+        }
+      }
+    }
+    if (hit > 0L) {
+      remap[i] <- hit
+    } else {
+      node$inputs <- lapply(node$inputs, function(e) {
+        c(remap[e[[1]]], e[[2]], e[[3]])
+      })
+      nodes[[length(nodes) + 1L]] <- node
+      remap[i] <- length(nodes)
+    }
+  }
+  list(nodes = nodes, remap = remap)
+}
+
+mx.symbol..apply <- function(op, name, attrs, in.syms) {
+  nodes <- list()
+  heads <- list()
+  for (s in in.syms) {
+    m <- mx.symbol..merge(nodes, s)
+    nodes <- m$nodes
+    heads[[length(heads) + 1L]] <- c(m$remap[s$head], 0L, 0L)
+  }
+  nodes[[length(nodes) + 1L]] <-
+    list(op = op, name = name, attrs = attrs, inputs = heads)
+  mx.symbol..new(nodes, length(nodes))
+}
+
+mx.symbol.FullyConnected <- function(data, num_hidden, name) {
+  w <- mx.symbol.Variable(paste0(name, "_weight"))
+  b <- mx.symbol.Variable(paste0(name, "_bias"))
+  mx.symbol..apply("FullyConnected", name,
+                   list(num_hidden = as.character(num_hidden)),
+                   list(data, w, b))
+}
+
+mx.symbol.Activation <- function(data, act_type, name) {
+  # attr values are reprs in the native JSON (symbol.py tojson)
+  mx.symbol..apply("Activation", name,
+                   list(act_type = paste0("'", act_type, "'")), list(data))
+}
+
+mx.symbol.SoftmaxOutput <- function(data, name) {
+  lab <- mx.symbol.Variable(paste0(name, "_label"))
+  mx.symbol..apply("SoftmaxOutput", name, list(), list(data, lab))
+}
+
+mx.symbol.arguments <- function(sym) {
+  unlist(lapply(Filter(function(n) n$op == "null", sym$nodes),
+                function(n) n$name))
+}
+
+# minimal JSON emitter (no external deps; values are strings/ints/lists)
+mx.symbol..json.str <- function(s) {
+  paste0('"', gsub('"', '\\\\"', s), '"')
+}
+
+mx.symbol.tojson <- function(sym) {
+  node.strs <- character(length(sym$nodes))
+  for (i in seq_along(sym$nodes)) {
+    n <- sym$nodes[[i]]
+    attr.strs <- character(0)
+    for (k in names(n$attrs)) {
+      attr.strs <- c(attr.strs, paste0(mx.symbol..json.str(k), ": ",
+                                       mx.symbol..json.str(n$attrs[[k]])))
+    }
+    input.strs <- vapply(n$inputs, function(e) {
+      paste0("[", e[[1]] - 1L, ", ", e[[2]], ", ", e[[3]], "]")
+    }, character(1))
+    node.strs[i] <- paste0(
+      '{"op": ', mx.symbol..json.str(n$op),
+      ', "name": ', mx.symbol..json.str(n$name),
+      ', "attrs": {', paste(attr.strs, collapse = ", "),
+      '}, "inputs": [', paste(input.strs, collapse = ", "), "]}")
+  }
+  arg.idx <- which(vapply(sym$nodes, function(n) n$op == "null",
+                          logical(1))) - 1L
+  paste0('{"nodes": [', paste(node.strs, collapse = ", "),
+         '], "arg_nodes": [', paste(arg.idx, collapse = ", "),
+         '], "heads": [[', sym$head - 1L, ", 0, 0]]}")
+}

@@ -1,0 +1,276 @@
+/* R .Call shim over the mxnet_tpu embedded-runtime C ABI.
+ *
+ * Reference analogue: R-package/src/ in the reference wraps its C API for
+ * R; here the same role is a ~300-line translation layer onto the
+ * mxtpu_rt_* / mxtpu_exec_* / mxtpu_kv_* surface (cpp/include/mxtpu.h,
+ * implemented by cpp/src/pyruntime.cc).  Handles are int64 values carried
+ * as R doubles (exact for the small ids the runtime issues); R numerics
+ * (double) convert to the runtime's float at the boundary.
+ *
+ * Compiles against real R headers (Rinternals.h) for the installed
+ * package, and against tests/r_stub/Rinternals.h for the hermetic CI
+ * drive (same source, stubbed R memory model).
+ */
+#include <Rinternals.h>
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* ---- dynamic binding to libmxtpu_rt.so ---------------------------------- */
+
+typedef int (*fn_rt_init)(void);
+typedef const char *(*fn_rt_last_error)(void);
+typedef int64_t (*fn_exec_create)(const char *);
+typedef int (*fn_exec_simple_bind)(int64_t, const char **, const int64_t *,
+                                   const int *, int);
+typedef int (*fn_exec_set_arg)(int64_t, const char *, const float *,
+                               const int64_t *, int);
+typedef int (*fn_exec_forward)(int64_t, int);
+typedef int (*fn_exec_backward)(int64_t);
+typedef int (*fn_exec_num_outputs)(int64_t);
+typedef int (*fn_exec_output_shape)(int64_t, int, int64_t *, int *, int);
+typedef int (*fn_exec_output)(int64_t, int, float *, int64_t);
+typedef int (*fn_exec_grad)(int64_t, const char *, float *, int64_t);
+typedef int64_t (*fn_kv_create)(const char *);
+typedef int (*fn_kv_init)(int64_t, int, const float *, const int64_t *, int);
+typedef int (*fn_kv_push)(int64_t, int, const float *, const int64_t *, int);
+typedef int (*fn_kv_pull)(int64_t, int, float *, int64_t);
+typedef int (*fn_kv_set_optimizer)(int64_t, const char *, float);
+typedef const char *(*fn_version)(void);
+
+static struct {
+  void *lib;
+  fn_rt_init rt_init;
+  fn_rt_last_error rt_last_error;
+  fn_exec_create exec_create;
+  fn_exec_simple_bind exec_simple_bind;
+  fn_exec_set_arg exec_set_arg;
+  fn_exec_forward exec_forward;
+  fn_exec_backward exec_backward;
+  fn_exec_num_outputs exec_num_outputs;
+  fn_exec_output_shape exec_output_shape;
+  fn_exec_output exec_output;
+  fn_exec_grad exec_grad;
+  fn_kv_create kv_create;
+  fn_kv_init kv_init;
+  fn_kv_push kv_push;
+  fn_kv_pull kv_pull;
+  fn_kv_set_optimizer kv_set_optimizer;
+  fn_version version;
+} g;
+
+static void *need_sym(const char *name) {
+  void *p = dlsym(g.lib, name);
+  if (!p) Rf_error("libmxtpu_rt.so: missing symbol %s", name);
+  return p;
+}
+
+static void check_rc(int rc, const char *what) {
+  if (rc != 0)
+    Rf_error("%s failed: %s", what,
+             g.rt_last_error ? g.rt_last_error() : "(no error fn)");
+}
+
+/* mxtpu_r_init(path): dlopen the runtime and initialize the embedded
+ * interpreter.  path == "" tries the default lookup. */
+SEXP mxtpu_r_init(SEXP path) {
+  const char *p = CHAR(STRING_ELT(path, 0));
+  if (g.lib == NULL) {
+    g.lib = dlopen(p[0] ? p : "libmxtpu_rt.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!g.lib) Rf_error("cannot dlopen %s: %s", p, dlerror());
+    g.rt_init = (fn_rt_init)need_sym("mxtpu_rt_init");
+    g.rt_last_error = (fn_rt_last_error)need_sym("mxtpu_rt_last_error");
+    g.exec_create = (fn_exec_create)need_sym("mxtpu_exec_create");
+    g.exec_simple_bind =
+        (fn_exec_simple_bind)need_sym("mxtpu_exec_simple_bind");
+    g.exec_set_arg = (fn_exec_set_arg)need_sym("mxtpu_exec_set_arg");
+    g.exec_forward = (fn_exec_forward)need_sym("mxtpu_exec_forward");
+    g.exec_backward = (fn_exec_backward)need_sym("mxtpu_exec_backward");
+    g.exec_num_outputs =
+        (fn_exec_num_outputs)need_sym("mxtpu_exec_num_outputs");
+    g.exec_output_shape =
+        (fn_exec_output_shape)need_sym("mxtpu_exec_output_shape");
+    g.exec_output = (fn_exec_output)need_sym("mxtpu_exec_output");
+    g.exec_grad = (fn_exec_grad)need_sym("mxtpu_exec_grad");
+    g.kv_create = (fn_kv_create)need_sym("mxtpu_kv_create");
+    g.kv_init = (fn_kv_init)need_sym("mxtpu_kv_init");
+    g.kv_push = (fn_kv_push)need_sym("mxtpu_kv_push");
+    g.kv_pull = (fn_kv_pull)need_sym("mxtpu_kv_pull");
+    g.kv_set_optimizer =
+        (fn_kv_set_optimizer)need_sym("mxtpu_kv_set_optimizer");
+    g.version = (fn_version)dlsym(g.lib, "mxtpu_version");
+    check_rc(g.rt_init(), "mxtpu_rt_init");
+  }
+  return R_NilValue;
+}
+
+SEXP mxtpu_r_version(void) {
+  return mkString(g.version ? g.version() : "unknown");
+}
+
+/* ---- executor ----------------------------------------------------------- */
+
+SEXP mxtpu_r_exec_create(SEXP json) {
+  int64_t h = g.exec_create(CHAR(STRING_ELT(json, 0)));
+  if (h < 0) check_rc(-1, "mxtpu_exec_create");
+  SEXP out = PROTECT(allocVector(REALSXP, 1));
+  REAL(out)[0] = (double)h;
+  UNPROTECT(1);
+  return out;
+}
+
+/* names: character vector; shapes: list of numeric vectors (same length) */
+SEXP mxtpu_r_exec_simple_bind(SEXP hx, SEXP names, SEXP shapes) {
+  int64_t h = (int64_t)asReal(hx);
+  int n = (int)XLENGTH(names);
+  const char **cnames =
+      (const char **)malloc(sizeof(const char *) * (size_t)n);
+  int *ndims = (int *)malloc(sizeof(int) * (size_t)n);
+  int64_t total = 0;
+  for (int i = 0; i < n; ++i) {
+    cnames[i] = CHAR(STRING_ELT(names, i));
+    ndims[i] = (int)XLENGTH(VECTOR_ELT(shapes, i));
+    total += ndims[i];
+  }
+  int64_t *dims = (int64_t *)malloc(sizeof(int64_t) * (size_t)total);
+  int64_t k = 0;
+  for (int i = 0; i < n; ++i) {
+    SEXP s = VECTOR_ELT(shapes, i);
+    for (int d = 0; d < ndims[i]; ++d) dims[k++] = (int64_t)REAL(s)[d];
+  }
+  int rc = g.exec_simple_bind(h, cnames, dims, ndims, n);
+  free(dims);
+  free(ndims);
+  free((void *)cnames);
+  check_rc(rc, "mxtpu_exec_simple_bind");
+  return R_NilValue;
+}
+
+SEXP mxtpu_r_exec_set_arg(SEXP hx, SEXP name, SEXP data, SEXP shape) {
+  int64_t h = (int64_t)asReal(hx);
+  int64_t n = (int64_t)XLENGTH(data);
+  int ndim = (int)XLENGTH(shape);
+  float *buf = (float *)malloc(sizeof(float) * (size_t)n);
+  int64_t dims[16];
+  for (int64_t i = 0; i < n; ++i) buf[i] = (float)REAL(data)[i];
+  for (int d = 0; d < ndim && d < 16; ++d) dims[d] = (int64_t)REAL(shape)[d];
+  int rc = g.exec_set_arg(h, CHAR(STRING_ELT(name, 0)), buf, dims, ndim);
+  free(buf);
+  check_rc(rc, "mxtpu_exec_set_arg");
+  return R_NilValue;
+}
+
+SEXP mxtpu_r_exec_forward(SEXP hx, SEXP is_train) {
+  check_rc(g.exec_forward((int64_t)asReal(hx), asLogical(is_train)),
+           "mxtpu_exec_forward");
+  return R_NilValue;
+}
+
+SEXP mxtpu_r_exec_backward(SEXP hx) {
+  check_rc(g.exec_backward((int64_t)asReal(hx)), "mxtpu_exec_backward");
+  return R_NilValue;
+}
+
+/* returns list(data = numeric, shape = numeric) */
+SEXP mxtpu_r_exec_output(SEXP hx, SEXP idx) {
+  int64_t h = (int64_t)asReal(hx);
+  int i = asInteger(idx);
+  int64_t dims[16];
+  int ndim = 0;
+  check_rc(g.exec_output_shape(h, i, dims, &ndim, 16),
+           "mxtpu_exec_output_shape");
+  int64_t n = 1;
+  for (int d = 0; d < ndim; ++d) n *= dims[d];
+  float *buf = (float *)malloc(sizeof(float) * (size_t)n);
+  int rc = g.exec_output(h, i, buf, n);
+  if (rc != 0) {
+    free(buf);
+    check_rc(rc, "mxtpu_exec_output");
+  }
+  SEXP data = PROTECT(allocVector(REALSXP, (R_xlen_t)n));
+  for (int64_t j = 0; j < n; ++j) REAL(data)[j] = (double)buf[j];
+  free(buf);
+  SEXP shape = PROTECT(allocVector(REALSXP, ndim));
+  for (int d = 0; d < ndim; ++d) REAL(shape)[d] = (double)dims[d];
+  SEXP out = PROTECT(allocVector(VECSXP, 2));
+  SET_VECTOR_ELT(out, 0, data);
+  SET_VECTOR_ELT(out, 1, shape);
+  UNPROTECT(3);
+  return out;
+}
+
+SEXP mxtpu_r_exec_grad(SEXP hx, SEXP name, SEXP nelem) {
+  int64_t h = (int64_t)asReal(hx);
+  int64_t n = (int64_t)asReal(nelem);
+  float *buf = (float *)malloc(sizeof(float) * (size_t)n);
+  int rc = g.exec_grad(h, CHAR(STRING_ELT(name, 0)), buf, n);
+  if (rc != 0) {
+    free(buf);
+    check_rc(rc, "mxtpu_exec_grad");
+  }
+  SEXP out = PROTECT(allocVector(REALSXP, (R_xlen_t)n));
+  for (int64_t j = 0; j < n; ++j) REAL(out)[j] = (double)buf[j];
+  free(buf);
+  UNPROTECT(1);
+  return out;
+}
+
+/* ---- kvstore ------------------------------------------------------------ */
+
+SEXP mxtpu_r_kv_create(SEXP kind) {
+  int64_t h = g.kv_create(CHAR(STRING_ELT(kind, 0)));
+  if (h < 0) check_rc(-1, "mxtpu_kv_create");
+  SEXP out = PROTECT(allocVector(REALSXP, 1));
+  REAL(out)[0] = (double)h;
+  UNPROTECT(1);
+  return out;
+}
+
+static int kv_data_call(int (*fn)(int64_t, int, const float *,
+                                  const int64_t *, int),
+                        SEXP hx, SEXP key, SEXP data, SEXP shape) {
+  int64_t n = (int64_t)XLENGTH(data);
+  int ndim = (int)XLENGTH(shape);
+  float *buf = (float *)malloc(sizeof(float) * (size_t)n);
+  int64_t dims[16];
+  for (int64_t i = 0; i < n; ++i) buf[i] = (float)REAL(data)[i];
+  for (int d = 0; d < ndim && d < 16; ++d) dims[d] = (int64_t)REAL(shape)[d];
+  int rc = fn((int64_t)asReal(hx), asInteger(key), buf, dims, ndim);
+  free(buf);
+  return rc;
+}
+
+SEXP mxtpu_r_kv_init(SEXP hx, SEXP key, SEXP data, SEXP shape) {
+  check_rc(kv_data_call(g.kv_init, hx, key, data, shape), "mxtpu_kv_init");
+  return R_NilValue;
+}
+
+SEXP mxtpu_r_kv_push(SEXP hx, SEXP key, SEXP data, SEXP shape) {
+  check_rc(kv_data_call(g.kv_push, hx, key, data, shape), "mxtpu_kv_push");
+  return R_NilValue;
+}
+
+SEXP mxtpu_r_kv_pull(SEXP hx, SEXP key, SEXP nelem) {
+  int64_t n = (int64_t)asReal(nelem);
+  float *buf = (float *)malloc(sizeof(float) * (size_t)n);
+  int rc = g.kv_pull((int64_t)asReal(hx), asInteger(key), buf, n);
+  if (rc != 0) {
+    free(buf);
+    check_rc(rc, "mxtpu_kv_pull");
+  }
+  SEXP out = PROTECT(allocVector(REALSXP, (R_xlen_t)n));
+  for (int64_t j = 0; j < n; ++j) REAL(out)[j] = (double)buf[j];
+  free(buf);
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxtpu_r_kv_set_optimizer(SEXP hx, SEXP name, SEXP lr) {
+  check_rc(g.kv_set_optimizer((int64_t)asReal(hx),
+                              CHAR(STRING_ELT(name, 0)), (float)asReal(lr)),
+           "mxtpu_kv_set_optimizer");
+  return R_NilValue;
+}

@@ -1,0 +1,33 @@
+# R train-MLP parity test (mirrors cpp-package/example/train_mlp.cc):
+# synthetic separable task, 2-layer MLP, kv-optimized SGD; final accuracy
+# must clear 0.85.  Needs an R toolchain:
+#   R CMD INSTALL R-package   (builds src/mxtpu_r.c against real R headers)
+#   MXTPU_RT_PLATFORM=cpu MXTPU_RT_HOME=/path/to/repo Rscript tests/train_mlp.R
+# The hermetic CI equivalent (no R in the image) drives the same shim via
+# tests/r_stub — see tests/test_r_binding.py at the repo root.
+
+library(mxtpu)
+
+mx.init(Sys.getenv("MXTPU_RT_LIB", "cpp/build/libmxtpu_rt.so"))
+cat("runtime:", mx.version(), "\n")
+
+B <- 64; D <- 32; C <- 10; N <- 64 * 24
+set.seed(0)
+wstar <- matrix(rnorm(D * C), D, C)
+X <- matrix(runif(N * D), N, D)
+y <- max.col(X %*% wstar) - 1
+
+data <- mx.symbol.Variable("data")
+fc1 <- mx.symbol.FullyConnected(data, num_hidden = 64, name = "fc1")
+act <- mx.symbol.Activation(fc1, act_type = "relu", name = "relu1")
+fc2 <- mx.symbol.FullyConnected(act, num_hidden = C, name = "fc2")
+net <- mx.symbol.SoftmaxOutput(fc2, name = "softmax")
+
+model <- mx.model.FeedForward.create(net, X, y, batch.size = B,
+                                     hidden = c(64, C), num.round = 12,
+                                     learning.rate = 0.2)
+pred <- mx.model.predict(model, X)
+acc <- mean(pred == y[seq_along(pred)])
+cat(sprintf("final train accuracy: %.4f\n", acc))
+stopifnot(acc > 0.85)
+cat("R binding train-MLP parity: OK\n")

@@ -97,7 +97,10 @@ def rt_exec_bind(h, names, shapes):
 
 def rt_exec_set_arg(h, name, mv, shape):
     exe = _H[h]["exe"]
-    a = _np.frombuffer(mv, dtype=_np.float32).reshape(tuple(shape))
+    # .copy(): the ABI contract lets callers free the buffer on return, but
+    # the jnp write below reads it lazily (async dispatch / zero-copy
+    # aliasing) — without the copy a prompt free() is a use-after-free
+    a = _np.frombuffer(mv, dtype=_np.float32).reshape(tuple(shape)).copy()
     exe.arg_dict[name][:] = _mx.nd.array(a)
     return 0
 

@@ -1,0 +1,53 @@
+/* Minimal stub of the R extension API surface used by
+ * R-package/src/mxtpu_r.c, so the shim compiles and RUNS in an image with
+ * no R toolchain.  This mocks only memory/marshaling (SEXP as a tagged
+ * heap record, PROTECT as no-op); semantics R actually guarantees (GC,
+ * attribute handling) are out of scope — the real-R path is exercised by
+ * R-package/tests/train_mlp.R wherever Rscript exists. */
+#ifndef MXTPU_R_STUB_RINTERNALS_H_
+#define MXTPU_R_STUB_RINTERNALS_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef long R_xlen_t;
+
+typedef struct r_stub_sexp {
+  int type;
+  R_xlen_t n;
+  double *reals;
+  char *chars;               /* CHARSXP payload */
+  struct r_stub_sexp **vec;  /* STRSXP / VECSXP elements */
+} *SEXP;
+
+#define REALSXP 14
+#define STRSXP 16
+#define VECSXP 19
+#define CHARSXP 9
+
+extern SEXP R_NilValue;
+
+SEXP allocVector(int type, R_xlen_t n);
+double *REAL(SEXP x);
+double asReal(SEXP x);
+int asInteger(SEXP x);
+int asLogical(SEXP x);
+R_xlen_t XLENGTH(SEXP x);
+SEXP mkChar(const char *s);
+SEXP mkString(const char *s);
+SEXP STRING_ELT(SEXP x, R_xlen_t i);
+void SET_STRING_ELT(SEXP x, R_xlen_t i, SEXP v);
+const char *CHAR(SEXP x);
+SEXP VECTOR_ELT(SEXP x, R_xlen_t i);
+void SET_VECTOR_ELT(SEXP x, R_xlen_t i, SEXP v);
+void Rf_error(const char *fmt, ...);
+
+#define PROTECT(x) (x)
+#define UNPROTECT(n) ((void)(n))
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_R_STUB_RINTERNALS_H_ */

@@ -1,0 +1,173 @@
+/* Hermetic drive of the R binding shim: performs exactly the .Call
+ * sequence R-package/R/model.R makes for the train-MLP parity task
+ * (mirrors cpp-package/example/train_mlp.cc), through mxtpu_r.c's SEXP
+ * marshaling on the stub R API.  Exit 0 iff final accuracy > 0.85. */
+#include "Rinternals.h"
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* shim entry points (R-package/src/mxtpu_r.c) */
+SEXP mxtpu_r_init(SEXP path);
+SEXP mxtpu_r_version(void);
+SEXP mxtpu_r_exec_create(SEXP json);
+SEXP mxtpu_r_exec_simple_bind(SEXP h, SEXP names, SEXP shapes);
+SEXP mxtpu_r_exec_set_arg(SEXP h, SEXP name, SEXP data, SEXP shape);
+SEXP mxtpu_r_exec_forward(SEXP h, SEXP is_train);
+SEXP mxtpu_r_exec_backward(SEXP h);
+SEXP mxtpu_r_exec_output(SEXP h, SEXP idx);
+SEXP mxtpu_r_exec_grad(SEXP h, SEXP name, SEXP nelem);
+SEXP mxtpu_r_kv_create(SEXP kind);
+SEXP mxtpu_r_kv_init(SEXP h, SEXP key, SEXP data, SEXP shape);
+SEXP mxtpu_r_kv_push(SEXP h, SEXP key, SEXP data, SEXP shape);
+SEXP mxtpu_r_kv_pull(SEXP h, SEXP key, SEXP nelem);
+SEXP mxtpu_r_kv_set_optimizer(SEXP h, SEXP name, SEXP lr);
+
+/* the JSON mx.symbol.tojson(R code in symbol.R) emits for the MLP; the
+ * Python runtime parses it identically to the cpp-package example's */
+static const char *kMlpJson =
+    "{\"nodes\": ["
+    "{\"op\": \"null\", \"name\": \"data\", \"attrs\": {}, \"inputs\": []}, "
+    "{\"op\": \"null\", \"name\": \"fc1_weight\", \"attrs\": {}, \"inputs\": []}, "
+    "{\"op\": \"null\", \"name\": \"fc1_bias\", \"attrs\": {}, \"inputs\": []}, "
+    "{\"op\": \"FullyConnected\", \"name\": \"fc1\", \"attrs\": {\"num_hidden\": \"64\"}, "
+    "\"inputs\": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]}, "
+    "{\"op\": \"Activation\", \"name\": \"relu1\", \"attrs\": {\"act_type\": \"'relu'\"}, "
+    "\"inputs\": [[3, 0, 0]]}, "
+    "{\"op\": \"null\", \"name\": \"fc2_weight\", \"attrs\": {}, \"inputs\": []}, "
+    "{\"op\": \"null\", \"name\": \"fc2_bias\", \"attrs\": {}, \"inputs\": []}, "
+    "{\"op\": \"FullyConnected\", \"name\": \"fc2\", \"attrs\": {\"num_hidden\": \"10\"}, "
+    "\"inputs\": [[4, 0, 0], [5, 0, 0], [6, 0, 0]]}, "
+    "{\"op\": \"null\", \"name\": \"softmax_label\", \"attrs\": {}, \"inputs\": []}, "
+    "{\"op\": \"SoftmaxOutput\", \"name\": \"softmax\", \"attrs\": {}, "
+    "\"inputs\": [[7, 0, 0], [8, 0, 0]]}], "
+    "\"arg_nodes\": [0, 1, 2, 5, 6, 8], "
+    "\"heads\": [[9, 0, 0]]}";
+
+static SEXP num_vec(const double *v, long n) {
+  SEXP x = allocVector(REALSXP, n);
+  for (long i = 0; i < n; ++i) REAL(x)[i] = v[i];
+  return x;
+}
+
+static SEXP num1(double v) { return num_vec(&v, 1); }
+
+static double frand(unsigned *seed) {
+  *seed = *seed * 1664525u + 1013904223u;
+  return ((double)(*seed) + 0.5) / 4294967296.0;
+}
+
+/* Box-Muller, matching the gaussian task/init of train_mlp.cc */
+static double grand_(unsigned *seed) {
+  double u1 = frand(seed), u2 = frand(seed);
+  return sqrt(-2.0 * log(u1)) * cos(6.283185307179586 * u2);
+}
+
+int main(void) {
+  setenv("MXTPU_RT_PLATFORM", "cpu", 0);
+  setenv("MXTPU_RT_HOME", ".", 0);
+  const char *lib = getenv("MXTPU_RT_LIB");
+  mxtpu_r_init(mkString(lib ? lib : "cpp/build/libmxtpu_rt.so"));
+  printf("runtime: %s\n", CHAR(STRING_ELT(mxtpu_r_version(), 0)));
+
+  enum { B = 64, D = 32, C = 10, EPOCHS = 30, BATCHES = 24 };
+  unsigned seed = 7u;
+
+  /* synthetic separable task: label = argmax(x . W*); X centered so
+   no class's score is mean-dominated (balanced labels) */
+  static double wstar[D * C], X[BATCHES * B * D], Y[BATCHES * B];
+  for (int i = 0; i < D * C; ++i) wstar[i] = grand_(&seed);
+  for (int i = 0; i < BATCHES * B; ++i) {
+    double best = -1e30;
+    int arg = 0;
+    for (int d = 0; d < D; ++d) X[i * D + d] = frand(&seed) - 0.5;
+    for (int c = 0; c < C; ++c) {
+      double s = 0;
+      for (int d = 0; d < D; ++d) s += X[i * D + d] * wstar[d * C + c];
+      if (s > best) { best = s; arg = c; }
+    }
+    Y[i] = (double)arg;
+  }
+
+  SEXP h = mxtpu_r_exec_create(mkString(kMlpJson));
+
+  /* simple_bind(names, shapes) exactly as mx.simple.bind sends them */
+  const char *names[6] = {"data", "fc1_weight", "fc1_bias",
+                          "fc2_weight", "fc2_bias", "softmax_label"};
+  double shp_data[2] = {B, D}, shp_w1[2] = {64, D}, shp_b1[1] = {64},
+         shp_w2[2] = {10, 64}, shp_b2[1] = {10}, shp_y[1] = {B};
+  SEXP rnames = allocVector(STRSXP, 6);
+  for (int i = 0; i < 6; ++i) SET_STRING_ELT(rnames, i, mkChar(names[i]));
+  SEXP shapes = allocVector(VECSXP, 6);
+  SET_VECTOR_ELT(shapes, 0, num_vec(shp_data, 2));
+  SET_VECTOR_ELT(shapes, 1, num_vec(shp_w1, 2));
+  SET_VECTOR_ELT(shapes, 2, num_vec(shp_b1, 1));
+  SET_VECTOR_ELT(shapes, 3, num_vec(shp_w2, 2));
+  SET_VECTOR_ELT(shapes, 4, num_vec(shp_b2, 1));
+  SET_VECTOR_ELT(shapes, 5, num_vec(shp_y, 1));
+  mxtpu_r_exec_simple_bind(h, rnames, shapes);
+
+  /* params, kv-optimized like mx.model.FeedForward.create */
+  struct {
+    const char *name;
+    double *shape;
+    int ndim;
+    long n;
+    double *val;
+  } ps[4] = {
+      {"fc1_weight", shp_w1, 2, 64 * D, 0},
+      {"fc1_bias", shp_b1, 1, 64, 0},
+      {"fc2_weight", shp_w2, 2, 10 * 64, 0},
+      {"fc2_bias", shp_b2, 1, 10, 0},
+  };
+  SEXP kv = mxtpu_r_kv_create(mkString("local"));
+  mxtpu_r_kv_set_optimizer(kv, mkString("sgd"), num1(0.05));
+  for (int k = 0; k < 4; ++k) {
+    ps[k].val = (double *)calloc((size_t)ps[k].n, sizeof(double));
+    double scale = 1.0 / sqrt(ps[k].shape[ps[k].ndim - 1]);
+    if (ps[k].ndim > 1)
+      for (long i = 0; i < ps[k].n; ++i)
+        ps[k].val[i] = grand_(&seed) * scale;
+    mxtpu_r_kv_init(kv, num1(k), num_vec(ps[k].val, ps[k].n),
+                    num_vec(ps[k].shape, ps[k].ndim));
+  }
+
+  double acc = 0;
+  for (int epoch = 0; epoch < EPOCHS; ++epoch) {
+    int hits = 0;
+    for (int b = 0; b < BATCHES; ++b) {
+      mxtpu_r_exec_set_arg(h, mkString("data"),
+                           num_vec(&X[b * B * D], B * D),
+                           num_vec(shp_data, 2));
+      mxtpu_r_exec_set_arg(h, mkString("softmax_label"),
+                           num_vec(&Y[b * B], B), num_vec(shp_y, 1));
+      for (int k = 0; k < 4; ++k)
+        mxtpu_r_exec_set_arg(h, mkString(ps[k].name),
+                             num_vec(ps[k].val, ps[k].n),
+                             num_vec(ps[k].shape, ps[k].ndim));
+      mxtpu_r_exec_forward(h, num1(1));
+      SEXP out = mxtpu_r_exec_output(h, num1(0));
+      double *probs = REAL(VECTOR_ELT(out, 0));
+      for (int i = 0; i < B; ++i) {
+        int arg = 0;
+        for (int c = 1; c < C; ++c)
+          if (probs[i * C + c] > probs[i * C + arg]) arg = c;
+        if (arg == (int)Y[b * B + i]) ++hits;
+      }
+      mxtpu_r_exec_backward(h);
+      for (int k = 0; k < 4; ++k) {
+        SEXP gr = mxtpu_r_exec_grad(h, mkString(ps[k].name),
+                                    num1((double)ps[k].n));
+        mxtpu_r_kv_push(kv, num1(k), gr, num_vec(ps[k].shape, ps[k].ndim));
+        SEXP nv = mxtpu_r_kv_pull(kv, num1(k), num1((double)ps[k].n));
+        memcpy(ps[k].val, REAL(nv), sizeof(double) * (size_t)ps[k].n);
+      }
+    }
+    acc = (double)hits / (BATCHES * B);
+    printf("epoch %d: train acc %.4f\n", epoch, acc);
+  }
+  printf("final train accuracy: %.4f\n", acc);
+  return acc > 0.85 ? 0 : 1;
+}

@@ -1,0 +1,59 @@
+/* Implementation of the stub R API (see Rinternals.h here).  Leaks by
+ * design — the drive is a short-lived test process. */
+#include "Rinternals.h"
+
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static struct r_stub_sexp nil_rec = {0, 0, 0, 0, 0};
+SEXP R_NilValue = &nil_rec;
+
+SEXP allocVector(int type, R_xlen_t n) {
+  SEXP x = (SEXP)calloc(1, sizeof(struct r_stub_sexp));
+  x->type = type;
+  x->n = n;
+  if (type == REALSXP) {
+    x->reals = (double *)calloc((size_t)(n > 0 ? n : 1), sizeof(double));
+  } else if (type == STRSXP || type == VECSXP) {
+    x->vec = (SEXP *)calloc((size_t)(n > 0 ? n : 1), sizeof(SEXP));
+  }
+  return x;
+}
+
+double *REAL(SEXP x) { return x->reals; }
+double asReal(SEXP x) { return x->n > 0 ? x->reals[0] : 0.0; }
+int asInteger(SEXP x) { return (int)asReal(x); }
+int asLogical(SEXP x) { return asReal(x) != 0.0; }
+R_xlen_t XLENGTH(SEXP x) { return x->n; }
+
+SEXP mkChar(const char *s) {
+  SEXP x = (SEXP)calloc(1, sizeof(struct r_stub_sexp));
+  x->type = CHARSXP;
+  x->n = (R_xlen_t)strlen(s);
+  x->chars = strdup(s);
+  return x;
+}
+
+SEXP mkString(const char *s) {
+  SEXP x = allocVector(STRSXP, 1);
+  x->vec[0] = mkChar(s);
+  return x;
+}
+
+SEXP STRING_ELT(SEXP x, R_xlen_t i) { return x->vec[i]; }
+void SET_STRING_ELT(SEXP x, R_xlen_t i, SEXP v) { x->vec[i] = v; }
+const char *CHAR(SEXP x) { return x->chars; }
+SEXP VECTOR_ELT(SEXP x, R_xlen_t i) { return x->vec[i]; }
+void SET_VECTOR_ELT(SEXP x, R_xlen_t i, SEXP v) { x->vec[i] = v; }
+
+void Rf_error(const char *fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  fprintf(stderr, "Rf_error: ");
+  vfprintf(stderr, fmt, ap);
+  fprintf(stderr, "\n");
+  va_end(ap);
+  exit(2);
+}
