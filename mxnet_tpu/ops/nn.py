@@ -250,14 +250,41 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
     stateful frontends (NDArray/Gluon) rather than mutated here — see
     ndarray/__init__.py `_STATEFUL_BN` handling.
     """
+    import os
+
     ax = int(axis) % data.ndim  # normalize axis=-1 (channels-last BN)
     red = tuple(i for i in range(data.ndim) if i != ax)
     shape = [1] * data.ndim
     shape[ax] = data.shape[ax]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if _training and not use_global_stats:
-        mean = jnp.mean(data.astype(jnp.float32), axis=red)
-        var = jnp.var(data.astype(jnp.float32), axis=red)
+        if (os.environ.get("MXTPU_BN_PALLAS") == "1" and ax == data.ndim - 1
+                and data.shape[ax] % 128 == 0):
+            # fused Pallas stats+normalize for channels-minor layouts
+            # (docs/perf_analysis.md: the train-fwd BN-stat passes).  NOTE:
+            # the env var is read at TRACE time and baked into jit caches —
+            # A/B it across fresh processes (tools/perf_sweep.py does), not
+            # by flipping os.environ mid-run.
+            from . import pallas_kernels as _pk
+
+            out, mean, var = _pk.bn_train_fused(data, g, beta, float(eps), ax)
+            if output_mean_var:
+                return out, mean, var
+            return out
+        xf = data.astype(jnp.float32)
+        # ONE pass over the activation: sum and sum-of-squares are sibling
+        # reductions over the same operand, which XLA multi-output-fuses
+        # into a single read (jnp.var's (x - mean)**2 form costs a second
+        # full pass).  The raw E[x^2] - mean^2 form cancels catastrophically
+        # at large mean/std, so recenter around a cheap per-channel pivot
+        # (one sampled row): E[(x-p)^2] - (mean-p)^2 is exact for any
+        # constant p and keeps the relative error O(((mean-p)/std)^2) ~ O(1)
+        slicer = tuple(slice(None) if i == ax else 0 for i in range(data.ndim))
+        pivot = lax.stop_gradient(xf[slicer]).reshape(shape)
+        xc = xf - pivot
+        mean_c = jnp.mean(xc, axis=red)
+        var = jnp.maximum(jnp.mean(xc * xc, axis=red) - mean_c * mean_c, 0.0)
+        mean = mean_c + pivot.reshape(-1)
     else:
         mean, var = moving_mean, moving_var
     inv = lax.rsqrt(var + eps)

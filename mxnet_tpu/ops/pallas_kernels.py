@@ -222,3 +222,138 @@ def _flash_bwd(causal, scale, res, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm train-mode stats + normalize (reference:
+# src/operator/nn/batch_norm.cc train-mode forward; cuDNN fuses these the
+# same way).  Measured r04 cost: train fwd = 61% of eval fwd purely from
+# the batch-stat passes (docs/perf_analysis.md).  Layout: channels-minor
+# (NHWC collapsed to (M, C)) so C rides the 128-lane dim.
+#
+# stats kernel: ONE read of the activation produces both sum and sum-of-
+# squares (TPU grid steps run sequentially, so partial sums accumulate into
+# the same (1, C) output block across the grid).  normalize kernel: one
+# read + one write applying (x - mean) * scale + shift.
+# ---------------------------------------------------------------------------
+
+def _bn_stats_kernel(x_ref, pivot_ref, s1_ref, s2_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
+
+    # recentered around a per-channel pivot to avoid the E[x^2] - mean^2
+    # cancellation at large mean/std (see batch_norm's one-pass comment)
+    x = x_ref[...].astype(jnp.float32) - pivot_ref[...]
+    s1_ref[...] += jnp.sum(x, axis=0, keepdims=True)
+    s2_ref[...] += jnp.sum(x * x, axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "interpret"))
+def _bn_stats_call(x2d, pivot, block_m, interpret):
+    m, c = x2d.shape
+    s1, s2 = pl.pallas_call(
+        _bn_stats_kernel,
+        grid=(m // block_m,),
+        in_specs=[pl.BlockSpec((block_m, c), lambda i: (i, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0))],
+        out_specs=(pl.BlockSpec((1, c), lambda i: (0, 0)),
+                   pl.BlockSpec((1, c), lambda i: (0, 0))),
+        out_shape=(jax.ShapeDtypeStruct((1, c), jnp.float32),
+                   jax.ShapeDtypeStruct((1, c), jnp.float32)),
+        interpret=interpret,
+    )(x2d, pivot.reshape(1, c))
+    return s1[0], s2[0]
+
+
+def _bn_norm_kernel(x_ref, scale_ref, shift_ref, o_ref):
+    # shift form: mean is folded into shift = beta - mean*scale already
+    xf = x_ref[...].astype(jnp.float32)
+    o_ref[...] = (xf * scale_ref[...] + shift_ref[...]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "interpret"))
+def _bn_norm_call(x2d, scale, shift, block_m, interpret):
+    m, c = x2d.shape
+    bcast = [pl.BlockSpec((1, c), lambda i: (0, 0))] * 2
+    return pl.pallas_call(
+        _bn_norm_kernel,
+        grid=(m // block_m,),
+        in_specs=[pl.BlockSpec((block_m, c), lambda i: (i, 0))] + bcast,
+        out_specs=pl.BlockSpec((block_m, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, c), x2d.dtype),
+        interpret=interpret,
+    )(x2d, scale.reshape(1, c), shift.reshape(1, c))
+
+
+def _bn_block_m(m: int) -> int:
+    """Largest power-of-two block dividing m; < 8 means the shape is
+    kernel-hostile (odd row counts) and the caller falls back to XLA."""
+    for cand in (1024, 512, 256, 128, 64, 32, 16, 8):
+        if m % cand == 0:
+            return cand
+    return 1
+
+
+def _bn_train_reference(x, gamma, beta, eps):
+    """jnp reference of the fused forward (channels-last) — the vjp donor
+    for the backward pass, like _flash_bwd replays local_attention."""
+    xf = x.astype(jnp.float32)
+    red = tuple(range(x.ndim - 1))
+    pivot = jax.lax.stop_gradient(xf[(0,) * (x.ndim - 1)])
+    xc = xf - pivot
+    mean_c = jnp.mean(xc, axis=red)
+    var = jnp.maximum(jnp.mean(xc * xc, axis=red) - mean_c * mean_c, 0.0)
+    mean = mean_c + pivot
+    inv = jax.lax.rsqrt(var + eps)
+    out = ((xf - mean) * (gamma.astype(jnp.float32) * inv)
+           + beta.astype(jnp.float32)).astype(x.dtype)
+    return out, mean, var
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def bn_train_fused(x, gamma, beta, eps, channel_axis):
+    """Fused train-mode BN over channels-minor data.  Returns
+    (out, mean, var) — mean/var so the stateful frontends can run their
+    running-stat update (gluon calls with output_mean_var=True).  x of any
+    rank with channels on `channel_axis` == last axis; kernel-hostile row
+    counts (odd M) fall back to the jnp reference."""
+    out, _res = _bn_fused_fwd(x, gamma, beta, eps, channel_axis)
+    return out
+
+
+def _bn_fused_fwd(x, gamma, beta, eps, channel_axis):
+    shape = x.shape
+    c = shape[channel_axis]
+    x2d = x.reshape(-1, c)
+    m = x2d.shape[0]
+    block_m = _bn_block_m(m)
+    if block_m < 8:  # odd row count: tiny blocks would be slower than XLA
+        out, mean, var = _bn_train_reference(x, gamma, beta, eps)
+        return (out, mean, var), (x, gamma, beta)
+    interp = _use_interpret()
+    pivot = jax.lax.stop_gradient(x2d[0].astype(jnp.float32))
+    s1, s2 = _bn_stats_call(x2d, pivot, block_m, interp)
+    n = jnp.float32(m)
+    mean_c = s1 / n
+    var = jnp.maximum(s2 / n - mean_c * mean_c, 0.0)
+    mean = mean_c + pivot
+    scale = gamma.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+    shift = beta.astype(jnp.float32) - mean * scale
+    # shift form: out = x*scale + shift == (x-mean)*scale + beta
+    out2d = _bn_norm_call(x2d, scale, shift, block_m, interp)
+    return (out2d.reshape(shape), mean, var), (x, gamma, beta)
+
+
+def _bn_fused_bwd(eps, channel_axis, res, g):
+    x, gamma, beta = res
+    _, vjp = jax.vjp(
+        lambda x_, g_, b_: _bn_train_reference(x_, g_, b_, eps), x, gamma,
+        beta)
+    return vjp(g)
+
+
+bn_train_fused.defvjp(_bn_fused_fwd, _bn_fused_bwd)

@@ -103,3 +103,97 @@ def test_flash_attention_nd_op():
     q = nd.array(r.rand(1, 32, 2, 8).astype(np.float32))
     out = nd.contrib.flash_attention(q, q, q, causal=True)
     assert out.shape == (1, 32, 2, 8)
+
+
+def test_bn_train_fused_parity():
+    """Fused BN stats+normalize kernel (docs/perf_analysis.md train-fwd
+    cost; reference src/operator/nn/batch_norm.cc): fwd + grads match the
+    jnp var-form implementation, bf16 preserved."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 5, 5, 256).astype(np.float32) * 2 + 0.7
+    g = rng.rand(256).astype(np.float32) + 0.5
+    b = rng.randn(256).astype(np.float32)
+    out, mean, var = pk.bn_train_fused(jnp.asarray(x), jnp.asarray(g),
+                                       jnp.asarray(b), 1e-3, -1)
+    m = x.reshape(-1, 256).mean(0)
+    v = x.reshape(-1, 256).var(0)
+    ref = (x - m) / np.sqrt(v + 1e-3) * g + b
+    assert np.allclose(np.asarray(out), ref, atol=1e-3)
+    assert np.allclose(np.asarray(mean), m, atol=1e-4)
+    assert np.allclose(np.asarray(var), v, rtol=1e-4, atol=1e-5)
+
+    def loss_fused(x_, g_, b_):
+        return jnp.sum(pk.bn_train_fused(x_, g_, b_, 1e-3, -1)[0] ** 2)
+
+    def loss_ref(x_, g_, b_):
+        mm = jnp.mean(x_, axis=(0, 1, 2))
+        vv = jnp.var(x_, axis=(0, 1, 2))
+        return jnp.sum(((x_ - mm) * jax.lax.rsqrt(vv + 1e-3) * g_ + b_) ** 2)
+
+    ga = jax.grad(loss_fused, argnums=(0, 1, 2))(
+        jnp.asarray(x), jnp.asarray(g), jnp.asarray(b))
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(
+        jnp.asarray(x), jnp.asarray(g), jnp.asarray(b))
+    for a, r in zip(ga, gr):
+        assert np.allclose(np.asarray(a), np.asarray(r), atol=2e-2)
+
+    outb, _, _ = pk.bn_train_fused(jnp.asarray(x, jnp.bfloat16),
+                                   jnp.asarray(g), jnp.asarray(b), 1e-3, -1)
+    assert outb.dtype == jnp.bfloat16
+
+    # odd row count (M = 3*5*5): kernel-hostile, must fall back cleanly
+    xo = rng.randn(3, 5, 5, 128).astype(np.float32)
+    oo, mo, vo = pk.bn_train_fused(jnp.asarray(xo), jnp.asarray(g[:128]),
+                                   jnp.asarray(b[:128]), 1e-3, -1)
+    assert np.allclose(np.asarray(mo), xo.reshape(-1, 128).mean(0),
+                       atol=1e-4)
+
+
+def test_batch_norm_pallas_env_flag(monkeypatch):
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn import batch_norm
+
+    monkeypatch.setenv("MXTPU_BN_PALLAS", "1")
+    rng = np.random.RandomState(5)
+    x = rng.randn(3, 4, 4, 128).astype(np.float32)
+    g = rng.rand(128).astype(np.float32)
+    b = rng.randn(128).astype(np.float32)
+    out = batch_norm(jnp.asarray(x), jnp.asarray(g), jnp.asarray(b),
+                     jnp.zeros(128), jnp.ones(128), eps=1e-3,
+                     fix_gamma=False, axis=-1, _training=True)
+    m = x.reshape(-1, 128).mean(0)
+    v = x.reshape(-1, 128).var(0)
+    ref = (x - m) / np.sqrt(v + 1e-3) * g + b
+    assert np.allclose(np.asarray(out), ref, atol=1e-3)
+
+
+def test_bn_one_pass_stats_precision_large_mean():
+    """The one-pass stats are pivot-recentered: large mean/std must not
+    cancel catastrophically (raw E[x^2]-mean^2 measured 58% var error on
+    this fixture)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_kernels as pk
+    from mxnet_tpu.ops.nn import batch_norm
+
+    rng = np.random.RandomState(11)
+    x = (rng.randn(4, 8, 8, 128) * 0.5 + 300.0).astype(np.float32)
+    v_ref = x.reshape(-1, 128).astype(np.float64).var(0)
+
+    _, _, var = pk.bn_train_fused(jnp.asarray(x), jnp.ones(128),
+                                  jnp.zeros(128), 1e-3, -1)
+    rel = np.abs(np.asarray(var) - v_ref) / v_ref
+    assert rel.max() < 1e-2, rel.max()
+
+    _, mean2, var2 = batch_norm(
+        jnp.asarray(x), jnp.ones(128), jnp.zeros(128), jnp.zeros(128),
+        jnp.ones(128), eps=1e-3, fix_gamma=False, axis=-1,
+        output_mean_var=True, _training=True)
+    rel2 = np.abs(np.asarray(var2) - v_ref) / v_ref
+    assert rel2.max() < 1e-2, rel2.max()
