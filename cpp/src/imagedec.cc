@@ -41,7 +41,10 @@
 #include <jpeglib.h>
 #endif
 
-namespace {
+#include "imageutil.h"
+
+namespace mxtpu {
+namespace img {
 
 // ------------------------------------------------------------------ decode
 
@@ -185,6 +188,15 @@ void ResizeBilinear(const uint8_t *src, int sh, int sw, uint8_t *dst, int dh,
     }
   }
 }
+
+}  // namespace img
+}  // namespace mxtpu
+
+namespace {
+
+using mxtpu::img::DecodeJpeg;
+using mxtpu::img::DecodeRaw0;
+using mxtpu::img::ResizeBilinear;
 
 // ------------------------------------------------------------------ pipeline
 

@@ -1,0 +1,33 @@
+// Shared JPEG/RAW0 decode + bilinear resize helpers (impl in imagedec.cc).
+// Used by the image pipeline and the im2rec CLI so the pixel-exact code has
+// one home (decode does 1/den scaled JPEG decode covering min_side; resize
+// has a same-size memcpy fast path).
+#ifndef MXTPU_SRC_IMAGEUTIL_H_
+#define MXTPU_SRC_IMAGEUTIL_H_
+
+#include <cstdint>
+#include <vector>
+
+namespace mxtpu {
+namespace img {
+
+// JPEG bytes -> tightly packed RGB.  min_side > 0 enables scaled decode
+// (smallest 1/den whose short side still covers min_side).  row_scratch is
+// caller-owned so the libjpeg error longjmp never skips a local vector's
+// destructor.  Returns false on corrupt input (or always, without libjpeg).
+bool DecodeJpeg(const uint8_t *data, size_t len, int min_side,
+                std::vector<uint8_t> *out, std::vector<uint8_t> *row_scratch,
+                int *h, int *w);
+
+// "RAW0" + ndim + int32 shape + uint8 data -> RGB.
+bool DecodeRaw0(const uint8_t *data, size_t len, std::vector<uint8_t> *out,
+                int *h, int *w);
+
+// Bilinear resize RGB HWC uint8 (same-size memcpy fast path).
+void ResizeBilinear(const uint8_t *src, int sh, int sw, uint8_t *dst, int dh,
+                    int dw);
+
+}  // namespace img
+}  // namespace mxtpu
+
+#endif  // MXTPU_SRC_IMAGEUTIL_H_

@@ -1,0 +1,303 @@
+// Native im2rec CLI: pack an image list into RecordIO (reference:
+// tools/im2rec.cc — list in, resized/re-encoded JPEG records out, OpenCV
+// replaced by libjpeg + the in-repo bilinear resize, dmlc recordio replaced
+// by the exported mxtpu_rec_writer_* ABI from libmxtpu.so).
+//
+//   im2rec <list.lst> <image-root> <out.rec> [--resize N] [--quality Q]
+//          [--num-thread T] [--no-idx]
+//
+// List format (same as tools/im2rec.py write_list):
+//   <index>\t<label...>\t<relative-path>\n      (k labels -> IRHeader flag=k)
+// Records are IRHeader(flag, label, id, id2=0) [+ k float labels when
+// flag>0] + image bytes, framed by the RecordIO writer; a .idx file
+// (id\toffset) is written next to the .rec unless --no-idx.
+//
+// --resize N decodes, scales the SHORT side to N (bilinear), re-encodes at
+// --quality (default 95).  Without --resize the source bytes pass through
+// unchanged.  Workers run decode/encode in parallel; records are written in
+// list order.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <condition_variable>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#ifdef MXTPU_HAVE_LIBJPEG
+#include <csetjmp>
+
+#include <jpeglib.h>
+#endif
+
+#include "../src/imageutil.h"
+
+extern "C" {
+int mxtpu_rec_writer_open(const char *path, void **out_handle);
+int mxtpu_rec_write(void *handle, const uint8_t *data, uint64_t len);
+int64_t mxtpu_rec_writer_tell(void *handle);
+void mxtpu_rec_writer_close(void *handle);
+const char *mxtpu_last_error(void);
+}
+
+namespace {
+
+struct Item {
+  uint64_t id = 0;
+  std::vector<float> labels;
+  std::string path;
+};
+
+#ifdef MXTPU_HAVE_LIBJPEG
+struct JErr {
+  jpeg_error_mgr mgr;
+  std::jmp_buf jmp;
+};
+
+void JErrExit(j_common_ptr cinfo) {
+  std::longjmp(reinterpret_cast<JErr *>(cinfo->err)->jmp, 1);
+}
+
+bool Encode(const std::vector<uint8_t> &rgb, int h, int w, int quality,
+            std::vector<uint8_t> *out) {
+  jpeg_compress_struct cinfo;
+  JErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = JErrExit;
+  uint8_t *buf = nullptr;
+  unsigned long buflen = 0;  // NOLINT(runtime/int) — libjpeg API type
+  // declared BEFORE setjmp: the error longjmp must not skip a local
+  // vector's destructor (same invariant as imagedec.cc DecodeJpeg)
+  std::vector<uint8_t> row(size_t(w) * 3);
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_compress(&cinfo);
+    std::free(buf);
+    return false;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, &buf, &buflen);
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  while (cinfo.next_scanline < cinfo.image_height) {
+    std::memcpy(row.data(), rgb.data() + size_t(cinfo.next_scanline) * w * 3,
+                size_t(w) * 3);
+    uint8_t *rp = row.data();
+    jpeg_write_scanlines(&cinfo, &rp, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  out->assign(buf, buf + buflen);
+  std::free(buf);
+  return true;
+}
+
+void ResizeShortSide(const std::vector<uint8_t> &src, int sh, int sw,
+                     int target, std::vector<uint8_t> *dst, int *dh,
+                     int *dw) {
+  if (sh <= sw) {
+    *dh = target;
+    *dw = std::max(1, sw * target / sh);
+  } else {
+    *dw = target;
+    *dh = std::max(1, sh * target / sw);
+  }
+  dst->resize(size_t(*dh) * *dw * 3);
+  mxtpu::img::ResizeBilinear(src.data(), sh, sw, dst->data(), *dh, *dw);
+}
+#endif  // MXTPU_HAVE_LIBJPEG
+
+bool ReadFile(const std::string &path, std::vector<uint8_t> *out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  f.seekg(0, std::ios::end);
+  out->resize(static_cast<size_t>(f.tellg()));
+  f.seekg(0);
+  f.read(reinterpret_cast<char *>(out->data()),
+         static_cast<std::streamsize>(out->size()));
+  return static_cast<bool>(f);
+}
+
+// IRHeader layout matches mxnet_tpu/recordio.py ("<IfQQ"): flag, label,
+// id, id2; flag = extra-label count, labels appended as f32 after header.
+void PackRecord(const Item &item, const std::vector<uint8_t> &img,
+                std::vector<uint8_t> *out) {
+  uint32_t flag = item.labels.size() > 1
+                      ? static_cast<uint32_t>(item.labels.size())
+                      : 0;
+  float label = flag ? 0.0f : item.labels[0];
+  uint64_t id2 = 0;
+  out->clear();
+  out->reserve(24 + 4 * item.labels.size() + img.size());
+  auto put = [&](const void *p, size_t n) {
+    const uint8_t *b = static_cast<const uint8_t *>(p);
+    out->insert(out->end(), b, b + n);
+  };
+  put(&flag, 4);
+  put(&label, 4);
+  put(&item.id, 8);
+  put(&id2, 8);
+  if (flag)
+    put(item.labels.data(), 4 * item.labels.size());
+  put(img.data(), img.size());
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <list.lst> <image-root> <out.rec> [--resize N] "
+                 "[--quality Q] [--num-thread T] [--no-idx]\n",
+                 argv[0]);
+    return 2;
+  }
+  std::string list_path = argv[1], root = argv[2], out_path = argv[3];
+  int resize = 0, quality = 95,
+      nthread = static_cast<int>(std::thread::hardware_concurrency());
+  bool write_idx = true;
+  for (int i = 4; i < argc; ++i) {
+    std::string a = argv[i];
+    if (a == "--resize" && i + 1 < argc) resize = std::atoi(argv[++i]);
+    else if (a == "--quality" && i + 1 < argc) quality = std::atoi(argv[++i]);
+    else if (a == "--num-thread" && i + 1 < argc)
+      nthread = std::atoi(argv[++i]);
+    else if (a == "--no-idx") write_idx = false;
+    else { std::fprintf(stderr, "unknown arg %s\n", a.c_str()); return 2; }
+  }
+  if (nthread < 1) nthread = 1;
+#ifndef MXTPU_HAVE_LIBJPEG
+  if (resize > 0) {
+    std::fprintf(stderr,
+                 "built without libjpeg: --resize unavailable "
+                 "(pass-through packing still works)\n");
+    return 2;
+  }
+#endif
+
+  std::vector<Item> items;
+  {
+    std::ifstream lf(list_path);
+    if (!lf) { std::fprintf(stderr, "cannot open %s\n", list_path.c_str());
+               return 1; }
+    std::string line;
+    while (std::getline(lf, line)) {
+      if (line.empty()) continue;
+      std::vector<std::string> parts;
+      std::stringstream ss(line);
+      std::string tok;
+      while (std::getline(ss, tok, '\t')) parts.push_back(tok);
+      if (parts.size() < 3) continue;
+      Item it;
+      it.id = std::stoull(parts[0]);
+      for (size_t k = 1; k + 1 < parts.size(); ++k)
+        it.labels.push_back(std::stof(parts[k]));
+      it.path = root + "/" + parts.back();
+      items.push_back(std::move(it));
+    }
+  }
+
+  void *writer = nullptr;
+  if (mxtpu_rec_writer_open(out_path.c_str(), &writer)) {
+    std::fprintf(stderr, "%s\n", mxtpu_last_error());
+    return 1;
+  }
+  std::ofstream idxf;
+  if (write_idx) {
+    size_t dot = out_path.rfind('.');
+    size_t slash = out_path.rfind('/');
+    std::string base = (dot != std::string::npos &&
+                        (slash == std::string::npos || dot > slash))
+                           ? out_path.substr(0, dot)
+                           : out_path;
+    idxf.open(base + ".idx");
+  }
+
+  // parallel encode, ordered write: workers fill done[i]; the writer loop
+  // drains in list order (the reference's OMP-ordered equivalent)
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<size_t, std::vector<uint8_t>> done;
+  size_t next_fetch = 0;
+  int n_err = 0;
+
+  auto worker = [&]() {
+    for (;;) {
+      size_t i;
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (next_fetch >= items.size()) return;
+        i = next_fetch++;
+      }
+      std::vector<uint8_t> bytes, record;
+      bool ok = ReadFile(items[i].path, &bytes);
+#ifdef MXTPU_HAVE_LIBJPEG
+      if (ok && resize > 0) {
+        std::vector<uint8_t> rgb, scaled, enc, scratch;
+        int h = 0, w = 0;
+        ok = mxtpu::img::DecodeJpeg(bytes.data(), bytes.size(), resize,
+                                    &rgb, &scratch, &h, &w);
+        if (ok && std::min(h, w) != resize) {
+          int dh = 0, dw = 0;
+          ResizeShortSide(rgb, h, w, resize, &scaled, &dh, &dw);
+          ok = Encode(scaled, dh, dw, quality, &enc);
+        } else if (ok) {
+          ok = Encode(rgb, h, w, quality, &enc);
+        }
+        if (ok) bytes.swap(enc);
+      }
+#endif
+      if (ok) PackRecord(items[i], bytes, &record);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (!ok) {
+          ++n_err;
+          std::fprintf(stderr, "skip %s\n", items[i].path.c_str());
+        }
+        done[i] = std::move(record);  // empty record == skipped
+      }
+      cv.notify_all();
+    }
+  };
+  std::vector<std::thread> pool;
+  for (int t = 0; t < nthread; ++t) pool.emplace_back(worker);
+
+  size_t written = 0;
+  for (size_t i = 0; i < items.size(); ++i) {
+    std::vector<uint8_t> rec;
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv.wait(lk, [&] { return done.count(i) > 0; });
+      rec = std::move(done[i]);
+      done.erase(i);
+    }
+    if (rec.empty()) continue;
+    if (write_idx) idxf << items[i].id << '\t'
+                        << mxtpu_rec_writer_tell(writer) << '\n';
+    if (mxtpu_rec_write(writer, rec.data(), rec.size())) {
+      std::fprintf(stderr, "write failed: %s\n", mxtpu_last_error());
+      for (auto &th : pool) th.join();
+      mxtpu_rec_writer_close(writer);
+      return 1;
+    }
+    ++written;
+    if (written % 1000 == 0)
+      std::fprintf(stderr, "packed %zu/%zu\n", written, items.size());
+  }
+  for (auto &th : pool) th.join();
+  mxtpu_rec_writer_close(writer);
+  std::fprintf(stderr, "done: %zu records (%d skipped) -> %s\n", written,
+               n_err, out_path.c_str());
+  return n_err == 0 ? 0 : 1;
+}

@@ -86,6 +86,14 @@ def _unpack_call(packed2d, thresh, dtype, interpret):
 
 
 def _use_interpret() -> bool:
+    # MXTPU_PALLAS_INTERPRET=1 forces the interpreter even on a TPU host —
+    # the two-backend oracle (tools/tpu_parity.py) needs a CPU-interpreted
+    # reference leg that is NOT the native Mosaic lowering being checked
+    import os
+
+    forced = os.environ.get("MXTPU_PALLAS_INTERPRET")
+    if forced is not None:
+        return forced == "1"
     return jax.default_backend() != "tpu"
 
 

@@ -102,3 +102,82 @@ def test_perl_binding_builds_and_passes():
     assert r.returncode == 0, \
         f"perl tests failed:\n{r.stdout[-3000:]}\n{r.stderr[-1000:]}"
     assert "All tests successful" in r.stdout
+
+
+@pytest.mark.skipif(bool(os.environ.get("MXTPU_NO_NATIVE")),
+                    reason="native runtime disabled explicitly")
+def test_native_im2rec_cli_packs_readable_records(tmp_path):
+    """The native im2rec CLI (cpp/tools/im2rec.cc; reference tools/im2rec.cc)
+    packs a JPEG list into RecordIO that the Python recordio reader and the
+    native image pipeline both consume."""
+    import numpy as np
+
+    PIL = pytest.importorskip("PIL.Image")
+
+    from mxnet_tpu import recordio
+
+    root = os.path.dirname(os.path.dirname(_native.__file__))
+    exe = os.path.join(root, "cpp", "build", "im2rec")
+    if not os.path.exists(exe):
+        r = subprocess.run(["make", "-C", os.path.join(root, "cpp")],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+    assert os.path.exists(exe)
+
+    rng = np.random.RandomState(0)
+    img_dir = tmp_path / "imgs"
+    os.makedirs(img_dir)
+    entries = []
+    for i in range(6):
+        arr = rng.randint(0, 255, (24 + i, 32, 3)).astype("uint8")
+        name = f"im{i}.jpg"
+        PIL.fromarray(arr).save(str(img_dir / name), quality=95)
+        entries.append((i, i % 3, name))
+    lst = tmp_path / "data.lst"
+    with open(lst, "w") as f:
+        for i, label, name in entries:
+            f.write(f"{i}\t{label}\t{name}\n")
+
+    # pass-through pack
+    rec = str(tmp_path / "data.rec")
+    r = subprocess.run([exe, str(lst), str(img_dir), rec],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    reader = recordio.MXRecordIO(rec, "r")
+    seen = []
+    while True:
+        item = reader.read()
+        if item is None:
+            break
+        header, img = recordio.unpack(item)
+        seen.append((header.id, header.label, len(img)))
+    assert [s[0] for s in seen] == [0, 1, 2, 3, 4, 5]
+    assert [s[1] for s in seen] == [0.0, 1.0, 2.0, 0.0, 1.0, 2.0]
+    # pass-through: bytes identical to the source file
+    src = open(str(img_dir / "im0.jpg"), "rb").read()
+    reader2 = recordio.MXRecordIO(rec, "r")
+    _h, img0 = recordio.unpack(reader2.read())
+    assert img0 == src
+    # .idx written and consistent
+    idx_lines = open(str(tmp_path / "data.idx")).read().strip().splitlines()
+    assert len(idx_lines) == 6 and idx_lines[0].split("\t")[0] == "0"
+
+    # resize pack: decoded shapes have short side == 16
+    rec2 = str(tmp_path / "small.rec")
+    r = subprocess.run([exe, str(lst), str(img_dir), rec2, "--resize", "16",
+                        "--quality", "90", "--num-thread", "2"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    reader3 = recordio.MXRecordIO(rec2, "r")
+    import io
+
+    count = 0
+    while True:
+        item = reader3.read()
+        if item is None:
+            break
+        _h, img = recordio.unpack(item)
+        with PIL.open(io.BytesIO(bytes(img))) as im:
+            assert min(im.size) == 16
+        count += 1
+    assert count == 6

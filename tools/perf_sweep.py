@@ -1,5 +1,5 @@
 """One-shot perf sweep for a healthy-tunnel window: runs the full matrix
-(layout x fused-steps), captures XLA cost analysis, and writes
+(layout x fused-steps x BN-kernel), captures XLA cost analysis, and writes
 /tmp/perf_sweep.json + a human summary.  Designed to be launched the moment
 the TPU tunnel returns (see docs/perf_analysis.md round-4 status).
 
@@ -119,23 +119,33 @@ def main():
 
     print("backend:", jax.default_backend(), jax.devices())
     results = []
-    configs = [("NCHW", 8), ("NHWC", 8)] if args.quick else \
-        [("NCHW", 1), ("NCHW", 8), ("NHWC", 1), ("NHWC", 8)]
+    # bn=1: MXTPU_BN_PALLAS fused stats kernel (channels-minor only, hence
+    # the NHWC-only rows).  Each measure() builds a fresh trace, so the
+    # trace-time env read is honored per config within this process.
+    configs = [("NCHW", 8, 0), ("NHWC", 8, 0), ("NHWC", 8, 1)] \
+        if args.quick else \
+        [("NCHW", 1, 0), ("NCHW", 8, 0), ("NHWC", 1, 0), ("NHWC", 8, 0),
+         ("NHWC", 8, 1)]
     if args.smoke:
-        configs = [("NCHW", 2), ("NHWC", 2)]
-    for layout, K in configs:
+        configs = [("NCHW", 2, 0), ("NHWC", 2, 0), ("NHWC", 2, 1)]
+    for layout, K, bn in configs:
+        os.environ["MXTPU_BN_PALLAS"] = "1" if bn else "0"
         try:
             r = measure(layout, K, args.bs, steps, depth, side)
+            r["bn_pallas"] = bn
         except Exception as e:
-            r = {"layout": layout, "K": K, "error": f"{type(e).__name__}: {e}"[:200]}
+            r = {"layout": layout, "K": K, "bn_pallas": bn,
+                 "error": f"{type(e).__name__}: {e}"[:200]}
         results.append(r)
         print(json.dumps(r))
+    os.environ.pop("MXTPU_BN_PALLAS", None)
     with open("/tmp/perf_sweep.json", "w") as f:
         json.dump(results, f, indent=1)
     ok = [r for r in results if "img_per_sec" in r]
     if ok:
         best = max(ok, key=lambda r: r["img_per_sec"])
-        print(f"\nBEST: {best['layout']} K={best['K']} -> "
+        print(f"\nBEST: {best['layout']} K={best['K']} "
+              f"bn_pallas={best.get('bn_pallas', 0)} -> "
               f"{best['img_per_sec']} img/s")
 
 

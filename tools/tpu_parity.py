@@ -1,0 +1,309 @@
+#!/usr/bin/env python
+"""On-TPU correctness tier: a curated op + gluon-layer subset executed on
+the real chip AND on the host CPU backend from identical inputs, compared
+case by case — the reference's same-op-two-backends oracle
+(tests/python/gpu/test_operator_gpu.py) with TPU standing in for GPU.
+
+Writes TPU_PARITY_r05.json (override with --out) INCREMENTALLY after every
+case, so a tunnel that wedges mid-run still leaves a partial artifact.
+Run plain (no env stripping) in a healthy tunnel window:
+
+    timeout 2400 python tools/tpu_parity.py
+
+Exit 0 iff every executed case passed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_cases():
+    """Returns [(name, fn)] where fn() computes outputs under the ambient
+    default context and returns a list of numpy arrays."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+
+    rng = np.random.RandomState(0)
+    a4 = rng.randn(4, 16).astype(np.float32)
+    b4 = rng.randn(4, 16).astype(np.float32)
+    m1 = rng.randn(8, 12).astype(np.float32)
+    m2 = rng.randn(12, 6).astype(np.float32)
+    img = rng.randn(2, 8, 14, 14).astype(np.float32)
+    img_hwc = rng.randn(2, 14, 14, 8).astype(np.float32)
+    seq = rng.randn(5, 3, 10).astype(np.float32)
+    spd = np.abs(rng.randn(3, 3)).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+    idx = rng.randint(0, 16, (4,)).astype(np.float32)
+
+    def case(f, *arrs):
+        def run():
+            nds = [nd.array(a) for a in arrs]
+            out = f(*nds)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [np.asarray(o.asnumpy()) for o in outs]
+
+        return run
+
+    cases = [
+        # elementwise / math
+        ("exp", case(lambda x: nd.exp(x), a4)),
+        ("log", case(lambda x: nd.log(nd.abs(x) + 1.0), a4)),
+        ("tanh", case(lambda x: nd.tanh(x), a4)),
+        ("erf", case(lambda x: nd.erf(x), a4)),
+        ("sqrt", case(lambda x: nd.sqrt(nd.abs(x)), a4)),
+        ("rsqrt", case(lambda x: nd.rsqrt(nd.abs(x) + 1.0), a4)),
+        ("sigmoid", case(lambda x: nd.sigmoid(x), a4)),
+        ("relu", case(lambda x: nd.relu(x), a4)),
+        ("broadcast_add", case(lambda x, y: nd.broadcast_add(x, y), a4, b4)),
+        ("broadcast_maximum", case(lambda x, y: nd.broadcast_maximum(x, y),
+                                   a4, b4)),
+        ("clip", case(lambda x: nd.clip(x, -0.5, 0.5), a4)),
+        ("where", case(lambda x, y: nd.where(x > 0, x, y), a4, b4)),
+        # reductions / ordering
+        ("sum_axis", case(lambda x: nd.sum(x, axis=1), a4)),
+        ("max_axis", case(lambda x: nd.max(x, axis=0), a4)),
+        ("argmax", case(lambda x: nd.argmax(x, axis=1), a4)),
+        ("topk", case(lambda x: nd.topk(x, k=3, ret_typ="value"), a4)),
+        ("sort", case(lambda x: nd.sort(x, axis=1), a4)),
+        ("reverse", case(lambda x: nd.reverse(x, axis=1), a4)),
+        # matmul family (MXU)
+        ("dot", case(lambda x, y: nd.dot(x, y), m1, m2)),
+        ("batch_dot", case(lambda x, y: nd.batch_dot(x, y),
+                           rng.randn(3, 4, 5).astype(np.float32),
+                           rng.randn(3, 5, 2).astype(np.float32))),
+        ("FullyConnected", case(
+            lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=6),
+            m1, rng.randn(6, 12).astype(np.float32),
+            np.zeros(6, np.float32))),
+        ("linalg_gemm2", case(lambda x, y: nd.linalg_gemm2(x, y), m1, m2)),
+        ("linalg_potrf", case(lambda x: nd.linalg_potrf(x), spd)),
+        # conv / pool / norm
+        ("Convolution", case(
+            lambda x, w, b: nd.Convolution(x, w, b, kernel=(3, 3),
+                                           num_filter=4, pad=(1, 1)),
+            img, rng.randn(4, 8, 3, 3).astype(np.float32) * 0.1,
+            np.zeros(4, np.float32))),
+        ("Pooling_max", case(
+            lambda x: nd.Pooling(x, kernel=(2, 2), pool_type="max",
+                                 stride=(2, 2)), img)),
+        ("Pooling_avg", case(
+            lambda x: nd.Pooling(x, kernel=(2, 2), pool_type="avg",
+                                 stride=(2, 2)), img)),
+        ("BatchNorm_train", case(
+            lambda x, g, b, mm, mv: nd.BatchNorm(
+                x, g, b, mm, mv, fix_gamma=False, output_mean_var=False),
+            img, np.abs(rng.randn(8)).astype(np.float32),
+            rng.randn(8).astype(np.float32), np.zeros(8, np.float32),
+            np.ones(8, np.float32))),
+        ("LayerNorm", case(
+            lambda x, g, b: nd.LayerNorm(x, g, b),
+            a4, np.ones(16, np.float32), np.zeros(16, np.float32))),
+        ("softmax", case(lambda x: nd.softmax(x, axis=-1), a4)),
+        ("log_softmax", case(lambda x: nd.log_softmax(x, axis=-1), a4)),
+        # indexing
+        ("take", case(lambda x, i: nd.take(x, i, axis=0), m1,
+                      rng.randint(0, 8, (3,)).astype(np.float32))),
+        ("Embedding", case(
+            lambda i, w: nd.Embedding(i, w, input_dim=16, output_dim=5),
+            idx, rng.randn(16, 5).astype(np.float32))),
+        ("one_hot", case(lambda i: nd.one_hot(i, depth=16), idx)),
+        ("gather_nd", case(
+            lambda x, i: nd.gather_nd(x, i), m1,
+            np.array([[0, 2], [1, 3]], np.float32))),
+        ("transpose", case(lambda x: nd.transpose(x, axes=(1, 0)), m1)),
+        ("reshape", case(lambda x: nd.reshape(x, (2, -1)), m1)),
+        ("slice", case(lambda x: nd.slice(x, begin=(1, 2), end=(5, 9)), m1)),
+        ("tile", case(lambda x: nd.tile(x, reps=(2, 1)), a4)),
+        ("concat", case(lambda x, y: nd.concat(x, y, dim=1), a4, b4)),
+        # losses / output heads
+        ("SoftmaxOutput", case(
+            lambda x, l: nd.SoftmaxOutput(x, l), a4,
+            rng.randint(0, 16, (4,)).astype(np.float32))),
+        ("smooth_l1", case(lambda x: nd.smooth_l1(x, scalar=1.0), a4)),
+        # sequence / rnn
+        ("SequenceMask", case(
+            lambda x, l: nd.SequenceMask(x, l, use_sequence_length=True,
+                                         value=-1.0),
+            seq, np.array([3, 5, 2], np.float32))),
+        ("SequenceReverse", case(
+            lambda x: nd.SequenceReverse(x), seq)),
+        # image ops
+        ("image_normalize", case(
+            lambda x: nd._image_normalize(x, mean=(0.5,), std=(0.25,)),
+            rng.rand(3, 8, 8).astype(np.float32))),
+        ("image_resize_bilinear", case(
+            lambda x: nd.contrib_BilinearResize2D(x, height=7, width=9)
+            if hasattr(nd, "contrib_BilinearResize2D")
+            else nd.contrib.BilinearResize2D(x, height=7, width=9), img)),
+        ("adjust_lighting", case(
+            lambda x: nd._image_adjust_lighting(x, alpha=(0.02, -0.01, 0.03)),
+            rng.rand(3, 6, 6).astype(np.float32) * 255)),
+        # optimizer / quantization kernels
+        ("sgd_mom_update", case(
+            lambda w, g, m: nd.sgd_mom_update(w, g, m, lr=0.1, momentum=0.9),
+            a4.copy(), b4.copy(), np.zeros_like(a4))),
+        ("adam_update", case(
+            lambda w, g, m, v: nd.adam_update(w, g, m, v, lr=0.01),
+            a4.copy(), b4.copy(), np.zeros_like(a4), np.zeros_like(a4))),
+    ]
+
+    # gluon layers: params captured on the FIRST run and force-loaded on
+    # the second, so both backends compute from identical weights
+    def gluon_case(make, x):
+        state = {}
+
+        def run():
+            net = make()
+            net.initialize()
+            net(nd.array(x))  # materialize deferred shapes
+            # keyed by ORDER: gluon prefixes carry a per-instance counter
+            # (dense1_ vs dense2_), so names differ between the two runs
+            plist = list(net.collect_params().values())
+            if "params" in state:
+                for p, arr in zip(plist, state["params"]):
+                    p.set_data(nd.array(arr))
+            else:
+                state["params"] = [p.data().asnumpy() for p in plist]
+            out = net(nd.array(x))
+            return [np.asarray(out.asnumpy())]
+
+        return run
+
+    cases += [
+        ("gluon_Dense", gluon_case(lambda: gluon.nn.Dense(5), m1)),
+        ("gluon_Conv2D", gluon_case(
+            lambda: gluon.nn.Conv2D(4, 3, padding=1), img)),
+        ("gluon_Conv2D_NHWC", gluon_case(
+            lambda: gluon.nn.Conv2D(4, 3, padding=1, layout="NHWC"),
+            img_hwc)),
+        ("gluon_LSTM", gluon_case(
+            lambda: gluon.rnn.LSTM(7, layout="TNC"), seq)),
+        ("gluon_resnet18_stem", gluon_case(
+            lambda: gluon.model_zoo.vision.resnet18_v1(classes=10).features,
+            rng.rand(1, 3, 32, 32).astype(np.float32))),
+    ]
+
+    # pallas kernels: interpret (CPU) vs native TPU (Mosaic) lowering.
+    # Inputs are hoisted — a closure drawing from `rng` would advance the
+    # stream between the two backend runs and compare different data.  The
+    # CPU leg must FORCE the interpreter and place inputs on the CPU device:
+    # without that, both legs on a TPU host would run the same native
+    # kernel and the comparison would be vacuous.
+    q_flash = rng.rand(1, 32, 2, 16).astype(np.float32)
+    x_bn = rng.randn(2, 4, 4, 128).astype(np.float32)
+
+    def _pallas_leg(fn):
+        import os
+
+        import jax
+
+        import mxnet_tpu as mx
+
+        ctx = mx.context.current_context()
+        on_cpu = ctx.jax_device.platform == "cpu"
+        prev = os.environ.get("MXTPU_PALLAS_INTERPRET")
+        os.environ["MXTPU_PALLAS_INTERPRET"] = "1" if on_cpu else "0"
+        try:
+            put = lambda a: jax.device_put(a, ctx.jax_device)  # noqa: E731
+            return fn(put)
+        finally:
+            if prev is None:
+                os.environ.pop("MXTPU_PALLAS_INTERPRET", None)
+            else:
+                os.environ["MXTPU_PALLAS_INTERPRET"] = prev
+
+    def pallas_flash():
+        from mxnet_tpu.ops import pallas_kernels as pk
+
+        def body(put):
+            q = put(q_flash)
+            return [np.asarray(pk.flash_attention(q, q, q, causal=True))]
+
+        return _pallas_leg(body)
+
+    def pallas_bn():
+        from mxnet_tpu.ops import pallas_kernels as pk
+
+        def body(put):
+            out, mean, var = pk.bn_train_fused(
+                put(x_bn), put(np.ones(128, np.float32)),
+                put(np.zeros(128, np.float32)), 1e-3, -1)
+            return [np.asarray(out), np.asarray(mean), np.asarray(var)]
+
+        return _pallas_leg(body)
+
+    cases += [("pallas_flash_attention", pallas_flash),
+              ("pallas_bn_train_fused", pallas_bn)]
+    return cases
+
+
+def main():
+    out_path = sys.argv[sys.argv.index("--out") + 1] \
+        if "--out" in sys.argv else os.path.join(REPO, "TPU_PARITY_r05.json")
+    import jax
+
+    import mxnet_tpu as mx
+
+    self_test = "--self-test" in sys.argv
+    tpu_ctx = mx.tpu() if any(d.platform != "cpu" for d in jax.devices()) \
+        else (mx.cpu() if self_test else None)
+    if tpu_ctx is None:
+        print("no accelerator visible; refusing to write a CPU-vs-CPU "
+              "artifact (--self-test exercises the cases hermetically)",
+              file=sys.stderr)
+        return 2
+    platform = tpu_ctx.jax_device.platform
+    cases = build_cases()
+    record = {"platform": platform, "started": time.strftime("%F %T"),
+              "n_cases": len(cases), "results": [], "done": False}
+
+    def flush():
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=1)
+
+    flush()
+    n_fail = 0
+    for name, fn in cases:
+        t0 = time.time()
+        entry = {"name": name}
+        try:
+            with mx.cpu():
+                ref = fn()
+            with tpu_ctx:
+                got = fn()
+            errs = []
+            ok = len(ref) == len(got)
+            for r, g in zip(ref, got):
+                e = float(np.max(np.abs(r.astype(np.float64)
+                                        - g.astype(np.float64)))) \
+                    if r.size else 0.0
+                scale = float(np.max(np.abs(r))) if r.size else 1.0
+                errs.append(e)
+                ok = ok and e <= 1e-3 * max(1.0, scale)
+            entry.update(ok=bool(ok), max_abs_err=max(errs) if errs else 0.0,
+                         seconds=round(time.time() - t0, 2))
+        except Exception as e:  # noqa: BLE001 — record and continue
+            entry.update(ok=False, error=f"{type(e).__name__}: {e}"[:300],
+                         seconds=round(time.time() - t0, 2))
+        if not entry["ok"]:
+            n_fail += 1
+        record["results"].append(entry)
+        flush()
+        print(f"{'PASS' if entry['ok'] else 'FAIL'} {name} "
+              f"({entry.get('max_abs_err', 'err')})")
+    record["done"] = True
+    record["n_pass"] = len(cases) - n_fail
+    flush()
+    print(f"{record['n_pass']}/{len(cases)} passed -> {out_path}")
+    return 0 if n_fail == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
