@@ -212,6 +212,10 @@ struct PipeConfig {
   int rand_crop, rand_mirror, shuffle;
   int label_width;
   uint64_t seed;
+  // batches every shard must emit per epoch (ceil(max_shard_size / B));
+  // shards short on records pad with count=0 batches so synchronized
+  // data-parallel hosts step the same number of times (-1 = no target)
+  int64_t target_batches = -1;
 };
 
 class ImagePipeline {
@@ -254,6 +258,8 @@ class ImagePipeline {
       error_.clear();
       pending_.clear();
       stream_end_ = false;
+      emitted_.store(0, std::memory_order_relaxed);
+      tmpl_.reset();
       ++epoch_;  // augmentation randomness must differ across epochs
     }
     Start();
@@ -380,13 +386,43 @@ class ImagePipeline {
       }
       batch->count = filled;
       std::unique_lock<std::mutex> lk(mu_);
+      if (!tmpl_) tmpl_ = std::make_unique<ImgBatch>(*batch);
       cv_push_.wait(lk, [&] {
         return stop_.load(std::memory_order_relaxed) ||
                static_cast<int>(queue_.size()) < cfg_.queue_depth;
       });
       if (stop_.load(std::memory_order_relaxed)) return;
       queue_.push_back(std::move(batch));
+      emitted_.fetch_add(1, std::memory_order_relaxed);
       cv_pop_.notify_one();
+    }
+    // equal steps across shards: claim and emit count=0 pad batches until
+    // this shard reaches the per-epoch target (consumers treat count as
+    // the real sample count, so metrics skip the padding)
+    while (cfg_.target_batches >= 0) {
+      int64_t cur = emitted_.load(std::memory_order_relaxed);
+      if (cur >= cfg_.target_batches ||
+          stop_.load(std::memory_order_relaxed))
+        break;
+      if (!emitted_.compare_exchange_strong(cur, cur + 1)) continue;
+      auto pad = std::make_unique<ImgBatch>();
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (tmpl_) {
+          *pad = *tmpl_;
+        } else {  // shard saw zero records: zero-filled frame
+          pad->data.assign(static_cast<size_t>(B) * H * W * 3, 0);
+          pad->labels.assign(static_cast<size_t>(B) * cfg_.label_width, 0.f);
+        }
+        pad->count = 0;
+        cv_push_.wait(lk, [&] {
+          return stop_.load(std::memory_order_relaxed) ||
+                 static_cast<int>(queue_.size()) < cfg_.queue_depth;
+        });
+        if (stop_.load(std::memory_order_relaxed)) return;
+        queue_.push_back(std::move(pad));
+        cv_pop_.notify_one();
+      }
     }
     std::lock_guard<std::mutex> lk(mu_);
     ++workers_done_;
@@ -478,6 +514,8 @@ class ImagePipeline {
   std::condition_variable cv_push_, cv_pop_, cv_rec_;
   std::deque<std::unique_ptr<ImgBatch>> queue_;
   std::deque<std::string> pending_;
+  std::atomic<int64_t> emitted_{0};
+  std::unique_ptr<ImgBatch> tmpl_;  // clone source for pad batches (mu_)
   std::atomic<bool> stop_{false};
   bool stream_end_ = false;
   int workers_done_ = 0;
@@ -494,6 +532,20 @@ int mxtpu_imgpipe_open(const char *path, int batch_size, int out_h, int out_w,
                        int shard_index, int num_shards, int rand_crop,
                        int rand_mirror, int shuffle, int label_width,
                        uint64_t seed, void **out_handle) {
+  if (batch_size < 1 || out_h < 1 || out_w < 1 || resize_px < 0) {
+    mxtpu::SetError("imgpipe: batch_size/out_h/out_w must be positive "
+                    "(a worker-thread length_error would kill the process)");
+    return 1;
+  }
+  if (num_shards < 1) num_shards = 1;
+  // one skip-mode scan per open: yields the logical record count for the
+  // per-shard batch target AND validates framing up front
+  int64_t n_total = mxtpu_rec_count(path);
+  if (n_total < 0) {
+    mxtpu::SetError(std::string("corrupt or unreadable record file: ") +
+                    path);
+    return 1;
+  }
   void *rec = nullptr;
   if (mxtpu_rec_open(path, std::max(64, batch_size), 4, shard_index,
                      num_shards, &rec)) {
@@ -511,6 +563,8 @@ int mxtpu_imgpipe_open(const char *path, int batch_size, int out_h, int out_w,
   cfg.shuffle = shuffle;
   cfg.label_width = std::max(1, label_width);
   cfg.seed = seed;
+  int64_t max_shard = (n_total + num_shards - 1) / num_shards;
+  cfg.target_batches = (max_shard + batch_size - 1) / batch_size;
   *out_handle = new ImagePipeline(rec, cfg);
   return 0;
 }

@@ -35,9 +35,15 @@ size_t DtypeSize(const std::string &dt) {
   return 0;
 }
 
+// Element count, or UINT64_MAX when the product overflows (a wrapped
+// product would report a full shape over a tiny buffer — out-of-bounds by
+// construction for any consumer iterating nd_data by shape).
 uint64_t NumElems(const std::vector<uint64_t> &shape) {
   uint64_t n = 1;
-  for (uint64_t s : shape) n *= s;
+  for (uint64_t s : shape) {
+    if (s != 0 && n > UINT64_MAX / s) return UINT64_MAX;
+    n *= s;
+  }
   return n;
 }
 
@@ -71,7 +77,21 @@ int mxtpu_nd_create(const char *dtype, const uint64_t *shape, int ndim,
   auto *a = new NDArray();
   a->dtype = dtype;
   a->shape.assign(shape, shape + ndim);
-  a->data.resize(NumElems(a->shape) * esz);
+  uint64_t n = NumElems(a->shape);
+  if (n == UINT64_MAX || n > UINT64_MAX / esz) {
+    delete a;
+    mxtpu::SetError("shape element count overflows");
+    return 1;
+  }
+  try {
+    a->data.resize(n * esz);
+  } catch (const std::exception &e) {
+    // bad_alloc/length_error must not cross the extern "C" boundary —
+    // ctypes callers get rc + mxtpu_last_error, not std::terminate
+    delete a;
+    mxtpu::SetError(std::string("allocation failed: ") + e.what());
+    return 1;
+  }
   *out_handle = a;
   return 0;
 }

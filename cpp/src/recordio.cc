@@ -45,9 +45,19 @@ struct Batch {
   std::vector<std::string> records;
 };
 
+long FileSize(FILE *f) {
+  long here = std::ftell(f);
+  if (here < 0 || std::fseek(f, 0, SEEK_END) != 0) return -1;
+  long end = std::ftell(f);
+  std::fseek(f, here, SEEK_SET);
+  return end;
+}
+
 // Reads one *part* (header + payload). Returns 1 on success, 0 on clean
-// EOF before the header, -1 on corruption/truncation.
-int ReadPart(FILE *f, uint32_t *cflag, std::string *payload, bool skip) {
+// EOF before the header, -1 on corruption/truncation.  fsize (FileSize(f),
+// computed once per file by the caller) bounds skip-mode seeks.
+int ReadPart(FILE *f, uint32_t *cflag, std::string *payload, bool skip,
+             long fsize) {
   uint32_t header[2];
   size_t n = std::fread(header, 1, sizeof(header), f);
   if (n == 0) return 0;
@@ -56,6 +66,13 @@ int ReadPart(FILE *f, uint32_t *cflag, std::string *payload, bool skip) {
   uint32_t padded = (len + 3u) & ~3u;
   *cflag = header[1] >> kLenBits;
   if (skip) {
+    // fseek happily lands past EOF, so verify the payload actually exists —
+    // otherwise skip-mode (rec_count, shard scans) reports a truncated
+    // record as valid while a full read of the same file raises
+    long here = std::ftell(f);
+    if (here < 0 || fsize < 0 ||
+        static_cast<uint64_t>(fsize - here) < padded)
+      return -1;
     std::fseek(f, padded, SEEK_CUR);
     return 1;
   }
@@ -69,16 +86,16 @@ int ReadPart(FILE *f, uint32_t *cflag, std::string *payload, bool skip) {
 // Reads one LOGICAL record, reassembling multipart payloads with the magic
 // word re-inserted between parts (dmlc recordio semantics). Same returns
 // as ReadPart.
-int ReadLogical(FILE *f, std::string *rec, bool skip) {
+int ReadLogical(FILE *f, std::string *rec, bool skip, long fsize = -1) {
   uint32_t cflag = 0;
   rec->clear();
-  int r = ReadPart(f, &cflag, rec, skip);
+  int r = ReadPart(f, &cflag, rec, skip, fsize);
   if (r <= 0) return r;
   if (cflag == 0) return 1;
   if (cflag != 1) return -1;  // stream must not start mid-record
   for (;;) {
     if (!skip) rec->append(reinterpret_cast<const char *>(&kMagic), 4);
-    r = ReadPart(f, &cflag, rec, skip);
+    r = ReadPart(f, &cflag, rec, skip, fsize);
     if (r <= 0) return -1;  // EOF inside a multipart record is corruption
     if (cflag == 3) return 1;
     if (cflag != 2) return -1;
@@ -152,11 +169,12 @@ class RecReader {
     }
     auto batch = std::make_unique<Batch>();
     int64_t ordinal = 0;
+    const long fsize = FileSize(f);
     for (;;) {
       bool mine = (ordinal % num_shards_) == shard_index_;
       ++ordinal;
       std::string rec;
-      int r = ReadLogical(f, &rec, !mine);
+      int r = ReadLogical(f, &rec, !mine, fsize);
       if (r == 0) break;  // clean EOF
       if (r < 0) {
         Finish(path_ + ": corrupt or truncated record");
@@ -296,8 +314,9 @@ int64_t mxtpu_rec_count(const char *path) {
   if (!f) return -1;
   int64_t count = 0;  // LOGICAL records: multipart groups count once
   std::string scratch;
+  const long fsize = FileSize(f);
   for (;;) {
-    int r = ReadLogical(f, &scratch, /*skip=*/true);
+    int r = ReadLogical(f, &scratch, /*skip=*/true, fsize);
     if (r == 0) break;
     if (r < 0) {
       std::fclose(f);

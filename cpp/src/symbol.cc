@@ -167,6 +167,7 @@ struct Symbol {
   std::vector<std::string> outputs;   // head names
   std::vector<std::string> ops;       // per-node op name ("null" for vars)
   std::vector<std::string> names;     // per-node name
+  std::vector<int> n_outputs;         // per-node output count (attr_dict)
 };
 
 }  // namespace
@@ -197,12 +198,19 @@ int mxtpu_sym_load_json(const char *json, void **out_handle) {
       // heads index nodes by position: keep the slot so ids stay aligned
       sym->ops.push_back("");
       sym->names.push_back("");
+      sym->n_outputs.push_back(1);
       continue;
     }
     sym->ops.push_back(op->str);
     sym->names.push_back(name->str);
+    const JValue *ad = n->Get("attr_dict");
+    int n_out = 1;
+    if (ad) {
+      const JValue *no = ad->Get("__num_outputs__");
+      if (no && !no->str.empty()) n_out = std::atoi(no->str.c_str());
+    }
+    sym->n_outputs.push_back(n_out < 1 ? 1 : n_out);
     if (op->str == "null") {
-      const JValue *ad = n->Get("attr_dict");
       bool is_aux = ad && ad->Get("__is_aux__") != nullptr;
       if (!is_aux) sym->args.push_back(name->str);
     }
@@ -226,7 +234,14 @@ int mxtpu_sym_load_json(const char *json, void **out_handle) {
       if (idx >= 0 && idx < static_cast<int>(sym->names.size())) {
         std::string name = sym->names[idx];
         if (sym->ops[idx] != "null") {
-          bool multi = head_max_idx[idx] > 0;
+          // Python appends the index iff the NODE is multi-output
+          // (symbol.py list_outputs), which tojson records as
+          // __num_outputs__; max-used-head-index is only the fallback for
+          // graphs written before that attr existed — it misnames a
+          // symbol selecting output 0 of a multi-output op
+          bool multi = (idx < static_cast<int>(sym->n_outputs.size()) &&
+                        sym->n_outputs[idx] > 1) ||
+                       head_max_idx[idx] > 0;
           name += multi ? "_output" + std::to_string(oidx) : "_output";
         }
         sym->outputs.push_back(name);

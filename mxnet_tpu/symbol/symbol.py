@@ -417,8 +417,13 @@ class Symbol:
                 "attrs": {k: repr(v) for k, v in n.attrs.items()},
                 "inputs": [[nid[id(e.node)], e.index, 0] for e in n.inputs],
             }
-            if n.attr_dict:
-                entry["attr_dict"] = dict(n.attr_dict)
+            ad = dict(n.attr_dict) if n.attr_dict else {}
+            if n.kind == "op" and n.num_outputs() > 1:
+                # foreign bindings (cpp/src/symbol.cc) need the node's
+                # output count to reproduce list_outputs naming
+                ad["__num_outputs__"] = str(n.num_outputs())
+            if ad:
+                entry["attr_dict"] = ad
             out_nodes.append(entry)
         heads = [[nid[id(e.node)], e.index, 0] for e in self._entries]
         arg_nodes = [i for i, n in enumerate(nodes) if n.kind == "var"]

@@ -376,3 +376,94 @@ def test_c_api_shm_segments():
     lib.mxtpu_shm_detach(h, 1)  # owner unlinks
     h3 = ctypes.c_void_p()
     assert lib.mxtpu_shm_attach(name, ctypes.byref(h3), None) != 0  # gone
+
+
+# ---------------------------------------------------------------------------
+# round-5 native audit regressions (executed repros; see commit message)
+# ---------------------------------------------------------------------------
+
+def test_rec_truncation_detected_in_skip_mode(tmp_path):
+    """Skip-mode scans (rec_count, shard passes) must flag truncated
+    records like a full read does, not fseek past the missing payload."""
+    from mxnet_tpu import _native, recordio
+
+    p = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(p, "w")
+    w.write(b"x" * 100)
+    w.close()
+    with open(p, "r+b") as f:
+        f.truncate(50)
+    assert _native.rec_count(p) == -1
+    with pytest.raises(IOError):
+        list(_native.RecordReader(p, shard_index=1, num_shards=2))
+
+
+def test_imgpipe_rejects_bad_batch_size(tmp_path):
+    from mxnet_tpu import _native, recordio
+
+    p = str(tmp_path / "i.rec")
+    w = recordio.MXRecordIO(p, "w")
+    w.write(recordio.pack(recordio.IRHeader(0, 1.0, 0, 0),
+                          b"RAW0" + (2).to_bytes(4, "little")
+                          + (4).to_bytes(4, "little")
+                          + (4).to_bytes(4, "little") + b"\x00" * 16))
+    w.close()
+    for bad in (-1, 0):
+        with pytest.raises(IOError):
+            _native.ImagePipeline(p, batch_size=bad, data_shape=(3, 4, 4),
+                                  resize=0)
+
+
+def test_imgpipe_equal_batches_across_shards(tmp_path):
+    """Round-robin shard sizes straddling a batch boundary must still give
+    every shard the same batch count (synchronized dp hosts step
+    together); short shards pad with count=0 batches."""
+    from mxnet_tpu import _native, recordio
+
+    p = str(tmp_path / "s.rec")
+    w = recordio.MXRecordIO(p, "w")
+    raw = (b"RAW0" + (2).to_bytes(4, "little") + (4).to_bytes(4, "little")
+           + (4).to_bytes(4, "little") + b"\x07" * 16)
+    for i in range(9):
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0), raw))
+    w.close()
+    counts = {}
+    for shard in (0, 1):
+        pipe = _native.ImagePipeline(p, batch_size=4, data_shape=(3, 4, 4),
+                                     resize=0, num_shards=2,
+                                     shard_index=shard, num_threads=1)
+        counts[shard] = len(list(pipe))
+        pipe.close()
+    assert counts[0] == counts[1] == 2, counts
+
+
+def test_nd_create_overflow_and_alloc_failure_return_error():
+    import ctypes
+
+    from mxnet_tpu import _native
+
+    lib = _native.lib()
+    h = ctypes.c_void_p()
+    big = (ctypes.c_uint64 * 2)(1 << 32, 1 << 32)  # product wraps mod 2^64
+    assert lib.mxtpu_nd_create(b"float32", big, 2, ctypes.byref(h)) == 1
+    huge = (ctypes.c_uint64 * 1)(1 << 61)  # bad_alloc / length_error
+    assert lib.mxtpu_nd_create(b"float32", huge, 1, ctypes.byref(h)) == 1
+
+
+def test_sym_output_name_multi_output_head0():
+    """Selecting output 0 of a multi-output op must name like Python's
+    list_outputs ('sc_output0', not 'sc_output')."""
+    import ctypes
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import _native
+
+    lib = _native.lib()
+    sc = mx.sym.SliceChannel(mx.sym.Variable("d"), num_outputs=2, name="sc")
+    head0 = sc[0]
+    h = ctypes.c_void_p()
+    assert lib.mxtpu_sym_load_json(head0.tojson().encode(),
+                                   ctypes.byref(h)) == 0
+    lib.mxtpu_sym_output_name.restype = ctypes.c_char_p
+    assert lib.mxtpu_sym_output_name(h, 0).decode() == \
+        head0.list_outputs()[0] == "sc_output0"
