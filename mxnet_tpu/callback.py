@@ -62,7 +62,13 @@ class Speedometer:
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                # coarse clocks / very fast batches can land two logs on
+                # one tick (reference #11504): report inf, don't crash
+                try:
+                    speed = self.frequent * self.batch_size \
+                        / (time.time() - self.tic)
+                except ZeroDivisionError:
+                    speed = float("inf")
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

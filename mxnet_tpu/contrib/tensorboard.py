@@ -36,7 +36,9 @@ class LogMetricsCallback:
             if self.prefix is not None:
                 name = f"{self.prefix}-{name}"
             if self._writer is not None:
-                self._writer.add_scalar(name, value, param.nbatch)
+                # reference logs per EPOCH (tensorboard.py:73): nbatch
+                # resets every epoch and would zigzag the step axis
+                self._writer.add_scalar(name, value, param.epoch)
             else:
                 self._jsonl.write(json.dumps(
                     {"ts": time.time(), "epoch": param.epoch,

@@ -39,3 +39,9 @@ def _init_kvstore_server_module():
         server = KVStoreServer()
         server.run()
         raise SystemExit(0)
+
+
+# a server-role process must become a parameter server the moment the
+# package imports (reference kvstore_server.py:85 runs this at import;
+# without it the PS host silently executes the worker script instead)
+_init_kvstore_server_module()

@@ -52,7 +52,7 @@ def print_summary(symbol, shape=None, line_length=120,
     def print_row(fields):
         line = ""
         for f, pos in zip(fields, positions):
-            line = (line + str(f))[:pos - 1].ljust(pos)
+            line = (line + str(f))[:pos].ljust(pos)
         print(line)
 
     print("_" * line_length)
@@ -66,13 +66,21 @@ def print_summary(symbol, shape=None, line_length=120,
                         if e.node.kind != "var" or e.node.name in data_names)
         params = 0
         for e in n.inputs:
-            if e.node.kind == "var" and e.node.name not in data_names:
-                s = par_shapes.get(e.node.name)
-                if s:
-                    c = 1
-                    for d in s:
-                        c *= d
-                    params += c
+            if e.node.kind != "var" or e.node.name in data_names:
+                continue
+            # trainable parameters only: aux states (BN moving stats) and
+            # label inputs are not params (reference print_layer_summary
+            # counts BatchNorm as num_filter*2 and losses as 0)
+            if e.node.attr_dict.get("__is_aux__"):
+                continue
+            if e.node.name.endswith("_label") or e.node.name == "label":
+                continue
+            s = par_shapes.get(e.node.name)
+            if s:
+                c = 1
+                for d in s:
+                    c *= d
+                params += c
         total += params
         oshape = out_shapes.get(n.name, "")
         print_row([f"{n.name} ({n.op.name})", oshape, params, prev])
@@ -91,21 +99,40 @@ _OP_STYLE = {
 }
 
 
+def _looks_like_weight(name):
+    """Parameter-style variable names the plot hides (reference
+    visualization.py looks_like_weight): everything else — data, labels,
+    custom inputs — stays visible."""
+    return name.endswith(("_weight", "_bias", "_beta", "_gamma",
+                          "_moving_var", "_moving_mean", "_running_var",
+                          "_running_mean"))
+
+
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,
                  node_attrs=None, hide_weights=True):
     """Graphviz dot source for the graph (reference: plot_network; returns
     the dot string — the graphviz binary is optional in this image).  Edge
-    labels carry output shapes when ``shape`` is given."""
+    labels carry output shapes when ``shape`` is given; ``node_attrs``
+    merge into every node's attribute list."""
     out_shapes = _node_shapes(symbol, shape)
+    var_shapes = dict(shape or {})
+    var_shapes.update(_param_shapes(symbol, shape))
+    extra = "".join(f', {k}="{v}"' for k, v in (node_attrs or {}).items())
     lines = [f'digraph "{title}" {{', "  rankdir=BT;"]
     nodes = topo_order(symbol._entries)
     nid = {id(n): i for i, n in enumerate(nodes)}
+
+    def hidden(node):
+        return (node.kind == "var" and hide_weights
+                and _looks_like_weight(node.name))
+
     for n in nodes:
-        if n.kind == "var" and hide_weights and n.name != "data":
+        if hidden(n):
             continue
         if n.kind == "var":
             lines.append(f'  n{nid[id(n)]} [label="{n.name}", '
-                         'shape=ellipse, style=filled, fillcolor="#8dd3c7"];')
+                         'shape=ellipse, style=filled, '
+                         f'fillcolor="#8dd3c7"{extra}];')
         else:
             label = n.name
             if n.op.name == "Convolution":
@@ -116,16 +143,16 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
                 label += f"\\n{n.attrs.get('num_hidden')}"
             color = _OP_STYLE.get(n.op.name, "#d9d9d9")
             lines.append(f'  n{nid[id(n)]} [label="{label}", shape=box, '
-                         f'style=filled, fillcolor="{color}"];')
+                         f'style=filled, fillcolor="{color}"{extra}];')
     for n in nodes:
         if n.kind == "var":
             continue
         for e in n.inputs:
-            if e.node.kind == "var" and hide_weights \
-                    and e.node.name != "data":
+            if hidden(e.node):
                 continue
             edge = f"  n{nid[id(e.node)]} -> n{nid[id(n)]}"
-            s = out_shapes.get(e.node.name) if e.node.kind != "var" else None
+            s = out_shapes.get(e.node.name) if e.node.kind != "var" \
+                else var_shapes.get(e.node.name)
             if s:
                 edge += f' [label="{"x".join(str(d) for d in s[1:])}"]'
             lines.append(edge + ";")

@@ -1000,3 +1000,102 @@ def test_kvstore_row_sparse_accumulation_bounded():
         oracle[1] += i + 1
         oracle[5 + i] += i + 1
     assert np.allclose(dense, oracle)
+
+
+def test_speedometer_same_tick_no_crash():
+    """Two logged batches on one clock tick must report inf, not raise
+    (reference callback.py #11504 guard)."""
+    import time as _time
+    import types
+
+    from mxnet_tpu.callback import Speedometer
+
+    sp = Speedometer(batch_size=8, frequent=1)
+    param = types.SimpleNamespace(nbatch=1, epoch=0, eval_metric=None)
+    orig = _time.time
+    _time.time = lambda: 123.0
+    try:
+        sp(param)
+        param.nbatch = 2
+        sp(param)  # same tick: previously ZeroDivisionError
+    finally:
+        _time.time = orig
+
+
+def test_print_summary_counts_trainable_params_only():
+    """BN counts gamma+beta (reference: num_filter*2), not moving stats;
+    loss labels count 0 (reference print_layer_summary)."""
+    import io
+    import sys
+
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.BatchNorm(
+            mx.sym.FullyConnected(data, num_hidden=4, name="fc1"),
+            name="bn1"), name="softmax")
+    buf = io.StringIO()
+    old = sys.stdout
+    sys.stdout = buf
+    try:
+        total = mx.visualization.print_summary(net, shape={"data": (1, 40)})
+    finally:
+        sys.stdout = old
+    assert total == (40 + 1) * 4 + 4 * 2  # fc 164 + bn gamma/beta 8
+
+
+def test_plot_network_reference_semantics():
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc1"),
+        name="softmax")
+    dot = mx.visualization.plot_network(
+        net, shape={"data": (1, 40)}, node_attrs={"fixedsize": "false"})
+    assert '"data"' in dot  # inputs render
+    assert "softmax_label" in dot  # labels are not weight-like: render
+    assert "fc1_weight" not in dot  # weights hidden by default
+    assert '[label="40"]' in dot  # var-source edges carry shapes
+    assert "fixedsize" in dot  # node_attrs honored
+
+
+def test_server_role_import_becomes_parameter_server():
+    """MXTPU_ROLE=server + import mxnet_tpu must start a blocking PS
+    (reference kvstore_server.py runs at import), never fall through to
+    the worker script."""
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    port = 19755
+    env = dict(os.environ, MXTPU_ROLE="server",
+               MXTPU_COORDINATOR=f"127.0.0.1:{port}", MXTPU_NUM_PROCS="1",
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    p = subprocess.Popen(
+        [sys.executable, "-c", "import mxnet_tpu; print('REACHED')"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        listening = False
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port),
+                                         timeout=1).close()
+                listening = True
+                break
+            except OSError:
+                if p.poll() is not None:
+                    break
+                time.sleep(0.5)
+        assert listening, p.communicate()[1][-500:]
+        assert p.poll() is None  # blocked serving, not running worker code
+    finally:
+        p.terminate()
+        out, _err = p.communicate(timeout=10)
+        assert "REACHED" not in out

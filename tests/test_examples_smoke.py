@@ -52,7 +52,12 @@ MANIFEST = {
     "onnx": [("onnx/onnx_roundtrip.py", [])],
     "profiler": [("profiler/profiler_demo.py", [])],
     "python-howto": [("python-howto/api_tour.py", [])],
-    "quantization": [("quantization/imagenet_inference.py", [])],
+    "quantization": [("quantization/imagenet_inference.py",
+                      # resnet-50 int8 at 224px overruns the 550 s budget on
+                      # the 1-core CI host; the quantize+calibrate+infer path
+                      # is identical at this scale
+                      ["--num-layers", "18", "--image-shape", "3,64,64",
+                       "--num-examples", "64", "--batch-size", "16"])],
     "rcnn": [("rcnn/train.py", [])],
     "recommenders": [("recommenders/neural_mf.py", [])],
     "reinforcement-learning": [
@@ -93,7 +98,9 @@ def run_example(rel, *args, timeout=550):
         capture_output=True, text=True, timeout=timeout, env=env, cwd=ROOT)
     assert r.returncode == 0, \
         f"{rel} failed:\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
-    return r.stdout
+    # Module.fit-style examples report through logging (stderr); the smoke
+    # criterion is "exited 0 and said something", not "used stdout"
+    return r.stdout + r.stderr
 
 
 def test_manifest_covers_every_example_dir():
