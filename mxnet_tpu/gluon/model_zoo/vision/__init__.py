@@ -9,8 +9,26 @@ _models = {}
 for _mod in (resnet, simple_nets):
     for _name in _mod.__all__:
         obj = getattr(_mod, _name)
-        if callable(obj) and _name[0].islower():
+        # parameterized helpers (get_resnet/get_vgg/...) are factories, not
+        # model names — the reference models dict lists only real names
+        if callable(obj) and _name[0].islower() \
+                and not _name.startswith("get_"):
             _models[_name] = obj
+
+# the reference's model table spells these with dots / no underscore
+# (model_zoo/vision/__init__.py models dict); accept both forms
+_REFERENCE_ALIASES = {
+    "squeezenet1.0": "squeezenet1_0", "squeezenet1.1": "squeezenet1_1",
+    "inceptionv3": "inception_v3",
+    "mobilenet1.0": "mobilenet1_0", "mobilenet0.75": "mobilenet0_75",
+    "mobilenet0.5": "mobilenet0_5", "mobilenet0.25": "mobilenet0_25",
+    "mobilenetv2_1.0": "mobilenet_v2_1_0",
+    "mobilenetv2_0.75": "mobilenet_v2_0_75",
+    "mobilenetv2_0.5": "mobilenet_v2_0_5",
+    "mobilenetv2_0.25": "mobilenet_v2_0_25",
+}
+for _ref, _ours in _REFERENCE_ALIASES.items():
+    _models[_ref] = _models[_ours]
 
 
 def get_model(name, **kwargs):

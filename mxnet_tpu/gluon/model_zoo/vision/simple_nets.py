@@ -25,11 +25,12 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
+from .... import initializer as init
 
-__all__ = ["AlexNet", "alexnet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+__all__ = ["AlexNet", "alexnet", "VGG", "get_vgg", "vgg11", "vgg13", "vgg16", "vgg19",
            "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn", "SqueezeNet",
            "squeezenet1_0", "squeezenet1_1", "MobileNet", "MobileNetV2",
-           "mobilenet1_0", "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
+           "get_mobilenet", "get_mobilenet_v2", "mobilenet1_0", "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
            "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
            "mobilenet_v2_0_25", "DenseNet", "densenet121", "densenet161",
            "densenet169", "densenet201", "Inception3", "inception_v3"]
@@ -51,14 +52,15 @@ def _relu6():
 
 
 def _unit(ch, k=1, s=1, p=0, groups=1, bias=False, norm=True, act="relu",
-          eps=1e-5):
+          eps=1e-5, weight_initializer=None):
     """conv [+ BatchNorm] [+ activation] — the one conv builder here.
 
     ``act`` is "relu", "relu6", or None. Returns a HybridSequential so a
     unit can be dropped anywhere a block is expected.
     """
     out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(ch, k, s, p, groups=groups, use_bias=bias))
+    out.add(nn.Conv2D(ch, k, s, p, groups=groups, use_bias=bias,
+                      weight_initializer=weight_initializer))
     if norm:
         out.add(nn.BatchNorm(epsilon=eps))
     if act == "relu":
@@ -164,10 +166,17 @@ class VGG(HybridBlock):
             raise ValueError("one filter width per VGG stage")
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
+            # reference vgg.py: Xavier(gaussian, factor_type='out',
+            # magnitude=2) on conv weights — from-scratch convergence
+            # parity matters here because pretrained weights are
+            # unavailable in this image
+            conv_init = init.Xavier(rnd_type="gaussian",
+                                    factor_type="out", magnitude=2)
             for reps, width in zip(layers, filters):
                 for _ in range(reps):
                     self.features.add(_unit(width, 3, 1, 1, bias=True,
-                                            norm=batch_norm))
+                                            norm=batch_norm,
+                                            weight_initializer=conv_init))
                 self.features.add(nn.MaxPool2D(strides=2))
             self.features.add(nn.Flatten())
             for _ in range(2):
@@ -190,6 +199,14 @@ def _vgg_constructor(depth, batch_norm):
     ctor.__doc__ = (f"VGG-{depth}" + (" with BatchNorm" if batch_norm
                                       else ""))
     return ctor
+
+
+def get_vgg(num_layers, batch_norm=False, **kwargs):
+    """Parameterized VGG factory (reference vgg.py get_vgg)."""
+    if num_layers not in _VGG_ROWS:
+        raise ValueError(f"VGG depth must be one of {sorted(_VGG_ROWS)}")
+    return VGG(list(_VGG_ROWS[num_layers]), list(_VGG_WIDTHS),
+               batch_norm=batch_norm, **_strip(kwargs))
 
 
 for _d in _VGG_ROWS:
@@ -235,8 +252,11 @@ class SqueezeNet(HybridBlock):
                 else:
                     self.features.add(_fire(*row))
             self.features.add(nn.Dropout(0.5))
+            # reference squeezenet.py: fixed AvgPool2D(13) head (identical
+            # to global pooling at 224px, different — and reference-matching
+            # — for other input sizes)
             self.output = _chain(_unit(classes, 1, bias=True, norm=False),
-                                 nn.GlobalAvgPool2D(), nn.Flatten())
+                                 nn.AvgPool2D(13), nn.Flatten())
 
     def hybrid_forward(self, F, x):
         return self.output(self.features(x))
@@ -333,6 +353,16 @@ def _mobile_constructor(cls, multiplier, tag):
     ctor.__name__ = ctor.__qualname__ = tag
     ctor.__doc__ = f"{cls.__name__} with width multiplier {multiplier}"
     return ctor
+
+
+def get_mobilenet(multiplier, **kwargs):
+    """Parameterized MobileNet v1 factory (reference mobilenet.py)."""
+    return MobileNet(multiplier, **_strip(kwargs))
+
+
+def get_mobilenet_v2(multiplier, **kwargs):
+    """Parameterized MobileNet v2 factory (reference mobilenet.py)."""
+    return MobileNetV2(multiplier, **_strip(kwargs))
 
 
 for _mult, _suffix in ((1.0, "1_0"), (0.75, "0_75"), (0.5, "0_5"),

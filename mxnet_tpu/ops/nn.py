@@ -187,6 +187,19 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
     # gluon layers pass their own stride=pool_size default explicitly
     s = _pair(stride, nd) if stride else (1,) * nd
     p = _pair(pad, nd) if pad else (0,) * nd
+    for i in range(nd):
+        # reference pooling checks kernel <= padded input (pooling-inl.h
+        # shape infer); XLA's reduce_window would instead emit a ZERO-SIZE
+        # output that silently poisons everything downstream (e.g.
+        # inception_v3 fed 224px produced constant logits from an empty
+        # matmul instead of this error)
+        if k[i] > data.shape[sp0 + i] + 2 * p[i]:
+            from ..base import MXNetError
+
+            raise MXNetError(
+                f"Pooling kernel {k} exceeds padded input "
+                f"{tuple(data.shape[sp0 + j] for j in range(nd))} "
+                f"(pad {p})")
 
     def _full(vals, fill):
         core = list(vals)

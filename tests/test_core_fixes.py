@@ -1099,3 +1099,50 @@ def test_server_role_import_becomes_parameter_server():
         p.terminate()
         out, _err = p.communicate(timeout=10)
         assert "REACHED" not in out
+
+
+def test_model_zoo_reference_names_and_factories():
+    """Reference model-table names (dotted) resolve; parameterized
+    factories are exported but not listed as model names."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    for name in ("squeezenet1.0", "squeezenet1.1", "mobilenet1.0",
+                 "mobilenet0.25", "mobilenetv2_1.0", "inceptionv3"):
+        assert callable(vision.get_model(name, classes=10).initialize)
+    for helper in ("get_vgg", "get_mobilenet", "get_mobilenet_v2",
+                   "get_resnet"):
+        assert hasattr(vision, helper)
+        with pytest.raises(ValueError):
+            vision.get_model(helper, classes=10)
+    assert vision.get_mobilenet(0.75, classes=10) is not None
+    assert vision.get_vgg(11, batch_norm=True, classes=10) is not None
+
+
+def test_pooling_kernel_larger_than_input_raises():
+    """Reference pooling shape-infer rejects kernel > padded input; XLA
+    would emit a zero-size output that silently poisons downstream
+    (inception_v3 at 224px produced constant logits)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.ops.nn import pooling
+
+    with pytest.raises(mx.base.MXNetError, match="Pooling kernel"):
+        pooling(jnp.zeros((1, 4, 5, 5)), kernel=(8, 8), pool_type="avg")
+    inc = vision.inception_v3(classes=10)
+    inc.initialize()
+    with pytest.raises(mx.base.MXNetError, match="Pooling kernel"):
+        inc(nd.array(np.zeros((1, 3, 224, 224), np.float32)))
+
+
+def test_vgg_conv_init_is_xavier_gaussian_out():
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.vgg11(classes=10)
+    net.initialize()
+    net(nd.array(np.zeros((1, 3, 32, 32), np.float32)))
+    w = list(net.collect_params().values())[0].data().asnumpy()
+    # uniform(0.07) default would put 0% of mass beyond 0.07; the
+    # reference's Xavier gaussian (std ~0.059 for the 3x3x3->64 stem
+    # transposed fan) puts a clear tail there
+    assert (np.abs(w) > 0.07).mean() > 0.05
