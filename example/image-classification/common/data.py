@@ -73,11 +73,19 @@ def get_rec_iter(args, kv):
     train_path = args.data_train or os.path.join(args.data_dir, "train.rec")
     val_path = args.data_val or os.path.join(args.data_dir, "val.rec")
     if args.synthetic:
+        from mxnet_tpu import _native
+
+        def usable(path):
+            # a killed earlier run can leave a partial .rec behind; the
+            # native reader now detects truncation (rec_count == -1), so
+            # regenerate instead of failing forever on the stale file
+            return os.path.exists(path) and _native.rec_count(path) > 0
+
         os.makedirs(os.path.dirname(os.path.abspath(train_path)), exist_ok=True)
-        if not os.path.exists(train_path):
+        if not usable(train_path):
             make_synthetic_rec(train_path, args.synthetic_size, shape,
                                args.num_classes, args.synthetic_encoding)
-        if not os.path.exists(val_path):
+        if not usable(val_path):
             make_synthetic_rec(val_path, max(args.batch_size,
                                              args.synthetic_size // 8),
                                shape, args.num_classes,

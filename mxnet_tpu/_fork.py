@@ -41,13 +41,24 @@ def _after_in_child():
         engine._host_engine = None
     # reseed LAZILY: never touch jax here — creating a PRNGKey would
     # initialize the backend (and dial the exclusive TPU tunnel) inside
-    # every forked DataLoader worker.  Drop the inherited key and divert
-    # the default seed; the next key use materializes it.
+    # every forked DataLoader worker.  Drop BOTH the thread-local key and
+    # the materialized global base (diverting _DEFAULT_SEED alone is
+    # ineffective once _base['key'] exists — every child would re-derive
+    # the parent's stream); the next key use rebuilds from the fresh seed.
     from . import random as _random
 
     if hasattr(_random._state, "key"):
         del _random._state.key
     _random._DEFAULT_SEED = int.from_bytes(os.urandom(4), "little")
+    with _random._base_lock:
+        _random._base["key"] = None
+        _random._base["gen"] += 1
+    # numpy's global RNG is NOT auto-reseeded at fork (stdlib random is):
+    # the flip/crop transforms draw from it, and correlated workers make
+    # identical augmentation decisions
+    import numpy as _np
+
+    _np.random.seed(int.from_bytes(os.urandom(4), "little"))
 
 
 def install():

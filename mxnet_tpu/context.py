@@ -42,7 +42,6 @@ class Context:
                 )
             self.device_type = device_type
             self.device_id = device_id
-        self._old_ctx: Optional[Context] = None
 
     @property
     def device_typeid(self) -> int:
@@ -75,7 +74,11 @@ class Context:
         return self
 
     def __exit__(self, *exc) -> None:
-        Context._tls.stack.pop()
+        stack = getattr(Context._tls, "stack", None)
+        if not stack:
+            raise RuntimeError(
+                "Context.__exit__ without a matching __enter__")
+        stack.pop()
 
     # -- value semantics ----------------------------------------------------------
     def __eq__(self, other) -> bool:

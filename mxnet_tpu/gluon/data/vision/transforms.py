@@ -40,15 +40,15 @@ class ToTensor(HybridBlock):
 class Normalize(HybridBlock):
     def __init__(self, mean=0.0, std=1.0):
         super().__init__()
-        self._mean = mean
-        self._std = std
+        # constants hoisted out of the per-sample hot path (two host->device
+        # array creations per forward otherwise)
+        self._mean = mean if _np.isscalar(mean) else nd.array(
+            _np.asarray(mean, dtype=_np.float32).reshape(-1, 1, 1))
+        self._std = std if _np.isscalar(std) else nd.array(
+            _np.asarray(std, dtype=_np.float32).reshape(-1, 1, 1))
 
     def hybrid_forward(self, F, x):
-        mean = nd.array(_np.asarray(self._mean, dtype=_np.float32)
-                        .reshape(-1, 1, 1)) if not _np.isscalar(self._mean) else self._mean
-        std = nd.array(_np.asarray(self._std, dtype=_np.float32)
-                       .reshape(-1, 1, 1)) if not _np.isscalar(self._std) else self._std
-        return (x - mean) / std
+        return (x - self._mean) / self._std
 
 
 class Resize(Block):

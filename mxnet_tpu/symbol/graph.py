@@ -34,10 +34,22 @@ OP_EXTRA_INPUTS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "LeakyReLU": (("gamma",), ()),
 }
 
+def attr_bool(v, default=False):
+    """Boolean attr that may arrive stringly-typed ("False", "0", "true" —
+    the reference frontend stringifies every attr); plain truthiness would
+    read "False" as True and silently change the graph structure."""
+    if v is None:
+        return default
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes")
+    return bool(v)
+
+
 # ops whose extra-input list depends on attrs
 def _active_extra_inputs(opname: str, attrs: dict) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
     params, aux = OP_EXTRA_INPUTS.get(opname, ((), ()))
-    if opname in ("FullyConnected", "Convolution", "Deconvolution") and attrs.get("no_bias"):
+    if opname in ("FullyConnected", "Convolution", "Deconvolution") \
+            and attr_bool(attrs.get("no_bias")):
         params = tuple(p for p in params if p != "bias")
     if opname == "LeakyReLU" and attrs.get("act_type", "leaky") != "prelu":
         params = ()
@@ -86,20 +98,29 @@ class SymbolEntry:
 
 
 def topo_order(entries: Sequence[SymbolEntry]) -> List[Node]:
-    """Post-order DFS over the DAG, deduplicated (reference: nnvm DFSVisit)."""
+    """Post-order DFS over the DAG, deduplicated (reference: nnvm DFSVisit,
+    which is iterative for the same reason this is: a 1000+-op chain — a
+    deeply unrolled RNN, a long residual stack — must not hit Python's
+    recursion limit)."""
     seen = set()
     order: List[Node] = []
-
-    def visit(node: Node):
-        if id(node) in seen:
-            return
-        seen.add(id(node))
-        for e in node.inputs:
-            visit(e.node)
-        order.append(node)
-
+    stack: List[tuple] = []
     for e in entries:
-        visit(e.node)
+        if id(e.node) in seen:
+            continue
+        seen.add(id(e.node))
+        stack.append((e.node, 0))
+        while stack:
+            node, i = stack[-1]
+            if i < len(node.inputs):
+                stack[-1] = (node, i + 1)
+                child = node.inputs[i].node
+                if id(child) not in seen:
+                    seen.add(id(child))
+                    stack.append((child, 0))
+            else:
+                stack.pop()
+                order.append(node)
     return order
 
 
@@ -129,8 +150,8 @@ def eval_node(node: Node, ins: List[object], is_train: bool, rng_key=None,
     if _op_accepts_training(op):
         kwargs["_training"] = is_train
     if op.name == "BatchNorm" and collect_aux is not None and is_train \
-            and not kwargs.get("use_global_stats"):
-        user_wants_stats = bool(node.attrs.get("output_mean_var"))
+            and not attr_bool(kwargs.get("use_global_stats")):
+        user_wants_stats = attr_bool(node.attrs.get("output_mean_var"))
         kwargs["output_mean_var"] = True
         y, mean, var = op.fn(*ins, **kwargs)
         aux_names = [e.node.name for e in node.inputs[-2:]]

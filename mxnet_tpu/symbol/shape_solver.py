@@ -15,10 +15,7 @@ import numpy as _np
 import jax
 
 from ..base import MXNetError
-from .graph import Node, SymbolEntry, _active_extra_inputs, input_nodes, topo_order
-
-_KEY_STRUCT = jax.ShapeDtypeStruct((2,), _np.uint32)
-
+from .graph import attr_bool, Node, SymbolEntry, _active_extra_inputs, input_nodes, topo_order
 
 def _param_shape_rule(op_name: str, slot: str, attrs: dict,
                       in_shapes: List[Tuple[int, ...]]) -> Tuple[int, ...]:
@@ -27,7 +24,7 @@ def _param_shape_rule(op_name: str, slot: str, attrs: dict,
     if op_name == "FullyConnected":
         nh = int(attrs["num_hidden"])
         flat = 1
-        if attrs.get("flatten", True):
+        if attr_bool(attrs.get("flatten"), default=True):
             for d in data[1:]:
                 flat *= d
         else:
@@ -85,9 +82,9 @@ def _label_shape(op_name: str, attrs: dict,
     FInferShape for these ops runs backward from data, so binding without
     label shapes works — e.g. Module.bind(for_training=False))."""
     if op_name in ("SoftmaxOutput", "Softmax"):
-        if attrs.get("multi_output"):
+        if attr_bool(attrs.get("multi_output")):
             return (data[0],) + tuple(data[2:])
-        if attrs.get("preserve_shape"):
+        if attr_bool(attrs.get("preserve_shape")):
             return tuple(data[:-1])
         return (data[0],)
     if op_name == "SVMOutput":
@@ -149,14 +146,11 @@ def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]]):
             in_shapes.append(sh)
         # abstract-eval the op for output shapes
         kwargs = dict(node.attrs)
-        if op.rng:
-            kwargs["rng_key"] = _KEY_STRUCT
         if _op_accepts_training(op):
             kwargs["_training"] = False
         structs = [jax.ShapeDtypeStruct(s, _np.float32) for s in in_shapes]
         try:
             if op.rng:
-                key = kwargs.pop("rng_key")
                 out = jax.eval_shape(lambda *a: op.fn(*a, rng_key=jax.random.PRNGKey(0), **kwargs), *structs)
             else:
                 out = jax.eval_shape(lambda *a: op.fn(*a, **kwargs), *structs)

@@ -1146,3 +1146,59 @@ def test_vgg_conv_init_is_xavier_gaussian_out():
     # reference's Xavier gaussian (std ~0.059 for the 3x3x3->64 stem
     # transposed fan) puts a clear tail there
     assert (np.abs(w) > 0.07).mean() > 0.05
+
+
+def test_stringly_typed_bool_attrs():
+    """The reference frontend stringifies every attr; "False" must parse as
+    false, not truthy (no_bias='False' silently dropped the bias input)."""
+    fc = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=5,
+                               no_bias="False", name="sb_f")
+    assert fc.list_arguments() == ["data", "sb_f_weight", "sb_f_bias"]
+    fc2 = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=5,
+                                no_bias="True", name="sb_g")
+    assert fc2.list_arguments() == ["data", "sb_g_weight"]
+
+
+def test_deep_graph_no_recursion_error():
+    """topo_order is iterative like nnvm DFSVisit — a 1500-op chain (deep
+    unrolled RNN scale) must infer, not RecursionError."""
+    x = mx.sym.Variable("x")
+    h = x
+    for _ in range(1500):
+        h = h + 1.0
+    _args, outs, _aux = h.infer_shape(x=(2,))
+    assert outs[0] == (2,)
+
+
+def test_fork_reseeds_jax_and_numpy_streams():
+    """Forked DataLoader workers must not replay the parent's (or each
+    other's) jax/numpy random streams — diverting the default seed alone
+    was ineffective once the base key had materialized."""
+    from mxnet_tpu import _fork
+    from mxnet_tpu import random as r
+
+    _fork.install()
+    k_parent = np.asarray(r.next_key())
+    np_parent = np.random.rand()
+    read_r, write_w = os.pipe()
+    pid = os.fork()
+    if pid == 0:  # child
+        try:
+            k_child = np.asarray(r.next_key())
+            np_child = np.random.rand()
+            ok = (not np.array_equal(k_child, k_parent)) \
+                and np_child != np_parent
+            os.write(write_w, b"1" if ok else b"0")
+        finally:
+            os._exit(0)
+    os.close(write_w)
+    try:
+        assert os.read(read_r, 1) == b"1"
+    finally:
+        os.close(read_r)
+        os.waitpid(pid, 0)
+
+
+def test_context_exit_unbalanced_raises():
+    with pytest.raises(RuntimeError, match="without a matching"):
+        mx.cpu().__exit__(None, None, None)
