@@ -186,28 +186,28 @@ class _Pooling(HybridBlock):
 class MaxPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, **kwargs):
-        super().__init__(_tuple(pool_size, 1), strides and _tuple(strides, 1),
+        super().__init__(_tuple(pool_size, 1), _tuple(strides, 1) if strides is not None else None,
                          _tuple(padding, 1), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
                  ceil_mode=False, **kwargs):
-        super().__init__(_tuple(pool_size, 2), strides and _tuple(strides, 2),
+        super().__init__(_tuple(pool_size, 2), _tuple(strides, 2) if strides is not None else None,
                          _tuple(padding, 2), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, **kwargs):
-        super().__init__(_tuple(pool_size, 3), strides and _tuple(strides, 3),
+        super().__init__(_tuple(pool_size, 3), _tuple(strides, 3) if strides is not None else None,
                          _tuple(padding, 3), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, count_include_pad=True, **kwargs):
-        super().__init__(_tuple(pool_size, 1), strides and _tuple(strides, 1),
+        super().__init__(_tuple(pool_size, 1), _tuple(strides, 1) if strides is not None else None,
                          _tuple(padding, 1), ceil_mode, False, "avg",
                          count_include_pad=count_include_pad,
                          layout=layout, **kwargs)
@@ -216,7 +216,7 @@ class AvgPool1D(_Pooling):
 class AvgPool2D(_Pooling):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
                  ceil_mode=False, count_include_pad=True, **kwargs):
-        super().__init__(_tuple(pool_size, 2), strides and _tuple(strides, 2),
+        super().__init__(_tuple(pool_size, 2), _tuple(strides, 2) if strides is not None else None,
                          _tuple(padding, 2), ceil_mode, False, "avg",
                          count_include_pad=count_include_pad,
                          layout=layout, **kwargs)
@@ -225,7 +225,7 @@ class AvgPool2D(_Pooling):
 class AvgPool3D(_Pooling):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, count_include_pad=True, **kwargs):
-        super().__init__(_tuple(pool_size, 3), strides and _tuple(strides, 3),
+        super().__init__(_tuple(pool_size, 3), _tuple(strides, 3) if strides is not None else None,
                          _tuple(padding, 3), ceil_mode, False, "avg",
                          count_include_pad=count_include_pad,
                          layout=layout, **kwargs)

@@ -53,6 +53,14 @@ class Parameter:
 
     @grad_req.setter
     def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise ValueError(f"invalid grad_req {req!r}: "
+                             "expected write/add/null")
+        if not self._differentiable:
+            # reference parameter.py: non-differentiable params (Constants)
+            # stay at 'null' — honoring a blanket setattr('grad_req',
+            # 'write') would silently make constants trainable
+            req = "null"
         self._grad_req = req
         if self._data is not None:
             if req == "null":
@@ -112,6 +120,13 @@ class Parameter:
                 self._finish_deferred_init(data.shape)
             else:
                 raise MXNetError(f"parameter {self.name} not initialized")
+        if tuple(data.shape) != tuple(self.shape):
+            # reference routes this through a validating shape setter; a
+            # silent install would leave self.shape/grad at the old shape
+            # and crash far from the cause on the next backward
+            raise MXNetError(
+                f"set_data for {self.name}: shape {tuple(data.shape)} "
+                f"incompatible with parameter shape {tuple(self.shape)}")
         self._data._data = data._data.astype(self._data._data.dtype) \
             if hasattr(data, "_data") else data
         # preserve autograd marking: the handle identity is unchanged
@@ -227,10 +242,34 @@ class ParameterDict:
         if param is None:
             param = Parameter(name, **kwargs)
             self._params[name] = param
-        else:
-            for k, v in kwargs.items():
-                if getattr(param, k, None) is None and v is not None:
-                    setattr(param, k, v)
+            return param
+        for k, v in kwargs.items():
+            if v is None:
+                continue
+            existing = getattr(param, k, None)
+            if k == "shape":
+                v = (v,) if isinstance(v, int) else tuple(v)
+                if existing is None:
+                    param.shape = v
+                    continue
+                existing = tuple(existing)
+                # reference parameter.py: partial-shape merge — dims must
+                # agree wherever both are known; 0s fill from the other side
+                if len(existing) != len(v) or any(
+                        a and b and a != b for a, b in zip(existing, v)):
+                    raise AssertionError(
+                        f"parameter {name} shape mismatch: existing "
+                        f"{existing} vs requested {v}")
+                param.shape = tuple(a if a else b
+                                    for a, b in zip(existing, v))
+            elif existing is None:
+                setattr(param, k, v)
+            elif k in ("init",):
+                pass  # differing initializer hints keep the first one
+            elif existing != v:
+                raise AssertionError(
+                    f"parameter {name} {k} mismatch: existing {existing!r} "
+                    f"vs requested {v!r}")
         return param
 
     def get_constant(self, name, value=None) -> Constant:
@@ -303,7 +342,21 @@ class ParameterDict:
                     raise MXNetError(f"parameter {name} in file is not in this dict")
                 continue
             p = self._params[name]
+            # reference _load_init: every declared dim must match the saved
+            # one (0 = unknown fills from the file), and dtypes must agree —
+            # a checkpoint from a differently-configured net fails fast here
+            if p.shape is not None:
+                ps, vs = tuple(p.shape), tuple(value.shape)
+                if len(ps) != len(vs) or any(
+                        a and a != b for a, b in zip(ps, vs)):
+                    raise MXNetError(
+                        f"loading {name}: saved shape {vs} incompatible "
+                        f"with declared shape {ps}")
+            if _np.dtype(p.dtype) != _np.dtype(value.dtype):
+                raise MXNetError(
+                    f"loading {name}: saved dtype {value.dtype} != "
+                    f"parameter dtype {p.dtype}")
             if p._data is None:
-                p.shape = value.shape
+                p.shape = tuple(value.shape)
                 p.initialize(ctx=ctx)
             p.set_data(value)

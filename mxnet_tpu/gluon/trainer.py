@@ -98,7 +98,7 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
-        if not self._kv_initialized:
+        if not self._kv_initialized or self._params_to_init:
             self._init_params()
         if self._kvstore is not None:
             idx = self._param2idx[parameter.name]
@@ -106,14 +106,17 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Rescale + allreduce + update (reference: trainer.py:254)."""
-        if not self._kv_initialized:
+        # params that finish deferred init AFTER the kvstore exists must
+        # still be kvstore.init'd (reference re-checks _params_to_init on
+        # every call, not just before the kvstore is created)
+        if not self._kv_initialized or self._params_to_init:
             self._init_params()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
-        if not self._kv_initialized:
+        if not self._kv_initialized or self._params_to_init:
             self._init_params()
         if self._update_on_kvstore:
             raise MXNetError("allreduce_grads() is invalid with update_on_kvstore")
@@ -129,7 +132,10 @@ class Trainer:
                                    ignore_sparse=False)
 
     def update(self, batch_size, ignore_stale_grad=False):
-        if not self._kv_initialized:
+        # params that finish deferred init AFTER the kvstore exists must
+        # still be kvstore.init'd (reference re-checks _params_to_init on
+        # every call, not just before the kvstore is created)
+        if not self._kv_initialized or self._params_to_init:
             self._init_params()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
@@ -152,7 +158,7 @@ class Trainer:
 
     def save_states(self, fname):
         assert self._optimizer is not None
-        if not self._kv_initialized:
+        if not self._kv_initialized or self._params_to_init:
             self._init_params()
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
@@ -161,7 +167,7 @@ class Trainer:
                 f.write(self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):
-        if not self._kv_initialized:
+        if not self._kv_initialized or self._params_to_init:
             self._init_params()
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)

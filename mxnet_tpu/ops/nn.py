@@ -187,6 +187,10 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(), pad=
     # gluon layers pass their own stride=pool_size default explicitly
     s = _pair(stride, nd) if stride else (1,) * nd
     p = _pair(pad, nd) if pad else (0,) * nd
+    if any(v < 1 for v in s):
+        from ..base import MXNetError
+
+        raise MXNetError(f"Pooling stride must be >= 1, got {s}")
     for i in range(nd):
         # reference pooling checks kernel <= padded input (pooling-inl.h
         # shape infer); XLA's reduce_window would instead emit a ZERO-SIZE

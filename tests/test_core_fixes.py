@@ -1202,3 +1202,56 @@ def test_fork_reseeds_jax_and_numpy_streams():
 def test_context_exit_unbalanced_raises():
     with pytest.raises(RuntimeError, match="without a matching"):
         mx.cpu().__exit__(None, None, None)
+
+
+def test_trainer_inits_params_deferred_past_kvstore_creation():
+    """save_states/step before the first forward creates the kvstore while
+    params are still deferred; the later step must kvstore.init them
+    (reference re-checks _params_to_init every call)."""
+    net = nn.Dense(4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    tr.save_states(os.path.join(tempfile.gettempdir(), "tr_def.states"))
+    x = nd.array(np.ones((2, 3), np.float32))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(2)  # previously: 'kvstore: key 0 not initialized'
+
+
+def test_parameter_validation_audit():
+    import pytest as _pytest
+
+    p = gluon.Parameter("w_val", shape=(2, 2))
+    p.initialize()
+    with _pytest.raises(mx.base.MXNetError, match="incompatible"):
+        p.set_data(nd.array(np.ones((3, 3), np.float32)))
+
+    c = gluon.Constant("c_val", [1.0, 2.0])
+    c.initialize()
+    c.grad_req = "write"  # non-differentiable: stays null
+    assert c.grad_req == "null"
+
+    pd = gluon.ParameterDict()
+    pd.get("w", shape=(2, 3))
+    with _pytest.raises(AssertionError, match="mismatch"):
+        pd.get("w", shape=(4, 5))
+    pd.get("v", shape=(2, 0))
+    assert pd.get("v", shape=(0, 3)).shape == (2, 3)  # partial-shape merge
+    pd.get("u")
+    pd.get("u", shape=5).initialize()  # int shape normalized
+
+    pd2 = gluon.ParameterDict()
+    pd2.get("w", shape=(2, 2))
+    path = os.path.join(tempfile.gettempdir(), "ld_val.params")
+    nd.save(path, {"w": nd.array(np.ones((3, 3), np.float32))})
+    with _pytest.raises(mx.base.MXNetError, match="incompatible"):
+        pd2.load(path)
+
+
+def test_pooling_stride_zero_rejected():
+    import pytest as _pytest
+
+    with _pytest.raises(mx.base.MXNetError, match="stride"):
+        nn.MaxPool2D(pool_size=2, strides=0)(
+            nd.array(np.ones((1, 1, 5, 5), np.float32)))
