@@ -124,6 +124,13 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
         location = dict(zip(arg_names, location))
     location = {k: (v if isinstance(v, NDArray) else nd.array(v))
                 for k, v in location.items()}
+    if aux_states is not None:
+        # accept the same forms bind() does: ordered list or dict, NDArray
+        # or numpy values
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(sym.list_auxiliary_states(), aux_states))
+        aux_states = {k: (v if isinstance(v, NDArray) else nd.array(v))
+                      for k, v in aux_states.items()}
     grad_nodes = grad_nodes or arg_names
     ex = sym.bind(ctx=ctx, args=location,
                   args_grad={n: nd.zeros(location[n].shape) for n in grad_nodes},
@@ -135,8 +142,11 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     analytic = {n: ex.grad_dict[n].asnumpy() for n in grad_nodes}
 
     # numeric: perturb each grad node
+    aux_env = {k: v._data for k, v in (aux_states or {}).items()}
+
     def f(vals):
         env = {k: v._data for k, v in location.items()}
+        env.update(aux_env)
         env.update(vals)
         from .symbol.graph import trace
 

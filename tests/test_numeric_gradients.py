@@ -1,0 +1,145 @@
+"""Finite-difference gradient checks across op families — the reference's
+core operator-test tool (python/mxnet/test_utils.py check_numeric_gradient,
+used throughout tests/python/unittest/test_operator.py).  Shapes are tiny:
+each perturbed element costs two eager re-evaluations."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+R = np.random.RandomState(7)
+
+
+def _d(shape):
+    return R.uniform(-1.0, 1.0, shape).astype(np.float32)
+
+
+def test_grad_elementwise_chain():
+    x = mx.sym.Variable("x")
+    y = mx.sym.tanh(x) * mx.sym.sigmoid(x) + mx.sym.exp(0.5 * x)
+    check_numeric_gradient(y, [_d((3, 4))])
+
+
+def test_grad_fully_connected():
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    b = mx.sym.Variable("b")
+    y = mx.sym.FullyConnected(x, w, b, num_hidden=3)
+    check_numeric_gradient(y, [_d((2, 4)), _d((3, 4)), _d((3,))])
+
+
+def test_grad_convolution():
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    b = mx.sym.Variable("b")
+    y = mx.sym.Convolution(x, w, b, kernel=(3, 3), num_filter=2, pad=(1, 1))
+    check_numeric_gradient(y, [_d((1, 2, 4, 4)), _d((2, 2, 3, 3)),
+                               _d((2,))], numeric_eps=1e-2, rtol=3e-2)
+
+
+def test_grad_deconvolution():
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    y = mx.sym.Deconvolution(x, w, kernel=(2, 2), num_filter=2,
+                             no_bias=True)
+    check_numeric_gradient(y, [_d((1, 2, 3, 3)), _d((2, 2, 2, 2))],
+                           numeric_eps=1e-2, rtol=3e-2)
+
+
+def test_grad_pooling_avg():
+    x = mx.sym.Variable("x")
+    y = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    check_numeric_gradient(y, [_d((1, 2, 4, 4))])
+
+
+def test_grad_batchnorm_train():
+    x = mx.sym.Variable("x")
+    g = mx.sym.Variable("g")
+    b = mx.sym.Variable("b")
+    mm = mx.sym.Variable("mm", __is_aux__="1")
+    mv = mx.sym.Variable("mv", __is_aux__="1")
+    y = mx.sym.BatchNorm(x, g, b, mm, mv, fix_gamma=False)
+    from mxnet_tpu import nd
+
+    check_numeric_gradient(
+        y, {"x": _d((2, 3, 2, 2)), "g": _d((3,)) + 1.5, "b": _d((3,))},
+        aux_states={"mm": nd.zeros((3,)), "mv": nd.array(np.ones(3))},
+        grad_nodes=["x", "g", "b"], numeric_eps=1e-2, rtol=5e-2, atol=5e-2)
+
+
+def test_grad_layernorm():
+    x = mx.sym.Variable("x")
+    g = mx.sym.Variable("g")
+    b = mx.sym.Variable("b")
+    y = mx.sym.LayerNorm(x, g, b)
+    check_numeric_gradient(y, [_d((3, 5)), _d((5,)) + 1.5, _d((5,))],
+                           numeric_eps=1e-2, rtol=5e-2, atol=5e-2)
+
+
+def test_grad_dot_and_batch_dot():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    check_numeric_gradient(mx.sym.dot(a, b), [_d((2, 3)), _d((3, 4))])
+    check_numeric_gradient(mx.sym.batch_dot(a, b),
+                           [_d((2, 2, 3)), _d((2, 3, 2))])
+
+
+def test_grad_broadcast_ops():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    y = mx.sym.broadcast_mul(a, b) + mx.sym.broadcast_div(
+        a, b + 3.0)
+    check_numeric_gradient(y, [_d((2, 3)), _d((1, 3))])
+
+
+def test_grad_reduce_and_reshape():
+    x = mx.sym.Variable("x")
+    y = mx.sym.sum(mx.sym.reshape(mx.sym.transpose(x), shape=(3, -1)) ** 2.0,
+                   axis=1)
+    check_numeric_gradient(y, [_d((4, 3))])
+
+
+def test_grad_take_wrt_data():
+    x = mx.sym.Variable("x")
+    i = mx.sym.Variable("i")
+    y = mx.sym.take(x, i, axis=0)
+    from mxnet_tpu import nd
+
+    check_numeric_gradient(
+        y, {"x": _d((5, 3)), "i": nd.array(np.array([0, 2, 4], np.float32))},
+        grad_nodes=["x"])
+
+
+def test_grad_leaky_relu_prelu():
+    x = mx.sym.Variable("x")
+    g = mx.sym.Variable("g")
+    y = mx.sym.LeakyReLU(x, g, act_type="prelu")
+    # keep inputs away from the kink at 0
+    loc = {"x": _d((2, 4)) + np.where(_d((2, 4)) > 0, 0.5, -0.5),
+           "g": np.full((4,), 0.3, np.float32)}
+    check_numeric_gradient(y, loc, numeric_eps=1e-3)
+
+
+def test_grad_concat_and_slice():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    y = mx.sym.slice(mx.sym.concat(a, b, dim=1), begin=(0, 1),
+                     end=(2, 5))
+    check_numeric_gradient(y, [_d((2, 3)), _d((2, 3))])
+
+
+def test_grad_smooth_l1():
+    x = mx.sym.Variable("x")
+    y = mx.sym.smooth_l1(x, scalar=1.0)
+    # keep away from the |x|=1/sigma^2 kink
+    loc = [np.clip(_d((3, 3)) * 3, -2.5, 2.5).astype(np.float32)]
+    loc[0][np.abs(np.abs(loc[0]) - 1.0) < 0.2] = 0.5
+    check_numeric_gradient(y, loc, numeric_eps=1e-3)
+
+
+def test_grad_linalg_gemm2():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    y = mx.sym.linalg_gemm2(a, b)
+    check_numeric_gradient(y, [_d((3, 2)), _d((2, 3))])
