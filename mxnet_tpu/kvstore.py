@@ -137,14 +137,29 @@ class KVStoreLocal(KVStore):
     def init(self, key, value):
         keys = _as_list(key)
         values = _as_list(value)
-        if len(values) != len(keys):  # single key, multiple device values
+        if len(values) != len(keys):
+            # single key, multiple device values — but N keys with M!=N
+            # values is a caller bug the reference rejects at init time
+            # (silently zip-dropping keys would fail far from the cause)
+            if len(keys) != 1:
+                raise MXNetError(
+                    f"kvstore.init: {len(keys)} keys but {len(values)} "
+                    "values")
             values = [values]
         for k, v in zip(keys, values):
             v0 = v[0] if isinstance(v, (list, tuple)) else v
-            if isinstance(v0, _sparse.BaseSparseNDArray):
-                self._store[k] = v0
-            else:
-                self._store[k] = NDArray(v0._data)
+            self._store[k] = self._copy_value(v0)
+
+    @staticmethod
+    def _copy_value(v):
+        """Store by value, never by reference (reference CopyFromTo):
+        callers reuse gradient buffers every backward, and an aliased store
+        would silently track them."""
+        if isinstance(v, _sparse.RowSparseNDArray):
+            return _sparse.RowSparseNDArray(v.values_, v.indices_, v.shape)
+        if isinstance(v, _sparse.CSRNDArray):
+            return _sparse.CSRNDArray(v.data_, v.indices_, v.indptr_, v.shape)
+        return NDArray(v._data)
 
     def _compress(self, key, slot, data: jnp.ndarray) -> jnp.ndarray:
         """Quantize-dequantize one contribution with error feedback, as the
@@ -166,7 +181,9 @@ class KVStoreLocal(KVStore):
         if len(vals) == 1:
             v = vals[0]
             if isinstance(v, _sparse.RowSparseNDArray):
-                return v
+                # by value: the caller's grad buffer is reused each backward
+                return _sparse.RowSparseNDArray(v.values_, v.indices_,
+                                                v.shape)
             if compress:
                 return NDArray(self._compress(key, 0, v._data))
             return NDArray(v._data)
@@ -251,6 +268,8 @@ class KVStoreLocal(KVStore):
             rids = [rids]  # group ALL row-id sets with the single key
         for k, o, r in zip(keys, outs, rids):
             src = self._store.get(k)
+            if src is None:
+                raise MXNetError(f"kvstore: key {k!r} not initialized")
             dsts = _as_list(o)
             rlist = _as_list(r)
             if len(rlist) == 1 and len(dsts) > 1:

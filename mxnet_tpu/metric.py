@@ -119,10 +119,13 @@ class Accuracy(EvalMetric):
         for label, pred in zip(labels, preds):
             p = _to_np(pred)
             l = _to_np(label).astype(_np.int64)
-            if p.ndim > l.ndim:
+            # reference: argmax whenever shapes differ — the ubiquitous
+            # (N,1) label with (N,C) preds included, not just ndim mismatch
+            if p.shape != l.shape:
                 p = _np.argmax(p, axis=self.axis)
-            p = p.astype(_np.int64)
-            self.sum_metric += float((p.flat == l.flat).sum())
+            p = p.astype(_np.int64).reshape(-1)
+            l = l.reshape(-1)
+            self.sum_metric += float((p == l).sum())
             self.num_inst += l.size
 
 
@@ -136,9 +139,11 @@ class TopKAccuracy(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             p = _to_np(pred)
-            l = _to_np(label).astype(_np.int64)
-            topk = _np.argsort(-p, axis=-1)[..., :self.top_k]
-            hits = (topk == l[..., None]).any(axis=-1)
+            # reference flattens the label; an (N,1) label would otherwise
+            # broadcast (N,k) against (N,1,1) and count cross-sample hits
+            l = _to_np(label).astype(_np.int64).reshape(-1)
+            topk = _np.argsort(-p.reshape(len(l), -1), axis=-1)[:, :self.top_k]
+            hits = (topk == l[:, None]).any(axis=-1)
             self.sum_metric += float(hits.sum())
             self.num_inst += l.size
 
@@ -275,8 +280,12 @@ class MAE(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             p, l = _to_np(pred), _to_np(label)
-            if l.ndim == 1 and p.ndim != 1:
-                l = l.reshape(p.shape)
+            # reference reshapes each 1-D side to (N,1): never broadcast a
+            # 1-D/2-D pair into an (N,N) matrix, and allow (N,)/(N,C)
+            if l.ndim == 1:
+                l = l.reshape(-1, 1)
+            if p.ndim == 1:
+                p = p.reshape(-1, 1)
             self.sum_metric += float(_np.abs(l - p).mean())
             self.num_inst += 1
 
@@ -289,8 +298,12 @@ class MSE(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             p, l = _to_np(pred), _to_np(label)
-            if l.ndim == 1 and p.ndim != 1:
-                l = l.reshape(p.shape)
+            # reference reshapes each 1-D side to (N,1): never broadcast a
+            # 1-D/2-D pair into an (N,N) matrix, and allow (N,)/(N,C)
+            if l.ndim == 1:
+                l = l.reshape(-1, 1)
+            if p.ndim == 1:
+                p = p.reshape(-1, 1)
             self.sum_metric += float(((l - p) ** 2).mean())
             self.num_inst += 1
 
@@ -303,8 +316,12 @@ class RMSE(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             p, l = _to_np(pred), _to_np(label)
-            if l.ndim == 1 and p.ndim != 1:
-                l = l.reshape(p.shape)
+            # reference reshapes each 1-D side to (N,1): never broadcast a
+            # 1-D/2-D pair into an (N,N) matrix, and allow (N,)/(N,C)
+            if l.ndim == 1:
+                l = l.reshape(-1, 1)
+            if p.ndim == 1:
+                p = p.reshape(-1, 1)
             self.sum_metric += float(math.sqrt(((l - p) ** 2).mean()))
             self.num_inst += 1
 

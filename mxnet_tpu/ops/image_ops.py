@@ -55,35 +55,57 @@ def random_flip_top_bottom(data, rng_key=None, p=0.5):
     return jnp.where(do, jnp.flip(data, axis=-2), data)
 
 
+def _luma_chw():
+    # 0.299/0.587/0.114 over the CHW channel axis (reference
+    # AdjustContrast/SaturationImpl coef)
+    return jnp.asarray((0.299, 0.587, 0.114), jnp.float32).reshape((3, 1, 1))
+
+
 @register("_image_random_brightness", rng=True, differentiable=False)
 def random_brightness(data, min_factor=0.5, max_factor=1.5, rng_key=None):
     f = jax.random.uniform(rng_key, (), minval=float(min_factor),
                            maxval=float(max_factor))
-    return data * f
+    return _cast_like(data.astype(jnp.float32) * f, data)
 
 
 @register("_image_random_contrast", rng=True, differentiable=False)
 def random_contrast(data, min_factor=0.5, max_factor=1.5, rng_key=None):
     f = jax.random.uniform(rng_key, (), minval=float(min_factor),
                            maxval=float(max_factor))
-    mean = jnp.mean(data, axis=(-1, -2), keepdims=True)
-    return (data - mean) * f + mean
+    xf = data.astype(jnp.float32)
+    # reference AdjustContrastImpl: blend toward the SCALAR luma gray mean
+    # (a per-channel spatial mean would make contrast a no-op on flat
+    # channels)
+    gray_mean = jnp.mean(jnp.sum(xf * _luma_chw(), axis=-3), axis=(-1, -2),
+                         keepdims=True)[..., None, :, :]
+    return _cast_like(xf * f + (1.0 - f) * gray_mean, data)
 
 
 @register("_image_random_saturation", rng=True, differentiable=False)
 def random_saturation(data, min_factor=0.5, max_factor=1.5, rng_key=None):
     f = jax.random.uniform(rng_key, (), minval=float(min_factor),
                            maxval=float(max_factor))
-    # grayscale via channel mean (CHW: channel axis -3)
-    gray = jnp.mean(data, axis=-3, keepdims=True)
-    return data * f + gray * (1.0 - f)
+    xf = data.astype(jnp.float32)
+    # reference AdjustSaturationImpl: per-pixel luma gray, not (R+G+B)/3
+    gray = jnp.sum(xf * _luma_chw(), axis=-3, keepdims=True)
+    return _cast_like(xf * f + gray * (1.0 - f), data)
 
 
 @register("_image_resize")
 def resize(data, size=0, keep_ratio=False, interp=1):
-    """Bilinear resize (reference: image resize op). size: int or (w, h)."""
+    """Bilinear resize (reference: image resize op). size: int or (w, h);
+    an int size with keep_ratio scales the SHORT side to `size` preserving
+    aspect ratio (reference gluon Resize semantics)."""
     if isinstance(size, (tuple, list)):
         w, h = int(size[0]), int(size[1])
+    elif keep_ratio:
+        ih, iw = (data.shape[-2], data.shape[-1])
+        if ih <= iw:
+            h = int(size)
+            w = max(1, round(iw * int(size) / ih))
+        else:
+            w = int(size)
+            h = max(1, round(ih * int(size) / iw))
     else:
         w = h = int(size)
     chw = data.ndim == 3
@@ -239,4 +261,4 @@ def random_color_jitter(data, brightness=0.0, contrast=0.0, saturation=0.0,
     out = data.astype(jnp.float32)
     for i in range(4):
         out = jax.lax.switch(order[i], branches, out)
-    return out
+    return _cast_like(out, data)

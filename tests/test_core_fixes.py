@@ -1255,3 +1255,72 @@ def test_pooling_stride_zero_rejected():
     with _pytest.raises(mx.base.MXNetError, match="stride"):
         nn.MaxPool2D(pool_size=2, strides=0)(
             nd.array(np.ones((1, 1, 5, 5), np.float32)))
+
+
+def test_metric_shape_normalization_audit():
+    """Reference metric semantics for (N,1)/(N,C) shape combinations."""
+    m = mx.metric.Accuracy()
+    m.update([nd.array(np.array([[0], [1], [1]], np.float32))],
+             [nd.array(np.array([[.9, .1], [.1, .9], [.2, .8]], np.float32))])
+    assert m.get()[1] == 1.0  # (N,1) label vs (N,C) preds: argmax applies
+
+    t = mx.metric.TopKAccuracy(top_k=2)
+    t.update([nd.array(np.array([[0], [1], [2]], np.float32))],
+             [nd.array(np.eye(3).astype(np.float32))])
+    assert t.get()[1] == 1.0  # flattened label: no cross-sample hits
+
+    mae = mx.metric.MAE()
+    mae.update([nd.array(np.array([[1], [2], [3]], np.float32))],
+               [nd.array(np.array([1, 2, 3], np.float32))])
+    assert mae.get()[1] == 0.0  # 1-D side reshapes to (N,1), no (N,N) blow-up
+
+    mae2 = mx.metric.MAE()
+    mae2.update([nd.array(np.array([1., 2.], np.float32))],
+                [nd.array(np.array([[1, 3], [2, 4]], np.float32))])
+    assert abs(mae2.get()[1] - 1.0) < 1e-6  # (N,)/(N,C) broadcasts per ref
+
+
+def test_kvstore_stores_by_value_and_validates():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ndarray import sparse
+
+    kv = mx.kv.create("local")
+    rsp = sparse.row_sparse_array(
+        (np.full((1, 2), 5, np.float32), np.array([1])), shape=(4, 2))
+    kv.init("e", rsp)
+    kv.push("e", rsp)
+    rsp.values_ = jnp.full((1, 2), 99.0)  # caller reuses its grad buffer
+    out = sparse.zeros("row_sparse", (4, 2))
+    kv.row_sparse_pull("e", out=out, row_ids=nd.array(np.arange(4)))
+    assert np.allclose(out.asnumpy()[1], 5.0)  # store was not aliased
+
+    with pytest.raises(mx.base.MXNetError):
+        kv.init(["a", "b"], [nd.array(np.ones(2, np.float32))])
+    with pytest.raises(mx.base.MXNetError, match="not initialized"):
+        kv.row_sparse_pull("missing", out=out,
+                           row_ids=nd.array(np.arange(4)))
+
+
+def test_image_aug_reference_semantics_audit():
+    """Contrast/saturation use the scalar/per-pixel LUMA gray (reference
+    AdjustContrast/SaturationImpl); outputs saturate-cast; resize honors
+    keep_ratio."""
+    img = np.zeros((3, 4, 4), np.float32)
+    img[2] = 100.0  # pure blue
+    out = nd._image_random_contrast(nd.array(img), min_factor=0.5,
+                                    max_factor=0.5 + 1e-9).asnumpy()
+    assert abs(out[0, 0, 0] - 5.7) < 0.1 and abs(out[2, 0, 0] - 55.7) < 0.1
+    out = nd._image_random_saturation(nd.array(img), min_factor=0.5,
+                                      max_factor=0.5 + 1e-9).asnumpy()
+    assert abs(out[0, 0, 0] - 5.7) < 0.1 and abs(out[2, 0, 0] - 55.7) < 0.1
+
+    i8 = np.full((3, 4, 4), 200, np.uint8)
+    out8 = nd._image_random_brightness(nd.array(i8), min_factor=1.5,
+                                       max_factor=1.5 + 1e-9).asnumpy()
+    assert out8.dtype == np.uint8 and (out8 == 255).all()
+
+    big = np.random.rand(3, 100, 200).astype(np.float32)
+    assert nd._image_resize(nd.array(big), size=50,
+                            keep_ratio=True).shape == (3, 50, 100)
+    assert nd._image_resize(nd.array(big), size=50).shape == (3, 50, 50)
