@@ -14,7 +14,15 @@ class AttrScope:
     _tls = threading.local()
 
     def __init__(self, **kwargs):
-        self._attr = {k: str(v) for k, v in kwargs.items()}
+        for k, v in kwargs.items():
+            if not isinstance(v, str):
+                # reference attribute.py: 'Attributes need to be string' —
+                # silently stringifying dicts/ints attaches garbage to
+                # every symbol in scope
+                raise ValueError(
+                    f"AttrScope value for {k!r} must be a string, "
+                    f"got {type(v).__name__}")
+        self._attr = dict(kwargs)
 
     def get(self, attr):
         out = dict(self._attr)
@@ -31,7 +39,11 @@ class AttrScope:
         return self
 
     def __exit__(self, *exc):
-        AttrScope._stack().pop()
+        stack = AttrScope._stack()
+        if len(stack) <= 1:
+            raise RuntimeError(
+                "AttrScope.__exit__ without a matching __enter__")
+        stack.pop()
 
     @staticmethod
     def _stack():

@@ -60,6 +60,13 @@ class Registry(Generic[T]):
     def register(self, name: Optional[str] = None, *aliases: str) -> Callable[[T], T]:
         def _reg(obj: T) -> T:
             key = (name or getattr(obj, "__name__", None) or str(obj)).lower()
+            for k in (key, *[a.lower() for a in aliases]):
+                # dmlc::Registry CHECK-fails on duplicates; allow only the
+                # idempotent re-registration of the SAME object (module
+                # reloads), never a silent replacement of a built-in
+                if k in self._map and self._map[k] is not obj:
+                    raise ValueError(
+                        f"{self.kind} {k!r} is already registered")
             self._map[key] = obj
             for a in aliases:
                 self._map[a.lower()] = obj

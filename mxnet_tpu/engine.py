@@ -24,7 +24,6 @@ tasks, not the XLA compute path.
 """
 from __future__ import annotations
 
-import contextlib
 import os
 import threading
 
@@ -33,8 +32,11 @@ from .base import getenv
 __all__ = ["set_bulk_size", "bulk", "is_naive", "wait_all", "push",
            "new_var", "wait_for_var", "host_engine", "NaiveEngine"]
 
-_state = threading.local()
 _ENGINE_TYPE = getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+# process-wide like MXEngineSetBulkSize (a threading.local here meant worker
+# threads never saw the user's setting)
+_bulk_size = 15
+_bulk_lock = threading.Lock()
 
 
 def is_naive() -> bool:
@@ -48,23 +50,36 @@ def set_engine_type(name: str) -> None:
 
 def set_bulk_size(size: int) -> int:
     """Reference: MXEngineSetBulkSize; returns previous value."""
-    old = getattr(_state, "bulk_size", 15)
-    _state.bulk_size = int(size)
+    global _bulk_size
+    with _bulk_lock:
+        old = _bulk_size
+        _bulk_size = int(size)
     return old
 
 
 def bulk_size() -> int:
-    return getattr(_state, "bulk_size", 15)
+    return _bulk_size
 
 
-@contextlib.contextmanager
-def bulk(size: int):
+class _BulkScope:
+    """Reusable bulk scope (reference engine.py returns an object that can
+    be stored and re-entered, not a single-use generator)."""
+
+    def __init__(self, size: int):
+        self._size = int(size)
+        self._old: list = []
+
+    def __enter__(self):
+        self._old.append(set_bulk_size(self._size))
+        return self
+
+    def __exit__(self, *exc):
+        set_bulk_size(self._old.pop())
+
+
+def bulk(size: int) -> _BulkScope:
     """Scope batching engine pushes (reference: python/mxnet/engine.py bulk)."""
-    old = set_bulk_size(size)
-    try:
-        yield
-    finally:
-        set_bulk_size(old)
+    return _BulkScope(size)
 
 
 _host_engine = None
@@ -122,16 +137,17 @@ def wait_for_var(var) -> None:
 
 
 def wait_all() -> None:
-    """Reference: Engine::WaitForAll — host engine first, then device."""
+    """Reference: Engine::WaitForAll — host engine first, then device.
+    Exceptions from failed async ops RETHROW (engine.h WaitForAll);
+    only the absence of effects_barrier on old jax is tolerated."""
     eng = _host_engine
     if eng is not None:
         eng.wait_all()
     import jax
 
-    try:
-        jax.effects_barrier()
-    except Exception:
-        pass
+    barrier = getattr(jax, "effects_barrier", None)
+    if barrier is not None:
+        barrier()
 
 
 class NaiveEngine:

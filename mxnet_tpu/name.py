@@ -27,7 +27,11 @@ class NameManager:
         return self
 
     def __exit__(self, *exc):
-        NameManager._stack().pop()
+        stack = NameManager._stack()
+        if len(stack) <= 1:
+            raise RuntimeError(
+                "NameManager.__exit__ without a matching __enter__")
+        stack.pop()
 
     @staticmethod
     def _stack():
@@ -42,7 +46,10 @@ class Prefix(NameManager):
         self._prefix = prefix
 
     def get(self, name, hint):
-        return name if name else self._prefix + super().get(None, hint)
+        # reference name.py Prefix: the prefix applies to EXPLICIT names
+        # too — dropping it for named layers collides parameter names
+        # across blocks and changes checkpoint keys
+        return self._prefix + (name if name else super().get(None, hint))
 
 
 def current() -> NameManager:

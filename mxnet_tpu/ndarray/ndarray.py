@@ -24,6 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import engine
 from ..base import MXNetError, np_dtype
 from ..context import Context, current_context
 from ..ops.registry import Op, get_op
@@ -597,6 +598,14 @@ def invoke(op: Op, inputs: Sequence[NDArray], attrs: dict, out=None):
 
     multi = isinstance(result, (tuple, list))
     results = list(result) if multi else [result]
+    if engine.is_naive():
+        # MXNET_ENGINE_TYPE=NaiveEngine: fully synchronous dispatch — block
+        # on every output so execution serializes and async exceptions
+        # surface at the faulting op (reference src/engine/naive_engine.cc;
+        # SURVEY §5.2 race-debug strategy depends on this)
+        for r in results:
+            if hasattr(r, "block_until_ready"):
+                r.block_until_ready()
     outputs = [NDArray(r) for r in results]
 
     if out is not None:

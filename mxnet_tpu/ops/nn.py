@@ -599,72 +599,3 @@ _regression_vjp.defvjp(_reg_fwd, _reg_bwd)
 @register("MakeLoss")
 def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
     return data
-
-
-@register("CTCLoss", aliases=("ctc_loss",))
-def ctc_loss(data, label, data_lengths=None, label_lengths=None,
-             use_data_lengths=False, use_label_lengths=False, blank_label="first"):
-    """CTC loss (reference: src/operator/contrib/ctc_loss.cc) via the standard
-    alpha-recursion in log space with lax.scan over time."""
-    # data: (T, N, C) as in the reference
-    T, N, C = data.shape
-    logp = jax.nn.log_softmax(data, axis=-1)
-    blank = 0 if blank_label == "first" else C - 1
-    L = label.shape[1]
-    lab = label.astype(jnp.int32)
-    if blank_label != "first":
-        pass  # labels already 0-based
-    # extended labels with blanks: length 2L+1
-    ext = jnp.full((N, 2 * L + 1), blank, dtype=jnp.int32)
-    ext = ext.at[:, 1::2].set(lab)
-    if use_label_lengths and label_lengths is not None:
-        lab_len = label_lengths.astype(jnp.int32)
-    else:
-        lab_len = jnp.sum(lab != 0, axis=1).astype(jnp.int32) if blank == 0 else \
-            jnp.sum(lab != -1, axis=1).astype(jnp.int32)
-    ext_len = 2 * lab_len + 1
-    S = 2 * L + 1
-    neg_inf = -1e30
-    # init alpha
-    alpha0 = jnp.full((N, S), neg_inf)
-    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
-    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0])
-
-    same_as_prev2 = jnp.pad(ext[:, 2:] == ext[:, :-2], ((0, 0), (2, 0)),
-                            constant_values=True)
-
-    def step(alpha, logp_t):
-        a = alpha
-        a1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=neg_inf)
-        a2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=neg_inf)
-        a2 = jnp.where(same_as_prev2, neg_inf, a2)
-        m = jnp.maximum(jnp.maximum(a, a1), a2)
-        m_safe = jnp.where(m == neg_inf, 0.0, m)
-        merged = m_safe + jnp.log(
-            jnp.exp(a - m_safe) + jnp.exp(a1 - m_safe) + jnp.exp(a2 - m_safe) + 1e-37
-        )
-        merged = jnp.where(m == neg_inf, neg_inf, merged)
-        emit = jnp.take_along_axis(logp_t, ext, axis=1)
-        out = merged + emit
-        return out, None
-
-    if use_data_lengths and data_lengths is not None:
-        dl = data_lengths.astype(jnp.int32)
-
-        def step_masked(carry, inp):
-            alpha, t = carry
-            new_alpha, _ = step(alpha, inp)
-            new_alpha = jnp.where((t < dl)[:, None], new_alpha, alpha)
-            return (new_alpha, t + 1), None
-
-        (alphaT, _), _ = lax.scan(step_masked, (alpha0, jnp.asarray(1)), logp[1:])
-    else:
-        alphaT, _ = lax.scan(step, alpha0, logp[1:])
-    idx_last = jnp.clip(ext_len - 1, 0, S - 1)
-    idx_prev = jnp.clip(ext_len - 2, 0, S - 1)
-    aL = jnp.take_along_axis(alphaT, idx_last[:, None], axis=1)[:, 0]
-    aP = jnp.take_along_axis(alphaT, idx_prev[:, None], axis=1)[:, 0]
-    m = jnp.maximum(aL, aP)
-    m_safe = jnp.where(m == neg_inf, 0.0, m)
-    ll = m_safe + jnp.log(jnp.exp(aL - m_safe) + jnp.exp(aP - m_safe) + 1e-37)
-    return -ll

@@ -73,6 +73,11 @@ def register(name: Optional[str] = None, num_outputs=1, differentiable=True,
                 rng=rng, aliases=aliases)
         if opname in OP_REGISTRY:
             raise ValueError(f"op {opname!r} already registered")
+        for a in aliases:
+            if a in OP_REGISTRY:
+                raise ValueError(
+                    f"op alias {a!r} already registered (would silently "
+                    f"rebind it to {opname!r})")
         OP_REGISTRY[opname] = op
         for a in aliases:
             OP_REGISTRY[a] = op

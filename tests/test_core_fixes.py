@@ -1324,3 +1324,70 @@ def test_image_aug_reference_semantics_audit():
     assert nd._image_resize(nd.array(big), size=50,
                             keep_ratio=True).shape == (3, 50, 100)
     assert nd._image_resize(nd.array(big), size=50).shape == (3, 50, 50)
+
+
+def test_prefix_applies_to_explicit_names():
+    """Reference name.py Prefix prefixes explicit layer names too —
+    dropping it collides parameter names across blocks."""
+    from mxnet_tpu import name as mxname
+
+    with mxname.Prefix("mynet_"):
+        fc = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                   name="fc1")
+    assert fc.list_arguments() == ["data", "mynet_fc1_weight",
+                                   "mynet_fc1_bias"]
+
+
+def test_scope_and_registry_guards():
+    import pytest as _pytest
+
+    from mxnet_tpu import attribute, engine
+    from mxnet_tpu import name as mxname
+    from mxnet_tpu.ops.registry import register
+
+    with _pytest.raises(ValueError):
+        attribute.AttrScope(lr_mult=2)  # non-string attrs rejected
+    with _pytest.raises(RuntimeError):
+        attribute.AttrScope(x="1").__exit__(None, None, None)
+    attribute.current()  # stack not poisoned
+    with _pytest.raises(RuntimeError):
+        mxname.NameManager().__exit__(None, None, None)
+    mxname.current()
+
+    register("zzz_guard_a")(lambda x: x)
+    with _pytest.raises(ValueError, match="alias"):
+        register("zzz_guard_b", aliases=("zzz_guard_a",))(lambda x: x)
+    with _pytest.raises(ValueError):
+        mx.metric.register("acc")(type("FakeAcc", (mx.metric.EvalMetric,),
+                                       {}))
+
+    # bulk scope: reusable object, process-wide size
+    sc = engine.bulk(10)
+    with sc:
+        assert engine.bulk_size() == 10
+    with sc:
+        assert engine.bulk_size() == 10
+    assert engine.bulk_size() == 15
+    old = engine.set_bulk_size(64)
+    try:
+        import threading
+
+        seen = []
+        t = threading.Thread(target=lambda: seen.append(engine.bulk_size()))
+        t.start()
+        t.join()
+        assert seen == [64]
+    finally:
+        engine.set_bulk_size(old)
+
+
+def test_naive_engine_blocks_dispatch():
+    from mxnet_tpu import engine
+
+    with engine.NaiveEngine():
+        out = nd.dot(nd.array(np.ones((32, 32), np.float32)),
+                     nd.array(np.ones((32, 32), np.float32)))
+        # synchronous mode: the result buffer is already materialized
+        assert hasattr(out._data, "is_ready") is False or \
+            out._data.is_ready()
+    assert not engine.is_naive()
