@@ -3,6 +3,7 @@ from __future__ import annotations
 
 from ... import ndarray as nd
 from ..block import Block, HybridBlock
+from ..nn import BatchNorm as _BatchNorm
 from ..nn import Sequential, HybridSequential
 
 __all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
@@ -68,8 +69,7 @@ class SparseEmbedding(Block):
 
 
 
-class SyncBatchNorm(__import__("mxnet_tpu.gluon.nn.basic_layers",
-                               fromlist=["BatchNorm"]).BatchNorm):
+class SyncBatchNorm(_BatchNorm):
     """Cross-device BatchNorm (reference: gluon/contrib/nn/basic_layers.py
     SyncBatchNorm over src/operator/contrib/sync_batch_norm-inl.h).
 

@@ -50,7 +50,10 @@ class VariationalDropoutCell(RecurrentCell):
                 inputs = inputs * mi
             ms = self._mask("s", states[0], self.drop_states)
             if ms is not None:
-                states = [s * ms for s in states]
+                # reference rnn_cell.py:96-98: 'state dropout only needs to
+                # be applied on h' — masking the LSTM cell state c too
+                # destroys/inflates long-term memory every step
+                states = [states[0] * ms] + list(states[1:])
         output, states = self.base_cell(inputs, states)
         if autograd.is_training():
             mo = self._mask("o", output, self.drop_outputs)
@@ -80,6 +83,15 @@ class _ConvRNNBase(RecurrentCell):
         self._ng = num_gates
         self._ik = tuple(i2h_kernel)
         self._hk = tuple(h2h_kernel)
+        # reference conv_rnn_cell.py:70: h2h must be odd — pad=k//2 only
+        # preserves the state's spatial size then; an even kernel grew the
+        # state each step and crashed at step 2 with a broadcast error
+        if any(k % 2 == 0 for k in self._hk):
+            raise ValueError(
+                f"h2h_kernel dimensions must be odd, got {self._hk}")
+        if any(k % 2 == 0 for k in self._ik):
+            raise ValueError(
+                f"i2h_kernel dimensions must be odd, got {self._ik}")
         self._activation = activation
         cin = self._input_shape[0]
         with self.name_scope():

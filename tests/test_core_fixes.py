@@ -1391,3 +1391,54 @@ def test_naive_engine_blocks_dispatch():
         assert hasattr(out._data, "is_ready") is False or \
             out._data.is_ready()
     assert not engine.is_naive()
+
+
+def test_variational_dropout_masks_h_only():
+    """Reference contrib rnn_cell.py:96-98: state dropout applies only to
+    h — masking the LSTM cell state c destroyed long-term memory."""
+    import mxnet_tpu.autograd as ag
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    base = gluon.rnn.LSTMCell(8)
+    base.initialize()
+    x = nd.array(np.ones((2, 8), np.float32))
+    h = nd.array(np.ones((2, 8), np.float32))
+    c = nd.array(np.full((2, 8), 3.0, np.float32))
+    base(x, [h, c])
+    cell = crnn.VariationalDropoutCell(base, drop_states=0.5)
+    cell.reset()
+    seen = {}
+    orig_fwd = base.forward
+
+    def spy(inputs, states, *a, **k):
+        seen["states"] = [s.asnumpy().copy() for s in states]
+        return orig_fwd(inputs, states, *a, **k)
+
+    base.forward = spy
+    with ag.record():
+        cell(x, [h, c])
+    assert set(np.unique(seen["states"][1]).tolist()) == {3.0}
+
+    # even conv-rnn kernels grew the state each step: rejected up front
+    with pytest.raises(ValueError, match="odd"):
+        crnn.Conv2DRNNCell((3, 6, 6), 4, i2h_kernel=(2, 2),
+                           h2h_kernel=(2, 2))
+
+
+def test_launch_py_dmlc_env_and_separator(tmp_path):
+    """DMLC_PS_ROOT_URI/PORT published per the dmlc tracker contract; the
+    conventional '--' separator works."""
+    import subprocess
+    import sys
+
+    w = tmp_path / "w.py"
+    w.write_text("import os; print(os.environ['DMLC_PS_ROOT_URI'], "
+                 "os.environ['DMLC_PS_ROOT_PORT'], "
+                 "os.environ['MXTPU_PROC_ID'])\n")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "launch.py"),
+         "-n", "2", "--", sys.executable, str(w)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "127.0.0.1 9027" in r.stdout

@@ -12,7 +12,6 @@ import argparse
 import os
 import subprocess
 import sys
-import threading
 
 
 def worker_env(args, rank):
@@ -25,10 +24,15 @@ def worker_env(args, rank):
         # rank 0 hosts the servers on consecutive ports from the
         # coordinator's (kvstore_dist.py)
         env["MXTPU_NUM_SERVERS"] = str(args.num_servers)
-    # reference env names kept for script compat (tools/launch.py DMLC_*)
+    # reference env names kept for script compat (tools/launch.py DMLC_*):
+    # the dmlc tracker contract also publishes the scheduler address, which
+    # reference-contract scripts read via DMLC_PS_ROOT_URI/PORT
     env["DMLC_NUM_WORKER"] = str(args.num_workers)
     env["DMLC_NUM_SERVER"] = str(args.num_servers or 1)
     env["DMLC_ROLE"] = "worker"
+    host, _, port = args.coordinator.rpartition(":")
+    env["DMLC_PS_ROOT_URI"] = host or "127.0.0.1"
+    env["DMLC_PS_ROOT_PORT"] = port
     return env
 
 
@@ -45,28 +49,21 @@ def launch_local(args, command):
 
 
 def launch_ssh(args, command):
-    hosts = []
     with open(args.hostfile) as f:
         hosts = [h.strip() for h in f if h.strip()]
     assert hosts, "empty hostfile"
+    # Popen is non-blocking: a plain loop launches all ranks concurrently
+    # (the old thread-per-rank scaffolding added unsynchronized appends for
+    # zero gain)
     procs = []
-
-    def run(rank, host):
+    for rank in range(args.num_workers):
+        host = hosts[rank % len(hosts)]
         env_fwd = " ".join(
             f"{k}={v}" for k, v in worker_env(args, rank).items()
             if k.startswith(("MXTPU_", "DMLC_")))
-        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host,
-               f"cd {os.getcwd()} && env {env_fwd} {command}"]
-        procs.append(subprocess.Popen(cmd))
-
-    threads = []
-    for rank in range(args.num_workers):
-        t = threading.Thread(target=run,
-                             args=(rank, hosts[rank % len(hosts)]))
-        t.start()
-        threads.append(t)
-    for t in threads:
-        t.join()
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host,
+             f"cd {os.getcwd()} && env {env_fwd} {command}"]))
     rc = 0
     for p in procs:
         rc = p.wait() or rc
@@ -87,7 +84,12 @@ def main():
                         help="host:port of process 0 for DCN bootstrap")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
-    command = " ".join(args.command)
+    cmd_parts = args.command
+    if cmd_parts and cmd_parts[0] == "--":
+        # argparse.REMAINDER keeps the conventional separator; passing the
+        # literal '--' to sh fails with 'Illegal option --'
+        cmd_parts = cmd_parts[1:]
+    command = " ".join(cmd_parts)
     assert command, "no command given"
     if args.launcher == "ssh":
         assert args.hostfile, "--hostfile required for ssh launcher"

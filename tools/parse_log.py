@@ -47,7 +47,9 @@ def main():
     for epoch in sorted(data):
         d = data[epoch]
         speed = sum(d["speed"]) / len(d["speed"]) if d["speed"] else 0.0
-        row = [str(epoch),
+        # reference parse_log.py prints 1-based epochs (k+1); 0-based rows
+        # mis-join against reference-produced tables
+        row = [str(epoch + 1),
                f"{d['train']:.4f}" if d["train"] is not None else "-",
                f"{d['val']:.4f}" if d["val"] is not None else "-",
                f"{d['time']:.1f}" if d["time"] is not None else "-",
