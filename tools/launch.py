@@ -30,8 +30,11 @@ def worker_env(args, rank):
     env["DMLC_NUM_WORKER"] = str(args.num_workers)
     env["DMLC_NUM_SERVER"] = str(args.num_servers or 1)
     env["DMLC_ROLE"] = "worker"
-    host, _, port = args.coordinator.rpartition(":")
-    env["DMLC_PS_ROOT_URI"] = host or "127.0.0.1"
+    host, sep, port = args.coordinator.rpartition(":")
+    if not sep or not host or not port.isdigit():
+        raise SystemExit(
+            f"--coordinator must be host:port, got {args.coordinator!r}")
+    env["DMLC_PS_ROOT_URI"] = host
     env["DMLC_PS_ROOT_PORT"] = port
     return env
 
