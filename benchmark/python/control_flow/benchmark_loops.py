@@ -31,8 +31,6 @@ def bench_foreach(T, D, iters):
 
 
 def bench_while(T, D, iters):
-    def cond(i, s):
-        return (i < T).asscalar()
 
     def step(i, s):
         return [i + 1, nd.tanh(s + 1.0)]

@@ -3,6 +3,11 @@
 Reference: benchmark/python/quantization/benchmark_op.py (quantized_conv
 speedup table).  Prints op, shape, fp32 ms, int8 ms, speedup.
 """
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
 import time
 
 import numpy as np

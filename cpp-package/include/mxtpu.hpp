@@ -62,7 +62,12 @@ class NDArray {
       keys.push_back(kv.first.c_str());
       hs.push_back(kv.second->handle());
     }
-    Check(mxtpu_nd_save(path.c_str(), hs.data(), keys.data(),
+    // empty map: keys.data() would be nullptr, which the C ABI reads as
+    // "write a LIST file" — keep the dict kind byte by passing a non-null
+    // (never dereferenced at count 0) pointer
+    static const char *kNoKeys[] = {""};
+    Check(mxtpu_nd_save(path.c_str(), hs.data(),
+                        keys.empty() ? kNoKeys : keys.data(),
                         static_cast<int>(hs.size())), "nd_save");
   }
   static std::map<std::string, NDArray> Load(const std::string &path) {
@@ -73,7 +78,12 @@ class NDArray {
     for (int i = 0; i < count; ++i) {
       const char *key = nullptr;
       mxtpu_nd_list_get(list, i, &key);
-      out.emplace(key ? key : "", NDArray(mxtpu_nd_list_take(list, i)));
+      std::string k = key ? key : "";
+      // list-format files (Python nd.save([...])) carry no keys: synthesize
+      // positional ones — std::map::emplace would otherwise silently drop
+      // every entry after the first
+      if (k.empty()) k = "_" + std::to_string(i);
+      out.emplace(std::move(k), NDArray(mxtpu_nd_list_take(list, i)));
     }
     mxtpu_nd_list_free(list);
     return out;
@@ -133,6 +143,8 @@ class Symbol {
 // Sharded RecordIO reader with background prefetch.
 class RecordReader {
  public:
+  RecordReader(const RecordReader &) = delete;
+  RecordReader &operator=(const RecordReader &) = delete;
   explicit RecordReader(const std::string &path, int batch_records = 64,
                         int queue_depth = 4, int shard_index = 0,
                         int num_shards = 1) {

@@ -129,13 +129,25 @@ def rt_exec_output_shape(h, i):
 
 def rt_exec_output(h, i, mv):
     out = _H[h]["exe"].outputs[i].asnumpy().astype(_np.float32).ravel()
-    _np.frombuffer(mv, dtype=_np.float32)[: out.size] = out
+    buf = _np.frombuffer(mv, dtype=_np.float32)
+    if buf.size != out.size:
+        # a partial fill would hand every binding silent garbage (and a
+        # heap info-leak) in the unwritten tail
+        raise ValueError(
+            f"output {i} has {out.size} elements; caller buffer has "
+            f"{buf.size}")
+    buf[:] = out
     return 0
 
 
 def rt_exec_grad(h, name, mv):
     g = _H[h]["exe"].grad_dict[name].asnumpy().astype(_np.float32).ravel()
-    _np.frombuffer(mv, dtype=_np.float32)[: g.size] = g
+    buf = _np.frombuffer(mv, dtype=_np.float32)
+    if buf.size != g.size:
+        raise ValueError(
+            f"grad {name!r} has {g.size} elements; caller buffer has "
+            f"{buf.size}")
+    buf[:] = g
     return 0
 
 
@@ -159,8 +171,13 @@ def rt_kv_push(h, key, mv, shape):
 def rt_kv_pull(h, key, mv, size):
     out = _mx.nd.zeros(_H[h]["shapes"][int(key)])
     _H[h]["kv"].pull(key, out=out)
-    _np.frombuffer(mv, dtype=_np.float32)[: int(size)] = \
-        out.asnumpy().astype(_np.float32).ravel()[: int(size)]
+    vals = out.asnumpy().astype(_np.float32).ravel()
+    buf = _np.frombuffer(mv, dtype=_np.float32)
+    if buf.size != vals.size:
+        raise ValueError(
+            f"key {key} has {vals.size} elements; caller buffer has "
+            f"{buf.size}")
+    buf[:] = vals
     return 0
 
 

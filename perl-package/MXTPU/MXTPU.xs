@@ -179,6 +179,8 @@ exec_output(h, idx, nelem)
     SV *out;
     float *buf;
   CODE:
+    if (nelem < 1)
+        croak("nelem must be a positive element count, got %g", nelem);
     out = newSV((STRLEN)(nelem * sizeof(float)));
     SvPOK_on(out);
     buf = (float *)SvPVX(out);
@@ -200,6 +202,8 @@ exec_grad(h, name, nelem)
     SV *out;
     float *buf;
   CODE:
+    if (nelem < 1)
+        croak("nelem must be a positive element count, got %g", nelem);
     out = newSV((STRLEN)(nelem * sizeof(float)));
     SvPOK_on(out);
     buf = (float *)SvPVX(out);
@@ -273,6 +277,8 @@ kv_pull(h, key, nelem)
     SV *out;
     float *buf;
   CODE:
+    if (nelem < 1)
+        croak("nelem must be a positive element count, got %g", nelem);
     out = newSV((STRLEN)(nelem * sizeof(float)));
     SvPOK_on(out);
     buf = (float *)SvPVX(out);

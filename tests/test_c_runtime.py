@@ -126,3 +126,31 @@ def test_kvstore_sgd_optimizer_through_c_abi(rt):
     assert rt.mxtpu_kv_pull(ctypes.c_int64(h), 1, op, 4) == 0
     # sgd: w <- w - lr * grad = 2.0 - 0.5
     np.testing.assert_allclose(out, 1.5, atol=1e-6)
+
+
+def test_exec_output_rejects_wrong_buffer_size(rt):
+    """A partial fill would hand every binding silent garbage plus a heap
+    info-leak in the unwritten tail (audit r5): the runtime now requires
+    the caller's buffer to match the output element count exactly."""
+    import ctypes
+
+    js = ('{"nodes": [{"op": "null", "name": "data", "attrs": {}, '
+          '"inputs": []}], "arg_nodes": [0], "heads": [[0, 0, 0]]}')
+    h = rt.mxtpu_exec_create(js.encode())
+    assert h > 0
+    names = (ctypes.c_char_p * 1)(b"data")
+    shapes = (ctypes.c_int64 * 2)(2, 3)
+    ndims = (ctypes.c_int * 1)(2)
+    assert rt.mxtpu_exec_simple_bind(ctypes.c_int64(h), names, shapes,
+                                     ndims, 1) == 0
+    data = (ctypes.c_float * 6)(*range(6))
+    assert rt.mxtpu_exec_set_arg(ctypes.c_int64(h), b"data", data,
+                                 shapes, 2) == 0
+    assert rt.mxtpu_exec_forward(ctypes.c_int64(h), 0) == 0
+    big = (ctypes.c_float * 40)()
+    assert rt.mxtpu_exec_output(ctypes.c_int64(h), 0, big, 40) != 0
+    err = rt.mxtpu_rt_last_error()
+    assert b"caller buffer" in ctypes.c_char_p(err).value if isinstance(err, int) else b"caller buffer" in err
+    exact = (ctypes.c_float * 6)()
+    assert rt.mxtpu_exec_output(ctypes.c_int64(h), 0, exact, 6) == 0
+    assert list(exact) == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
