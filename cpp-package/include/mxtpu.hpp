@@ -81,8 +81,10 @@ class NDArray {
       std::string k = key ? key : "";
       // list-format files (Python nd.save([...])) carry no keys: synthesize
       // positional ones — std::map::emplace would otherwise silently drop
-      // every entry after the first
+      // every entry after the first.  Extend on collision (a real "_0" key
+      // can coexist with a renamed empty key) and never drop silently.
       if (k.empty()) k = "_" + std::to_string(i);
+      while (out.count(k)) k += "_dup";
       out.emplace(std::move(k), NDArray(mxtpu_nd_list_take(list, i)));
     }
     mxtpu_nd_list_free(list);

@@ -168,7 +168,7 @@ def rt_kv_push(h, key, mv, shape):
     return 0
 
 
-def rt_kv_pull(h, key, mv, size):
+def rt_kv_pull(h, key, mv):
     out = _mx.nd.zeros(_H[h]["shapes"][int(key)])
     _H[h]["kv"].pull(key, out=out)
     vals = out.asnumpy().astype(_np.float32).ravel()
@@ -466,8 +466,7 @@ int mxtpu_kv_pull(int64_t h, int key, float* buf, int64_t nelem) {
   PyObject* mv = PyMemoryView_FromMemory((char*)buf,
                                          nelem * (int64_t)sizeof(float),
                                          PyBUF_WRITE);
-  PyObject* args = Py_BuildValue("(LiNL)", (long long)h, key, mv,
-                                 (long long)nelem);
+  PyObject* args = Py_BuildValue("(LiN)", (long long)h, key, mv);
   int rc = -1;
   PyObject* r = rt_call("rt_kv_pull", args);
   Py_XDECREF(args);

@@ -52,17 +52,25 @@ def _relu6():
 
 
 def _unit(ch, k=1, s=1, p=0, groups=1, bias=False, norm=True, act="relu",
-          eps=1e-5, weight_initializer=None):
+          eps=1e-5, weight_initializer=None, layout="NCHW"):
     """conv [+ BatchNorm] [+ activation] — the one conv builder here.
 
     ``act`` is "relu", "relu6", or None. Returns a HybridSequential so a
-    unit can be dropped anywhere a block is expected.
+    unit can be dropped anywhere a block is expected.  ``layout="NHWC"``
+    builds the channels-last variant (parameters stay OIHW, so checkpoints
+    swap freely — same contract as the resnet zoo).
     """
+    from ....ops.nn import is_channels_last
+
     out = nn.HybridSequential(prefix="")
     out.add(nn.Conv2D(ch, k, s, p, groups=groups, use_bias=bias,
-                      weight_initializer=weight_initializer))
+                      weight_initializer=weight_initializer, layout=layout))
     if norm:
-        out.add(nn.BatchNorm(epsilon=eps))
+        # classify like Conv2D does (is_channels_last), not by exact string
+        # compare — a non-canonical channels-last string would otherwise
+        # normalize the H axis silently
+        out.add(nn.BatchNorm(
+            epsilon=eps, axis=-1 if is_channels_last(layout) else 1))
     if act == "relu":
         out.add(nn.Activation("relu"))
     elif act == "relu6":
@@ -279,25 +287,28 @@ _MOBILE_V1_ROWS = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1),
                    (512, 1), (1024, 2), (1024, 1)]
 
 
-def _separable(width_in, width_out, stride, act="relu"):
+def _separable(width_in, width_out, stride, act="relu", layout="NCHW"):
     """Depthwise 3x3 over ``width_in`` then pointwise to ``width_out``."""
-    return _chain(_unit(width_in, 3, stride, 1, groups=width_in, act=act),
-                  _unit(width_out, act=act))
+    return _chain(_unit(width_in, 3, stride, 1, groups=width_in, act=act,
+                        layout=layout),
+                  _unit(width_out, act=act, layout=layout))
 
 
 class MobileNet(HybridBlock):
-    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+    def __init__(self, multiplier=1.0, classes=1000, layout="NCHW",
+                 **kwargs):
         super().__init__(**kwargs)
         scale = lambda c: int(c * multiplier)  # noqa: E731
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             width = scale(32)
-            self.features.add(_unit(width, 3, 2, 1))
+            self.features.add(_unit(width, 3, 2, 1, layout=layout))
             for out, stride in _MOBILE_V1_ROWS:
                 out = scale(out)
-                self.features.add(_separable(width, out, stride))
+                self.features.add(_separable(width, out, stride,
+                                             layout=layout))
                 width = out
-            self.features.add(nn.GlobalAvgPool2D())
+            self.features.add(nn.GlobalAvgPool2D(layout=layout))
             self.features.add(nn.Flatten())
             self.output = _head(classes)
 
@@ -314,32 +325,36 @@ _MOBILE_V2_ROWS = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
                    (6, 320, 1, 1)]
 
 
-def _inverted_residual(width_in, width_out, t, stride):
+def _inverted_residual(width_in, width_out, t, stride, layout="NCHW"):
     mid = width_in * t
-    body = _chain(_unit(mid, act="relu6"),
-                  _unit(mid, 3, stride, 1, groups=mid, act="relu6"),
-                  _unit(width_out, act=None))
+    body = _chain(_unit(mid, act="relu6", layout=layout),
+                  _unit(mid, 3, stride, 1, groups=mid, act="relu6",
+                        layout=layout),
+                  _unit(width_out, act=None, layout=layout))
     return _SkipJoin(body, joined=stride == 1 and width_in == width_out)
 
 
 class MobileNetV2(HybridBlock):
-    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+    def __init__(self, multiplier=1.0, classes=1000, layout="NCHW",
+                 **kwargs):
         super().__init__(**kwargs)
         scale = lambda c: int(c * multiplier)  # noqa: E731
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             width = scale(32)
-            self.features.add(_unit(width, 3, 2, 1, act="relu6"))
+            self.features.add(_unit(width, 3, 2, 1, act="relu6",
+                                    layout=layout))
             for t, c, n, s in _MOBILE_V2_ROWS:
                 out = scale(c)
                 for i in range(n):
                     self.features.add(_inverted_residual(
-                        width, out, t, s if i == 0 else 1))
+                        width, out, t, s if i == 0 else 1, layout=layout))
                     width = out
             tip = scale(1280) if multiplier > 1.0 else 1280
-            self.features.add(_unit(tip, act="relu6"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.output = _chain(_unit(classes, 1, norm=False, act=None),
+            self.features.add(_unit(tip, act="relu6", layout=layout))
+            self.features.add(nn.GlobalAvgPool2D(layout=layout))
+            self.output = _chain(_unit(classes, 1, norm=False, act=None,
+                                       layout=layout),
                                  nn.Flatten())
 
     def hybrid_forward(self, F, x):

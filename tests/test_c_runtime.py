@@ -150,7 +150,7 @@ def test_exec_output_rejects_wrong_buffer_size(rt):
     big = (ctypes.c_float * 40)()
     assert rt.mxtpu_exec_output(ctypes.c_int64(h), 0, big, 40) != 0
     err = rt.mxtpu_rt_last_error()
-    assert b"caller buffer" in ctypes.c_char_p(err).value if isinstance(err, int) else b"caller buffer" in err
+    assert b"caller buffer" in err
     exact = (ctypes.c_float * 6)()
     assert rt.mxtpu_exec_output(ctypes.c_int64(h), 0, exact, 6) == 0
     assert list(exact) == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]

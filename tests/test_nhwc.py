@@ -117,3 +117,26 @@ def test_symbol_conv_nhwc_bind_and_run():
     exe2.arg_dict["c_weight"][:] = mx.nd.array(w)
     ref = exe2.forward()[0].asnumpy()
     np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref, atol=1e-4)
+
+
+def test_mobilenet_layouts_match():
+    """MobileNet v1/v2 take layout="NHWC" with layout-independent OIHW
+    parameter storage (same contract as the resnet zoo): identical params
+    => identical outputs across layouts."""
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    rng = np.random.RandomState(0)
+    for factory in (vision.mobilenet0_25, vision.mobilenet_v2_0_25):
+        a = factory(classes=10)
+        a.initialize()
+        x = rng.rand(2, 3, 64, 64).astype(np.float32)
+        oa = a(nd.array(x)).asnumpy()
+        b = factory(classes=10, layout="NHWC")
+        b.initialize()
+        xb = nd.array(np.transpose(x, (0, 2, 3, 1)))
+        b(xb)  # materialize deferred shapes
+        for qa, qb in zip(a.collect_params().values(),
+                          b.collect_params().values()):
+            qb.set_data(qa.data())
+        ob = b(xb).asnumpy()
+        assert np.allclose(oa, ob, atol=2e-4), factory.__name__
