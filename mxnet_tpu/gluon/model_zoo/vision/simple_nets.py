@@ -131,18 +131,34 @@ _ALEX_TRUNK = [(64, 11, 4, 2), "P", (192, 5, 1, 2), "P",
                (384, 3, 1, 1), (256, 3, 1, 1), (256, 3, 1, 1), "P"]
 
 
+def _to_nchw_order(layout):
+    """Before Flatten->Dense: put channels back in NCHW order so the
+    flattened feature order — and therefore the Dense weights — stay
+    layout-independent (checkpoints swap freely).  The relayout happens at
+    the final, smallest feature map; GlobalAvgPool-headed nets don't need
+    it."""
+    from ....ops.nn import is_channels_last
+
+    if not is_channels_last(layout):
+        return None
+    return nn.HybridLambda(lambda F, x: F.transpose(x, axes=(0, 3, 1, 2)))
+
+
 class AlexNet(HybridBlock):
-    def __init__(self, classes=1000, **kwargs):
+    def __init__(self, classes=1000, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             for row in _ALEX_TRUNK:
                 if row == "P":
-                    self.features.add(nn.MaxPool2D(3, 2))
+                    self.features.add(nn.MaxPool2D(3, 2, layout=layout))
                 else:
                     ch, k, s, p = row
                     self.features.add(_unit(ch, k, s, p, bias=True,
-                                            norm=False))
+                                            norm=False, layout=layout))
+            relayout = _to_nchw_order(layout)
+            if relayout is not None:
+                self.features.add(relayout)
             self.features.add(nn.Flatten())
             for _ in range(2):
                 self.features.add(nn.Dense(4096, activation="relu"))
@@ -168,7 +184,7 @@ _VGG_WIDTHS = (64, 128, 256, 512, 512)
 
 class VGG(HybridBlock):
     def __init__(self, layers, filters, classes=1000, batch_norm=False,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         if len(layers) != len(filters):
             raise ValueError("one filter width per VGG stage")
@@ -184,8 +200,12 @@ class VGG(HybridBlock):
                 for _ in range(reps):
                     self.features.add(_unit(width, 3, 1, 1, bias=True,
                                             norm=batch_norm,
-                                            weight_initializer=conv_init))
-                self.features.add(nn.MaxPool2D(strides=2))
+                                            weight_initializer=conv_init,
+                                            layout=layout))
+                self.features.add(nn.MaxPool2D(strides=2, layout=layout))
+            relayout = _to_nchw_order(layout)
+            if relayout is not None:
+                self.features.add(relayout)
             self.features.add(nn.Flatten())
             for _ in range(2):
                 self.features.add(nn.Dense(4096, activation="relu",

@@ -61,6 +61,13 @@ def block_train_fn(block: Block, is_train: bool = True):
     """
     from .. import random as _random
 
+    # materialize the calling thread's stream key OUTSIDE any trace: the
+    # first swap_key inside a jitted apply_fn would otherwise create the
+    # key mid-trace and leak a tracer into global state, poisoning every
+    # later eager op in the process (the verify-skill gotcha, caught live
+    # by the bench synthetic->e2e sequence)
+    _random.ensure_key()
+
     pd = block.collect_params()
     param_names = [n for n in pd if pd[n].grad_req != "null"]
     aux_names = [n for n in pd if pd[n].grad_req == "null"]

@@ -1442,3 +1442,44 @@ def test_launch_py_dmlc_env_and_separator(tmp_path):
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr
     assert "127.0.0.1 9027" in r.stdout
+
+
+def test_block_apply_fn_does_not_leak_tracer_into_global_stream():
+    """bench's synthetic->e2e sequence in one process: a jitted step built
+    from block_apply_fn must not materialize the global PRNG key
+    mid-trace (the leaked tracer poisoned every later eager random op
+    with UnexpectedTracerError)."""
+    import threading
+
+    import jax
+
+    from mxnet_tpu.parallel.data_parallel import block_apply_fn
+
+    def run():
+        # fresh thread = fresh thread-local stream key (the leak scenario)
+        net = nn.Dense(3)
+        net.initialize()
+        net(nd.array(np.ones((2, 4), np.float32)))
+        apply_fn, params = block_apply_fn(net, is_train=True)
+
+        @jax.jit
+        def step(p, x, rng):
+            return apply_fn(p, x, rng).sum()
+
+        step(params, np.ones((2, 4), np.float32),
+             jax.random.PRNGKey(0)).block_until_ready()
+        # previously: UnexpectedTracerError here
+        nd.random.uniform(shape=(2,)).asnumpy()
+
+    errs = []
+
+    def wrapped():
+        try:
+            run()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=wrapped)
+    t.start()
+    t.join()
+    assert not errs, errs
