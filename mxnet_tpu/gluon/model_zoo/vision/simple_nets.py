@@ -78,8 +78,10 @@ def _unit(ch, k=1, s=1, p=0, groups=1, bias=False, norm=True, act="relu",
     return out
 
 
-def _fanout(*branches):
-    out = nn.HybridConcatenate(axis=1)
+def _fanout(*branches, layout="NCHW"):
+    from ....ops.nn import is_channels_last
+
+    out = nn.HybridConcatenate(axis=-1 if is_channels_last(layout) else 1)
     for branch in branches:
         out.add(branch)
     return out
@@ -101,12 +103,13 @@ class _SkipJoin(HybridBlock):
 class _WidenJoin(HybridBlock):
     """concat(x, body(x)) along channels — DenseNet's growth step."""
 
-    def __init__(self, body, **kwargs):
+    def __init__(self, body, channel_dim=1, **kwargs):
         super().__init__(**kwargs)
         self.body = body
+        self._cdim = channel_dim
 
     def hybrid_forward(self, F, x):
-        return F.concat(x, self.body(x), dim=1)
+        return F.concat(x, self.body(x), dim=self._cdim)
 
 
 def _strip(kwargs):
@@ -248,11 +251,14 @@ del _d, _bn, _f
 # SqueezeNet — token lists of fire cells and pools
 # ---------------------------------------------------------------------------
 
-def _fire(squeeze, expand):
+def _fire(squeeze, expand, layout="NCHW"):
     """1x1 squeeze feeding a (1x1 || 3x3) expand fanout."""
-    return _chain(_unit(squeeze, 1, bias=True, norm=False),
-                  _fanout(_unit(expand, 1, bias=True, norm=False),
-                          _unit(expand, 3, p=1, bias=True, norm=False)))
+    return _chain(_unit(squeeze, 1, bias=True, norm=False, layout=layout),
+                  _fanout(_unit(expand, 1, bias=True, norm=False,
+                                layout=layout),
+                          _unit(expand, 3, p=1, bias=True, norm=False,
+                                layout=layout),
+                          layout=layout))
 
 
 # stem conv row then "P" pools / fire (squeeze, expand) rows
@@ -265,7 +271,7 @@ _SQUEEZE_PLANS = {
 
 
 class SqueezeNet(HybridBlock):
-    def __init__(self, version, classes=1000, **kwargs):
+    def __init__(self, version, classes=1000, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
         if version not in _SQUEEZE_PLANS:
             raise ValueError(f"unknown SqueezeNet version {version!r}")
@@ -273,18 +279,22 @@ class SqueezeNet(HybridBlock):
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             ch, k, s = plan[0]
-            self.features.add(_unit(ch, k, s, bias=True, norm=False))
+            self.features.add(_unit(ch, k, s, bias=True, norm=False,
+                                    layout=layout))
             for row in plan[1:]:
                 if row == "P":
-                    self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+                    self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True,
+                                                   layout=layout))
                 else:
-                    self.features.add(_fire(*row))
+                    self.features.add(_fire(*row, layout=layout))
             self.features.add(nn.Dropout(0.5))
             # reference squeezenet.py: fixed AvgPool2D(13) head (identical
             # to global pooling at 224px, different — and reference-matching
             # — for other input sizes)
-            self.output = _chain(_unit(classes, 1, bias=True, norm=False),
-                                 nn.AvgPool2D(13), nn.Flatten())
+            self.output = _chain(_unit(classes, 1, bias=True, norm=False,
+                                       layout=layout),
+                                 nn.AvgPool2D(13, layout=layout),
+                                 nn.Flatten())
 
     def hybrid_forward(self, F, x):
         return self.output(self.features(x))
@@ -419,41 +429,53 @@ _DENSE_ROWS = {121: (64, 32, (6, 12, 24, 16)),
                201: (64, 32, (6, 12, 48, 32))}
 
 
-def _norm_relu():
-    return _chain(nn.BatchNorm(), nn.Activation("relu"))
+def _norm_relu(layout="NCHW"):
+    from ....ops.nn import is_channels_last
+
+    return _chain(nn.BatchNorm(axis=-1 if is_channels_last(layout) else 1),
+                  nn.Activation("relu"))
 
 
-def _grow(growth, bn_size, dropout):
+def _grow(growth, bn_size, dropout, layout="NCHW"):
     """BN-relu-1x1-BN-relu-3x3, concatenated onto the running features."""
-    body = _chain(_norm_relu(), _unit(bn_size * growth, 1, norm=False,
-                                      act=None),
-                  _norm_relu(), _unit(growth, 3, p=1, norm=False, act=None))
+    from ....ops.nn import is_channels_last
+
+    body = _chain(_norm_relu(layout),
+                  _unit(bn_size * growth, 1, norm=False, act=None,
+                        layout=layout),
+                  _norm_relu(layout),
+                  _unit(growth, 3, p=1, norm=False, act=None, layout=layout))
     if dropout:
         body.add(nn.Dropout(dropout))
-    return _WidenJoin(body)
+    return _WidenJoin(body,
+                      channel_dim=-1 if is_channels_last(layout) else 1)
 
 
 class DenseNet(HybridBlock):
     def __init__(self, num_init_features, growth_rate, block_config,
-                 bn_size=4, dropout=0, classes=1000, **kwargs):
+                 bn_size=4, dropout=0, classes=1000, layout="NCHW",
+                 **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
-            self.features.add(_unit(num_init_features, 7, 2, 3))
-            self.features.add(nn.MaxPool2D(3, 2, 1))
+            self.features.add(_unit(num_init_features, 7, 2, 3,
+                                    layout=layout))
+            self.features.add(nn.MaxPool2D(3, 2, 1, layout=layout))
             width = num_init_features
             for i, reps in enumerate(block_config):
                 for _ in range(reps):
-                    self.features.add(_grow(growth_rate, bn_size, dropout))
+                    self.features.add(_grow(growth_rate, bn_size, dropout,
+                                            layout=layout))
                 width += reps * growth_rate
                 if i + 1 < len(block_config):
                     width //= 2
-                    self.features.add(_chain(_norm_relu(),
+                    self.features.add(_chain(_norm_relu(layout),
                                              _unit(width, 1, norm=False,
-                                                   act=None),
-                                             nn.AvgPool2D(2, 2)))
-            self.features.add(_norm_relu())
-            self.features.add(nn.AvgPool2D(pool_size=7))
+                                                   act=None, layout=layout),
+                                             nn.AvgPool2D(2, 2,
+                                                          layout=layout)))
+            self.features.add(_norm_relu(layout))
+            self.features.add(nn.AvgPool2D(pool_size=7, layout=layout))
             self.features.add(nn.Flatten())
             self.output = _head(classes)
 

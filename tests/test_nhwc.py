@@ -129,7 +129,8 @@ def test_zoo_layouts_match():
 
     rng = np.random.RandomState(0)
     cases = ((vision.mobilenet0_25, 64), (vision.mobilenet_v2_0_25, 64),
-             (vision.alexnet, 224), (vision.vgg11, 64))
+             (vision.alexnet, 224), (vision.vgg11, 64),
+             (vision.squeezenet1_1, 224), (vision.densenet121, 224))
     for factory, sz in cases:
         a = factory(classes=10)
         a.initialize()
@@ -143,4 +144,4 @@ def test_zoo_layouts_match():
                           b.collect_params().values()):
             qb.set_data(qa.data())
         ob = b(xb).asnumpy()
-        assert np.allclose(oa, ob, atol=3e-4), factory.__name__
+        assert np.allclose(oa, ob, atol=5e-4), factory.__name__
