@@ -506,68 +506,73 @@ del _d, _f
 # ("avg"/"max", pool, stride, pad) or a conv row (ch, kernel, stride, pad),
 # where kernel/pad may be 2-tuples for the factorized 7x7 paths.
 
-def _iconv(ch, k=1, s=1, p=0):
-    return _unit(ch, k, s, p, eps=0.001)
+def _iconv(ch, k=1, s=1, p=0, layout="NCHW"):
+    return _unit(ch, k, s, p, eps=0.001, layout=layout)
 
 
-def _branch(steps):
+def _branch(steps, layout="NCHW"):
     parts = []
     for step in steps:
         if step[0] == "avg":
-            parts.append(nn.AvgPool2D(step[1], step[2], step[3]))
+            parts.append(nn.AvgPool2D(step[1], step[2], step[3],
+                                      layout=layout))
         elif step[0] == "max":
-            parts.append(nn.MaxPool2D(step[1], step[2], step[3]))
+            parts.append(nn.MaxPool2D(step[1], step[2], step[3],
+                                      layout=layout))
         else:
-            parts.append(_iconv(*step))
+            parts.append(_iconv(*step, layout=layout))
     return parts[0] if len(parts) == 1 else _chain(*parts)
 
 
-def _cell(*branch_specs):
-    return _fanout(*(_branch(s) for s in branch_specs))
+def _cell(*branch_specs, layout="NCHW"):
+    return _fanout(*(_branch(s, layout) for s in branch_specs),
+                   layout=layout)
 
 
-def _cell_a(tail):
+def _cell_a(tail, lo="NCHW"):
     return _cell(((64, 1),),
                  ((48, 1), (64, 5, 1, 2)),
                  ((64, 1), (96, 3, 1, 1), (96, 3, 1, 1)),
-                 (("avg", 3, 1, 1), (tail, 1)))
+                 (("avg", 3, 1, 1), (tail, 1)), layout=lo)
 
 
-def _cell_b():
+def _cell_b(lo="NCHW"):
     return _cell(((384, 3, 2, 0),),
                  ((64, 1), (96, 3, 1, 1), (96, 3, 2, 0)),
-                 (("max", 3, 2, 0),))
+                 (("max", 3, 2, 0),), layout=lo)
 
 
-def _cell_c(mid):
+def _cell_c(mid, lo="NCHW"):
     return _cell(((192, 1),),
                  ((mid, 1), (mid, (1, 7), 1, (0, 3)),
                   (192, (7, 1), 1, (3, 0))),
                  ((mid, 1), (mid, (7, 1), 1, (3, 0)),
                   (mid, (1, 7), 1, (0, 3)), (mid, (7, 1), 1, (3, 0)),
                   (192, (1, 7), 1, (0, 3))),
-                 (("avg", 3, 1, 1), (192, 1)))
+                 (("avg", 3, 1, 1), (192, 1)), layout=lo)
 
 
-def _cell_d():
+def _cell_d(lo="NCHW"):
     return _cell(((192, 1), (320, 3, 2, 0)),
                  ((192, 1), (192, (1, 7), 1, (0, 3)),
                   (192, (7, 1), 1, (3, 0)), (192, 3, 2, 0)),
-                 (("max", 3, 2, 0),))
+                 (("max", 3, 2, 0),), layout=lo)
 
 
-def _split_pair(ch):
+def _split_pair(ch, lo="NCHW"):
     """The E-cell's (1x3 || 3x1) split applied to one stem."""
-    return _fanout(_iconv(ch, (1, 3), 1, (0, 1)),
-                   _iconv(ch, (3, 1), 1, (1, 0)))
+    return _fanout(_iconv(ch, (1, 3), 1, (0, 1), layout=lo),
+                   _iconv(ch, (3, 1), 1, (1, 0), layout=lo), layout=lo)
 
 
-def _cell_e():
-    return _fanout(_iconv(320, 1),
-                   _chain(_iconv(384, 1), _split_pair(384)),
-                   _chain(_iconv(448, 1), _iconv(384, 3, 1, 1),
-                          _split_pair(384)),
-                   _chain(nn.AvgPool2D(3, 1, 1), _iconv(192, 1)))
+def _cell_e(lo="NCHW"):
+    return _fanout(_iconv(320, 1, layout=lo),
+                   _chain(_iconv(384, 1, layout=lo), _split_pair(384, lo)),
+                   _chain(_iconv(448, 1, layout=lo),
+                          _iconv(384, 3, 1, 1, layout=lo),
+                          _split_pair(384, lo)),
+                   _chain(nn.AvgPool2D(3, 1, 1, layout=lo),
+                          _iconv(192, 1, layout=lo)), layout=lo)
 
 
 _INCEPTION_STEM = [(32, 3, 2, 0), (32, 3, 1, 0), (64, 3, 1, 1), "P",
@@ -575,20 +580,22 @@ _INCEPTION_STEM = [(32, 3, 2, 0), (32, 3, 1, 0), (64, 3, 1, 1), "P",
 
 
 class Inception3(HybridBlock):
-    def __init__(self, classes=1000, **kwargs):
+    def __init__(self, classes=1000, layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        lo = layout
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             for row in _INCEPTION_STEM:
                 if row == "P":
-                    self.features.add(nn.MaxPool2D(3, 2))
+                    self.features.add(nn.MaxPool2D(3, 2, layout=lo))
                 else:
-                    self.features.add(_iconv(*row))
-            for cell in (_cell_a(32), _cell_a(64), _cell_a(64), _cell_b(),
-                         _cell_c(128), _cell_c(160), _cell_c(160),
-                         _cell_c(192), _cell_d(), _cell_e(), _cell_e()):
+                    self.features.add(_iconv(*row, layout=lo))
+            for cell in (_cell_a(32, lo), _cell_a(64, lo), _cell_a(64, lo),
+                         _cell_b(lo), _cell_c(128, lo), _cell_c(160, lo),
+                         _cell_c(160, lo), _cell_c(192, lo), _cell_d(lo),
+                         _cell_e(lo), _cell_e(lo)):
                 self.features.add(cell)
-            self.features.add(nn.AvgPool2D(pool_size=8))
+            self.features.add(nn.AvgPool2D(pool_size=8, layout=lo))
             self.features.add(nn.Dropout(0.5))
             self.features.add(nn.Flatten())
             self.output = _head(classes)

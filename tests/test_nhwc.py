@@ -130,7 +130,8 @@ def test_zoo_layouts_match():
     rng = np.random.RandomState(0)
     cases = ((vision.mobilenet0_25, 64), (vision.mobilenet_v2_0_25, 64),
              (vision.alexnet, 224), (vision.vgg11, 64),
-             (vision.squeezenet1_1, 224), (vision.densenet121, 224))
+             (vision.squeezenet1_1, 224), (vision.densenet121, 224),
+             (vision.inception_v3, 299))
     for factory, sz in cases:
         a = factory(classes=10)
         a.initialize()
