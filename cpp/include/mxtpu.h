@@ -212,6 +212,23 @@ int mxtpu_kv_pull(int64_t h, int key, float *buf, int64_t nelem);
 int mxtpu_kv_set_optimizer(int64_t h, const char *name, float lr);
 int mxtpu_rt_free(int64_t h);
 
+/* Inference-only predict surface (reference: include/mxnet/c_predict_api.h
+ * MXPredCreate/SetInput/Forward/GetOutputShape/GetOutput/Free).  Creates a
+ * bound executor from graph JSON + a .params checkpoint (native TPMX or
+ * stock-MXNet binary format, auto-detected) with weights installed; handles
+ * are executor handles, so the exec_* accessors work on them too. */
+int64_t mxtpu_pred_create(const char *symbol_json, const char *params_path,
+                          const char **input_names,
+                          const int64_t *shapes_concat, const int *ndims,
+                          int n_inputs);
+int mxtpu_pred_set_input(int64_t h, const char *name, const float *data,
+                         const int64_t *shape, int ndim);
+int mxtpu_pred_forward(int64_t h);
+int mxtpu_pred_get_output_shape(int64_t h, int idx, int64_t *shape,
+                                int *ndim, int cap);
+int mxtpu_pred_get_output(int64_t h, int idx, float *buf, int64_t nelem);
+int mxtpu_pred_free(int64_t h);
+
 /* ----------------------------------------------------------------- misc */
 
 const char *mxtpu_last_error(void);

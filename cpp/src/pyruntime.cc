@@ -189,6 +189,38 @@ def rt_kv_set_optimizer(h, name, lr):
 def rt_free(h):
     _H.pop(h, None)
     return 0
+
+
+def rt_pred_create(sym_json, params_path, names, shapes):
+    """Inference-only predictor (reference: src/c_api/c_predict_api.cc
+    MXPredCreate): graph JSON + a .params checkpoint (either the native or
+    the stock-MXNet binary format via nd.load auto-detection) + input
+    shapes -> a bound executor with weights installed."""
+    h = rt_exec_create(sym_json)
+    try:
+        rt_exec_bind(h, names, shapes)
+        exe = _H[h]["exe"]
+        if params_path:
+            loaded = _mx.nd.load(params_path)
+            if not isinstance(loaded, dict):
+                raise ValueError("predictor needs a keyed .params file")
+            for k, v in loaded.items():
+                name = k.split(":", 1)[1] if ":" in k else k
+                if name in exe.arg_dict and name not in names:
+                    exe.arg_dict[name][:] = v
+                elif name in exe.aux_dict:
+                    exe.aux_dict[name][:] = v
+    except Exception:
+        # a failed create must not leak the registered handle (long-lived
+        # servers retry pred_create on user models)
+        rt_free(h)
+        raise
+    return h
+
+
+
+
+
 )PY";
 
 int mxtpu_rt_init(void) {
@@ -479,6 +511,61 @@ int mxtpu_kv_set_optimizer(int64_t h, const char* name, float lr) {
   return call_fmt("rt_kv_set_optimizer", "(Lsd)", (long long)h, name,
                   (double)lr) < 0 ? -1 : 0;
 }
+
+int mxtpu_rt_free(int64_t h);
+
+/* ---- inference-only predict surface (reference c_predict_api.cc:
+ * MXPredCreate / MXPredSetInput / MXPredForward / MXPredGetOutput /
+ * MXPredFree).  Thin aliases over the executor runtime: same handles, so
+ * mxtpu_exec_set_arg / mxtpu_exec_output_shape / mxtpu_exec_output serve
+ * SetInput / GetOutputShape / GetOutput. */
+int64_t mxtpu_pred_create(const char* symbol_json, const char* params_path,
+                          const char** input_names,
+                          const int64_t* shapes_concat, const int* ndims,
+                          int n_inputs) {
+  if (!g_ns && mxtpu_rt_init() != 0) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* nlist = PyList_New(n_inputs);
+  PyObject* slist = PyList_New(n_inputs);
+  const int64_t* p = shapes_concat;
+  for (int i = 0; i < n_inputs; ++i) {
+    PyList_SetItem(nlist, i, PyUnicode_FromString(input_names[i]));
+    PyObject* shp = PyList_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; ++d)
+      PyList_SetItem(shp, d, PyLong_FromLongLong((long long)*p++));
+    PyList_SetItem(slist, i, shp);
+  }
+  PyObject* args = Py_BuildValue("(ssNN)", symbol_json,
+                                 params_path ? params_path : "", nlist,
+                                 slist);
+  int64_t h = -1;
+  PyObject* r = rt_call("rt_pred_create", args);
+  Py_XDECREF(args);
+  if (r) {
+    h = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return h;
+}
+
+int mxtpu_pred_set_input(int64_t h, const char* name, const float* data,
+                         const int64_t* shape, int ndim) {
+  return mxtpu_exec_set_arg(h, name, data, shape, ndim);
+}
+
+int mxtpu_pred_forward(int64_t h) { return mxtpu_exec_forward(h, 0); }
+
+int mxtpu_pred_get_output_shape(int64_t h, int idx, int64_t* shape,
+                                int* ndim, int cap) {
+  return mxtpu_exec_output_shape(h, idx, shape, ndim, cap);
+}
+
+int mxtpu_pred_get_output(int64_t h, int idx, float* buf, int64_t nelem) {
+  return mxtpu_exec_output(h, idx, buf, nelem);
+}
+
+int mxtpu_pred_free(int64_t h) { return mxtpu_rt_free(h); }
 
 int mxtpu_rt_free(int64_t h) {
   return call_fmt("rt_free", "(L)", (long long)h) < 0 ? -1 : 0;

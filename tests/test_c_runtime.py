@@ -154,3 +154,50 @@ def test_exec_output_rejects_wrong_buffer_size(rt):
     exact = (ctypes.c_float * 6)()
     assert rt.mxtpu_exec_output(ctypes.c_int64(h), 0, exact, 6) == 0
     assert list(exact) == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+
+def test_predict_api_loads_checkpoint_and_infers(rt, tmp_path):
+    """Inference-only predict surface (reference c_predict_api.cc):
+    graph JSON + .params checkpoint -> SetInput/Forward/GetOutput."""
+    import json
+
+    from mxnet_tpu import nd
+
+    w = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    b = np.arange(4, dtype=np.float32)
+    params_path = str(tmp_path / "pred.params")
+    nd.save(params_path, {"arg:pfc_weight": nd.array(w),
+                          "arg:pfc_bias": nd.array(b)})
+    graph = {
+        "nodes": [
+            {"op": "null", "name": "data", "attrs": {}, "inputs": []},
+            {"op": "null", "name": "pfc_weight", "attrs": {}, "inputs": []},
+            {"op": "null", "name": "pfc_bias", "attrs": {}, "inputs": []},
+            {"op": "FullyConnected", "name": "pfc",
+             "attrs": {"num_hidden": "4"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2], "heads": [[3, 0, 0]],
+    }
+    rt.mxtpu_pred_create.restype = ctypes.c_int64
+    names = (ctypes.c_char_p * 1)(b"data")
+    shapes = (ctypes.c_int64 * 2)(2, 5)
+    ndims = (ctypes.c_int * 1)(2)
+    h = rt.mxtpu_pred_create(json.dumps(graph).encode(),
+                             params_path.encode(), names, shapes, ndims, 1)
+    assert h > 0, rt.mxtpu_rt_last_error()
+    x = np.random.RandomState(1).rand(2, 5).astype(np.float32)
+    data = (ctypes.c_float * 10)(*x.ravel())
+    assert rt.mxtpu_pred_set_input(ctypes.c_int64(h), b"data", data,
+                                   shapes, 2) == 0
+    assert rt.mxtpu_pred_forward(ctypes.c_int64(h)) == 0
+    oshape = (ctypes.c_int64 * 8)()
+    ondim = ctypes.c_int()
+    assert rt.mxtpu_pred_get_output_shape(
+        ctypes.c_int64(h), 0, oshape, ctypes.byref(ondim), 8) == 0
+    assert list(oshape[:ondim.value]) == [2, 4]
+    out = (ctypes.c_float * 8)()
+    assert rt.mxtpu_pred_get_output(ctypes.c_int64(h), 0, out, 8) == 0
+    expect = x @ w.T + b
+    assert np.allclose(np.array(out).reshape(2, 4), expect, atol=1e-5)
+    assert rt.mxtpu_pred_free(ctypes.c_int64(h)) == 0
