@@ -188,6 +188,25 @@ class RecordReader {
 // Reference analogue: cpp-package's Executor/KVStore over the C API.
 // ---------------------------------------------------------------------------
 
+inline void RtCheck(int rc, const char *what) {
+  if (rc != 0)
+    throw std::runtime_error(std::string(what) + ": " +
+                             mxtpu_rt_last_error());
+}
+
+/* shared by Executor::Output and Predictor::Output — pred_* handles ARE
+ * executor handles (pyruntime.cc alias contract) */
+inline std::vector<float> FetchOutput(int64_t h, int idx) {
+  int64_t shape[8];
+  int ndim = 0;
+  RtCheck(mxtpu_exec_output_shape(h, idx, shape, &ndim, 8), "output_shape");
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  std::vector<float> out(static_cast<size_t>(n));
+  RtCheck(mxtpu_exec_output(h, idx, out.data(), n), "output");
+  return out;
+}
+
 class Executor {
  public:
   explicit Executor(const std::string &symbol_json) {
@@ -239,14 +258,7 @@ class Executor {
     return std::vector<int64_t>(shape, shape + ndim);
   }
 
-  std::vector<float> Output(int i) {
-    auto s = OutputShape(i);
-    int64_t n = 1;
-    for (auto d : s) n *= d;
-    std::vector<float> out(n);
-    Check(mxtpu_exec_output(h_, i, out.data(), n), "output");
-    return out;
-  }
+  std::vector<float> Output(int i) { return FetchOutput(h_, i); }
 
   void Grad(const std::string &name, float *buf, int64_t nelem) {
     Check(mxtpu_exec_grad(h_, name.c_str(), buf, nelem), "grad");
@@ -259,6 +271,49 @@ class Executor {
                                mxtpu_rt_last_error());
   }
   int64_t h_ = 0;
+};
+
+class Predictor {
+ public:
+  /* Inference-only deploy surface (reference: cpp-package consumers of
+   * c_predict_api): graph JSON + .params checkpoint + input shapes.  The
+   * checkpoint may be the native or the stock-MXNet binary format. */
+  Predictor(const std::string &symbol_json, const std::string &params_path,
+            const std::map<std::string, std::vector<int64_t>> &input_shapes) {
+    std::vector<const char *> names;
+    std::vector<int64_t> dims;
+    std::vector<int> ndims;
+    for (const auto &kv : input_shapes) {
+      names.push_back(kv.first.c_str());
+      ndims.push_back(static_cast<int>(kv.second.size()));
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+    }
+    h_ = mxtpu_pred_create(symbol_json.c_str(),
+                           params_path.empty() ? nullptr
+                                               : params_path.c_str(),
+                           names.data(), dims.data(), ndims.data(),
+                           static_cast<int>(names.size()));
+    if (h_ < 0)
+      throw std::runtime_error(std::string("pred_create: ") +
+                               mxtpu_rt_last_error());
+  }
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+  ~Predictor() {
+    if (h_ >= 0) mxtpu_pred_free(h_);
+  }
+
+  void SetInput(const std::string &name, const float *data,
+                const std::vector<int64_t> &shape) {
+    RtCheck(mxtpu_pred_set_input(h_, name.c_str(), data, shape.data(),
+                                 static_cast<int>(shape.size())),
+            "pred_set_input");
+  }
+  void Forward() { RtCheck(mxtpu_pred_forward(h_), "pred_forward"); }
+  std::vector<float> Output(int idx = 0) { return FetchOutput(h_, idx); }
+
+ private:
+  int64_t h_ = -1;
 };
 
 class KVStore {

@@ -181,3 +181,79 @@ def test_native_im2rec_cli_packs_readable_records(tmp_path):
             assert min(im.size) == 16
         count += 1
     assert count == 6
+
+
+@pytest.mark.skipif(bool(os.environ.get("MXTPU_NO_NATIVE")),
+                    reason="native runtime disabled explicitly")
+def test_cpp_predictor_wrapper(tmp_path):
+    """mxtpu::Predictor (the c_predict_api analogue for C++ deployers):
+    graph JSON + Python-written checkpoint -> inference from pure C++."""
+    import json
+
+    import numpy as np
+
+    from mxnet_tpu import nd
+
+    root = os.path.dirname(os.path.dirname(_native.__file__))
+    rt = os.path.join(root, "cpp", "build", "libmxtpu_rt.so")
+    if not os.path.exists(rt):
+        r = subprocess.run(["make", "-C", os.path.join(root, "cpp")],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+    w = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    params = str(tmp_path / "p.params")
+    nd.save(params, {"arg:qfc_weight": nd.array(w),
+                     "arg:qfc_bias": nd.array(np.zeros(3, np.float32))})
+    graph = {
+        "nodes": [
+            {"op": "null", "name": "data", "attrs": {}, "inputs": []},
+            {"op": "null", "name": "qfc_weight", "attrs": {}, "inputs": []},
+            {"op": "null", "name": "qfc_bias", "attrs": {}, "inputs": []},
+            {"op": "FullyConnected", "name": "qfc",
+             "attrs": {"num_hidden": "3"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2], "heads": [[3, 0, 0]],
+    }
+    sym = str(tmp_path / "p-symbol.json")
+    with open(sym, "w") as f:
+        json.dump(graph, f)
+    src = tmp_path / "drive.cc"
+    src.write_text(r'''
+#include <cstdio>
+#include <cmath>
+#include <fstream>
+#include <sstream>
+#include "mxtpu.hpp"
+int main(int argc, char **argv) {
+  std::ifstream f(argv[1]);
+  std::stringstream ss; ss << f.rdbuf();
+  mxtpu::Predictor pred(ss.str(), argv[2], {{"data", {2, 4}}});
+  float x[8];
+  for (int i = 0; i < 8; ++i) x[i] = 0.25f * i;
+  pred.SetInput("data", x, {2, 4});
+  pred.Forward();
+  auto out = pred.Output(0);
+  if (out.size() != 6) return 1;
+  for (float v : out) std::printf("%g ", v);
+  std::printf("\n");
+  return 0;
+}
+''')
+    exe = str(tmp_path / "drive")
+    r = subprocess.run(
+        ["g++", "-O1", "-std=c++17", str(src), "-o", exe,
+         "-I", os.path.join(root, "cpp-package", "include"),
+         "-I", os.path.join(root, "cpp", "include"),
+         "-L", os.path.join(root, "cpp", "build"),
+         f"-Wl,-rpath,{os.path.join(root, 'cpp', 'build')}",
+         "-lmxtpu_rt"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    env = dict(os.environ, MXTPU_RT_PLATFORM="cpu", MXTPU_RT_HOME=root)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([exe, sym, params], capture_output=True, text=True,
+                       timeout=200, env=env, cwd=root)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr[-1000:]}"
+    got = np.array([float(v) for v in r.stdout.split()]).reshape(2, 3)
+    x = (0.25 * np.arange(8, dtype=np.float32)).reshape(2, 4)
+    assert np.allclose(got, x @ w.T, atol=1e-4)
