@@ -14,7 +14,8 @@ from mxnet_tpu import autograd, gluon, nd
 
 
 def main(args):
-    rs = np.random.RandomState(0)
+    mx.random.seed(args.seed)  # adversarial dynamics are seed-sensitive;
+    rs = np.random.RandomState(args.seed)  # deterministic run end to end
     # real data: ring of gaussians
     theta = rs.rand(args.n_real) * 2 * np.pi
     real = np.stack([np.cos(theta), np.sin(theta)], 1).astype(np.float32)
@@ -63,4 +64,5 @@ if __name__ == "__main__":
     p.add_argument("--latent", type=int, default=8)
     p.add_argument("--steps", type=int, default=400)
     p.add_argument("--n-real", type=int, default=4096)
+    p.add_argument("--seed", type=int, default=0)
     main(p.parse_args())

@@ -8,6 +8,7 @@ stays stateful like the reference.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 
 import jax
@@ -30,12 +31,31 @@ def _base_key():
         return _base["key"], _base["gen"]
 
 
+_thread_seq = itertools.count(1)
+
+
+def _thread_index() -> int:
+    # The MAIN thread is structurally index 0 (not by touch order, which
+    # races against worker threads): index 0 means "the seeded base key
+    # itself", so mx.random.seed(N) fully determines the main thread's
+    # stream across processes and runs — the reference's
+    # same-seed-same-results contract for single-threaded programs.
+    # threading.get_ident() could not provide this (idents vary with ASLR).
+    if threading.current_thread() is threading.main_thread():
+        return 0
+    if not hasattr(_state, "seq"):
+        # worker threads: distinct streams by first-touch ordinal.  Like
+        # the reference's shared per-device generator, multi-threaded draw
+        # REPRODUCIBILITY is not promised — only stream distinctness.
+        _state.seq = next(_thread_seq)
+    return _state.seq
+
+
 def _get_key():
     base, gen = _base_key()
     if not hasattr(_state, "key") or getattr(_state, "gen", None) != gen:
-        # derive a distinct per-thread stream from the seeded base — without
-        # the fold_in, every worker thread would replay the identical stream
-        _state.key = jax.random.fold_in(base, threading.get_ident() & 0x7FFFFFFF)
+        idx = _thread_index()
+        _state.key = base if idx == 0 else jax.random.fold_in(base, idx)
         _state.gen = gen
     return _state.key
 
@@ -57,9 +77,11 @@ def seed(seed_state: int, ctx=None) -> None:
     with _base_lock:
         _base["key"] = jax.random.PRNGKey(int(seed_state))
         _base["gen"] += 1
-        gen = _base["gen"]
-    _state.key = jax.random.PRNGKey(int(seed_state))
-    _state.gen = gen
+    # this thread re-derives its stream (base for the first-touch thread,
+    # fold_in(seq) otherwise) on the next draw like everyone else — setting
+    # _state.key directly here bypassed the seq bookkeeping
+    if hasattr(_state, "key"):
+        del _state.key
 
 
 def next_key():

@@ -28,7 +28,11 @@ MANIFEST = {
     "deep-embedded-clustering": [("deep-embedded-clustering/dec.py", [])],
     "dsd": [("dsd/dsd_training.py", [])],
     "fcn-xs": [("fcn-xs/fcn_segmentation.py", [])],
-    "gan": [("gan/dcgan_synthetic.py", [])],
+    "gan": [("gan/dcgan_synthetic.py",
+             # adversarial dynamics are seed-sensitive; the example is now
+             # seeded (default 0) and 300 steps converges to radius ~0.99
+             # on that seed while fitting the 1-core CI budget
+             ["--steps", "300"])],
     "gluon": [("gluon/word_language_model/train.py", [])],
     "image-classification": [
         ("image-classification/train_mnist.py", ["--num-epochs", "2"]),
