@@ -246,10 +246,17 @@ class KVStoreDistServer:
             self._threads.append(t)
 
     def _serve(self, conn: socket.socket):
+        from . import profiler as _prof
+
         try:
             while True:
                 msg = _recv_msg(conn)
-                reply = self._handle(msg)
+                # server-side spans: the server's work (merge/update) is
+                # raw jnp, not op dispatch, so the remote profiler records
+                # command-handling durations — the server_* rows the
+                # reference's test_server_profiling flow inspects
+                with _prof.scope(f"server_{msg[0]}", cat="server"):
+                    reply = self._handle(msg)
                 _send_msg(conn, reply)
                 if msg[0] == "shutdown":
                     return
@@ -343,6 +350,33 @@ class KVStoreDistServer:
                 dead = sum(1 for r in range(self._num_workers)
                            if now - self._heartbeats.get(r, 0) > timeout_s)
             return ("ok", dead)
+        if cmd == "profiler":
+            # remote server profiling (reference: KVStoreServerProfilerCommand,
+            # include/mxnet/kvstore.h:49-51; tests/nightly/
+            # test_server_profiling.py) — workers toggle the server-side
+            # profiler and fetch its table or chrome-trace dump over the
+            # wire.  NOTE: in the default layout rank 0 hosts the server
+            # tier IN-PROCESS, so this profiler is that process's global
+            # one (worker and server events share it); with a dedicated
+            # server host (MXTPU_ROLE=server) it is genuinely separate,
+            # matching the reference's profile_process="server".
+            from . import profiler as _prof
+
+            _, action, arg = msg
+            if action == "set_config":
+                _prof.set_config(filename=arg or "server_profile.json",
+                                 profile_imperative=True)
+                return ("ok",)
+            if action == "state":
+                _prof.set_state(arg)
+                return ("ok",)
+            if action == "dump":
+                return ("ok", _prof.dumps(reset=False,
+                                          format=arg or "table"))
+            if action == "dump_file":
+                _prof.dump()
+                return ("ok",)
+            return ("error", f"unknown profiler action {action!r}")
         if cmd == "shutdown":
             with self._lock:
                 self._barrier_count["__shutdown__"] = \
@@ -702,6 +736,33 @@ class KVStoreDist(KVStore):
         """Reference: KVStore::get_num_dead_node via ps-lite heartbeats
         (include/mxnet/kvstore.h:353)."""
         return int(self._request("num_dead_node", float(timeout))[1])
+
+    def set_server_profiler_state(self, state, server=None):
+        """Toggle the remote servers' profiler (reference:
+        MXSetProcessProfilerState with profile_process='server' →
+        KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49-51)."""
+        targets = range(self._n_servers) if server is None else [server]
+        for srv in targets:
+            self._request_on(srv, "profiler", "state", state)
+
+    def set_server_profiler_config(self, filename="server_profile.json",
+                                   server=None):
+        targets = range(self._n_servers) if server is None else [server]
+        for srv in targets:
+            self._request_on(srv, "profiler", "set_config", filename)
+
+    def dump_server_profile(self, format="table", server=0):
+        """Fetch a server's profiler dump over the wire (format="json"
+        returns chrome://tracing events; reference:
+        tests/nightly/test_server_profiling.py flow)."""
+        return self._request_on(server, "profiler", "dump", format)[1]
+
+    def dump_server_profile_file(self, server=None):
+        """Ask servers to write their configured chrome-trace file
+        (reference MXDumpProfile with profile_process='server')."""
+        targets = range(self._n_servers) if server is None else [server]
+        for srv in targets:
+            self._request_on(srv, "profiler", "dump_file", "")
 
     def _barrier_before_exit(self):
         self.close()

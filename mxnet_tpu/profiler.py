@@ -100,7 +100,15 @@ def _emit(ph, name, cat, ts=None, dur=None, args=None, force=False):
 
 
 def dumps(reset=False, format="table"):
-    """Aggregate summary string (reference: MXAggregateProfileStatsPrint)."""
+    """Aggregate summary string (reference: MXAggregateProfileStatsPrint);
+    format="json" returns the chrome://tracing event JSON instead."""
+    if format == "json":
+        with _lock:
+            out = json.dumps({"traceEvents": list(_events),
+                              "displayTimeUnit": "ms"})
+            if reset:
+                _events.clear()
+        return out
     agg = defaultdict(lambda: [0, 0.0])
     with _lock:
         for ev in _events:

@@ -245,3 +245,33 @@ def test_wire_codec_rejects_arbitrary_objects():
 
     with pytest.raises(mx.base.MXNetError):
         _enc(("push", Evil()), [])
+
+
+def test_server_profiler_command():
+    """Remote server profiling over the wire (reference:
+    KVStoreServerProfilerCommand, include/mxnet/kvstore.h:49-51;
+    tests/nightly/test_server_profiling.py): toggle the server-side
+    profiler from a worker and fetch its dump."""
+    script = COMMON.format(mode="dist_sync") + textwrap.dedent("""
+        kv.set_server_profiler_config(filename="/tmp/srv_prof.json")
+        kv.set_server_profiler_state("run")
+        # server-side optimizer: the updater's NDArray ops are what the
+        # server profiler records (reference test_server_profiling.py
+        # profiles the server's update path)
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+        kv.init(3, nd.array(np.ones(4, np.float32)))
+        kv.push(3, nd.array(np.ones(4, np.float32)))
+        out = nd.zeros(4)
+        kv.pull(3, out=out)
+        kv.set_server_profiler_state("stop")
+        dump = kv.dump_server_profile(format="table")
+        # events must actually have been recorded (not just the header)
+        assert len(dump.strip().splitlines()) > 1, repr(dump)
+        import json as _json
+        trace = _json.loads(kv.dump_server_profile(format="json"))
+        assert trace["traceEvents"], trace
+        print("SERVER_PROFILE_OK")
+        kv.close()
+    """)
+    outs = _run_workers(script, 1)
+    assert "SERVER_PROFILE_OK" in outs[0], outs[0]
