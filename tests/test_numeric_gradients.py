@@ -143,3 +143,57 @@ def test_grad_linalg_gemm2():
     b = mx.sym.Variable("b")
     y = mx.sym.linalg_gemm2(a, b)
     check_numeric_gradient(y, [_d((3, 2)), _d((2, 3))])
+
+
+def test_grad_embedding_wrt_weight():
+    i = mx.sym.Variable("i")
+    w = mx.sym.Variable("w")
+    y = mx.sym.Embedding(i, w, input_dim=6, output_dim=3)
+    from mxnet_tpu import nd
+
+    check_numeric_gradient(
+        y, {"i": nd.array(np.array([0, 2, 5], np.float32)), "w": _d((6, 3))},
+        grad_nodes=["w"])
+
+
+def test_grad_instance_norm():
+    x = mx.sym.Variable("x")
+    g = mx.sym.Variable("g")
+    b = mx.sym.Variable("b")
+    y = mx.sym.InstanceNorm(x, g, b)
+    check_numeric_gradient(y, [_d((2, 3, 4)), _d((3,)) + 1.5, _d((3,))],
+                           numeric_eps=1e-2, rtol=5e-2, atol=5e-2)
+
+
+def test_grad_sequence_mask_and_reverse():
+    x = mx.sym.Variable("x")
+    y = mx.sym.SequenceReverse(mx.sym.SequenceMask(
+        x, mx.sym.Variable("l"), use_sequence_length=True, value=0.0))
+    from mxnet_tpu import nd
+
+    check_numeric_gradient(
+        y, {"x": _d((4, 2, 3)), "l": nd.array(np.array([3, 2], np.float32))},
+        grad_nodes=["x"])
+
+
+def test_grad_bilinear_sampler():
+    x = mx.sym.Variable("x")
+    grid = mx.sym.Variable("grid")
+    y = mx.sym.BilinearSampler(x, grid)
+    # grid in [-1,1], keep away from exact cell boundaries
+    g = (np.linspace(-0.7, 0.7, 2 * 3 * 3).reshape(1, 2, 3, 3)
+         .astype(np.float32)) + 0.013
+    check_numeric_gradient(y, {"x": _d((1, 2, 4, 4)), "grid": g},
+                           grad_nodes=["x", "grid"], numeric_eps=1e-2,
+                           rtol=5e-2, atol=5e-2)
+
+
+def test_grad_softmax_cross_entropy_composite():
+    x = mx.sym.Variable("x")
+    y = -mx.sym.sum(mx.sym.log_softmax(x, axis=-1) *
+                    mx.sym.one_hot(mx.sym.Variable("lab"), depth=4), axis=-1)
+    from mxnet_tpu import nd
+
+    check_numeric_gradient(
+        y, {"x": _d((3, 4)), "lab": nd.array(np.array([0, 2, 3], np.float32))},
+        grad_nodes=["x"])
