@@ -1,0 +1,67 @@
+"""Edge-shape sweep vs numpy oracles: empty arrays, size-1 axes, zero-dim
+contractions, degenerate broadcasts (the reference test_operator.py's
+corner-shape regression style)."""
+import numpy as np
+
+from mxnet_tpu import nd
+
+R = np.random.RandomState(0)
+
+
+def _eq(got, want):
+    want = np.asarray(want)
+    assert got.shape == want.shape, (got.shape, want.shape)
+    assert np.allclose(got, want, atol=1e-5), (got, want)
+
+
+def test_unary_and_reduce_over_edge_shapes():
+    for s in [(0,), (1,), (3,), (0, 4), (2, 0), (1, 1), (2, 3)]:
+        a = R.rand(*s).astype(np.float32)
+        _eq(nd.exp(nd.array(a)).asnumpy(), np.exp(a))
+        _eq(nd.sum(nd.array(a)).asnumpy(),
+            np.float32(np.sum(a)).reshape(()))
+        _eq(nd.sort(nd.array(a), axis=-1).asnumpy(), np.sort(a, axis=-1))
+        if a.size:
+            _eq(nd.max(nd.array(a)).asnumpy(),
+                np.float32(np.max(a)).reshape(()))
+        _eq(nd.clip(nd.array(a), 0.2, 0.8).asnumpy(), np.clip(a, 0.2, 0.8))
+
+
+def test_broadcast_pairs_including_empty():
+    for sa, sb in [((1,), (3,)), ((2, 1), (1, 3)), ((0, 3), (1, 3)),
+                   ((2, 3), (3,))]:
+        a = R.rand(*sa).astype(np.float32)
+        b = R.rand(*sb).astype(np.float32)
+        _eq(nd.broadcast_add(nd.array(a), nd.array(b)).asnumpy(), a + b)
+        _eq(nd.broadcast_mul(nd.array(a), nd.array(b)).asnumpy(), a * b)
+        _eq(nd.broadcast_maximum(nd.array(a), nd.array(b)).asnumpy(),
+            np.maximum(a, b))
+
+
+def test_zero_dim_contractions_and_concat():
+    a = np.zeros((0, 4), np.float32)
+    b = R.rand(4, 3).astype(np.float32)
+    _eq(nd.dot(nd.array(a), nd.array(b)).asnumpy(), a @ b)
+    _eq(nd.dot(nd.array(np.zeros((2, 0), np.float32)),
+               nd.array(np.zeros((0, 3), np.float32))).asnumpy(),
+        np.zeros((2, 0), np.float32) @ np.zeros((0, 3), np.float32))
+    c = R.rand(2, 3).astype(np.float32)
+    _eq(nd.concat(nd.array(np.zeros((0, 3), np.float32)),
+                  nd.array(c), dim=0).asnumpy(),
+        np.concatenate([np.zeros((0, 3), np.float32), c], 0))
+
+
+def test_argmax_size_one_axis_and_reshape_zero_token():
+    import pytest
+
+    import mxnet_tpu as mx
+
+    x = R.rand(3, 1).astype(np.float32)
+    _eq(nd.argmax(nd.array(x), axis=1).asnumpy(),
+        np.argmax(x, 1).astype(np.float32))
+    # reference reshape: 0 is the KEEP-DIM token, not a literal zero —
+    # reshaping (0,5) to (3,0) means (3,5), size 15 != 0, so it must raise
+    with pytest.raises(mx.base.MXNetError):
+        nd.reshape(nd.array(np.zeros((0, 5), np.float32)), shape=(3, 0))
+    # keep-dim token works on a normal array
+    _eq(nd.reshape(nd.array(x), shape=(0, 1)).asnumpy(), x)
