@@ -483,7 +483,17 @@ class NDArray:
             if isinstance(v, (int, float)):
                 self._data = jnp.full_like(self._data, v)
             else:
-                self._data = jnp.broadcast_to(v, self._data.shape).astype(self._data.dtype)
+                try:
+                    self._data = jnp.broadcast_to(
+                        v, self._data.shape).astype(self._data.dtype)
+                except (ValueError, TypeError) as e:
+                    # reference CopyFromTo raises its typed error on shape
+                    # mismatch; a raw jnp ValueError escaping here breaks
+                    # except-MXNetError handlers in ported scripts
+                    raise MXNetError(
+                        f"cannot assign array of shape "
+                        f"{tuple(np.shape(v))} to NDArray of shape "
+                        f"{tuple(self._data.shape)}") from e
         else:
             self._data = self._data.at[jkey].set(v)
 

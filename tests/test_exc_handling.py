@@ -1,0 +1,68 @@
+"""Exception-surfacing semantics (reference:
+tests/python/unittest/test_exc_handling.py — errors from ops must surface
+as MXNetError at a well-defined point with the failing op named, both
+imperatively and through bound executors)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_imperative_bad_args_raise_named_mxnet_error():
+    a = nd.array(np.ones((2, 3), np.float32))
+    with pytest.raises(mx.base.MXNetError, match="dot"):
+        nd.dot(a, a)  # inner dims mismatch: 3 vs 2
+    with pytest.raises(mx.base.MXNetError, match="concat"):
+        nd.concat(a, nd.array(np.ones((2, 4), np.float32)), dim=0)
+
+
+def test_executor_bad_shape_raises_at_bind_or_forward():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="xfc")
+    with pytest.raises(mx.base.MXNetError):
+        exe = net.simple_bind(ctx=mx.cpu(), data=(2, 3))
+        exe.arg_dict["xfc_weight"][:] = nd.array(
+            np.ones((4, 7), np.float32))  # wrong fan-in
+        exe.forward()
+        exe.outputs[0].asnumpy()
+
+
+def test_error_under_recording_does_not_poison_tape():
+    x = nd.array(np.ones((2, 2), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        with pytest.raises(mx.base.MXNetError):
+            nd.dot(x, nd.array(np.ones((3, 3), np.float32)))
+        y = (x * 2).sum()  # recording continues after the failed op
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2.0)
+
+
+def test_naive_engine_surfaces_errors_at_the_op():
+    from mxnet_tpu import engine
+
+    with engine.NaiveEngine():
+        with pytest.raises(mx.base.MXNetError):
+            nd.dot(nd.array(np.ones((2, 3), np.float32)),
+                   nd.array(np.ones((2, 3), np.float32)))
+        # engine mode restored even after the raise path
+    assert not engine.is_naive()
+
+
+def test_dataloader_worker_exception_propagates():
+    from mxnet_tpu import gluon
+
+    class Boom(gluon.data.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, idx):
+            if idx == 5:
+                raise ValueError("boom at 5")
+            return np.zeros(3, np.float32)
+
+    loader = gluon.data.DataLoader(Boom(), batch_size=4)
+    with pytest.raises(Exception, match="boom"):
+        for _ in loader:
+            pass
