@@ -84,6 +84,7 @@ def supervise():
     allow_cpu = os.environ.get("BENCH_ALLOW_CPU") == "1"
     deadline = time.monotonic() + budget
     attempts = []
+    measure_failures = 0
     while True:
         try:
             platform, _n = _probe_backend(probe_timeout)
@@ -133,6 +134,25 @@ def supervise():
                     except OSError:
                         pass
                 print(json.dumps(result))
+                return
+            # probe healthy but measurement crashed: a code/config error,
+            # not tunnel weather — two strikes and report it as what it is
+            # instead of burning the budget and mislabeling the artifact
+            measure_failures += 1
+            if measure_failures >= 2:
+                last_good = _load_last_good()
+                print(json.dumps({
+                    "metric": "resnet50_train_throughput",
+                    "value": last_good.get("value"),
+                    "unit": last_good.get("unit", "images/sec/chip"),
+                    "vs_baseline": round(
+                        float(last_good.get("value", 0)) / NORTH_STAR, 4),
+                    "status": "measure_failed",
+                    "measured": False,
+                    "last_good": last_good,
+                    "error_tail":
+                        (proc.stderr or proc.stdout).strip()[-400:],
+                }))
                 return
             raise RuntimeError(
                 f"measurement rc={proc.returncode}: "

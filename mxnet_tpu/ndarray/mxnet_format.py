@@ -46,31 +46,40 @@ class _Reader:
         self.data = data
         self.off = 0
 
-    def u32(self) -> int:
-        (v,) = struct.unpack_from("<I", self.data, self.off)
-        self.off += 4
+    def _fixed(self, fmt: str, size: int) -> int:
+        # bounds-checked so every truncation raises the module's documented
+        # ValueError, never a position-dependent struct.error
+        if self.off + size > len(self.data):
+            raise ValueError("truncated MXNet NDArray file")
+        (v,) = struct.unpack_from(fmt, self.data, self.off)
+        self.off += size
         return v
+
+    def u32(self) -> int:
+        return self._fixed("<I", 4)
 
     def i32(self) -> int:
-        (v,) = struct.unpack_from("<i", self.data, self.off)
-        self.off += 4
-        return v
+        return self._fixed("<i", 4)
 
     def u64(self) -> int:
-        (v,) = struct.unpack_from("<Q", self.data, self.off)
-        self.off += 8
-        return v
+        return self._fixed("<Q", 8)
 
     def raw(self, n: int) -> bytes:
-        if self.off + n > len(self.data):
-            raise ValueError("truncated MXNet NDArray file")
+        if n < 0 or self.off + n > len(self.data):
+            # negative n (corrupt dims) would return b'' and move the
+            # cursor BACKWARDS, desyncing every later record
+            raise ValueError("truncated or corrupt MXNet NDArray file")
         b = self.data[self.off:self.off + n]
         self.off += n
         return b
 
     def tshape(self) -> Tuple[int, ...]:
         ndim = self.u32()
-        return struct.unpack_from(f"<{ndim}q", self.raw(8 * ndim), 0)
+        shape = struct.unpack_from(f"<{ndim}q", self.raw(8 * ndim), 0)
+        if any(d < 0 for d in shape):
+            raise ValueError(f"corrupt MXNet NDArray file: negative dim "
+                             f"in shape {shape}")
+        return shape
 
     def tshape_pre_v1(self, ndim: int) -> Tuple[int, ...]:
         return struct.unpack_from(f"<{ndim}I", self.raw(4 * ndim), 0)

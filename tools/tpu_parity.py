@@ -245,13 +245,24 @@ def build_cases():
 
 
 def main():
-    out_path = sys.argv[sys.argv.index("--out") + 1] \
-        if "--out" in sys.argv else os.path.join(REPO, "TPU_PARITY_r05.json")
+    self_test = "--self-test" in sys.argv
+    if "--out" in sys.argv:
+        i = sys.argv.index("--out")
+        if i + 1 >= len(sys.argv):
+            print("usage: tpu_parity.py [--self-test] [--out FILE]",
+                  file=sys.stderr)
+            return 2
+        out_path = sys.argv[i + 1]
+    elif self_test:
+        # a hermetic CPU-vs-CPU self-test must never masquerade as the
+        # round's on-chip parity artifact
+        out_path = "/tmp/tpu_parity_selftest.json"
+    else:
+        out_path = os.path.join(REPO, "TPU_PARITY_r05.json")
     import jax
 
     import mxnet_tpu as mx
 
-    self_test = "--self-test" in sys.argv
     tpu_ctx = mx.tpu() if any(d.platform != "cpu" for d in jax.devices()) \
         else (mx.cpu() if self_test else None)
     if tpu_ctx is None:
@@ -265,8 +276,13 @@ def main():
               "n_cases": len(cases), "results": [], "done": False}
 
     def flush():
-        with open(out_path, "w") as f:
+        # atomic: a SIGTERM/SIGKILL landing mid-write must not destroy the
+        # previously flushed results — that partial artifact is the whole
+        # point of incremental flushing under a wedging tunnel
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(record, f, indent=1)
+        os.replace(tmp, out_path)
 
     flush()
     n_fail = 0

@@ -11,14 +11,21 @@ POLL=${WATCH_POLL:-600}
 PROBE_TIMEOUT=${WATCH_PROBE_TIMEOUT:-250}
 echo "$(date -u +%FT%TZ) watcher start (poll ${POLL}s)"
 while true; do
+  # probe stderr is kept: a broken python env must be distinguishable
+  # from a tunnel outage (both would otherwise log 'tunnel still down')
   if timeout "$PROBE_TIMEOUT" python -c \
       "import jax; d=jax.devices(); assert d[0].platform != 'cpu'; \
 import jax.numpy as jnp; (jnp.ones((128,128))@jnp.ones((128,128))).block_until_ready(); \
-print('PROBE_OK', d[0].platform)" 2>/dev/null | grep -q PROBE_OK; then
+print('PROBE_OK', d[0].platform)" 2>/tmp/window_watcher_probe.err | grep -q PROBE_OK; then
     echo "$(date -u +%FT%TZ) HEALTHY WINDOW — starting measurement list"
     echo "== perf_sweep --quick =="
+    rm -f /tmp/perf_sweep.json  # never promote a STALE prior-run file
     timeout 2700 python tools/perf_sweep.py --quick 2>&1 | tail -20
-    cp /tmp/perf_sweep.json PERF_SWEEP_r05.json 2>/dev/null
+    if [ -f /tmp/perf_sweep.json ]; then
+      cp /tmp/perf_sweep.json PERF_SWEEP_r05.json
+    else
+      echo "perf_sweep produced no artifact (killed mid-run?)"
+    fi
     echo "== tpu_parity =="
     timeout 2700 python tools/tpu_parity.py 2>&1 | tail -8
     echo "== bench.py =="
@@ -26,6 +33,6 @@ print('PROBE_OK', d[0].platform)" 2>/dev/null | grep -q PROBE_OK; then
     echo "$(date -u +%FT%TZ) measurement list DONE"
     exit 0
   fi
-  echo "$(date -u +%FT%TZ) tunnel still down"
+  echo "$(date -u +%FT%TZ) tunnel still down ($(tail -c 80 /tmp/window_watcher_probe.err 2>/dev/null | tr '\n' ' '))"
   sleep "$POLL"
 done
