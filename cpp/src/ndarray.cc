@@ -47,7 +47,7 @@ uint64_t NumElems(const std::vector<uint64_t> &shape) {
   return n;
 }
 
-constexpr char kMagic[] = "TPMX0001";
+constexpr char kNdMagic[] = "TPMX0001";
 
 bool ReadExact(FILE *f, void *dst, size_t n) {
   return std::fread(dst, 1, n, f) == n;
@@ -148,7 +148,7 @@ int mxtpu_nd_save(const char *path, void *const *handles,
     ok = ok && std::fwrite(src, 1, sz, f) == sz;
   };
   char kind = keys ? 'D' : 'L';
-  put(kMagic, 8);
+  put(kNdMagic, 8);
   put(&kind, 1);
   uint64_t count = static_cast<uint64_t>(n);
   put(&count, 8);
@@ -192,7 +192,7 @@ int mxtpu_nd_load(const char *path, void **out_list, int *out_count) try {
   char magic[8];
   char kind;
   uint64_t count = 0;
-  if (!ReadExact(f, magic, 8) || std::memcmp(magic, kMagic, 8) != 0 ||
+  if (!ReadExact(f, magic, 8) || std::memcmp(magic, kNdMagic, 8) != 0 ||
       !ReadExact(f, &kind, 1) || !ReadExact(f, &count, 8)) {
     std::fclose(f);
     mxtpu::SetError(std::string(path) + ": not a tpu-mx NDArray file");

@@ -37,7 +37,7 @@
 
 namespace {
 
-constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kRecMagic = 0xced7230a;
 constexpr uint32_t kLenBits = 29;
 constexpr uint32_t kLenMask = (1u << kLenBits) - 1;
 
@@ -61,7 +61,7 @@ int ReadPart(FILE *f, uint32_t *cflag, std::string *payload, bool skip,
   uint32_t header[2];
   size_t n = std::fread(header, 1, sizeof(header), f);
   if (n == 0) return 0;
-  if (n < sizeof(header) || header[0] != kMagic) return -1;
+  if (n < sizeof(header) || header[0] != kRecMagic) return -1;
   uint32_t len = header[1] & kLenMask;
   uint32_t padded = (len + 3u) & ~3u;
   *cflag = header[1] >> kLenBits;
@@ -94,7 +94,7 @@ int ReadLogical(FILE *f, std::string *rec, bool skip, long fsize = -1) {
   if (cflag == 0) return 1;
   if (cflag != 1) return -1;  // stream must not start mid-record
   for (;;) {
-    if (!skip) rec->append(reinterpret_cast<const char *>(&kMagic), 4);
+    if (!skip) rec->append(reinterpret_cast<const char *>(&kRecMagic), 4);
     r = ReadPart(f, &cflag, rec, skip, fsize);
     if (r <= 0) return -1;  // EOF inside a multipart record is corruption
     if (cflag == 3) return 1;
@@ -241,7 +241,7 @@ class RecWriter {
     for (uint64_t off = 0; off + 4 <= len; off += 4) {
       uint32_t word;
       std::memcpy(&word, data + off, 4);
-      if (word == kMagic) splits.push_back(off);
+      if (word == kRecMagic) splits.push_back(off);
     }
     if (splits.empty()) return WritePart(data, len, 0);
     uint64_t pos = 0;
@@ -255,7 +255,7 @@ class RecWriter {
   }
 
   int WritePart(const uint8_t *data, uint64_t len, uint32_t cflag) {
-    uint32_t header[2] = {kMagic, static_cast<uint32_t>(len & kLenMask) |
+    uint32_t header[2] = {kRecMagic, static_cast<uint32_t>(len & kLenMask) |
                                       (cflag << kLenBits)};
     if (std::fwrite(header, 1, sizeof(header), f_) != sizeof(header)) return 1;
     if (len && std::fwrite(data, 1, len, f_) != len) return 1;

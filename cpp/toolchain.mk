@@ -1,0 +1,15 @@
+# Shared toolchain probes for the native builds (included by cpp/Makefile and
+# amalgamation/Makefile — one source of truth for Python/libjpeg detection).
+CXX ?= g++
+CXXFLAGS ?= -O2 -g -std=c++17 -fPIC -Wall -pthread
+
+PY_INC := $(shell python3-config --includes 2>/dev/null)
+PY_LD := $(shell python3-config --ldflags --embed 2>/dev/null || python3-config --ldflags 2>/dev/null)
+
+HAVE_JPEG := $(shell printf '\043include <jpeglib.h>\n' | $(CXX) $(CXXFLAGS) $(CPPFLAGS) -E -x c++ - >/dev/null 2>&1 && echo 1)
+ifeq ($(HAVE_JPEG),1)
+CXXFLAGS += -DMXTPU_HAVE_LIBJPEG
+JPEG_LIB := -ljpeg
+else
+JPEG_LIB :=
+endif

@@ -1,0 +1,116 @@
+"""Amalgamation smoke: mxtpu-all.cc (the whole native runtime as ONE
+translation unit) regenerates, compiles, and carries the merged C ABI.
+
+Reference parity: amalgamation/ builds mxnet_predict-all.cc into
+libmxnet_predict.so and the nightly compiles it (reference
+tests/nightly/test_all.sh `make amalgamation`).  Here the single TU exports
+the union of libmxtpu.so (engine/recordio/ndarray) and libmxtpu_rt.so
+(embedded-runtime executor/predict), so one ctypes session exercises both
+halves to prove the merge didn't shadow or drop symbols.
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AMAL = os.path.join(ROOT, "amalgamation")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    # Drive the shipped build recipe itself (one source of truth for flags);
+    # outputs land in amalgamation/ and are gitignored.
+    for tool in ("g++", "make", "python3-config"):
+        if shutil.which(tool) is None:
+            pytest.skip(f"no {tool} in PATH")
+    r = subprocess.run(["make", "-C", AMAL, "-B"], capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, \
+        f"make -C amalgamation failed:\n{r.stdout[-1000:]}\n{r.stderr[-3000:]}"
+    L = ctypes.CDLL(os.path.join(AMAL, "libmxtpu_all.so"))
+    L.mxtpu_last_error.restype = ctypes.c_char_p
+    L.mxtpu_version.restype = ctypes.c_char_p
+    return L
+
+
+def test_engine_and_recordio_half(lib, tmp_path):
+    # libmxtpu half: version, engine round-trip, recordio write/read
+    assert b"mxtpu" in lib.mxtpu_version()
+    lib.mxtpu_engine_new_var.restype = ctypes.c_uint64
+    lib.mxtpu_rec_count.restype = ctypes.c_int64
+    eng = ctypes.c_void_p()
+    assert lib.mxtpu_engine_create(2, ctypes.byref(eng)) == 0
+    var = lib.mxtpu_engine_new_var(eng)
+    assert var != 0
+    failed = ctypes.c_uint64()
+    assert lib.mxtpu_engine_wait_all(eng, ctypes.byref(failed)) == 0
+    lib.mxtpu_engine_delete_var(eng, ctypes.c_uint64(var))
+    lib.mxtpu_engine_destroy(eng)
+
+    rec = os.path.join(tmp_path, "a.rec")
+    w = ctypes.c_void_p()
+    assert lib.mxtpu_rec_writer_open(rec.encode(), ctypes.byref(w)) == 0, \
+        lib.mxtpu_last_error()
+    payload = b"amalgamated-record"
+    assert lib.mxtpu_rec_write(w, payload,
+                               ctypes.c_uint64(len(payload))) == 0
+    lib.mxtpu_rec_writer_close(w)
+    assert lib.mxtpu_rec_count(rec.encode()) == 1
+    rd = ctypes.c_void_p()
+    assert lib.mxtpu_rec_open(rec.encode(), 4, 2, 0, 1,
+                              ctypes.byref(rd)) == 0, lib.mxtpu_last_error()
+    batch = ctypes.c_void_p()
+    count = ctypes.c_int()
+    assert lib.mxtpu_rec_next_batch(rd, ctypes.byref(batch),
+                                    ctypes.byref(count)) == 0
+    assert batch.value and count.value == 1
+    data = ctypes.POINTER(ctypes.c_uint8)()
+    ln = ctypes.c_uint64()
+    lib.mxtpu_rec_get(batch, 0, ctypes.byref(data), ctypes.byref(ln))
+    assert bytes(bytearray(data[: ln.value])) == payload
+    lib.mxtpu_rec_free_batch(batch)
+    lib.mxtpu_rec_close(rd)
+
+
+def test_embedded_runtime_half(lib):
+    # libmxtpu_rt half in the SAME handle: init the embedded interpreter and
+    # run a forward through the executor C API
+    lib.mxtpu_rt_last_error.restype = ctypes.c_char_p
+    lib.mxtpu_exec_create.restype = ctypes.c_int64
+    lib.mxtpu_exec_create.argtypes = [ctypes.c_char_p]
+    os.environ.setdefault("MXTPU_RT_PLATFORM", "cpu")
+    os.environ.setdefault("MXTPU_RT_HOME", ROOT)
+    assert lib.mxtpu_rt_init() == 0, lib.mxtpu_rt_last_error()
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, no_bias=True, name="fc")
+    h = lib.mxtpu_exec_create(fc.tojson().encode())
+    assert h > 0, lib.mxtpu_rt_last_error()
+    names = (ctypes.c_char_p * 2)(b"data", b"fc_weight")
+    shapes = (ctypes.c_int64 * 4)(2, 4, 3, 4)
+    ndims = (ctypes.c_int * 2)(2, 2)
+    assert lib.mxtpu_exec_simple_bind(ctypes.c_int64(h), names, shapes,
+                                      ndims, 2) == 0, \
+        lib.mxtpu_rt_last_error()
+    rng = np.random.RandomState(0)
+    x = np.ascontiguousarray(rng.rand(2, 4), dtype=np.float32)
+    w = np.ascontiguousarray(rng.randn(3, 4) * 0.3, dtype=np.float32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    sh = lambda s: (ctypes.c_int64 * len(s))(*s)
+    assert lib.mxtpu_exec_set_arg(ctypes.c_int64(h), b"data",
+                                  x.ctypes.data_as(fp), sh((2, 4)), 2) == 0
+    assert lib.mxtpu_exec_set_arg(ctypes.c_int64(h), b"fc_weight",
+                                  w.ctypes.data_as(fp), sh((3, 4)), 2) == 0
+    assert lib.mxtpu_exec_forward(ctypes.c_int64(h), 0) == 0, \
+        lib.mxtpu_rt_last_error()
+    out = np.zeros((2, 3), dtype=np.float32)
+    assert lib.mxtpu_exec_output(ctypes.c_int64(h), 0,
+                                 out.ctypes.data_as(fp),
+                                 ctypes.c_int64(out.size)) == 0
+    np.testing.assert_allclose(out, x @ w.T, rtol=1e-5, atol=1e-5)
