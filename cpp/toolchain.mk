@@ -1,7 +1,7 @@
 # Shared toolchain probes for the native builds (included by cpp/Makefile and
 # amalgamation/Makefile — one source of truth for Python/libjpeg detection).
 CXX ?= g++
-CXXFLAGS ?= -O2 -g -std=c++17 -fPIC -Wall -pthread
+CXXFLAGS ?= -O2 -g -std=c++17 -fPIC -Wall -Wextra -pthread
 
 PY_INC := $(shell python3-config --includes 2>/dev/null)
 PY_LD := $(shell python3-config --ldflags --embed 2>/dev/null || python3-config --ldflags 2>/dev/null)

@@ -29,7 +29,7 @@ def lib():
     for tool in ("g++", "make", "python3-config"):
         if shutil.which(tool) is None:
             pytest.skip(f"no {tool} in PATH")
-    r = subprocess.run(["make", "-C", AMAL, "-B"], capture_output=True,
+    r = subprocess.run(["make", "-C", AMAL], capture_output=True,
                        text=True, timeout=300)
     assert r.returncode == 0, \
         f"make -C amalgamation failed:\n{r.stdout[-1000:]}\n{r.stderr[-3000:]}"

@@ -1,0 +1,196 @@
+"""Dedicated gluon.loss tier (reference: tests/python/unittest/test_loss.py).
+
+Every loss class is checked against a NumPy oracle computed from the same
+definition the reference documents, plus weighting/sample_weight semantics,
+hybridize consistency, gradient flow, and one small convergence train.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import loss as gloss
+
+RS = np.random.RandomState(7)
+
+
+def _np_softrelu(x):
+    return np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0) - x * (x < 0)
+
+
+def _check(loss_block, args, oracle_per_sample, rtol=1e-5, atol=1e-6):
+    """loss(args) must equal the per-sample oracle; hybridized too."""
+    out = loss_block(*[nd.array(a) for a in args]).asnumpy()
+    np.testing.assert_allclose(out, oracle_per_sample, rtol=rtol, atol=atol)
+    loss_block.hybridize()
+    out_h = loss_block(*[nd.array(a) for a in args]).asnumpy()
+    np.testing.assert_allclose(out_h, oracle_per_sample, rtol=rtol, atol=atol)
+
+
+def test_l2_loss():
+    pred = RS.randn(4, 5).astype(np.float32)
+    label = RS.randn(4, 5).astype(np.float32)
+    _check(gloss.L2Loss(), (pred, label),
+           np.mean(np.square(label - pred), axis=1) / 2)
+    # weight scales linearly
+    _check(gloss.L2Loss(weight=3.0), (pred, label),
+           3.0 * np.mean(np.square(label - pred), axis=1) / 2)
+
+
+def test_l1_loss():
+    pred = RS.randn(4, 5).astype(np.float32)
+    label = RS.randn(4, 5).astype(np.float32)
+    _check(gloss.L1Loss(), (pred, label), np.mean(np.abs(label - pred), axis=1))
+
+
+def test_sigmoid_bce_loss():
+    pred = (RS.randn(3, 4) * 2).astype(np.float32)
+    label = RS.randint(0, 2, (3, 4)).astype(np.float32)
+    want = np.mean(np.maximum(pred, 0) - pred * label +
+                   np.log1p(np.exp(-np.abs(pred))), axis=1)
+    _check(gloss.SigmoidBinaryCrossEntropyLoss(), (pred, label), want)
+    # from_sigmoid path agrees with the logit path at the same point
+    probs = 1 / (1 + np.exp(-pred))
+    got = gloss.SigmoidBCELoss(from_sigmoid=True)(
+        nd.array(probs), nd.array(label)).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_ce_loss_sparse_and_dense():
+    pred = RS.randn(6, 10).astype(np.float32)
+    label = RS.randint(0, 10, (6,)).astype(np.float32)
+    logp = pred - pred.max(-1, keepdims=True)
+    logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+    want = -logp[np.arange(6), label.astype(int)]
+    _check(gloss.SoftmaxCrossEntropyLoss(), (pred, label), want)
+    onehot = np.eye(10, dtype=np.float32)[label.astype(int)]
+    _check(gloss.SoftmaxCELoss(sparse_label=False), (pred, onehot), want)
+    # from_logits consumes pre-computed log-probabilities unchanged
+    _check(gloss.SoftmaxCrossEntropyLoss(from_logits=True), (logp, label), want)
+
+
+def test_kldiv_loss():
+    logits = RS.randn(4, 6).astype(np.float32)
+    label = RS.dirichlet(np.ones(6), size=4).astype(np.float32)
+    logp = logits - logits.max(-1, keepdims=True)
+    logp = (logp - np.log(np.exp(logp).sum(-1, keepdims=True)))
+    want = np.mean(label * (np.log(label + 1e-12) - logp), axis=1)
+    _check(gloss.KLDivLoss(from_logits=False), (logits, label), want,
+           rtol=1e-4)
+    _check(gloss.KLDivLoss(from_logits=True), (logp, label), want, rtol=1e-4)
+
+
+def test_huber_loss():
+    pred = np.array([[0.0, 0.0, 3.0]], np.float32)
+    label = np.array([[0.5, 2.0, 3.0]], np.float32)  # |d| = .5, 2, 0
+    want = np.array([np.mean([0.5 * 0.25, 2 - 0.5, 0.0])], np.float32)
+    _check(gloss.HuberLoss(rho=1), (pred, label), want)
+
+
+def test_hinge_losses():
+    pred = np.array([[0.3, -2.0], [1.5, 0.2]], np.float32)
+    label = np.array([[1, -1], [1, -1]], np.float32)
+    m = np.maximum(1 - pred * label, 0)
+    _check(gloss.HingeLoss(), (pred, label), m.mean(axis=1))
+    _check(gloss.SquaredHingeLoss(), (pred, label), (m ** 2).mean(axis=1))
+
+
+def test_logistic_loss_formats():
+    pred = RS.randn(5, 3).astype(np.float32)
+    signed = np.sign(RS.randn(5, 3)).astype(np.float32)
+    binary = (signed + 1) / 2
+    want = np.mean(np.maximum(pred, 0) - pred * binary +
+                   np.log1p(np.exp(-np.abs(pred))), axis=1)
+    _check(gloss.LogisticLoss(label_format="signed"), (pred, signed), want)
+    _check(gloss.LogisticLoss(label_format="binary"), (pred, binary), want)
+
+
+def test_triplet_loss():
+    a = RS.randn(4, 8).astype(np.float32)
+    p = RS.randn(4, 8).astype(np.float32)
+    n = RS.randn(4, 8).astype(np.float32)
+    want = np.maximum(
+        ((p - a) ** 2).sum(1) - ((n - a) ** 2).sum(1) + 1.0, 0)
+    _check(gloss.TripletLoss(margin=1), (a, p, n), want, rtol=1e-4, atol=1e-5)
+
+
+def test_cosine_embedding_loss():
+    x1 = RS.randn(4, 6).astype(np.float32)
+    x2 = RS.randn(4, 6).astype(np.float32)
+    label = np.array([1, -1, 1, -1], np.float32)
+    cos = (x1 * x2).sum(1) / (np.linalg.norm(x1, axis=1) *
+                              np.linalg.norm(x2, axis=1) + 1e-12)
+    want = np.where(label == 1, 1 - cos, np.maximum(cos - 0.0, 0))
+    _check(gloss.CosineEmbeddingLoss(), (x1, x2, label), want,
+           rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_layouts_agree():
+    # NTC/TNC and NT/TN must produce identical losses for transposed inputs
+    T, N, C = 6, 2, 5
+    pred = RS.randn(N, T, C).astype(np.float32)
+    label = np.array([[1, 2, 2], [3, 1, 0]], np.float32)
+    l_ntc = gloss.CTCLoss(layout="NTC")(nd.array(pred), nd.array(label))
+    l_tnc = gloss.CTCLoss(layout="TNC")(
+        nd.array(pred.transpose(1, 0, 2)), nd.array(label))
+    np.testing.assert_allclose(l_ntc.asnumpy(), l_tnc.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+    l_tn = gloss.CTCLoss(layout="NTC", label_layout="TN")(
+        nd.array(pred), nd.array(label.T))
+    np.testing.assert_allclose(l_ntc.asnumpy(), l_tn.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+    assert np.all(l_ntc.asnumpy() > 0)
+
+
+def test_sample_weight_zeroes_out_samples():
+    pred = RS.randn(4, 5).astype(np.float32)
+    label = RS.randn(4, 5).astype(np.float32)
+    sw = np.array([[1.0], [0.0], [2.0], [0.0]], np.float32)
+    out = gloss.L2Loss()(nd.array(pred), nd.array(label),
+                         nd.array(sw)).asnumpy()
+    base = np.mean(np.square(label - pred), axis=1) / 2
+    np.testing.assert_allclose(out, base * sw[:, 0], rtol=1e-5, atol=1e-6)
+    assert out[1] == 0 and out[3] == 0
+
+
+def test_loss_gradient_flows():
+    pred = nd.array(RS.randn(3, 4).astype(np.float32))
+    label = nd.array(RS.randn(3, 4).astype(np.float32))
+    pred.attach_grad()
+    with autograd.record():
+        l = gloss.L2Loss()(pred, label)
+    l.backward()
+    # dL/dpred = (pred - label) / n_cols  (weight/2 * 2 = 1, mean over axis 1)
+    np.testing.assert_allclose(
+        pred.grad.asnumpy(),
+        (pred.asnumpy() - label.asnumpy()) / 4, rtol=1e-5, atol=1e-6)
+
+
+def test_l2_converges_on_linear_regression():
+    w_true = np.array([[2.0, -3.4]], np.float32)
+    x = RS.randn(128, 2).astype(np.float32)
+    y = x @ w_true.T + 4.2
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gloss.L2Loss()
+    for _ in range(60):
+        with autograd.record():
+            l = loss_fn(net(nd.array(x)), nd.array(y))
+        l.backward()
+        trainer.step(x.shape[0])
+    assert l.mean().asscalar() < 1e-3
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w_true,
+                               rtol=0, atol=0.05)
+
+
+def test_repr_and_batch_axis():
+    l = gloss.L2Loss(weight=2.0, batch_axis=0)
+    assert "L2Loss" in repr(l)
+    # batch_axis=1: per-sample axis is the second one
+    pred = RS.randn(3, 4).astype(np.float32)
+    label = RS.randn(3, 4).astype(np.float32)
+    out = gloss.L2Loss(batch_axis=1)(nd.array(pred), nd.array(label)).asnumpy()
+    np.testing.assert_allclose(out, np.square(label - pred).mean(0) / 2,
+                               rtol=1e-5, atol=1e-6)
