@@ -252,7 +252,11 @@ def main():
     ishape = (3, 224, 224) if layout == "NCHW" else (224, 224, 3)
     net = gluon.model_zoo.vision.resnet50_v1(classes=1000, layout=layout)
     net.initialize()
-    net(nd.array(np.zeros((1,) + ishape, np.float32)))  # materialize shapes
+    # materialize shapes on the host CPU backend: the eager pass is ~270
+    # tiny per-op dispatches that would otherwise each ride the tunnel
+    import mxnet_tpu as _mx
+    with _mx.cpu():
+        net(nd.array(np.zeros((1,) + ishape, np.float32)))
     apply_fn, params = block_apply_fn(net, is_train=True)
     momenta = {k: jnp.zeros_like(v) for k, v in params.items()}
 

@@ -27,7 +27,14 @@ class AttrScope:
     def get(self, attr):
         out = dict(self._attr)
         if attr:
-            out.update(attr)
+            for k, v in attr.items():
+                if not isinstance(v, str):
+                    # same contract as __init__: per-call attr= dicts must
+                    # not smuggle non-string values into attr_dict/tojson
+                    raise ValueError(
+                        f"attr value for {k!r} must be a string, "
+                        f"got {type(v).__name__}")
+                out[k] = v
         return out
 
     def __enter__(self):

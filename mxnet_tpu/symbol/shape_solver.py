@@ -98,31 +98,77 @@ _LABEL_OPS = ("SoftmaxOutput", "Softmax", "SVMOutput",
               "LogisticRegressionOutput")
 
 
-def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]]):
-    """Returns (arg_shapes, out_shapes, aux_shapes) in listing order."""
+def _invert_data_shape(op_name: str, attrs: dict, partial: Tuple[int, ...],
+                       param_shapes: Dict[str, Tuple[int, ...]]):
+    """Fill 0 (= unknown, reference 1.x convention) dims of a data input
+    from already-known parameter shapes — the contained slice of NNVM's
+    bidirectional InferShape (reference
+    src/executor/infer_graph_attr_pass.cc) that covers the common case:
+    a known weight pins the data's feature/channel dimension."""
+    out = list(partial)
+    w = param_shapes.get("weight")
+    if w is None:
+        return tuple(out)
+    if op_name == "FullyConnected":
+        if attr_bool(attrs.get("flatten"), default=True):
+            if len(out) == 2 and out[1] == 0:
+                out[1] = w[1]
+        elif out and out[-1] == 0:
+            out[-1] = w[1]
+    elif op_name == "Convolution":
+        from ..ops.nn import is_channels_last
+
+        ng = int(attrs.get("num_group", 1))
+        if is_channels_last(attrs.get("layout")):
+            if out and out[-1] == 0:
+                out[-1] = w[-1] * ng
+        elif len(out) > 1 and out[1] == 0:
+            out[1] = w[1] * ng
+    return tuple(out)
+
+
+def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]],
+                 partial: bool = False):
+    """Returns (arg_shapes, out_shapes, aux_shapes) in listing order.
+
+    A dim of 0 in a caller-supplied shape means UNKNOWN (reference 1.x
+    convention) — the solver back-fills it from known parameter shapes
+    where an inverse rule exists.  With ``partial=True`` unknown inputs
+    skip their consuming ops instead of raising, and unresolved entries
+    come back as None (reference: infer_shape_partial)."""
     from ..ndarray.ndarray import _op_accepts_training
 
     entries = symbol._entries
     shapes: Dict[int, Tuple] = {}  # id(node) -> tuple of output shapes
     var_shape: Dict[str, Tuple[int, ...]] = dict(known)
 
+    def _complete(sh) -> bool:
+        return sh is not None and all(d > 0 for d in sh)
+
     for node in topo_order(entries):
         if node.kind == "var":
-            if node.name in var_shape:
+            if _complete(var_shape.get(node.name)):
                 shapes[id(node)] = (tuple(var_shape[node.name]),)
             elif node.attr_dict.get("__shape__"):
                 sh = tuple(eval(node.attr_dict["__shape__"]))  # noqa: S307 — own format
-                var_shape[node.name] = sh
-                shapes[id(node)] = (sh,)
-            # else: deferred — a consuming op's param rule will fill it
+                if node.name not in var_shape:
+                    var_shape[node.name] = sh
+                # a declared shape with 0-dims stays deferred so backward
+                # inference can fill it, same as caller-supplied partials
+                if _complete(var_shape[node.name]):
+                    shapes[id(node)] = (tuple(var_shape[node.name]),)
+            # else: deferred — a consuming op's rule will fill it (param
+            # rule forward, or _invert_data_shape backward from a weight)
             continue
         op = node.op
         params, aux = _active_extra_inputs(op.name, node.attrs)
         extra = list(params) + list(aux)
         n_data = len(node.inputs) - len(extra)
         in_shapes: List[Tuple[int, ...]] = []
-        # data inputs must be known — except a loss head's label variable,
-        # which is inferred from the data shape like the reference does
+        unknown_input = False
+        # data inputs must be known — except a loss head's label variable
+        # (inferred from the data shape like the reference) and a var with
+        # 0-dims a known weight can pin (backward inference)
         for i, e in enumerate(node.inputs[:n_data]):
             if id(e.node) not in shapes:
                 if (i == n_data - 1 and op.name in _LABEL_OPS
@@ -132,9 +178,27 @@ def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]]):
                     shapes[id(e.node)] = (sh,)
                     in_shapes.append(sh)
                     continue
+                if e.node.kind == "var" and e.node.name in var_shape:
+                    pshapes = {
+                        slot: var_shape[pe.node.name]
+                        for slot, pe in zip(extra, node.inputs[n_data:])
+                        if pe.node.kind == "var"
+                        and _complete(var_shape.get(pe.node.name))}
+                    cand = _invert_data_shape(op.name, node.attrs,
+                                              var_shape[e.node.name], pshapes)
+                    if _complete(cand):
+                        var_shape[e.node.name] = cand
+                        shapes[id(e.node)] = (cand,)
+                        in_shapes.append(cand)
+                        continue
+                if partial:
+                    unknown_input = True
+                    break
                 raise MXNetError(
                     f"infer_shape: input {e.node.name!r} of op {node.name!r} has unknown shape")
             in_shapes.append(shapes[id(e.node)][e.index])
+        if unknown_input:
+            continue  # partial mode: this op's outputs stay unknown
         # solve param/aux shapes
         for slot, e in zip(extra, node.inputs[n_data:]):
             if id(e.node) in shapes:
@@ -163,10 +227,30 @@ def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]]):
     for n in input_nodes(entries):
         if n.attr_dict.get("__is_aux__"):
             continue
-        if n.name not in var_shape:
+        if not _complete(var_shape.get(n.name)):
+            if partial:
+                arg_shapes.append(None)
+                continue
             raise MXNetError(f"infer_shape: could not determine shape of {n.name!r}")
         arg_shapes.append(tuple(var_shape[n.name]))
-    aux_shapes = [tuple(var_shape[n.name]) for n in input_nodes(entries)
-                  if n.attr_dict.get("__is_aux__")]
-    out_shapes = [shapes[id(e.node)][e.index] for e in entries]
+    aux_shapes = []
+    for n in input_nodes(entries):
+        if not n.attr_dict.get("__is_aux__"):
+            continue
+        if not _complete(var_shape.get(n.name)):
+            if partial:
+                aux_shapes.append(None)
+                continue
+            raise MXNetError(f"infer_shape: could not determine shape of {n.name!r}")
+        aux_shapes.append(tuple(var_shape[n.name]))
+    out_shapes = []
+    for e in entries:
+        if id(e.node) in shapes:
+            out_shapes.append(shapes[id(e.node)][e.index])
+        elif partial:
+            out_shapes.append(None)
+        else:
+            raise MXNetError(
+                f"infer_shape: could not determine shape of output "
+                f"{e.node.name!r}")
     return arg_shapes, out_shapes, aux_shapes

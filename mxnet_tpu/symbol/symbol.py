@@ -268,19 +268,25 @@ class Symbol:
         """
         from .shape_solver import solve_shapes
 
+        return solve_shapes(self, self._known_shapes(args, kwargs))
+
+    def _known_shapes(self, args, kwargs) -> Dict[str, tuple]:
         known: Dict[str, tuple] = {}
         if args:
             for name, sh in zip(self.list_arguments(), args):
                 if sh is not None:
                     known[name] = tuple(sh)
         known.update({k: tuple(v) for k, v in kwargs.items()})
-        return solve_shapes(self, known)
+        return known
 
     def infer_shape_partial(self, *args, **kwargs):
-        try:
-            return self.infer_shape(*args, **kwargs)
-        except Exception:
-            return None, None, None
+        """Like infer_shape but never raises on missing information: ops
+        whose inputs are unknown are skipped and the corresponding entries
+        come back as None (reference: symbol.py infer_shape_partial)."""
+        from .shape_solver import solve_shapes
+
+        return solve_shapes(self, self._known_shapes(args, kwargs),
+                            partial=True)
 
     def infer_type(self, *args, **kwargs):
         """Propagate dtypes through the graph (reference: InferType pass).
@@ -557,14 +563,17 @@ _DECLARED_DATA_INPUTS = {
 }
 
 
-def _apply_op(op: Op, inputs: List[Symbol], attrs: dict, name: Optional[str]) -> Symbol:
+def _apply_op(op: Op, inputs: List[Symbol], attrs: dict, name: Optional[str],
+              attr: Optional[dict] = None) -> Symbol:
     node_name = _name_mod.current().get(name, op.name.lstrip("_"))
     entries = []
     for s in inputs:
         if len(s._entries) != 1:
             raise MXNetError(f"{op.name}: cannot take multi-output symbol as one input")
         entries.append(s._entries[0])
-    node = Node("op", node_name, op, attrs, entries, attribute.current().get(None))
+    # per-call attr= overrides the ambient AttrScope (reference: every op
+    # wrapper accepts attr, python/mxnet/symbol/register.py generated code)
+    node = Node("op", node_name, op, attrs, entries, attribute.current().get(attr))
     n_out = op.n_outputs(attrs)
     return Symbol([SymbolEntry(node, i) for i in range(n_out)])
 
@@ -592,7 +601,7 @@ def _make_sym_wrapper(opname):
         if declared is None and not params and not aux:
             # generic op: positional + any keyword symbols in given order
             inputs = pos_inputs + list(sym_kwargs.values())
-            return _apply_op(op, inputs, kwargs, node_name)
+            return _apply_op(op, inputs, kwargs, node_name, attr)
         # named-slot op: fill declared data slots, then params, then aux;
         # missing learnable/aux slots become auto-created variables
         # (reference: NNVM compose auto-var creation).
@@ -620,7 +629,7 @@ def _make_sym_wrapper(opname):
                 # slot — learnable params AND data slots like SoftmaxOutput's
                 # label (which becomes `<name>_label`, what Module binds to)
                 inputs.append(Variable(f"{node_name}_{slot}"))
-        return _apply_op(op, inputs, kwargs, node_name)
+        return _apply_op(op, inputs, kwargs, node_name, attr)
 
     wrapper.__name__ = opname
     wrapper.__doc__ = op.doc

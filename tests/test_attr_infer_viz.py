@@ -1,0 +1,199 @@
+"""Symbol attributes, shape/type inference, and visualization tiers
+(reference: tests/python/unittest/{test_attr,test_infer_shape,test_viz}.py).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+# ------------------------------------------------------------------ attrs
+
+
+def test_attr_basic_get_set():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data, name="conv", kernel=(1, 1), num_filter=1,
+                            attr={"__force_mirroring__": "True"})
+    assert data.attr("mood") == "angry"
+    assert op.attr("__force_mirroring__") == "True"
+    assert op.attr("nonexistent") is None
+
+
+def test_attr_scope_propagates():
+    with mx.AttrScope(ctx_group="stage1", lr_mult="0.5"):
+        a = mx.sym.Variable("a")
+        b = mx.sym.Variable("b")
+        fc = mx.sym.FullyConnected(a, num_hidden=4, name="fc", no_bias=True)
+    c = mx.sym.Variable("c")
+    assert a.attr("ctx_group") == "stage1"
+    assert b.attr("lr_mult") == "0.5"
+    assert fc.attr("ctx_group") == "stage1"
+    assert c.attr("ctx_group") is None
+
+
+def test_attr_scope_nesting_inner_wins():
+    with mx.AttrScope(group="outer", keep="yes"):
+        with mx.AttrScope(group="inner"):
+            v = mx.sym.Variable("v")
+        w = mx.sym.Variable("w")
+    assert v.attr("group") == "inner"
+    assert v.attr("keep") == "yes"  # outer attrs still visible inside
+    assert w.attr("group") == "outer"
+
+
+def test_attr_dict_covers_graph():
+    with mx.AttrScope(tag="t"):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=2, name="fc",
+                                   no_bias=True)
+    d = fc.attr_dict()
+    assert d["data"]["tag"] == "t"
+    assert d["fc"]["tag"] == "t"
+    assert fc.list_attr().get("tag") == "t"
+
+
+def test_attrs_survive_json_roundtrip():
+    with mx.AttrScope(ctx_group="g0"):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc",
+                                   no_bias=True)
+    js = fc.tojson()
+    back = mx.sym.load_json(js)
+    assert back.attr_dict()["fc"]["ctx_group"] == "g0"
+
+
+# ------------------------------------------------------------ infer_shape
+
+
+def test_infer_shape_forward_mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10, name="fc2")
+    arg_shapes, out_shapes, aux_shapes = fc2.infer_shape(data=(32, 100))
+    args = dict(zip(fc2.list_arguments(), arg_shapes))
+    assert args["fc1_weight"] == (64, 100)
+    assert args["fc1_bias"] == (64,)
+    assert args["fc2_weight"] == (10, 64)
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_backward_from_weight():
+    # the solver must propagate BACKWARD: knowing the weight shape pins the
+    # data's feature dim (reference test_infer_shape.py mlp2 pattern)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc", no_bias=True)
+    arg_shapes, out_shapes, _ = fc.infer_shape(fc_weight=(8, 20),
+                                               data=(4, 0))
+    args = dict(zip(fc.list_arguments(), arg_shapes))
+    assert args["data"] == (4, 20)
+    assert out_shapes == [(4, 8)]
+
+
+def test_infer_shape_partial_tolerates_unknown():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    # nothing known: no exception, unknown entries come back as None
+    assert len(arg_shapes) == len(fc.list_arguments())
+    assert all(s is None for s in arg_shapes)
+    assert out_shapes == [None]
+
+
+def test_infer_shape_partial_mixed_known_unknown():
+    # one branch fully known, the other not: partial returns what it can
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    fa = mx.sym.FullyConnected(a, num_hidden=4, name="fa", no_bias=True)
+    fb = mx.sym.FullyConnected(b, num_hidden=4, name="fb", no_bias=True)
+    g = mx.sym.Group([fa, fb])
+    arg_shapes, out_shapes, _ = g.infer_shape_partial(a=(2, 6))
+    args = dict(zip(g.list_arguments(), arg_shapes))
+    assert args["a"] == (2, 6) and args["fa_weight"] == (4, 6)
+    assert args["b"] is None and args["fb_weight"] is None
+    assert out_shapes[0] == (2, 4) and out_shapes[1] is None
+
+
+def test_infer_shape_conv_chain():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16, pad=(1, 1),
+                            name="c1")
+    p1 = mx.sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    c2 = mx.sym.Convolution(p1, kernel=(3, 3), num_filter=32, name="c2")
+    _, out_shapes, _ = c2.infer_shape(data=(2, 3, 32, 32))
+    assert out_shapes == [(2, 32, 14, 14)]
+
+
+def test_infer_shape_mismatch_raises():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc", no_bias=True)
+    with pytest.raises(Exception):
+        fc.infer_shape(data=(4, 10), fc_weight=(8, 20))
+
+
+def test_infer_type():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_types, out_types, _ = fc.infer_type(data=np.float32)
+    assert all(t == np.float32 for t in arg_types)
+    assert out_types == [np.float32]
+
+
+# ------------------------------------------------------------------- viz
+
+
+def _lenet_sym():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(5, 5), num_filter=8, name="conv1")
+    a = mx.sym.Activation(c, act_type="tanh")
+    p = mx.sym.Pooling(a, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = mx.sym.FullyConnected(mx.sym.Flatten(p), num_hidden=10, name="fc1")
+    return mx.sym.SoftmaxOutput(f, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def test_print_summary_layers_and_params(capsys):
+    sym = _lenet_sym()
+    mx.viz.print_summary(sym, shape={"data": (1, 1, 28, 28)})
+    out = capsys.readouterr().out
+    assert "conv1" in out and "fc1" in out
+    # total parameter count printed and correct:
+    # conv1: 8*1*5*5+8 = 208; fc1: 10*(8*12*12)+10 = 11530
+    assert "11,738" in out.replace(" ", "") or "11738" in out
+
+
+def test_plot_network_graph_structure():
+    sym = _lenet_sym()
+    g = mx.viz.plot_network(sym, shape={"data": (1, 1, 28, 28)},
+                            save_format="dot")
+    src = getattr(g, "source", None) or str(g)
+    assert "conv1" in src and "fc1" in src and "->" in src
+
+
+def test_attr_nonstring_value_raises():
+    data = mx.sym.Variable("data")
+    with pytest.raises(ValueError):
+        mx.sym.FullyConnected(data, num_hidden=2, name="f",
+                              attr={"lr_mult": 0.5})
+
+
+def test_infer_shape_backfill_from_declared_variable_shape():
+    # shape declared on the Variable itself (not passed to infer_shape)
+    # with a 0 dim still gets back-filled from the known weight
+    data = mx.sym.Variable("data", shape=(4, 0))
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc", no_bias=True)
+    arg_shapes, out_shapes, _ = fc.infer_shape(fc_weight=(8, 20))
+    args = dict(zip(fc.list_arguments(), arg_shapes))
+    assert args["data"] == (4, 20)
+    assert out_shapes == [(4, 8)]
+
+
+def test_infer_shape_unresolvable_var_output():
+    x = mx.sym.Variable("x")
+    with pytest.raises(Exception):
+        x.infer_shape(x=(0, 3))  # 0 = unknown, nothing can pin it
+    arg_shapes, out_shapes, _ = x.infer_shape_partial(x=(0, 3))
+    assert arg_shapes == [None] and out_shapes == [None]

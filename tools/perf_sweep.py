@@ -29,11 +29,17 @@ def build_step(layout, depth=50, side=224):
     from mxnet_tpu import gluon, nd
     from mxnet_tpu.parallel.data_parallel import block_apply_fn
 
+    import mxnet_tpu as mx
+
     ishape = (3, side, side) if layout == "NCHW" else (side, side, 3)
     net = gluon.model_zoo.vision.get_resnet(1, depth, classes=1000,
                                             layout=layout)
     net.initialize()
-    net(nd.array(np.zeros((1,) + ishape, np.float32)))
+    # shape materialization runs eagerly op-by-op; pin it to the host CPU
+    # backend so ~270 tiny dispatches never touch the tunnel (the timed jit
+    # program below transfers the params to the chip on first call anyway)
+    with mx.cpu():
+        net(nd.array(np.zeros((1,) + ishape, np.float32)))
     apply_fn, params = block_apply_fn(net, is_train=True)
 
     def step(p, m, x, y, rng):
@@ -122,10 +128,12 @@ def main():
     # bn=1: MXTPU_BN_PALLAS fused stats kernel (channels-minor only, hence
     # the NHWC-only rows).  Each measure() builds a fresh trace, so the
     # trace-time env read is honored per config within this process.
-    configs = [("NCHW", 8, 0), ("NHWC", 8, 0), ("NHWC", 8, 1)] \
+    # NHWC first: if the window dies mid-sweep, the A/B hypothesis answer
+    # (is channels-last faster?) is the config we can least afford to lose
+    configs = [("NHWC", 8, 0), ("NHWC", 8, 1), ("NCHW", 8, 0)] \
         if args.quick else \
-        [("NCHW", 1, 0), ("NCHW", 8, 0), ("NHWC", 1, 0), ("NHWC", 8, 0),
-         ("NHWC", 8, 1)]
+        [("NHWC", 8, 0), ("NHWC", 8, 1), ("NCHW", 8, 0), ("NCHW", 1, 0),
+         ("NHWC", 1, 0)]
     if args.smoke:
         configs = [("NCHW", 2, 0), ("NHWC", 2, 0), ("NHWC", 2, 1)]
     for layout, K, bn in configs:
@@ -137,10 +145,12 @@ def main():
             r = {"layout": layout, "K": K, "bn_pallas": bn,
                  "error": f"{type(e).__name__}: {e}"[:200]}
         results.append(r)
-        print(json.dumps(r))
+        print(json.dumps(r), flush=True)
+        # write after EVERY config: a timeout mid-sweep must not lose the
+        # configs that did complete (cost round 5 its first window)
+        with open("/tmp/perf_sweep.json", "w") as f:
+            json.dump(results, f, indent=1)
     os.environ.pop("MXTPU_BN_PALLAS", None)
-    with open("/tmp/perf_sweep.json", "w") as f:
-        json.dump(results, f, indent=1)
     ok = [r for r in results if "img_per_sec" in r]
     if ok:
         best = max(ok, key=lambda r: r["img_per_sec"])
