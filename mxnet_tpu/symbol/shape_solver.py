@@ -107,7 +107,9 @@ def _invert_data_shape(op_name: str, attrs: dict, partial: Tuple[int, ...],
     a known weight pins the data's feature/channel dimension."""
     out = list(partial)
     w = param_shapes.get("weight")
-    if w is None:
+    if w is None or len(w) < 2:
+        # a malformed/rank-deficient weight never back-fills; the forward
+        # rule or eval_shape will report it with a proper MXNetError
         return tuple(out)
     if op_name == "FullyConnected":
         if attr_bool(attrs.get("flatten"), default=True):
@@ -205,6 +207,13 @@ def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]],
                 in_shapes.append(shapes[id(e.node)][e.index])
                 continue
             sh = _param_shape_rule(op.name, slot, node.attrs, in_shapes)
+            given = var_shape.get(e.node.name)
+            if given is not None and (
+                    len(given) != len(sh)
+                    or any(g not in (0, s) for g, s in zip(given, sh))):
+                raise MXNetError(
+                    f"infer_shape: {e.node.name!r} given as {tuple(given)} "
+                    f"but op {node.name!r} requires {sh}")
             var_shape[e.node.name] = sh
             shapes[id(e.node)] = (sh,)
             in_shapes.append(sh)

@@ -261,10 +261,11 @@ class Symbol:
     def infer_shape(self, *args, **kwargs):
         """Returns (arg_shapes, out_shapes, aux_shapes) like the reference.
 
-        Shapes for unlisted params are inferred by abstract evaluation —
-        but unlike NNVM's bidirectional inference, parameter shapes must be
-        derivable forward; callers (Module/simple_bind) pass data shapes and
-        parameter shapes are *solved* via the helper in ``shape_solver``.
+        Output shapes come from abstract evaluation; parameter shapes are
+        solved forward from data shapes via per-op rules, and a dim given
+        as 0 (= unknown, reference 1.x convention) is back-filled from
+        known weight shapes where an inverse rule exists — the common slice
+        of NNVM's bidirectional pass (see ``shape_solver``).
         """
         from .shape_solver import solve_shapes
 

@@ -197,3 +197,16 @@ def test_infer_shape_unresolvable_var_output():
         x.infer_shape(x=(0, 3))  # 0 = unknown, nothing can pin it
     arg_shapes, out_shapes, _ = x.infer_shape_partial(x=(0, 3))
     assert arg_shapes == [None] and out_shapes == [None]
+
+
+def test_infer_shape_partial_param_conflict_raises():
+    # partial info tolerates MISSING data, not CONTRADICTIONS: a given
+    # weight dim that disagrees with the op rule must raise, and a
+    # rank-deficient weight must not crash the backward fill
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc", no_bias=True)
+    with pytest.raises(Exception):
+        fc.infer_shape(data=(4, 10), fc_weight=(9, 0))
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial(data=(4, 0),
+                                                       fc_weight=(8,))
+    assert out_shapes == [None]

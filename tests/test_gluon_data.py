@@ -1,0 +1,197 @@
+"""Dedicated gluon.data tier (reference: tests/python/unittest/
+{test_gluon_data,test_gluon_data_vision}.py): samplers, datasets,
+DataLoader batching policies, and vision transforms against NumPy oracles.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler, DataLoader,
+                                  RandomSampler, SequentialSampler,
+                                  SimpleDataset)
+from mxnet_tpu.gluon.data.vision import transforms
+
+RS = np.random.RandomState(11)
+
+
+# ---------------------------------------------------------------- samplers
+
+
+def test_sequential_sampler():
+    assert list(SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    assert len(SequentialSampler(5)) == 5
+
+
+def test_random_sampler_is_permutation():
+    s = RandomSampler(10)
+    got = list(s)
+    assert sorted(got) == list(range(10))
+    assert len(s) == 10
+
+
+def test_batch_sampler_policies():
+    base = SequentialSampler(7)
+    keep = list(BatchSampler(base, 3, "keep"))
+    assert keep == [[0, 1, 2], [3, 4, 5], [6]]
+    discard = list(BatchSampler(SequentialSampler(7), 3, "discard"))
+    assert discard == [[0, 1, 2], [3, 4, 5]]
+    rollover = BatchSampler(SequentialSampler(7), 3, "rollover")
+    first = list(rollover)
+    assert first == [[0, 1, 2], [3, 4, 5]]
+    # the leftover [6] rolls into the next epoch
+    second = list(rollover)
+    assert second[0] == [6, 0, 1]
+
+
+# ---------------------------------------------------------------- datasets
+
+
+def test_array_dataset_and_transform_lazy():
+    X = RS.rand(10, 4).astype(np.float32)
+    Y = np.arange(10, dtype=np.float32)
+    ds = ArrayDataset(X, Y)
+    assert len(ds) == 10
+    x0, y0 = ds[3]
+    np.testing.assert_allclose(np.asarray(x0), X[3])
+    assert float(y0) == 3.0
+
+    calls = []
+
+    def tf(x, y):
+        calls.append(1)
+        return x, y * 2
+
+    lazy = ds.transform(tf, lazy=True)
+    assert not calls  # lazy: nothing evaluated yet
+    _, y = lazy[4]
+    assert float(y) == 8.0 and len(calls) == 1
+
+    first = ds.transform_first(lambda x: x + 1)
+    x, y = first[2]
+    np.testing.assert_allclose(np.asarray(x), X[2] + 1, rtol=1e-6)
+    assert float(y) == 2.0
+
+
+def test_simple_dataset():
+    ds = SimpleDataset([5, 6, 7])
+    assert len(ds) == 3 and ds[1] == 6
+
+
+def test_record_file_dataset(tmp_path):
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "r.rec")
+    w = recordio.MXIndexedRecordIO(path[:-4] + ".idx", path, "w")
+    for i in range(5):
+        w.write_idx(i, f"payload-{i}".encode())
+    w.close()
+    ds = gluon.data.RecordFileDataset(path)
+    assert len(ds) == 5
+    assert ds[2] == b"payload-2"
+    assert ds[4] == b"payload-4"
+
+
+# -------------------------------------------------------------- dataloader
+
+
+def test_dataloader_last_batch_modes():
+    X = RS.rand(10, 3).astype(np.float32)
+    ds = ArrayDataset(X, np.arange(10, dtype=np.float32))
+    sizes = [b[0].shape[0] for b in DataLoader(ds, batch_size=4)]
+    assert sizes == [4, 4, 2]
+    sizes = [b[0].shape[0]
+             for b in DataLoader(ds, batch_size=4, last_batch="discard")]
+    assert sizes == [4, 4]
+    assert len(DataLoader(ds, batch_size=4, last_batch="discard")) == 2
+
+
+def test_dataloader_shuffle_covers_all():
+    X = np.arange(20, dtype=np.float32).reshape(20, 1)
+    ds = ArrayDataset(X, X[:, 0])
+    seen = np.concatenate([np.asarray(b[1])
+                           for b in DataLoader(ds, batch_size=6,
+                                               shuffle=True)])
+    assert sorted(seen.tolist()) == list(range(20))
+
+
+def test_dataloader_explicit_sampler_conflicts():
+    ds = SimpleDataset(list(range(6)))
+    with pytest.raises(ValueError):
+        DataLoader(ds, batch_size=2, shuffle=True,
+                   sampler=SequentialSampler(6))
+    with pytest.raises(ValueError):
+        DataLoader(ds, batch_sampler=BatchSampler(SequentialSampler(6), 2),
+                   batch_size=2)
+
+
+# -------------------------------------------------------------- transforms
+
+
+def test_to_tensor_scales_and_transposes():
+    img = RS.randint(0, 255, (5, 7, 3)).astype(np.uint8)
+    out = transforms.ToTensor()(nd.array(img)).asnumpy()
+    assert out.shape == (3, 5, 7)
+    np.testing.assert_allclose(out, img.transpose(2, 0, 1) / 255.0,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_normalize_oracle():
+    x = RS.rand(3, 4, 4).astype(np.float32)
+    mean, std = (0.5, 0.4, 0.3), (0.2, 0.25, 0.5)
+    out = transforms.Normalize(mean, std)(nd.array(x)).asnumpy()
+    want = (x - np.asarray(mean)[:, None, None]) / \
+        np.asarray(std)[:, None, None]
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_resize_and_center_crop_shapes():
+    img = RS.randint(0, 255, (10, 16, 3)).astype(np.uint8)
+    r = transforms.Resize((8, 6))(nd.array(img)).asnumpy()  # (w, h)
+    assert r.shape == (6, 8, 3)
+    c = transforms.CenterCrop((4, 4))(nd.array(img)).asnumpy()
+    assert c.shape == (4, 4, 3)
+    np.testing.assert_allclose(c, img[3:7, 6:10], rtol=1e-5, atol=1)
+
+
+def test_cast():
+    # float64 is gated off by default under XLA (jax_enable_x64); int32 and
+    # float16 casts are the meaningful portable checks
+    x = nd.array(RS.rand(2, 2).astype(np.float32) * 10)
+    assert transforms.Cast("int32")(x).dtype == np.int32
+    assert transforms.Cast("float16")(x).dtype == np.float16
+
+
+def test_random_flips_preserve_content():
+    img = RS.rand(6, 8, 3).astype(np.float32)
+    for t, axis in [(transforms.RandomFlipLeftRight(), 1),
+                    (transforms.RandomFlipTopBottom(), 0)]:
+        out = t(nd.array(img)).asnumpy()
+        same = np.allclose(out, img)
+        flipped = np.allclose(out, np.flip(img, axis=axis))
+        assert same or flipped
+
+
+def test_compose_pipeline_end_to_end():
+    tf = transforms.Compose([
+        transforms.Resize(8),
+        transforms.CenterCrop(6),
+        transforms.ToTensor(),
+        transforms.Normalize(0.5, 0.5),
+    ])
+    img = RS.randint(0, 255, (12, 12, 3)).astype(np.uint8)
+    out = tf(nd.array(img)).asnumpy()
+    assert out.shape == (3, 6, 6)
+    assert out.min() >= -1.001 and out.max() <= 1.001
+
+
+def test_transform_first_with_dataloader_trains_shapes():
+    ds = gluon.data.vision.MNIST(train=False)
+    tf = transforms.Compose([transforms.ToTensor(),
+                             transforms.Normalize(0.13, 0.31)])
+    dl = DataLoader(ds.transform_first(tf), batch_size=16)
+    x, y = next(iter(dl))
+    assert tuple(x.shape) == (16, 1, 28, 28)
+    assert tuple(y.shape) == (16,)
