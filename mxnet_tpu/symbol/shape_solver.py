@@ -201,13 +201,22 @@ def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]],
             in_shapes.append(shapes[id(e.node)][e.index])
         if unknown_input:
             continue  # partial mode: this op's outputs stay unknown
-        # solve param/aux shapes
+        # solve param/aux shapes; caller-GIVEN shapes (complete or partial)
+        # are validated against the op rule — a typo'd weight must raise,
+        # not silently build a wrong-sized model
         for slot, e in zip(extra, node.inputs[n_data:]):
-            if id(e.node) in shapes:
+            given = var_shape.get(e.node.name) \
+                if e.node.kind == "var" else None
+            if id(e.node) in shapes and given is None:
                 in_shapes.append(shapes[id(e.node)][e.index])
                 continue
-            sh = _param_shape_rule(op.name, slot, node.attrs, in_shapes)
-            given = var_shape.get(e.node.name)
+            try:
+                sh = _param_shape_rule(op.name, slot, node.attrs, in_shapes)
+            except MXNetError:
+                if id(e.node) in shapes:  # no rule, but shape known: accept
+                    in_shapes.append(shapes[id(e.node)][e.index])
+                    continue
+                raise
             if given is not None and (
                     len(given) != len(sh)
                     or any(g not in (0, s) for g, s in zip(given, sh))):

@@ -22,6 +22,9 @@ MANIFEST = {
     "bi-lstm-sort": [("bi-lstm-sort/sort_lstm.py", [])],
     "capsnet": [("capsnet/capsnet_mnist.py", [])],
     "captcha": [("captcha/captcha_ocr.py", [])],
+    "cnn_chinese_text_classification": [
+        ("cnn_chinese_text_classification/cnn_chinese.py",
+         ["--num-epochs", "3"])],
     "cnn_text_classification": [("cnn_text_classification/text_cnn.py", [])],
     "cnn_visualization": [("cnn_visualization/gradcam.py", [])],
     "ctc": [("ctc/lstm_ocr_ctc.py", [])],

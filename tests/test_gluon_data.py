@@ -2,12 +2,10 @@
 {test_gluon_data,test_gluon_data_vision}.py): samplers, datasets,
 DataLoader batching policies, and vision transforms against NumPy oracles.
 """
-import os
 
 import numpy as np
 import pytest
 
-import mxnet_tpu as mx
 from mxnet_tpu import gluon, nd
 from mxnet_tpu.gluon.data import (ArrayDataset, BatchSampler, DataLoader,
                                   RandomSampler, SequentialSampler,
