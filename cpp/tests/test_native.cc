@@ -1,0 +1,185 @@
+// Native-layer unit tests in C++ (reference analogue: tests/cpp/ gtest
+// suite — engine/threaded_engine_test.cc, storage/storage_test.cc; this
+// image ships no gtest, so plain CHECK asserts + exit codes).
+//
+// Covers the invariants the Python ctypes tier can't probe from inside one
+// interpreter thread: multi-threaded pushers hammering one write-var,
+// read-before-write ordering, exception poisoning, pool reuse accounting,
+// and a RecordIO round-trip through the C ABI.
+#include "../include/mxtpu.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,       \
+                   __LINE__, #cond);                                      \
+      std::exit(1);                                                       \
+    }                                                                     \
+  } while (0)
+
+namespace {
+
+int g_counter = 0;  // deliberately NOT atomic: exclusivity is under test
+
+int bump_counter(void *) {
+  // non-atomic RMW: only correct if the engine serializes writers
+  int v = g_counter;
+  std::this_thread::yield();
+  g_counter = v + 1;
+  return 0;
+}
+
+std::atomic<int> g_reads{0};
+int g_read_count_at_write = -1;
+
+int slow_read(void *) {
+  g_reads.fetch_add(1);
+  std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  return 0;
+}
+
+int capture_reads(void *) {
+  g_read_count_at_write = g_reads.load();
+  return 0;
+}
+
+int fail_op(void *) { return 7; }
+
+void test_write_exclusive_under_contention() {
+  void *eng = nullptr;
+  CHECK(mxtpu_engine_create(4, &eng) == 0);
+  uint64_t var = mxtpu_engine_new_var(eng);
+  g_counter = 0;
+  std::vector<std::thread> pushers;
+  for (int t = 0; t < 4; ++t) {
+    pushers.emplace_back([eng, var] {
+      for (int i = 0; i < 100; ++i) {
+        CHECK(mxtpu_engine_push(eng, bump_counter, nullptr, nullptr, 0,
+                                &var, 1, 0, 0) == 0);
+      }
+    });
+  }
+  for (auto &t : pushers) t.join();
+  uint64_t failed = 1;
+  CHECK(mxtpu_engine_wait_all(eng, &failed) == 0);
+  CHECK(failed == 0);
+  CHECK(g_counter == 400);  // any lost update = writers overlapped
+  mxtpu_engine_delete_var(eng, var);
+  mxtpu_engine_destroy(eng);
+}
+
+void test_reads_complete_before_writer() {
+  void *eng = nullptr;
+  CHECK(mxtpu_engine_create(4, &eng) == 0);
+  uint64_t var = mxtpu_engine_new_var(eng);
+  g_reads = 0;
+  g_read_count_at_write = -1;
+  for (int i = 0; i < 50; ++i) {
+    CHECK(mxtpu_engine_push(eng, slow_read, nullptr, &var, 1, nullptr, 0,
+                            0, 0) == 0);
+  }
+  CHECK(mxtpu_engine_push(eng, capture_reads, nullptr, nullptr, 0, &var, 1,
+                          0, 0) == 0);
+  uint64_t failed = 1;
+  CHECK(mxtpu_engine_wait_all(eng, &failed) == 0);
+  CHECK(failed == 0);
+  CHECK(g_read_count_at_write == 50);  // writer saw every prior read done
+  mxtpu_engine_delete_var(eng, var);
+  mxtpu_engine_destroy(eng);
+}
+
+void test_poisoning_reports_failed_ctx() {
+  void *eng = nullptr;
+  CHECK(mxtpu_engine_create(2, &eng) == 0);
+  uint64_t var = mxtpu_engine_new_var(eng);
+  int marker = 0;
+  CHECK(mxtpu_engine_push(eng, fail_op, &marker, nullptr, 0, &var, 1, 0,
+                          0) == 0);
+  // a dependent op on the poisoned var must not erase the failure
+  CHECK(mxtpu_engine_push(eng, bump_counter, nullptr, nullptr, 0, &var, 1,
+                          0, 0) == 0);
+  uint64_t failed = 0;
+  CHECK(mxtpu_engine_wait_var(eng, var, &failed) == 1);
+  CHECK(failed == reinterpret_cast<uint64_t>(&marker));
+  mxtpu_engine_delete_var(eng, var);
+  mxtpu_engine_destroy(eng);
+}
+
+void test_sync_push_runs_inline() {
+  void *eng = nullptr;
+  CHECK(mxtpu_engine_create(2, &eng) == 0);
+  uint64_t var = mxtpu_engine_new_var(eng);
+  g_counter = 0;
+  // NaiveEngine mode: the call itself blocks until the op (and deps) ran
+  CHECK(mxtpu_engine_push(eng, bump_counter, nullptr, nullptr, 0, &var, 1,
+                          0, 1) == 0);
+  CHECK(g_counter == 1);
+  CHECK(mxtpu_engine_num_pending(eng) == 0);
+  mxtpu_engine_delete_var(eng, var);
+  mxtpu_engine_destroy(eng);
+}
+
+void test_pool_reuse_accounting() {
+  mxtpu_pool_clear();
+  void *a = mxtpu_pool_alloc(1 << 16);
+  CHECK(a != nullptr);
+  std::memset(a, 0xAB, 1 << 16);
+  mxtpu_pool_free(a, 1 << 16);
+  void *b = mxtpu_pool_alloc(1 << 16);  // freed block must be recycled
+  CHECK(b == a);
+  mxtpu_pool_free(b, 1 << 16);
+  uint64_t stats[4] = {0, 0, 0, 0};
+  mxtpu_pool_stats(stats);
+  CHECK(stats[1] >= 1);  // at least one pool hit recorded
+  mxtpu_pool_clear();
+}
+
+void test_recordio_roundtrip() {
+  const char *path = "/tmp/mxtpu_cpptest.rec";
+  void *w = nullptr;
+  CHECK(mxtpu_rec_writer_open(path, &w) == 0);
+  const char *payloads[3] = {"alpha", "beta-beta", "g"};
+  for (const char *p : payloads) {
+    CHECK(mxtpu_rec_write(w, reinterpret_cast<const uint8_t *>(p),
+                          std::strlen(p)) == 0);
+  }
+  mxtpu_rec_writer_close(w);
+  CHECK(mxtpu_rec_count(path) == 3);
+  void *r = nullptr;
+  CHECK(mxtpu_rec_open(path, 8, 2, 0, 1, &r) == 0);
+  void *batch = nullptr;
+  int count = 0;
+  CHECK(mxtpu_rec_next_batch(r, &batch, &count) == 0);
+  CHECK(batch != nullptr && count == 3);
+  for (int i = 0; i < 3; ++i) {
+    const uint8_t *data = nullptr;
+    uint64_t len = 0;
+    mxtpu_rec_get(batch, i, &data, &len);
+    CHECK(len == std::strlen(payloads[i]));
+    CHECK(std::memcmp(data, payloads[i], len) == 0);
+  }
+  mxtpu_rec_free_batch(batch);
+  mxtpu_rec_close(r);
+  std::remove(path);
+}
+
+}  // namespace
+
+int main() {
+  test_write_exclusive_under_contention();
+  test_reads_complete_before_writer();
+  test_poisoning_reports_failed_ctx();
+  test_sync_push_runs_inline();
+  test_pool_reuse_accounting();
+  test_recordio_roundtrip();
+  std::printf("ALL CPP TESTS PASSED\n");
+  return 0;
+}
