@@ -1,0 +1,112 @@
+"""Thread-local frontend state isolation (reference:
+tests/python/unittest/test_thread_local.py): NameManager, AttrScope, and
+Context stacks must be per-thread — a scope entered on one thread must
+never leak names/attrs/placement into graphs built on another.
+"""
+import threading
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _run(fn):
+    out, err = [], []
+
+    def wrap():
+        try:
+            out.append(fn())
+        except BaseException as e:  # surfaced in the main thread
+            err.append(e)
+
+    t = threading.Thread(target=wrap)
+    t.start()
+    t.join(30)
+    assert not t.is_alive(), "worker thread hung"
+    if err:
+        raise err[0]
+    return out[0]
+
+
+def test_attr_scope_does_not_leak_across_threads():
+    with mx.AttrScope(ctx_group="main_g"):
+        main_var = mx.sym.Variable("mv")
+
+        def worker():
+            # the main thread's open scope must be invisible here
+            v = mx.sym.Variable("wv")
+            with mx.AttrScope(ctx_group="worker_g"):
+                w = mx.sym.Variable("wv2")
+            return v.attr("ctx_group"), w.attr("ctx_group")
+
+        got = _run(worker)
+    assert main_var.attr("ctx_group") == "main_g"
+    assert got == (None, "worker_g")
+    # and the worker's scope did not leak back
+    assert mx.sym.Variable("after").attr("ctx_group") is None
+
+
+def test_name_manager_counters_are_per_thread():
+    def fresh_names():
+        a = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=2)
+        b = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=2)
+        return a.name, b.name
+
+    main_first, main_second = fresh_names()
+    worker_first, _ = _run(fresh_names)
+    # each thread starts its own counter sequence: the worker's first
+    # auto-name repeats the main thread's pattern instead of continuing it
+    assert main_first != main_second
+    assert worker_first.rsplit("_", 1)[0] == main_first.rsplit("_", 1)[0]
+
+
+def test_prefix_scope_isolated():
+    def worker():
+        with mx.name.Prefix("wkr_"):
+            return mx.sym.FullyConnected(mx.sym.Variable("d"),
+                                         num_hidden=2).name
+
+    with mx.name.Prefix("main_"):
+        got = _run(worker)
+        local = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=2).name
+    assert got.startswith("wkr_")
+    assert local.startswith("main_")
+
+
+def test_context_stack_isolated():
+    with mx.Context(mx.cpu(0)):
+        def worker():
+            return mx.current_context()
+
+        got = _run(worker)
+    # worker sees the process default, not the main thread's entered ctx
+    assert isinstance(got, mx.Context)
+
+
+def test_graph_build_race_free():
+    """Many threads composing symbols concurrently: every graph stays
+    self-consistent (names unique within a thread, attrs correct)."""
+    errs = []
+
+    def build(tid):
+        try:
+            with mx.AttrScope(tag=f"t{tid}"):
+                data = mx.sym.Variable(f"d{tid}")
+                net = data
+                for i in range(5):
+                    net = mx.sym.FullyConnected(net, num_hidden=4,
+                                                name=f"fc{tid}_{i}")
+                d = net.attr_dict()
+            for i in range(5):
+                assert d[f"fc{tid}_{i}"]["tag"] == f"t{tid}", d
+            args = net.list_arguments()
+            assert len(args) == len(set(args))
+        except BaseException as e:
+            errs.append((tid, e))
+
+    threads = [threading.Thread(target=build, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs, errs
