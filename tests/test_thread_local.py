@@ -3,9 +3,8 @@ tests/python/unittest/test_thread_local.py): NameManager, AttrScope, and
 Context stacks must be per-thread — a scope entered on one thread must
 never leak names/attrs/placement into graphs built on another.
 """
+import re
 import threading
-
-import numpy as np
 
 import mxnet_tpu as mx
 
@@ -54,10 +53,14 @@ def test_name_manager_counters_are_per_thread():
 
     main_first, main_second = fresh_names()
     worker_first, _ = _run(fresh_names)
-    # each thread starts its own counter sequence: the worker's first
-    # auto-name repeats the main thread's pattern instead of continuing it
-    assert main_first != main_second
-    assert worker_first.rsplit("_", 1)[0] == main_first.rsplit("_", 1)[0]
+    stem = lambda n: re.sub(r"\d+$", "", n)
+    num = lambda n: int(re.search(r"(\d+)$", n).group(1))
+    # within a thread the counter advances...
+    assert main_first != main_second and stem(main_first) == stem(main_second)
+    # ...and the worker starts its OWN sequence at 0 instead of continuing
+    # the main thread's (which may sit anywhere, depending on test order)
+    assert stem(worker_first) == stem(main_first)
+    assert num(worker_first) == 0
 
 
 def test_prefix_scope_isolated():
@@ -74,13 +77,18 @@ def test_prefix_scope_isolated():
 
 
 def test_context_stack_isolated():
-    with mx.Context(mx.cpu(0)):
+    # enter a context DISTINGUISHABLE from the process default so a leak
+    # of the main thread's stack is actually detectable
+    entered = mx.cpu(1)
+    with mx.Context(entered):
+        assert mx.current_context() == entered
+
         def worker():
             return mx.current_context()
 
         got = _run(worker)
-    # worker sees the process default, not the main thread's entered ctx
     assert isinstance(got, mx.Context)
+    assert got != entered  # worker sees the process default, not the leak
 
 
 def test_graph_build_race_free():
@@ -109,4 +117,5 @@ def test_graph_build_race_free():
         t.start()
     for t in threads:
         t.join(30)
+        assert not t.is_alive(), "builder thread hung"
     assert not errs, errs
