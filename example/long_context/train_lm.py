@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Long-context LM training with sequence parallelism (SURVEY §5.7).
+
+No single reference twin — this is the capability the survey makes
+first-class for the TPU build: a decoder-only Transformer whose training
+step is laid out over a dp×sp `Mesh`, the sequence axis sharded so each
+device holds T/sp of every activation and attention runs as a causal RING
+(`parallel/ring_attention.py`) over ICI.  On the CPU image this drives the
+same program on 8 virtual devices (the real-chip layout is identical).
+
+The corpus is a deterministic Markov chain, so loss collapsing toward its
+entropy floor proves the ring step is learning across shard boundaries
+(every next-token dependency crosses them T/sp-periodically).
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+# the virtual mesh must exist before jax initializes (tests/conftest recipe);
+# size it to the requested dp*sp layout, not a constant
+def _cli_int(flag, default):
+    if flag in sys.argv:
+        try:
+            return int(sys.argv[sys.argv.index(flag) + 1])
+        except (IndexError, ValueError):
+            pass
+    return default
+
+
+if "--real-chip" not in sys.argv and "jax" not in sys.modules:
+    _n = _cli_int("--dp", 2) * _cli_int("--sp", 4)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = \
+            (_flags + f" --xla_force_host_platform_device_count={_n}").strip()
+
+import jax
+import jax.numpy as jnp
+
+if "--real-chip" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
+from mxnet_tpu.parallel.mesh import make_mesh
+from mxnet_tpu.parallel import transformer as tr
+
+
+def markov_corpus(rs, n_seq, seq_len, vocab, branch=2):
+    trans = rs.randint(0, vocab, size=(vocab, branch))
+    toks = np.empty((n_seq, seq_len), np.int32)
+    for i in range(n_seq):
+        t = rs.randint(0, vocab)
+        for j in range(seq_len):
+            toks[i, j] = t
+            t = int(trans[t, rs.randint(branch)])
+    return toks
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--sp", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--lr", type=float, default=0.2)
+    ap.add_argument("--real-chip", action="store_true",
+                    help="skip the virtual-device setup (dp*sp must match "
+                         "the real device count)")
+    args = ap.parse_args()
+
+    cfg = tr.TransformerConfig(vocab=args.vocab, d_model=64, n_heads=4,
+                               n_layers=2, d_ff=128,
+                               max_len=max(128, args.seq_len))
+    mesh = make_mesh({"dp": args.dp, "sp": args.sp})
+    print(f"mesh dp={args.dp} x sp={args.sp} over "
+          f"{len(jax.devices())} devices; T={args.seq_len} "
+          f"(={args.seq_len // args.sp}/shard)")
+
+    rs = np.random.RandomState(0)
+    data = markov_corpus(rs, 512, args.seq_len + 1, args.vocab)
+    params = tr.transformer_lm_init(cfg, jax.random.PRNGKey(0))
+    momenta = {k: jnp.zeros_like(v) for k, v in params.items()}
+    step = tr.make_sharded_train_step(mesh, cfg, lr=args.lr)
+    positions = jnp.arange(args.seq_len, dtype=jnp.int32)
+
+    first = None
+    for i in range(args.steps):
+        idx = rs.randint(0, len(data), args.batch)
+        tokens = jnp.asarray(data[idx, :-1])
+        labels = jnp.asarray(data[idx, 1:])
+        loss, params, momenta = step(
+            params, momenta, *tr.shard_batch(mesh, tokens, labels,
+                                             positions))
+        first = first if first is not None else float(loss)
+        if i % 10 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(loss):.4f}")
+
+    # branch=2 Markov chain: entropy floor = ln(2) ≈ 0.69 (uniform over
+    # vocab would be ln(64) ≈ 4.16); below 60% of the start proves the
+    # cross-shard dependencies are being learned
+    final = float(loss)
+    print(f"final loss: {final:.4f} (start {first:.4f}, "
+          f"floor ~{np.log(2):.2f})")
+    assert final < 0.6 * first, "loss did not drop"
+    return final
+
+
+if __name__ == "__main__":
+    main()

@@ -25,8 +25,10 @@ from .ring_attention import ring_attention, ring_attention_sharded, \
 from .sequence_parallel import ulysses_attention, ulysses_attention_sharded
 from . import moe
 from . import pipeline
+from . import transformer
 
 __all__ = ["MeshConfig", "get_mesh", "make_mesh", "local_mesh", "collectives",
            "compression", "DataParallelTrainer", "ring_attention",
            "ring_attention_sharded", "local_attention", "ulysses_attention",
+           "transformer",
            "ulysses_attention_sharded", "pipeline", "moe"]

@@ -1,0 +1,194 @@
+"""Decoder-only Transformer LM, mesh-first (SURVEY §5.7 long-context).
+
+The reference era treats sequence length as a single-device axis; this
+module is the capability the survey calls out as first-class here: a
+language model whose TRAINING STEP is laid out over a ``Mesh`` with the
+batch on ``dp`` and the sequence on ``sp``, attention running as a ring
+(`ring_attention`, flash-style m/l accumulators, causal across shard
+boundaries) so each device holds T/sp of every activation — the memory
+that bounds context length.  Everything else in the block (embeddings,
+LayerNorm, MLP) is pointwise over the sequence, so sp-sharding them is
+free; gradients are psum'd over the mesh and the replicated params stay
+bit-identical on every shard.
+
+Design notes (tpu-first):
+- params are a flat dict of jnp arrays; the apply fn is pure and takes the
+  attention callable as a parameter — `local_attention` single-device,
+  `ring_attention` inside shard_map.  One model definition, no divergence.
+- tied input/output embeddings (d_model-major matmuls for the MXU).
+- the sharded step is ONE compiled program: shard_map(jit) over the whole
+  forward/backward/update, collectives only where math requires them
+  (ring ppermute inside attention, one grad psum).
+
+Oracles: tests/test_transformer_lm.py checks the sp-sharded forward and
+train step against the single-device model to 1e-3.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .ring_attention import local_attention, ring_attention
+
+Params = Dict[str, jnp.ndarray]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    max_len: int = 512
+
+    @property
+    def d_head(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def transformer_lm_init(cfg: TransformerConfig, key) -> Params:
+    """Scaled-normal init; residual-out projections down-scaled by
+    1/sqrt(2*n_layers) (standard GPT-2 style stabilization)."""
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape) * scale).astype(jnp.float32)
+
+    keys = iter(jax.random.split(key, 4 + 6 * cfg.n_layers))
+    s = 1.0 / math.sqrt(cfg.d_model)
+    res = s / math.sqrt(2.0 * cfg.n_layers)
+    p: Params = {
+        "tok_emb": normal(next(keys), (cfg.vocab, cfg.d_model), 0.02),
+        "pos_emb": normal(next(keys), (cfg.max_len, cfg.d_model), 0.02),
+        "lnf_g": jnp.ones((cfg.d_model,)),
+        "lnf_b": jnp.zeros((cfg.d_model,)),
+    }
+    for i in range(cfg.n_layers):
+        p[f"l{i}_ln1_g"] = jnp.ones((cfg.d_model,))
+        p[f"l{i}_ln1_b"] = jnp.zeros((cfg.d_model,))
+        p[f"l{i}_wqkv"] = normal(next(keys), (cfg.d_model, 3 * cfg.d_model), s)
+        p[f"l{i}_wo"] = normal(next(keys), (cfg.d_model, cfg.d_model), res)
+        p[f"l{i}_ln2_g"] = jnp.ones((cfg.d_model,))
+        p[f"l{i}_ln2_b"] = jnp.zeros((cfg.d_model,))
+        p[f"l{i}_w1"] = normal(next(keys), (cfg.d_model, cfg.d_ff), s)
+        p[f"l{i}_b1"] = jnp.zeros((cfg.d_ff,))
+        p[f"l{i}_w2"] = normal(next(keys), (cfg.d_ff, cfg.d_model), res)
+        p[f"l{i}_b2"] = jnp.zeros((cfg.d_model,))
+    return p
+
+
+def _ln(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def transformer_lm_apply(params: Params, tokens, positions,
+                         cfg: TransformerConfig, attention=None):
+    """Logits for next-token prediction.
+
+    tokens: (B, T) int32 — T may be the LOCAL sequence block under sp.
+    positions: (T,) int32 GLOBAL positions of those columns.
+    attention: (q, k, v) -> out with shapes (B, T, H, Dh); defaults to the
+    single-device `local_attention(causal=True)`.
+    """
+    if attention is None:
+        attention = functools.partial(local_attention, causal=True)
+    B, T = tokens.shape
+    x = params["tok_emb"][tokens] + params["pos_emb"][positions][None, :, :]
+    for i in range(cfg.n_layers):
+        g = lambda n: params[f"l{i}_{n}"]  # noqa: B023 — read immediately
+        h = _ln(x, g("ln1_g"), g("ln1_b"))
+        qkv = h @ g("wqkv")
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, T, cfg.n_heads, cfg.d_head)
+        o = attention(to_heads(q), to_heads(k), to_heads(v))
+        x = x + o.reshape(B, T, cfg.d_model) @ g("wo")
+        h = _ln(x, g("ln2_g"), g("ln2_b"))
+        x = x + jax.nn.gelu(h @ g("w1") + g("b1")) @ g("w2") + g("b2")
+    x = _ln(x, params["lnf_g"], params["lnf_b"])
+    return x @ params["tok_emb"].T  # tied embeddings
+
+
+def lm_loss(params: Params, tokens, labels, positions,
+            cfg: TransformerConfig, attention=None, mask=None):
+    """Mean next-token cross-entropy; `mask` (B, T) optionally excludes
+    positions (e.g. padding) from the mean."""
+    logits = transformer_lm_apply(params, tokens, positions, cfg, attention)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return jnp.mean(nll)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def train_step(params, momenta, tokens, labels, positions, cfg,
+               lr=0.1, momentum=0.9, attention=None):
+    """Single-device SGD-momentum step (the oracle for the sharded one)."""
+    loss, grads = jax.value_and_grad(lm_loss)(params, tokens, labels,
+                                              positions, cfg,
+                                              attention=attention)
+    momenta = jax.tree_util.tree_map(lambda m, g: momentum * m + g,
+                                     momenta, grads)
+    params = jax.tree_util.tree_map(lambda p, m: p - lr * m, params, momenta)
+    return loss, params, momenta
+
+
+def make_sharded_train_step(mesh: Mesh, cfg: TransformerConfig,
+                            lr=0.1, momentum=0.9):
+    """One compiled dp×sp training step.
+
+    Layout: tokens/labels (B, T) sharded P('dp', 'sp'); positions (T,)
+    sharded P('sp'); params/momenta replicated.  Attention is the ring over
+    'sp'; the per-shard mean loss is weighted into the global mean and
+    grads are psum'd over both axes, so the replicated update is identical
+    everywhere.  Returns step(params, momenta, tokens, labels, positions)
+    -> (loss, params, momenta), jitted with donated carries.
+    """
+    axes = ("dp", "sp")
+    repl, data = P(), P("dp", "sp")
+
+    def shard_step(params, momenta, tokens, labels, positions):
+        attention = functools.partial(ring_attention, axis_name="sp",
+                                      causal=True)
+
+        n_shards = 1
+        for a in axes:
+            n_shards *= mesh.shape[a]
+
+        def local_loss(p):
+            # scaled so that the AUTO-PSUM shard_map applies to the
+            # cotangent of replicated params (each shard contributes
+            # d(local_i)/dp; the sum over shards must equal the gradient
+            # of the GLOBAL mean = (1/n) sum_i local_i, every shard
+            # holding B/dp x T/sp tokens)
+            return lm_loss(p, tokens, labels, positions, cfg,
+                           attention=attention) / n_shards
+
+        loss, grads = jax.value_and_grad(local_loss)(params)
+        loss = jax.lax.psum(loss, axes)  # back to the global mean for report
+        momenta = jax.tree_util.tree_map(lambda m, g: momentum * m + g,
+                                         momenta, grads)
+        params = jax.tree_util.tree_map(lambda p, m: p - lr * m,
+                                        params, momenta)
+        return loss, params, momenta
+
+    fn = jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(repl, repl, data, data, P("sp")),
+        out_specs=(repl, repl, repl))
+    return jax.jit(fn, donate_argnums=(0, 1))
+
+
+def shard_batch(mesh: Mesh, tokens, labels, positions):
+    """Place host arrays with the layout make_sharded_train_step expects."""
+    data = NamedSharding(mesh, P("dp", "sp"))
+    pos = NamedSharding(mesh, P("sp"))
+    return (jax.device_put(tokens, data), jax.device_put(labels, data),
+            jax.device_put(positions, pos))
