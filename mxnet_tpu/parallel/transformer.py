@@ -141,22 +141,37 @@ def train_step(params, momenta, tokens, labels, positions, cfg,
 
 
 def make_sharded_train_step(mesh: Mesh, cfg: TransformerConfig,
-                            lr=0.1, momentum=0.9):
+                            lr=0.1, momentum=0.9, sp_impl: str = "ring"):
     """One compiled dp×sp training step.
 
     Layout: tokens/labels (B, T) sharded P('dp', 'sp'); positions (T,)
-    sharded P('sp'); params/momenta replicated.  Attention is the ring over
-    'sp'; the per-shard mean loss is weighted into the global mean and
-    grads are psum'd over both axes, so the replicated update is identical
-    everywhere.  Returns step(params, momenta, tokens, labels, positions)
+    sharded P('sp'); params/momenta replicated.  Attention over 'sp' is
+    the causal ring (``sp_impl="ring"``: ppermute k/v blocks, activation
+    memory stays T/sp everywhere) or Ulysses (``sp_impl="ulysses"``:
+    all_to_all to head-sharding, full-sequence local attention — fewer
+    collective hops, but requires n_heads % sp == 0 and holds full-T
+    activations inside attention).  The per-shard mean loss is weighted
+    into the global mean and grads are psum'd over both axes, so the
+    replicated update is identical everywhere.  Returns
+    step(params, momenta, tokens, labels, positions)
     -> (loss, params, momenta), jitted with donated carries.
     """
     axes = ("dp", "sp")
     repl, data = P(), P("dp", "sp")
+    if sp_impl == "ulysses":
+        from .sequence_parallel import ulysses_attention
+        if cfg.n_heads % mesh.shape["sp"]:
+            raise ValueError(
+                f"ulysses needs n_heads ({cfg.n_heads}) divisible by "
+                f"sp ({mesh.shape['sp']})")
+        attn_fn = ulysses_attention
+    elif sp_impl == "ring":
+        attn_fn = ring_attention
+    else:
+        raise ValueError(f"unknown sp_impl {sp_impl!r}")
 
     def shard_step(params, momenta, tokens, labels, positions):
-        attention = functools.partial(ring_attention, axis_name="sp",
-                                      causal=True)
+        attention = functools.partial(attn_fn, axis_name="sp", causal=True)
 
         n_shards = 1
         for a in axes:

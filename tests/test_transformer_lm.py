@@ -111,3 +111,30 @@ def test_loss_mask_excludes_padding():
     np.testing.assert_allclose(
         float(tr.lm_loss(params, tokens, labels, positions, CFG, mask=m2)),
         float(only_first), rtol=1e-5)
+
+
+def test_ulysses_step_equals_oracle():
+    """sp_impl="ulysses": all_to_all head-sharding path reproduces the same
+    single-device step the ring does."""
+    params = _params(seed=3)
+    tokens, labels, positions = _batch(B=4, T=32)
+    mesh = make_mesh({"dp": 2, "sp": 4})  # n_heads=4 % sp=4 == 0
+    step = tr.make_sharded_train_step(mesh, CFG, lr=0.1, sp_impl="ulysses")
+    p2 = {k: jnp.array(v) for k, v in params.items()}
+    m2 = {k: jnp.zeros_like(v) for k, v in params.items()}
+    loss_s, p2, _ = step(p2, m2, *tr.shard_batch(mesh, tokens, labels,
+                                                 positions))
+    loss1, p1, _ = jax.jit(
+        lambda p, m: tr.train_step(p, m, tokens, labels, positions, CFG,
+                                   lr=0.1))(
+        {k: jnp.array(v) for k, v in params.items()},
+        {k: jnp.zeros_like(v) for k, v in params.items()})
+    assert abs(float(loss_s) - float(loss1)) < 1e-4
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p2[k]), np.asarray(p1[k]),
+                                   atol=2e-4, err_msg=k)
+    with pytest.raises(ValueError):
+        tr.make_sharded_train_step(make_mesh({"dp": 1, "sp": 8}), CFG,
+                                   sp_impl="ulysses")  # 4 heads % 8 != 0
+    with pytest.raises(ValueError):
+        tr.make_sharded_train_step(mesh, CFG, sp_impl="nope")
