@@ -36,6 +36,17 @@ def reduce_scatter(x, axis_name: str, axis: int = 0):
 
 def broadcast(x, axis_name: str, src: int = 0):
     """Broadcast src's shard to all members of the axis."""
+    n = lax.axis_size(axis_name)
+    if not isinstance(src, jax.core.Tracer):
+        # static src (incl. numpy ints): validate now — an out-of-range src
+        # would make the mask never fire and psum return silent ZEROS, the
+        # worst kind of collective bug to debug downstream
+        import operator
+
+        src = operator.index(src)
+        if not 0 <= src < n:
+            raise ValueError(f"broadcast src={src} out of range for axis "
+                             f"{axis_name!r} of size {n}")
     idx = lax.axis_index(axis_name)
     masked = jnp.where(idx == src, x, jnp.zeros_like(x))
     return lax.psum(masked, axis_name)
