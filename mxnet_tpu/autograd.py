@@ -33,6 +33,7 @@ def _st():
         _tls.recording = False
         _tls.training = False
         _tls.tape = []
+        _tls.tape_out_ids = set()  # ids of every tape entry's outputs
         _tls.marked = {}  # id(handle) -> (weakref(var), weakref(grad), grad_req)
     return _tls
 
@@ -57,6 +58,7 @@ class _TapeEntry:
 def _record_op(op, kwargs, inputs, outputs):
     """Called by ndarray.invoke for every op executed under record()."""
     st = _st()
+    st.tape_out_ids.update(id(o) for o in outputs)
     st.tape.append(_TapeEntry(
         op.fn, dict(kwargs),
         [id(i) for i in inputs],
@@ -107,13 +109,15 @@ def predict_mode() -> _Scope:
 
 
 def _is_on_tape(arr) -> bool:
-    """True if `arr` participates in the current tape (as input or output)."""
+    """True if an in-place write to `arr` could corrupt the recorded graph:
+    it is a marked variable (backward reads its CURRENT buffer) or a tape
+    entry's output (the replay recomputes it, silently diverging from the
+    overwritten eager value).  Pure tape INPUTS are safe — _record_op
+    snapshots their immutable buffers — and the set-based check keeps the
+    guard O(1) however long the tape grows."""
     st = _st()
     i = id(arr)
-    for e in st.tape:
-        if i in e.in_ids or i in e.out_ids:
-            return True
-    return False
+    return i in st.tape_out_ids or i in st.marked
 
 
 def check_inplace(arr) -> None:
@@ -121,8 +125,8 @@ def check_inplace(arr) -> None:
 
     The reference forbids in-place ops under autograd recording outright
     (imperative autograd 'Inplace operations are not supported when
-    recording'); here only writes to arrays already ON the tape are fatal —
-    the replay would silently recompute from the post-write buffer."""
+    recording'); here only writes that can change gradients are fatal —
+    marked variables and op outputs (see _is_on_tape)."""
     st = _st()
     if st.recording and _is_on_tape(arr):
         from .base import MXNetError
@@ -135,6 +139,92 @@ def check_inplace(arr) -> None:
 
 def is_recording() -> bool:
     return _st().recording
+
+
+class _ArrSlot:
+    """Placeholder for an index ARRAY extracted out of a tuple key so the
+    array rides the tape as a dynamic kwarg (argument of the jitted
+    backward) instead of being baked in as a constant — baking it would
+    both bloat the structural cache key with repr'd data and silently
+    replay STALE indices when a same-shaped key changed between steps.
+    Value-hashable so identical key structures produce identical cache
+    keys across steps."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+    def __repr__(self):
+        return f"<mxtpu-key-arr{self.i}>"
+
+    def __eq__(self, other):
+        return isinstance(other, _ArrSlot) and other.i == self.i
+
+    def __hash__(self):
+        return hash(("_ArrSlot", self.i))
+
+
+class _GetitemOp:
+    """Tape shim for NDArray.__getitem__ — a stable fn object so the
+    structural backward cache hits across steps (a per-call lambda would
+    force a recompile every iteration)."""
+
+    name = "_autograd_getitem"
+
+    @staticmethod
+    def fn(x, *, _key, _training=None, **kw):
+        if isinstance(_key, tuple) and any(isinstance(k, _ArrSlot)
+                                           for k in _key):
+            _key = tuple(kw[f"_karr{k.i}"] if isinstance(k, _ArrSlot) else k
+                         for k in _key)
+        return x[_key]
+
+
+def _is_arr(k) -> bool:
+    return hasattr(k, "dtype") and hasattr(k, "shape")
+
+
+def record_getitem(src, key, out) -> None:
+    """Record an indexing read on the tape so gradients flow back through
+    slicing (reference: slice/take ops are differentiable; a silent
+    zero-gradient here was the worst kind of bug).  ``key`` is the already
+    jnp-converted index.
+
+    Policy: only reads of CONNECTED arrays (marked variables or tape-entry
+    outputs) are recorded — nothing else can carry gradient, and taping
+    unrelated inspection reads would bloat the tape.  Boolean-mask reads
+    are never recorded: their output shape is data-dependent, so the jitted
+    replay cannot differentiate them — warn instead of poisoning backward.
+    """
+    st = _st()
+    if not st.recording:
+        return
+    i = id(src)
+    if i not in st.tape_out_ids and i not in st.marked:
+        return
+    keys = key if isinstance(key, tuple) else (key,)
+    if any(_is_arr(k) and jnp.issubdtype(k.dtype, jnp.bool_) for k in keys):
+        import warnings
+
+        warnings.warn(
+            "boolean-mask indexing under autograd.record() is not "
+            "differentiable (data-dependent shape); no gradient will flow "
+            "through this read", stacklevel=3)
+        return
+    if isinstance(key, tuple) and any(_is_arr(k) for k in key):
+        kwargs = {}
+        tmpl = []
+        for k in key:
+            if _is_arr(k):
+                kwargs[f"_karr{len(kwargs)}"] = k
+                tmpl.append(_ArrSlot(len(kwargs) - 1))
+            else:
+                tmpl.append(k)
+        kwargs["_key"] = tuple(tmpl)
+    else:
+        kwargs = {"_key": key}
+    _record_op(_GetitemOp, kwargs, [src], [out])
 
 
 def is_training() -> bool:
@@ -362,6 +452,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             g._data = gv
     if not retain_graph:
         st.tape = []
+        st.tape_out_ids = set()
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
@@ -411,9 +502,11 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
             _grad_of,
             {}, var_ids, var_vals, [id(o) for o in outs], "_grad_of", list(outs))
         if st.recording:
+            st.tape_out_ids.update(entry.out_ids)
             st.tape.append(entry)
         if retain_graph is False:
             st.tape = []
+            st.tape_out_ids = set()
         return outs
     primals, vjp_fn = jax.vjp(f, var_vals)
     cts = [jnp.ones_like(p) if head_grads is None or (isinstance(head_grads, list) and head_grads[i] is None)
@@ -421,6 +514,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     (grads,) = vjp_fn(cts)
     if retain_graph is False or (retain_graph is None and not create_graph):
         st.tape = []
+        st.tape_out_ids = set()
     return [NDArray(g) for g in grads]
 
 

@@ -467,8 +467,12 @@ class NDArray:
         return key
 
     def __getitem__(self, key):
+        from .. import autograd
+
         jkey = self._convert_key(key)
-        return NDArray(self._data[jkey])
+        out = NDArray(self._data[jkey])
+        autograd.record_getitem(self, jkey, out)
+        return out
 
     def __setitem__(self, key, value):
         self._guard_inplace()

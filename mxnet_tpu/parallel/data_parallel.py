@@ -116,7 +116,14 @@ class DataParallelTrainer:
                  mesh: Optional[Mesh] = None, dp_axis: str = "dp",
                  compute_dtype=None, update_fn: Optional[Callable] = None,
                  donate: bool = True, compression_params: Optional[Dict] = None):
-        self._mesh = mesh or get_mesh()
+        self._mesh = mesh
+        if self._mesh is None:
+            fallback = get_mesh()
+            # only adopt the ambient mesh if it actually has our axis — a
+            # leftover global mesh from unrelated work (say an ep-only MoE
+            # mesh) would otherwise crash every sharding constraint here
+            if fallback is not None and dp_axis in fallback.shape:
+                self._mesh = fallback
         self._axis = dp_axis
         self._block = block
         self._loss_fn = loss_fn

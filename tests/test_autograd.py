@@ -135,3 +135,108 @@ def test_training_flag_affects_dropout():
         y_pred = nd.Dropout(x, p=0.5)
     assert (y_train.asnumpy() == 0).any()
     assert not (y_pred.asnumpy() == 0).any()
+
+
+def test_getitem_gradient_flows():
+    """Indexing reads are tape-recorded: y[i] under record() must carry
+    gradient back to y (was silently zero — the eager foreach data-slicing
+    path depends on it)."""
+    y = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    y.attach_grad()
+    with autograd.record():
+        (y[1] * y[1]).sum().backward()
+    np.testing.assert_allclose(y.grad.asnumpy(),
+                               [[0, 0], [4, 6], [0, 0]])
+    # slices and steps
+    y.attach_grad()
+    with autograd.record():
+        y[0:3:2].sum().backward()
+    np.testing.assert_allclose(y.grad.asnumpy(),
+                               [[1, 1], [0, 0], [1, 1]])
+
+
+def test_getitem_gradient_advanced_index():
+    z = nd.array(np.arange(8, dtype=np.float32))
+    z.attach_grad()
+    idx = nd.array(np.array([1, 3, 3], np.float32))
+    with autograd.record():
+        (z[idx] * nd.array(np.array([1.0, 2.0, 4.0], np.float32))) \
+            .sum().backward()
+    np.testing.assert_allclose(z.grad.asnumpy(),
+                               [0, 1, 0, 6, 0, 0, 0, 0])
+
+
+def test_getitem_gradient_through_eager_foreach():
+    from mxnet_tpu import nd as _nd
+
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    s0 = nd.array(np.zeros(2, np.float32))
+    x.attach_grad()
+    with autograd.record():
+        outs, fin = _nd.contrib.foreach(
+            lambda c, st: (st + c * c, st + c * c), x, s0)
+        fin.sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_getitem_dynamic_tuple_index_not_cached_stale():
+    """A tuple key containing an index ARRAY must ride the tape as a
+    dynamic argument: two steps with same-shaped but different indices
+    must not hit a stale cached backward (indices baked as constants)."""
+    for idx_np in (np.array([1, 2]), np.array([3, 0])):
+        x = nd.array(np.arange(20, dtype=np.float32).reshape(4, 5))
+        x.attach_grad()
+        idx = nd.array(idx_np.astype(np.float32))
+        with autograd.record():
+            x[:, idx].sum().backward()
+        want = np.zeros((4, 5), np.float32)
+        want[:, idx_np] = 1
+        np.testing.assert_allclose(x.grad.asnumpy(), want,
+                                   err_msg=str(idx_np))
+
+
+def test_getitem_bool_mask_warns_not_poisons():
+    import warnings
+
+    x = nd.array(np.arange(6, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            _ = y[np.array([1, 0, 1, 0, 1, 0]).astype(bool)]
+        assert any("boolean-mask" in str(i.message) for i in w)
+        y.sum().backward()  # the un-taped read must not break backward
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.ones(6))
+
+
+def test_getitem_unconnected_reads_stay_off_tape():
+    a = nd.array(np.arange(4, dtype=np.float32))
+    a.attach_grad()
+    unrelated = nd.array(np.arange(10, dtype=np.float32))
+    with autograd.record():
+        loss = (a * a).sum()
+        _ = unrelated[3]  # inspection read of an unconnected array
+        loss.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 2 * np.arange(4))
+
+
+def test_inplace_guard_scope():
+    """Writes to MARKED vars and op OUTPUTS raise; writes to arrays that
+    were merely READ are safe (their buffers were snapshotted)."""
+    w = nd.array(np.ones(3, np.float32))
+    w.attach_grad()
+    data = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    with autograd.record():
+        loss = (w * data[0]).sum()
+        data[1] = nd.array(np.zeros(3, np.float32))  # read-only array: OK
+        loss.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), [0, 1, 2])
+    with pytest.raises(Exception):
+        with autograd.record():
+            _ = (w * w).sum()
+            w[0] = 5.0  # marked var
+    with pytest.raises(Exception):
+        with autograd.record():
+            y = w * 2
+            y[0] = 1.0  # op output

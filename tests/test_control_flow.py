@@ -332,3 +332,50 @@ def test_cond_tojson_roundtrip():
     args["p"] = nd.array(np.zeros((1,), np.float32))
     got2 = loaded.bind(args=args).forward()[0].asnumpy()
     assert np.allclose(got2, 4.0)
+
+
+def test_nested_foreach_forward_grad_and_json():
+    """foreach inside foreach: forward oracle, gradient flow, and the
+    serialized spec rebuilds through op_from_spec recursively."""
+    x_np = np.arange(24, dtype=np.float32).reshape(3, 4, 2)
+
+    # eager: gradient through both scan levels
+    x = nd.array(x_np)
+    s0 = nd.array(np.zeros(2, np.float32))
+    x.attach_grad()
+    with autograd.record():
+        def inner(col, st):
+            s = st + col * col
+            return s, s
+
+        def outer(row, st):
+            _, f = nd.contrib.foreach(inner, row, st)
+            return f, f
+
+        outs, fin = nd.contrib.foreach(outer, x, s0)
+        fin.sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x_np, rtol=1e-5)
+
+    # symbolic: build, execute, round-trip through JSON
+    data = mx.sym.Variable("data")
+    sv = mx.sym.Variable("s0")
+
+    def sym_inner(col, st):
+        s = st + col
+        return s, s
+
+    def sym_outer(row, st):
+        _, f = mx.sym.contrib.foreach(sym_inner, row, st)
+        return f, f
+
+    o, f = mx.sym.contrib.foreach(sym_outer, data, sv)
+    g = mx.sym.Group([o, f])
+    want_fin = x_np.sum(axis=(0, 1))
+    want_outs = np.cumsum(x_np.sum(axis=1), axis=0)
+    for sym in (g, mx.sym.load_json(g.tojson())):
+        exe = sym.simple_bind(ctx=mx.cpu(), data=(3, 4, 2), s0=(2,))
+        exe.arg_dict["data"][:] = nd.array(x_np)
+        exe.arg_dict["s0"][:] = nd.array(np.zeros(2, np.float32))
+        res = exe.forward()
+        np.testing.assert_allclose(res[0].asnumpy(), want_outs, rtol=1e-6)
+        np.testing.assert_allclose(res[1].asnumpy(), want_fin, rtol=1e-6)
