@@ -117,7 +117,16 @@ def _is_on_tape(arr) -> bool:
     guard O(1) however long the tape grows."""
     st = _st()
     i = id(arr)
-    return i in st.tape_out_ids or i in st.marked
+    if i in st.tape_out_ids:
+        return True
+    entry = st.marked.get(i)
+    if entry is not None:
+        # validate the weakref: a dead entry means CPython may have reused
+        # this id for an unrelated array — never misclassify it
+        if entry[0]() is arr:
+            return True
+        del st.marked[i]
+    return False
 
 
 def check_inplace(arr) -> None:
@@ -200,8 +209,7 @@ def record_getitem(src, key, out) -> None:
     st = _st()
     if not st.recording:
         return
-    i = id(src)
-    if i not in st.tape_out_ids and i not in st.marked:
+    if not _is_on_tape(src):  # weakref-validated marked check included
         return
     keys = key if isinstance(key, tuple) else (key,)
     if any(_is_arr(k) and jnp.issubdtype(k.dtype, jnp.bool_) for k in keys):
@@ -560,6 +568,7 @@ class Function:
         outs = list(outputs) if multi else [outputs]
         if st.recording:
             fn = _make_custom_vjp(self, len(inputs), len(outs))
+            st.tape_out_ids.update(id(o) for o in outs)
             st.tape.append(_TapeEntry(
                 fn, {}, [id(i) for i in inputs], [i._data for i in inputs],
                 [id(o) for o in outs], type(self).__name__,

@@ -117,11 +117,19 @@ def invoke_custom(inputs: Sequence[NDArray], op_type: str, **kwargs):
                               [i.dtype for i in inputs])
     n_out = len(prop.list_outputs())
 
+    try:
+        _, out_types, _ = prop.infer_type([i.dtype for i in inputs])
+        out_types = [_np.dtype(t) for t in out_types]
+    except Exception:
+        out_types = [inputs[0].dtype] * n_out
+
     class _Fn(autograd.Function):
         def forward(self, *ins):
-            outs = [NDArray(jnp.zeros(tuple(s), ins[0]._data.dtype))
-                    for s in out_shapes]
-            op.forward(is_train=autograd.is_recording(),
+            outs = [NDArray(jnp.zeros(tuple(s), t))
+                    for s, t in zip(out_shapes, out_types)]
+            # is_train is the MODE, not the recording flag (reference:
+            # CustomOp.forward's is_train follows train_mode/predict_mode)
+            op.forward(is_train=autograd.is_training(),
                        req=["write"] * n_out,
                        in_data=list(ins), out_data=outs, aux=[])
             self.save_for_backward(*ins, *outs)
@@ -193,7 +201,7 @@ def make_custom_symbol_fn(op_type: str, kwargs: dict):
                 for s, t in zip(out_shapes, out_types)]
         from . import autograd as _ag
 
-        op.forward(is_train=_ag.is_recording(), req=["write"] * n_out,
+        op.forward(is_train=_ag.is_training(), req=["write"] * n_out,
                    in_data=ins, out_data=outs, aux=[])
         return tuple(_np.asarray(o._data) for o in outs)
 

@@ -240,3 +240,42 @@ def test_inplace_guard_scope():
         with autograd.record():
             y = w * 2
             y[0] = 1.0  # op output
+
+
+def test_getitem_through_custom_function_output():
+    """Function outputs land in the on-tape set: indexing a custom-op
+    result under record() must carry gradient (was silently zero), and
+    in-place writes to it must raise."""
+    class Double(autograd.Function):
+        def forward(self, x):
+            return nd.array(2 * x.asnumpy())
+
+        def backward(self, dy):
+            return nd.array(2 * dy.asnumpy())
+
+    x = nd.array(np.arange(4, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = Double()(x)
+        y[1:3].sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0, 2, 2, 0])
+    with pytest.raises(Exception):
+        with autograd.record():
+            y = Double()(x)
+            y[0] = 9.0  # op output: in-place write must raise
+
+
+def test_stale_marked_id_not_misclassified():
+    """A garbage-collected marked variable must not poison a new array
+    that CPython allocates at the recycled id."""
+    import gc
+
+    for _ in range(30):
+        w = nd.array(np.ones(3, np.float32))
+        w.attach_grad()
+        del w
+        gc.collect()
+        fresh = nd.array(np.zeros(3, np.float32))
+        with autograd.record():
+            fresh[0] = 1.0  # unmarked, un-taped: must NOT raise
+        assert fresh.asnumpy()[0] == 1.0
