@@ -244,3 +244,53 @@ def test_cast_storage_roundtrip():
     assert np.allclose(csr.asnumpy(), x.asnumpy())
     assert np.allclose(rsp.asnumpy(), x.asnumpy())
     assert np.allclose(csr.tostype("default").asnumpy(), x.asnumpy())
+
+
+def test_cross_entropy_and_nll():
+    probs = np.array([[0.2, 0.7, 0.1], [0.6, 0.3, 0.1]], np.float32)
+    labels = np.array([1, 0], np.float32)
+    want = -np.mean(np.log([0.7, 0.6]))
+    for cls in (mx.metric.CrossEntropy, mx.metric.NegativeLogLikelihood):
+        m = cls()
+        m.update([nd.array(labels)], [nd.array(probs)])
+        assert abs(m.get()[1] - want) < 1e-5, cls.__name__
+
+
+def test_pearson_correlation():
+    rs = np.random.RandomState(0)
+    x = rs.rand(50).astype(np.float32)
+    y = (2 * x + 0.1 * rs.rand(50)).astype(np.float32)
+    m = mx.metric.PearsonCorrelation()
+    m.update([nd.array(y)], [nd.array(x)])
+    want = np.corrcoef(x, y)[0, 1]
+    assert abs(m.get()[1] - want) < 1e-4
+    # perfectly anticorrelated
+    m.reset()
+    m.update([nd.array(-x)], [nd.array(x)])
+    assert abs(m.get()[1] + 1.0) < 1e-5
+
+
+def test_loss_metric_and_registry_create():
+    m = mx.metric.Loss()
+    m.update(None, [nd.array(np.array([1.0, 3.0], np.float32))])
+    assert abs(m.get()[1] - 2.0) < 1e-6
+    # string / registry round trips (reference: metric.create)
+    for spec in ("accuracy", "mse", "top_k_accuracy"):
+        got = mx.metric.create(spec)
+        assert isinstance(got, mx.metric.EvalMetric), spec
+    comp = mx.metric.create(["accuracy", "mse"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+    again = mx.metric.create(mx.metric.Accuracy())
+    assert isinstance(again, mx.metric.Accuracy)
+
+
+def test_metric_reset_and_accumulation():
+    m = mx.metric.Accuracy()
+    m.update([nd.array(np.array([0.0]))],
+             [nd.array(np.array([[0.9, 0.1]], np.float32))])
+    m.update([nd.array(np.array([1.0]))],
+             [nd.array(np.array([[0.9, 0.1]], np.float32))])
+    assert m.get()[1] == 0.5 and m.num_inst == 2
+    m.reset()
+    assert m.num_inst == 0
+    assert np.isnan(m.get()[1])  # no updates yet -> NaN, reference behavior
