@@ -74,6 +74,26 @@ def check_sha1(filename, sha1_hash):
 
 def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
              verify_ssl=True):
+    """Reference-compatible short-circuit, egress-disabled fetch.
+
+    Like the reference (gluon/utils.py download), a file already present at
+    ``path`` with a matching sha1 (or no hash requested) is returned WITHOUT
+    touching the network — so "provide files locally" workflows (pretrained
+    weights, datasets) run unchanged.  Only an actual fetch attempt raises.
+    """
+    import os
+
+    tail = url.split("/")[-1]
+    if path is None:
+        fname = tail
+    else:
+        path = os.path.expanduser(path)
+        fname = os.path.join(path, tail) if os.path.isdir(path) else path
+    if not os.path.basename(fname):
+        raise ValueError(f"cannot derive a filename from url {url!r}")
+    if os.path.isfile(fname) and not overwrite and \
+            (sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
     raise RuntimeError(
-        "download() disabled: this environment has no egress; "
-        "provide files locally")
+        f"download() disabled: this environment has no egress; place the "
+        f"file at {fname!r} (sha1 {sha1_hash or 'unchecked'}) manually")

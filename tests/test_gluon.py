@@ -323,3 +323,40 @@ def test_contrib_sync_batch_norm_layer():
     with autograd.record():
         y2 = ref(x)
     assert np.allclose(y.asnumpy(), y2.asnumpy(), atol=1e-5)
+
+
+def test_split_data_uneven():
+    data = nd.array(np.arange(10, dtype=np.float32).reshape(10, 1))
+    with pytest.raises(ValueError):
+        gluon.utils.split_data(data, 3)  # 10 % 3 != 0, even_split=True
+    parts = gluon.utils.split_data(data, 3, even_split=False)
+    # reference semantics: equal slices, remainder on the LAST one
+    assert [p.shape[0] for p in parts] == [3, 3, 4]
+    got = np.concatenate([p.asnumpy() for p in parts])
+    np.testing.assert_allclose(got, data.asnumpy())
+
+
+def test_check_sha1_and_download_shortcircuit(tmp_path):
+    import hashlib
+
+    f = tmp_path / "blob.bin"
+    f.write_bytes(b"mxtpu-test-payload")
+    sha = hashlib.sha1(b"mxtpu-test-payload").hexdigest()
+    assert gluon.utils.check_sha1(str(f), sha)
+    assert not gluon.utils.check_sha1(str(f), "0" * 40)
+    # a present file with the right hash must short-circuit (no egress)
+    out = gluon.utils.download("http://invalid.invalid/blob.bin",
+                               path=str(f), sha1_hash=sha)
+    assert out == str(f)
+    # a corrupt/absent file still refuses (no silent use of a bad blob)
+    with pytest.raises(RuntimeError):
+        gluon.utils.download("http://invalid.invalid/blob.bin",
+                             path=str(f), sha1_hash="0" * 40)
+
+
+def test_clip_global_norm_noop_below_threshold():
+    arrays = [nd.array(np.array([0.3, 0.4], np.float32))]
+    before = arrays[0].asnumpy().copy()
+    norm = gluon.utils.clip_global_norm(arrays, 10.0)
+    assert abs(norm - 0.5) < 1e-6
+    np.testing.assert_allclose(arrays[0].asnumpy(), before)
