@@ -129,3 +129,217 @@ def test_schedulers():
     assert w(0) == 0.0
     assert w(5) == 0.5
     assert w(20) == 1.0
+
+
+# ------------------------------------------------------------------------
+# step oracles: 5 updates of each optimizer vs an independent NumPy twin
+# (SGD/Adam have exact-math tests above; LBSGD's warmup schedule is covered
+# by test_optimizer_decreases_quadratic; SGLD gets a noise-statistics check)
+# (reference test_optimizer.py pattern: compare_optimizer against a python
+# reference implementation, including weight decay + grad clipping)
+# ------------------------------------------------------------------------
+
+_WD, _CLIP = 0.01, 0.5
+
+
+def _np_steps(update, n=5, seed=3, shape=(6,)):
+    rs = np.random.RandomState(seed)
+    w = rs.rand(*shape).astype(np.float32)
+    grads = [rs.randn(*shape).astype(np.float32) for _ in range(n)]
+    state = {}
+    for t, g in enumerate(grads, 1):
+        gc = np.clip(g, -_CLIP, _CLIP)
+        w = update(w, gc, state, t)
+    return w, grads
+
+
+def _mx_steps(opt, grads, seed=3, shape=(6,)):
+    rs = np.random.RandomState(seed)
+    w = nd.array(rs.rand(*shape).astype(np.float32))
+    state = opt.create_state(0, w)
+    for g in grads:
+        opt.update(0, w, nd.array(g), state)
+    return w.asnumpy()
+
+
+def _check_against(opt, np_update, atol=1e-5):
+    want, grads = _np_steps(np_update)
+    got = _mx_steps(opt, grads)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=atol)
+
+
+def test_nag_oracle():
+    lr, mom = 0.1, 0.9
+
+    def up(w, g, s, t):
+        g = g + _WD * w
+        m = mom * s.get("m", 0) + g
+        s["m"] = m
+        return w - lr * (g + mom * m)
+
+    _check_against(mx.optimizer.NAG(learning_rate=lr, momentum=mom, wd=_WD,
+                                    clip_gradient=_CLIP), up)
+
+
+def test_adagrad_oracle():
+    lr, eps = 0.1, 1e-7
+
+    def up(w, g, s, t):
+        s["h"] = s.get("h", 0) + g * g  # wd applies OUTSIDE the history
+        return w - lr * (g / np.sqrt(s["h"] + eps) + _WD * w)
+
+    _check_against(mx.optimizer.AdaGrad(learning_rate=lr, eps=eps, wd=_WD,
+                                        clip_gradient=_CLIP), up)
+
+
+def test_rmsprop_oracle():
+    lr, g1, eps = 0.01, 0.9, 1e-8
+
+    def up(w, g, s, t):
+        g = g + _WD * w
+        s["n"] = (1 - g1) * g * g + g1 * s.get("n", 0)
+        return w - lr * g / np.sqrt(s["n"] + eps)
+
+    _check_against(mx.optimizer.RMSProp(learning_rate=lr, gamma1=g1, wd=_WD,
+                                        clip_gradient=_CLIP), up)
+
+
+def test_rmsprop_centered_oracle():
+    lr, g1, g2, eps = 0.01, 0.9, 0.9, 1e-8
+
+    def up(w, g, s, t):
+        g = g + _WD * w
+        s["n"] = (1 - g1) * g * g + g1 * s.get("n", 0)
+        s["g"] = (1 - g1) * g + g1 * s.get("g", 0)
+        s["d"] = g2 * s.get("d", 0) - lr * g / np.sqrt(
+            s["n"] - s["g"] ** 2 + eps)
+        return w + s["d"]
+
+    _check_against(mx.optimizer.RMSProp(learning_rate=lr, gamma1=g1,
+                                        gamma2=g2, centered=True, wd=_WD,
+                                        clip_gradient=_CLIP), up)
+
+
+def test_adadelta_oracle():
+    rho, eps = 0.9, 1e-5
+
+    def up(w, g, s, t):
+        g = g + _WD * w
+        s["ag"] = rho * s.get("ag", 0) + (1 - rho) * g * g
+        delta = np.sqrt(s.get("ad", 0) + eps) / np.sqrt(s["ag"] + eps) * g
+        s["ad"] = rho * s.get("ad", 0) + (1 - rho) * delta * delta
+        return w - delta
+
+    _check_against(mx.optimizer.AdaDelta(rho=rho, epsilon=eps, wd=_WD,
+                                         clip_gradient=_CLIP), up)
+
+
+def test_ftrl_oracle():
+    lr, l1, beta = 0.1, 0.01, 1.0
+
+    def up(w, g, s, t):
+        n = s.get("n", 0)
+        sigma = (np.sqrt(n + g * g) - np.sqrt(n)) / lr
+        s["z"] = s.get("z", 0) + g - sigma * w
+        s["n"] = n + g * g
+        return (np.sign(s["z"]) * l1 - s["z"]) / (
+            (beta + np.sqrt(s["n"])) / lr + _WD) * (np.abs(s["z"]) > l1)
+
+    _check_against(mx.optimizer.Ftrl(learning_rate=lr, lamda1=l1, beta=beta,
+                                     wd=_WD, clip_gradient=_CLIP), up)
+
+
+def test_adamax_oracle():
+    lr, b1, b2 = 0.002, 0.9, 0.999
+
+    def up(w, g, s, t):
+        g = g + _WD * w
+        s["m"] = b1 * s.get("m", 0) + (1 - b1) * g
+        s["u"] = np.maximum(b2 * s.get("u", 0), np.abs(g))
+        return w - (lr / (1 - b1 ** t)) * s["m"] / (s["u"] + 1e-8)
+
+    _check_against(mx.optimizer.Adamax(learning_rate=lr, beta1=b1, beta2=b2,
+                                       wd=_WD, clip_gradient=_CLIP), up)
+
+
+def test_signum_oracle():
+    lr, mom, wd_lh = 0.01, 0.9, 0.001
+
+    def up(w, g, s, t):
+        m = mom * s.get("m", 0) - (1 - mom) * (g + _WD * w)
+        s["m"] = m
+        return (1 - lr * wd_lh) * w + lr * np.sign(m)
+
+    _check_against(mx.optimizer.Signum(learning_rate=lr, momentum=mom,
+                                       wd_lh=wd_lh, wd=_WD,
+                                       clip_gradient=_CLIP), up)
+
+
+def test_ftml_oracle():
+    lr, b1, b2, eps = 0.02, 0.6, 0.999, 1e-8
+
+    def up(w, g, s, t):
+        g = g + _WD * w
+        v = b2 * s.get("v", 0) + (1 - b2) * g * g
+        d = (1 - b1 ** t) / lr * (np.sqrt(v / (1 - b2 ** t)) + eps)
+        sigma = d - b1 * s.get("d", 0)
+        z = b1 * s.get("z", 0) + (1 - b1) * g - sigma * w
+        s["d"], s["v"], s["z"] = d, v, z
+        return -z / d
+
+    _check_against(mx.optimizer.FTML(learning_rate=lr, beta1=b1, beta2=b2,
+                                     epsilon=eps, wd=_WD,
+                                     clip_gradient=_CLIP), up)
+
+
+def test_nadam_oracle():
+    lr, b1, b2, eps, sd = 0.001, 0.9, 0.999, 1e-8, 0.004
+    sched = {"m": 1.0}
+
+    def up(w, g, s, t):
+        g = g + _WD * w
+        mt = b1 * (1 - 0.5 * 0.96 ** (t * sd))
+        mt1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * sd))
+        sched["m"] *= mt
+        m_next = sched["m"] * mt1
+        s["m"] = b1 * s.get("m", 0) + (1 - b1) * g
+        s["v"] = b2 * s.get("v", 0) + (1 - b2) * g * g
+        g_p = g / (1 - sched["m"])
+        m_p = s["m"] / (1 - m_next)
+        v_p = s["v"] / (1 - b2 ** t)
+        m_bar = (1 - mt) * g_p + mt1 * m_p
+        return w - lr * m_bar / (np.sqrt(v_p) + eps)
+
+    _check_against(mx.optimizer.Nadam(learning_rate=lr, beta1=b1, beta2=b2,
+                                      epsilon=eps, schedule_decay=sd,
+                                      wd=_WD, clip_gradient=_CLIP), up)
+
+
+def test_dcasgd_oracle():
+    lr, mom, lam = 0.05, 0.9, 0.04
+
+    def up(w, g, s, t):
+        comp = g + lam * g * g * (w - s.get("prev", w))
+        m = mom * s.get("m", 0) - lr * (comp + _WD * w)
+        s["m"] = m
+        s["prev"] = w
+        return w + m
+
+    _check_against(mx.optimizer.DCASGD(learning_rate=lr, momentum=mom,
+                                       lamda=lam, wd=_WD,
+                                       clip_gradient=_CLIP), up)
+
+
+def test_sgld_noise_statistics():
+    """SGLD is stochastic: check the drift matches -lr/2*g and the injected
+    noise has the Langevin std sqrt(lr) (reference: optimizer.py SGLD)."""
+    mx.random.seed(7)
+    lr = 0.01
+    opt = mx.optimizer.SGLD(learning_rate=lr, wd=0.0)
+    n = 20000
+    w = nd.array(np.zeros(n, np.float32))
+    g = np.full(n, 2.0, np.float32)
+    opt.update(0, w, nd.array(g), opt.create_state(0, w))
+    resid = w.asnumpy() - (-lr / 2 * g)
+    assert abs(resid.mean()) < 3e-3
+    assert abs(resid.std() - np.sqrt(lr)) < 3e-3
