@@ -286,6 +286,29 @@ class ColorJitterAug(Augmenter):
         return src
 
 
+def _color_augmenters(mean=None, std=None, brightness=0, contrast=0,
+                      saturation=0, pca_noise=0):
+    """The box-invariant color tail shared by CreateAugmenter and the
+    detection iterator's default list (color ops never move pixels, so
+    they are safe under fixed normalized bboxes)."""
+    auglist: List[Augmenter] = []
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, pca_noise=0, rand_gray=0, inter_method=2):
@@ -304,20 +327,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
-    if brightness or contrast or saturation:
-        auglist.append(ColorJitterAug(brightness, contrast, saturation))
-    if pca_noise > 0:
-        eigval = _np.array([55.46, 4.794, 1.148])
-        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
-                            [-0.5808, -0.0045, -0.8140],
-                            [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
-    if mean is True:
-        mean = _np.array([123.68, 116.28, 103.53])
-    if std is True:
-        std = _np.array([58.395, 57.12, 57.375])
-    if mean is not None and std is not None:
-        auglist.append(ColorNormalizeAug(mean, std))
+    auglist.extend(_color_augmenters(mean, std, brightness, contrast,
+                                     saturation, pca_noise))
     return auglist
 
 
@@ -463,6 +474,27 @@ class ImageDetIter(ImageIter):
         self.obj_width = int(obj_width)
         self._det_rand_mirror = rand_mirror
         kwargs.pop("label_width", None)
+        for geo in ("rand_crop", "rand_resize"):
+            if kwargs.pop(geo, False):
+                # cropping moves the box frame; without the reference's
+                # bbox-aware DetRandomCropAug the labels would be silently
+                # wrong — refuse instead (mirror IS box-aware here)
+                raise NotImplementedError(
+                    f"ImageDetIter does not support {geo}: only force-resize "
+                    "and rand_mirror adjust the normalized boxes correctly")
+        if kwargs.get("aug_list") is None:
+            # det-safe default (also when the caller passes aug_list=None —
+            # falling through to CreateAugmenter's CenterCrop would shift
+            # the box frame): FORCE resize to the output size (normalized
+            # boxes are invariant to it), then the box-invariant color tail
+            # (mean/std/brightness/... keep working like the reference's
+            # CreateDetAugmenter)
+            color = {k: kwargs.pop(k) for k in
+                     ("mean", "std", "brightness", "contrast", "saturation",
+                      "pca_noise") if k in kwargs}
+            kwargs["aug_list"] = [
+                ForceResizeAug((data_shape[2], data_shape[1])), CastAug(),
+            ] + _color_augmenters(**color)
         super().__init__(batch_size, data_shape, label_width=1,
                          path_imgrec=path_imgrec, rand_mirror=False, **kwargs)
 

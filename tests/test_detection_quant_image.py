@@ -236,3 +236,40 @@ def test_image_imdecode_imread(tmp_path):
     assert bgr.asnumpy()[0, 0, 2] == 200  # channel order flipped
     gray = mx.image.imdecode(buf, flag=0)
     assert gray.shape == (8, 10, 1)
+
+
+def test_image_det_iter_force_resize_and_crop_rejection(tmp_path):
+    """Non-square inputs FORCE-resize to data_shape (normalized boxes are
+    invariant); geometric crops without bbox adjustment are refused."""
+    from mxnet_tpu import recordio, image
+
+    path = str(tmp_path / "det2.rec")
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "det2.idx"), path, "w")
+    # 32x20 image: left half red, right half black; one box on the red half
+    img = np.zeros((20, 32, 3), np.uint8)
+    img[:, :16] = [255, 0, 0]
+    objs = np.array([[0.0, 0.0, 0.0, 0.5, 1.0]], np.float32)
+    w.write_idx(0, recordio.pack_img(
+        recordio.IRHeader(0, image.ImageDetIter.pack_label(objs), 0, 0),
+        img, img_fmt=".png"))
+    w.close()
+
+    it = image.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                            path_imgrec=path, max_objects=2)
+    batch = it.next()
+    data = batch.data[0].asnumpy()[0]       # (3, 16, 16)
+    lab = batch.label[0].asnumpy()[0, 0]
+    assert data.shape == (3, 16, 16)        # forced to shape, no crop
+    np.testing.assert_allclose(lab, [0.0, 0.0, 0.0, 0.5, 1.0], atol=1e-6)
+    # the box still covers the red region in the RESIZED frame
+    xmin, xmax = int(lab[1] * 16), int(lab[3] * 16)
+    red = data[0, :, xmin:max(xmax - 1, 1)]
+    assert red.mean() > 200, red.mean()
+    assert data[0, :, 12:].mean() < 50      # outside the box stays black
+
+    with pytest.raises(NotImplementedError):
+        image.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                           path_imgrec=path, rand_crop=True)
+    with pytest.raises(NotImplementedError):
+        image.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                           path_imgrec=path, rand_resize=True)
