@@ -15,7 +15,10 @@ from mxnet_tpu import autograd, gluon, nd
 
 def main(args):
     mx.random.seed(args.seed)  # adversarial dynamics are seed-sensitive;
-    rs = np.random.RandomState(args.seed)  # deterministic run end to end
+    np.random.seed(args.seed)  # initializers draw from the GLOBAL numpy
+    # stream — leaving it unseeded made every subprocess run a different
+    # GAN (flaky smoke tier); now the run is deterministic end to end
+    rs = np.random.RandomState(args.seed)
     # real data: ring of gaussians
     theta = rs.rand(args.n_real) * 2 * np.pi
     real = np.stack([np.cos(theta), np.sin(theta)], 1).astype(np.float32)
@@ -30,8 +33,10 @@ def main(args):
     G.initialize()
     D.initialize()
     bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
-    gt = gluon.Trainer(G.collect_params(), "adam", {"learning_rate": 2e-3})
-    dt = gluon.Trainer(D.collect_params(), "adam", {"learning_rate": 2e-3})
+    gt = gluon.Trainer(G.collect_params(), "adam",
+                       {"learning_rate": args.g_lr, "beta1": 0.5})
+    dt = gluon.Trainer(D.collect_params(), "adam",
+                       {"learning_rate": args.d_lr, "beta1": 0.5})
     ones = nd.ones((args.batch_size,))
     zeros = nd.zeros((args.batch_size,))
     for step in range(args.steps):
@@ -65,4 +70,6 @@ if __name__ == "__main__":
     p.add_argument("--steps", type=int, default=400)
     p.add_argument("--n-real", type=int, default=4096)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--g-lr", type=float, default=1e-3)
+    p.add_argument("--d-lr", type=float, default=2e-3)
     main(p.parse_args())

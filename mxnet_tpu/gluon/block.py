@@ -298,6 +298,10 @@ class HybridBlock(Block):
             f"{self.name}: cannot infer shape for {param.name}")
 
     def __call__(self, *args, **kwargs):
+        if args and isinstance(args[0], _symbol_cls()):
+            # symbolic trace (export / SymbolBlock composition): never route
+            # a Symbol through the jit cache
+            return self.forward(*args, **kwargs)
         # kwargs are not part of the cache key — run them through the eager
         # path rather than silently dropping them from a cached program
         if self._active and not kwargs:
@@ -305,6 +309,16 @@ class HybridBlock(Block):
         return super().__call__(*args, **kwargs)
 
     def forward(self, x, *args, **kwargs):
+        if isinstance(x, _symbol_cls()):
+            from .. import symbol as sym_mod
+
+            # reference semantics: hybrid_forward(F=symbol, x, **param_vars)
+            # builds the deploy graph; Parameter.var() carries the full name
+            # (for by-name .params binding) plus lr_mult/wd_mult attrs, and
+            # is cached so shared sub-blocks contribute ONE variable node
+            params = {name: p.var()
+                      for name, p in self._reg_params.items()}
+            return self.hybrid_forward(sym_mod, x, *args, **kwargs, **params)
         params = {}
         for name, p in self._reg_params.items():
             try:
@@ -419,17 +433,28 @@ class HybridBlock(Block):
         return op, n_out, structure["out_struct"], updated_idx
 
     def export(self, path, epoch=0, remove_amp_cast=True):
-        """Export symbol+params for deployment (reference: block.py:869)."""
+        """Export a REAL traced symbol + params for deployment (reference:
+        block.py:869) — the result loads back through
+        ``SymbolBlock.imports(path + "-symbol.json", ["data"], ...)`` or any
+        symbol consumer (Module, the C predict API).
+
+        The graph comes from running ``hybrid_forward`` with the symbol
+        namespace as ``F`` and one variable named ``data`` — so export
+        requires a single-input block whose parameters are initialized
+        (run one forward first for deferred shapes)."""
         from .. import symbol as sym_mod
         from .. import ndarray as nd
 
-        params = {f"arg:{name}": p.data()
-                  for name, p in self.collect_params().items()}
+        out = self(sym_mod.var("data"))
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        out.save(f"{path}-symbol.json")
+        aux_names = set(out.list_auxiliary_states())
+        params = {}
+        for name, p in self.collect_params().items():
+            kind = "aux" if p.name in aux_names else "arg"
+            params[f"{kind}:{p.name}"] = p.data()
         nd.save(f"{path}-{epoch:04d}.params", params)
-        # a JSON stub marking the entry; full symbol export requires sym tracing
-        with open(f"{path}-symbol.json", "w") as f:
-            f.write('{"nodes": [], "format": "tpu-mx-hybrid", "note": '
-                    '"use load_parameters + the Python Block definition"}')
 
 
 class _NDFrontend:
@@ -443,12 +468,28 @@ class _NDFrontend:
 
 _NDF = _NDFrontend()
 
+_SYMBOL_CLS = None
+
+
+def _symbol_cls():
+    """Symbol type, resolved once (lazy: block.py loads before symbol during
+    package init, so a top-level import would cycle)."""
+    global _SYMBOL_CLS
+    if _SYMBOL_CLS is None:
+        from ..symbol import Symbol
+
+        _SYMBOL_CLS = Symbol
+    return _SYMBOL_CLS
+
 
 class SymbolBlock(HybridBlock):
     """Wrap a Symbol as a Block (reference: gluon/block.py SymbolBlock)."""
 
     def __init__(self, outputs, inputs, params=None):
-        super().__init__(prefix=None, params=params)
+        # empty prefix: parameter names must equal the symbol's argument
+        # names verbatim (reference SymbolBlock), or by-name loading of
+        # exported .params files cannot match
+        super().__init__(prefix="", params=params)
         from .. import symbol as sym_mod
 
         if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
@@ -474,6 +515,10 @@ class SymbolBlock(HybridBlock):
     def forward(self, *args):
         from ..executor import Executor
 
+        if args and isinstance(args[0], _symbol_cls()):
+            # symbolic composition (e.g. a SymbolBlock inside an exported
+            # net): splice the wrapped graph in by replacing its input vars
+            return self._symbol(**dict(zip(self._input_names, args)))
         env = dict(zip(self._input_names, args))
         arg_dict = {}
         for name in self._symbol.list_arguments():

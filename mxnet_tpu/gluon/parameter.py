@@ -177,8 +177,14 @@ class Parameter:
     def var(self):
         from .. import symbol as sym
 
-        return sym.var(self.name, shape=self.shape, dtype=self.dtype,
-                       lr_mult=self.lr_mult, wd_mult=self.wd_mult)
+        # cached (reference Parameter.var): a SHARED sub-block invoked
+        # twice in one trace must contribute ONE variable node, not two
+        # same-named duplicates that misalign positional bind lists
+        if getattr(self, "_var", None) is None:
+            self._var = sym.var(self.name, shape=self.shape,
+                                dtype=self.dtype, lr_mult=self.lr_mult,
+                                wd_mult=self.wd_mult)
+        return self._var
 
 
 def _zeros_like_data(arr: NDArray):

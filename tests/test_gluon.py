@@ -360,3 +360,62 @@ def test_clip_global_norm_noop_below_threshold():
     norm = gluon.utils.clip_global_norm(arrays, 10.0)
     assert abs(norm - 0.5) < 1e-6
     np.testing.assert_allclose(arrays[0].asnumpy(), before)
+
+
+def test_export_produces_real_symbol_and_roundtrips(tmp_path):
+    """export() writes a TRACED symbol (not a stub) that reloads through
+    SymbolBlock.imports AND binds as a plain Symbol — the deploy contract
+    (reference gluon/block.py HybridBlock.export + SymbolBlock.imports)."""
+    rs = np.random.RandomState(0)
+    cnn = gluon.nn.HybridSequential()
+    cnn.add(gluon.nn.Conv2D(4, 3, padding=1), gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"), gluon.nn.MaxPool2D(),
+            gluon.nn.Flatten(), gluon.nn.Dense(2))
+    cnn.initialize()
+    x = nd.array(rs.rand(2, 3, 8, 8).astype(np.float32))
+    want = cnn(x).asnumpy()  # eval-mode BN
+    path = str(tmp_path / "net")
+    cnn.export(path, epoch=3)
+
+    back = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                     path + "-0003.params")
+    np.testing.assert_allclose(back(x).asnumpy(), want, rtol=1e-4,
+                               atol=1e-5)
+    # the symbol is a real graph with aux states classified
+    sym = mx.sym.load(path + "-symbol.json")
+    aux = sym.list_auxiliary_states()
+    # name counters are process-global: match by suffix, not exact prefix
+    assert any(a.endswith("_running_mean") for a in aux), aux
+    assert any(a.endswith("_running_var") for a in aux), aux
+    assert len(sym.list_arguments()) > 1
+    # params file uses arg:/aux: prefixes (Module.load_checkpoint format)
+    loaded = mx.nd.load(path + "-0003.params")
+    assert any(k.startswith("aux:") for k in loaded)
+    assert any(k.startswith("arg:") for k in loaded)
+
+
+def test_export_shared_subblock_single_var(tmp_path):
+    """A sub-block invoked twice in one forward exports ONE variable per
+    parameter (cached Parameter.var), so positional bind lists align."""
+    class Twice(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = gluon.nn.Dense(4, in_units=4)
+
+        def hybrid_forward(self, F, x):
+            return self.d(x) + self.d(self.d(x))
+
+    net = Twice()
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).rand(2, 4).astype(np.float32))
+    want = net(x).asnumpy()
+    path = str(tmp_path / "twice")
+    net.export(path)
+    sym = mx.sym.load(path + "-symbol.json")
+    args = sym.list_arguments()
+    assert len(args) == len(set(args)), args  # no duplicate names
+    back = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                     path + "-0000.params")
+    np.testing.assert_allclose(back(x).asnumpy(), want, rtol=1e-5,
+                               atol=1e-6)
