@@ -448,12 +448,15 @@ class HybridBlock(Block):
         out = self(sym_mod.var("data"))
         if isinstance(out, (list, tuple)):
             out = sym_mod.Group(list(out))
-        out.save(f"{path}-symbol.json")
         aux_names = set(out.list_auxiliary_states())
+        # materialize every parameter BEFORE writing either file: a
+        # deferred-init error must not leave a fresh symbol.json next to a
+        # stale/absent .params from an earlier export
         params = {}
         for name, p in self.collect_params().items():
             kind = "aux" if p.name in aux_names else "arg"
             params[f"{kind}:{p.name}"] = p.data()
+        out.save(f"{path}-symbol.json")
         nd.save(f"{path}-{epoch:04d}.params", params)
 
 
@@ -517,8 +520,30 @@ class SymbolBlock(HybridBlock):
 
         if args and isinstance(args[0], _symbol_cls()):
             # symbolic composition (e.g. a SymbolBlock inside an exported
-            # net): splice the wrapped graph in by replacing its input vars
-            return self._symbol(**dict(zip(self._input_names, args)))
+            # net): copy the op nodes but SHARE parameter/aux var nodes, so
+            # two splices of one SymbolBlock contribute each parameter ONCE
+            # (Symbol.__call__'s full deep copy would duplicate the names)
+            from ..symbol.graph import Node, SymbolEntry
+
+            repl = dict(zip(self._input_names,
+                            [a._entries[0] for a in args]))
+            memo = {}
+
+            def copy_entry(entry):
+                n = entry.node
+                if n.kind == "var":
+                    if n.name in repl:
+                        return repl[n.name]
+                    return entry  # shared parameter/aux node
+                if id(n) not in memo:
+                    nn = Node(n.kind, n.name, n.op, dict(n.attrs), [],
+                              dict(n.attr_dict))
+                    memo[id(n)] = nn
+                    nn.inputs = [copy_entry(e) for e in n.inputs]
+                return SymbolEntry(memo[id(n)], entry.index)
+
+            cls = _symbol_cls()
+            return cls([copy_entry(e) for e in self._symbol._entries])
         env = dict(zip(self._input_names, args))
         arg_dict = {}
         for name in self._symbol.list_arguments():

@@ -108,6 +108,7 @@ class Parameter:
                                 grad_reqs=[self._grad_req])
 
     def _finish_deferred_init(self, shape):
+        self._var = None  # cached symbol var would carry the stale shape
         if self._deferred_init is None:
             raise DeferredInitializationError(self.name)
         self.shape = tuple(shape)
@@ -168,6 +169,7 @@ class Parameter:
             self._data._data = self._data.as_in_context(ctx[0])._data
 
     def cast(self, dtype):
+        self._var = None  # cached symbol var would carry the stale dtype
         self.dtype = dtype
         if self._data is not None:
             self._data._data = self._data.astype(dtype)._data

@@ -201,3 +201,43 @@ def test_predict_api_loads_checkpoint_and_infers(rt, tmp_path):
     expect = x @ w.T + b
     assert np.allclose(np.array(out).reshape(2, 4), expect, atol=1e-5)
     assert rt.mxtpu_pred_free(ctypes.c_int64(h)) == 0
+
+
+def test_predict_api_consumes_gluon_export(rt, tmp_path):
+    """The C predict path loads a GLUON-exported net (traced symbol +
+    arg:/aux: params) — the full deploy chain: train in Python, export,
+    serve from C (reference: c_predict_api consuming gluon exports)."""
+    import mxnet_tpu as _mx
+    from mxnet_tpu import gluon as _gluon, nd as _nd
+
+    rs = np.random.RandomState(0)
+    net = _gluon.nn.HybridSequential()
+    net.add(_gluon.nn.Dense(8, activation="relu"), _gluon.nn.Dense(3))
+    net.initialize()
+    x = rs.rand(2, 5).astype(np.float32)
+    want = net(_nd.array(x)).asnumpy()
+    path = str(tmp_path / "cdeploy")
+    net.export(path)
+
+    rt.mxtpu_pred_create.restype = ctypes.c_int64
+    with open(path + "-symbol.json") as f:
+        sym_json = f.read()
+    names = (ctypes.c_char_p * 1)(b"data")
+    shapes = (ctypes.c_int64 * 2)(2, 5)
+    ndims = (ctypes.c_int * 1)(2)
+    h = rt.mxtpu_pred_create(sym_json.encode(),
+                             (path + "-0000.params").encode(),
+                             names, shapes, ndims, 1)
+    assert h > 0, rt.mxtpu_rt_last_error()
+    xc = np.ascontiguousarray(x)
+    fp = ctypes.POINTER(ctypes.c_float)
+    assert rt.mxtpu_pred_set_input(ctypes.c_int64(h), b"data",
+                                   xc.ctypes.data_as(fp), shapes, 2) == 0
+    assert rt.mxtpu_pred_forward(ctypes.c_int64(h)) == 0, \
+        rt.mxtpu_rt_last_error()
+    out = np.zeros((2, 3), np.float32)
+    assert rt.mxtpu_pred_get_output(ctypes.c_int64(h), 0,
+                                    out.ctypes.data_as(fp),
+                                    ctypes.c_int64(out.size)) == 0
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+    rt.mxtpu_pred_free(ctypes.c_int64(h))
