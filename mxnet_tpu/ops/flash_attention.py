@@ -1,0 +1,227 @@
+"""Pallas flash attention (single-chip; the ring carries it across chips).
+
+Forward is one Pallas kernel: for each (batch*head, q-block) program, k/v
+blocks stream through VMEM with the online-softmax m/l recurrence, so HBM
+traffic is O(T*D) and nothing T×T ever materializes — the standard
+flash-attention scheme mapped to the TPU memory hierarchy (VMEM blocks,
+MXU matmuls; /opt/skills/guides/pallas_guide.md patterns).  The reference
+has no analogue (2018 softmax(QK^T)V materializes the scores); SURVEY §5.7
+makes long-context first-class, and this is the single-device leg the
+sequence-parallel ring composes with (`parallel/ring_attention.py` holds
+the cross-chip m/l merge).
+
+Backward is the memory-efficient recompute form as a lax.scan over k/v
+blocks (one (Bq, Bk) score tile live at a time) — XLA fuses it well and it
+keeps O(T) residency without a second hand kernel.
+
+On CPU (tests, virtual meshes) the SAME kernel runs through the Pallas
+interpreter (`MXTPU_PALLAS_INTERPRET` / non-TPU backend, like the other
+kernels in pallas_kernels.py).  Oracle: tests/test_flash_attention.py
+checks outputs AND gradients against `parallel.ring_attention.local_attention`.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+
+def _use_interpret():
+    # lazy: pallas_kernels re-exports flash_attention from here, so a
+    # top-level back-import would be circular when this module loads first
+    from .pallas_kernels import _use_interpret as impl
+
+    return impl()
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                bq: int, bk: int, causal: bool, scale: float, t_real: int):
+    # grid = (bh, q blocks, k blocks); kj is the INNERMOST (sequential)
+    # dim, so the VMEM scratch (acc/m/l) carries the online-softmax state
+    # across k blocks while only ONE (bk, d) k/v tile is resident — true
+    # streaming, VMEM use independent of T
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: blocks strictly above the diagonal contribute nothing
+    live = (kj * bk <= (qi + 1) * bq - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale      # (bq, d)
+        k = k_ref[0].astype(jnp.float32)              # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = kpos < t_real                          # padding tail
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (kpos <= qpos)
+        s = jnp.where(mask, s, _NEG)
+        m_old = m_ref[:, 0]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_old - m_new)
+        l_ref[:, 0] = alpha * l_ref[:, 0] + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, 0] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
+                    ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("t_real", "causal", "bq", "bk",
+                                             "scale", "interpret"))
+def _fwd_call(q3, k3, v3, t_real, causal, bq, bk, scale, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t_pad, d = q3.shape
+    grid = (bh, t_pad // bq, t_pad // bk)
+    kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, causal=causal,
+                               scale=scale, t_real=t_real)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+                  pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+                  pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0))],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+def _bwd_scan(q3, k3, v3, o3, g3, t_real, causal, scale, bk):
+    """Memory-efficient backward: scan over k/v blocks, one (T, bk) tile
+    live; standard flash-attention recompute with delta = sum(g*o)."""
+    bh, t, d = q3.shape
+    q = q3.astype(jnp.float32) * scale
+    g = g3.astype(jnp.float32)
+    o = o3.astype(jnp.float32)
+    delta = jnp.sum(g * o, axis=-1)                    # (bh, t)
+
+    # logsumexp per row, recomputed blockwise (cheap: one pass)
+    def lse_body(carry, j):
+        m, l = carry
+        k = jax.lax.dynamic_slice(k3, (0, j * bk, 0), (bh, bk, d)) \
+            .astype(jnp.float32)
+        s = jnp.einsum("btd,bkd->btk", q, k)
+        s = s + _mask(j, bk, t, t_real, causal)
+        m_new = jnp.maximum(m, jnp.max(s, axis=2))
+        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[..., None]),
+                                             axis=2)
+        return (m_new, l), None
+
+    nk = t // bk
+    (m, l), _ = jax.lax.scan(lse_body,
+                             (jnp.full((bh, t), _NEG, jnp.float32),
+                              jnp.zeros((bh, t), jnp.float32)),
+                             jnp.arange(nk))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+
+    def grad_body(dq, j):
+        k = jax.lax.dynamic_slice(k3, (0, j * bk, 0), (bh, bk, d)) \
+            .astype(jnp.float32)
+        v = jax.lax.dynamic_slice(v3, (0, j * bk, 0), (bh, bk, d)) \
+            .astype(jnp.float32)
+        s = jnp.einsum("btd,bkd->btk", q, k) + _mask(j, bk, t, t_real,
+                                                     causal)
+        p = jnp.exp(s - lse[..., None])                # (bh, t, bk)
+        dv = jnp.einsum("btk,btd->bkd", p, g)
+        dp = jnp.einsum("btd,bkd->btk", g, v)
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("btk,bkd->btd", ds, k)
+        dk = jnp.einsum("btk,btd->bkd", ds, q)
+        return dq, (dk, dv)
+
+    dq, (dks, dvs) = jax.lax.scan(grad_body,
+                                  jnp.zeros((bh, t, d), jnp.float32),
+                                  jnp.arange(nk))
+    dk = jnp.moveaxis(dks, 0, 1).reshape(bh, t, d)
+    dv = jnp.moveaxis(dvs, 0, 1).reshape(bh, t, d)
+    return (dq * scale).astype(q3.dtype), dk.astype(k3.dtype), \
+        dv.astype(v3.dtype)
+
+
+def _mask(j, bk, t, t_real, causal):
+    kpos = j * bk + jnp.arange(bk)[None, :]            # (1, bk)
+    qpos = jnp.arange(t)[:, None]                      # (t, 1)
+    ok = kpos < t_real
+    if causal:
+        ok = ok & (kpos <= qpos)
+    return jnp.where(ok, 0.0, _NEG)[None]              # (1, t, bk)
+
+
+def _pad_to(x, t_pad):
+    pad = t_pad - x.shape[1]
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, t_real, causal, blocks, scale):
+    bq, bk = blocks
+    t_pad = ((t_real + bq - 1) // bq) * bq
+    t_pad = ((t_pad + bk - 1) // bk) * bk
+    out = _fwd_call(_pad_to(q3, t_pad), _pad_to(k3, t_pad),
+                    _pad_to(v3, t_pad), t_real, causal, bq, bk, scale,
+                    _use_interpret())
+    return out[:, :t_real]
+
+
+def _flash_fwd(q3, k3, v3, t_real, causal, blocks, scale):
+    out = _flash(q3, k3, v3, t_real, causal, blocks, scale)
+    return out, (q3, k3, v3, out)
+
+
+def _flash_bwd(t_real, causal, blocks, scale, res, g):
+    q3, k3, v3, out = res
+    bq, bk = blocks
+    t_pad = ((t_real + bk - 1) // bk) * bk
+    dq, dk, dv = _bwd_scan(_pad_to(q3, t_pad), _pad_to(k3, t_pad),
+                           _pad_to(v3, t_pad), _pad_to(out, t_pad),
+                           _pad_to(g, t_pad), t_real, causal, scale, bk)
+    return dq[:, :t_real], dk[:, :t_real], dv[:, :t_real]
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None,
+                    block_q: int = 128, block_k: int = 128):
+    """(B, T, H, D) attention with O(T) memory.  Drop-in for
+    `parallel.ring_attention.local_attention` (same signature/semantics,
+    incl. the optional softmax scale), usable as the `attention=` callable
+    of the transformer LM and behind the `_contrib_flash_attention` op."""
+    B, T, H, D = q.shape
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    if T >= block_q:
+        bq = block_q
+    else:
+        bq = max(16, 1 << (T - 1).bit_length())  # next pow2, >= 16
+    bk = min(block_k, bq)
+    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    out = _flash(to3(q), to3(k), to3(v), T, causal, (bq, bk), scale)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3).astype(q.dtype)

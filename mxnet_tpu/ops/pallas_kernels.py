@@ -137,99 +137,11 @@ def twobit_unpack(packed, shape, threshold, dtype=jnp.float32):
 
 
 # ---------------------------------------------------------------------------
-# Flash attention (SURVEY.md §5.7 sequence scaling; the fused-softmax kernel
-# the reference era never had).  One pass over KV per query block with the
-# online-softmax running max/denominator — attention scores never hit HBM.
-# ---------------------------------------------------------------------------
-
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
-                      scale, causal):
-    qi = pl.program_id(0)
-    q = q_ref[:].astype(jnp.float32) * scale          # (block_q, D)
-    T, D = k_ref.shape
-    m = jnp.full((block_q,), -1e30, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, D), jnp.float32)
-    q_pos = qi * block_q + jax.lax.iota(jnp.int32, block_q)
-    for start in range(0, T, block_k):                # static unroll
-        k = k_ref[start:start + block_k, :].astype(jnp.float32)
-        v = v_ref[start:start + block_k, :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            k_pos = start + jax.lax.iota(jnp.int32, block_k)
-            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, -1e30)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m = m_new
-    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
-
-
-@functools.partial(jax.jit,
-                   static_argnames=("scale", "block_q", "block_k", "causal",
-                                    "interpret"))
-def _flash_call(q3, k3, v3, scale, block_q, block_k, causal, interpret):
-    # q3/k3/v3: (BH, T, D) — one grid row per (batch*head, q-block)
-    BH, T, D = q3.shape
-    grid = (T // block_q, BH)
-    return pl.pallas_call(
-        functools.partial(_flash_fwd_kernel, block_q=block_q,
-                          block_k=block_k, scale=float(scale),
-                          causal=causal),
-        grid=grid,
-        in_specs=[pl.BlockSpec((None, block_q, D),
-                               lambda qi, bh: (bh, qi, 0)),
-                  pl.BlockSpec((None, T, D), lambda qi, bh: (bh, 0, 0)),
-                  pl.BlockSpec((None, T, D), lambda qi, bh: (bh, 0, 0))],
-        out_specs=pl.BlockSpec((None, block_q, D),
-                               lambda qi, bh: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
-        interpret=interpret,
-    )(q3, k3, v3)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=False, scale=None):
-    """Fused attention over (B, T, H, D) tensors — the Pallas analogue of
-    ``parallel.ring_attention.local_attention`` (same layout and numerics).
-
-    Forward runs the flash kernel (scores stay in VMEM); backward falls
-    back to the standard jnp attention vjp (recompute) so training works
-    everywhere the forward does.
-    """
-    return _flash_fwd(q, k, v, causal, scale)[0]
-
-
-def _flash_fwd(q, k, v, causal, scale):
-    B, T, H, D = q.shape
-    import math
-    scale_v = scale if scale is not None else 1.0 / math.sqrt(D)
-    block_q = min(128, T)
-    while T % block_q:
-        block_q //= 2
-    block_k = block_q
-    to3 = lambda a: jnp.moveaxis(a, 2, 1).reshape(B * H, T, D)  # noqa: E731
-    o3 = _flash_call(to3(q), to3(k), to3(v), float(scale_v), block_q,
-                     block_k, bool(causal), _use_interpret())
-    out = jnp.moveaxis(o3.reshape(B, H, T, D), 1, 2)
-    return out, (q, k, v)
-
-
-def _flash_bwd(causal, scale, res, g):
-    from ..parallel.ring_attention import local_attention
-
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: local_attention(
-        q_, k_, v_, causal=causal, scale=scale), q, k, v)
-    return vjp(g)
-
-
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+# Flash attention moved to its own module (ops/flash_attention.py):
+# fori-loop KV streaming with causal block skipping, arbitrary T via
+# padding+masking, and a memory-efficient scan backward.  Re-exported here
+# so pk.flash_attention remains the stable name (tpu_parity, contrib op).
+from .flash_attention import flash_attention  # noqa: E402,F401
 
 
 # ---------------------------------------------------------------------------
