@@ -126,6 +126,21 @@ class DataParallelTrainer:
                 self._mesh = fallback
         self._axis = dp_axis
         self._block = block
+        if isinstance(loss_fn, Block):
+            # gluon Loss blocks work as-is: run them over NDArray views of
+            # the traced values inside the step (same mechanism hybridize
+            # uses), so users pass gluon.loss.* directly
+            _loss_block = loss_fn
+
+            def loss_fn(pred, y):  # noqa: F811
+                # pause: without it a step() issued inside autograd.record()
+                # would record the block's traced ops on the global eager
+                # tape and poison the next eager backward (same guard as
+                # block_train_fn above)
+                with autograd.pause(train_mode=True):
+                    out = _loss_block(NDArray(pred), NDArray(y))
+                return out._data
+
         self._loss_fn = loss_fn
         self._lr = lr
         self._momentum = momentum

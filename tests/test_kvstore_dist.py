@@ -23,7 +23,10 @@ def _free_port():
     return port
 
 
-def _run_workers(script, num_workers, timeout=120, extra_env=None):
+def _run_workers(script, num_workers, timeout=300, extra_env=None):
+    # 300s: three cold interpreter starts (jax import each) on the 1-core
+    # CI host can exceed 120s when a heavy tier (zoo sweep) ran just
+    # before — the PS logic itself completes in seconds once up
     port = _free_port()
     procs = []
     for rank in range(num_workers):

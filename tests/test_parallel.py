@@ -306,3 +306,43 @@ def test_moe_over_capacity_drops_to_zero():
     # kept tokens went through expert 2 (scale 3): output = 3 * ones * gate
     scaled = out[kept] / out[kept][0, 0]
     assert np.allclose(scaled, 1.0, atol=1e-5)
+
+
+def test_data_parallel_accepts_gluon_loss_block():
+    """gluon.loss.* blocks work directly as DataParallelTrainer loss_fn
+    (wrapped over NDArray views inside the traced step)."""
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).rand(2, 3).astype(np.float32))
+    net(x)
+    tr = DataParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             mesh=None, lr=0.1)
+    y = np.random.RandomState(1).randint(0, 4, 16).astype(np.float32)
+    xs = np.random.RandomState(2).rand(16, 3).astype(np.float32)
+    l0 = float(tr.step(xs, y))
+    for _ in range(20):
+        loss = tr.step(xs, y)
+    assert float(loss) < l0, (l0, float(loss))
+
+
+def test_data_parallel_step_under_record_does_not_poison_tape():
+    """step() inside autograd.record() (a migration habit) must not leak
+    tracers onto the global eager tape via a gluon Loss block."""
+    from mxnet_tpu import autograd
+
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).rand(2, 3).astype(np.float32))
+    net(x)
+    tr = DataParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             mesh=None, lr=0.1)
+    with autograd.record():
+        tr.step(np.random.RandomState(1).rand(8, 3).astype(np.float32),
+                np.random.RandomState(2).randint(0, 4, 8)
+                .astype(np.float32))
+    # an ordinary eager record/backward afterwards must still work
+    w = nd.array(np.ones(3, np.float32))
+    w.attach_grad()
+    with autograd.record():
+        (w * w).sum().backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), 2 * np.ones(3))
