@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Transformer-LM throughput bench: local vs flash attention, single chip
+(no reference twin — the 2018 codebase has no transformer; SURVEY §5.7
+makes long-context first-class and this measures its two attention legs).
+
+Prints one JSON line per configuration with tokens/sec (chained-args
+timing: each step consumes the previous step's params so nothing can be
+elided — same discipline as bench.py / tools/perf_sweep.py).
+
+CPU smoke: --smoke (tiny shapes, validates the harness hermetically).
+On a TPU host run as-is; flash streams k/v through VMEM so the memory
+ceiling is O(T) and long sequences fit where dense attention OOMs.
+"""
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.flash_attention import flash_attention
+from mxnet_tpu.parallel import transformer as tr
+from mxnet_tpu.parallel.ring_attention import local_attention
+
+
+def bench_step(cfg, B, T, attention, steps):
+    params = tr.transformer_lm_init(cfg, jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(0, cfg.vocab, (B, T)).astype(np.int32))
+    labels = jnp.asarray(np.roll(np.asarray(tokens), -1, 1))
+    positions = jnp.arange(T, dtype=jnp.int32)
+
+    step = jax.jit(functools.partial(
+        tr.train_step, cfg=cfg, lr=0.1, attention=attention),
+        donate_argnums=(0, 1))
+    momenta = {k: jnp.zeros_like(v) for k, v in params.items()}
+    t0 = time.perf_counter()
+    loss, params, momenta = step(params, momenta, tokens, labels, positions)
+    float(loss)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, momenta = step(params, momenta, tokens, labels,
+                                     positions)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return B * T * steps / dt, compile_s
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--d-model", type=int, default=512)
+    ap.add_argument("--n-heads", type=int, default=8)
+    ap.add_argument("--n-layers", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes; hermetic CPU harness validation")
+    args = ap.parse_args()
+    if args.smoke:
+        args.batch, args.seq_len, args.d_model = 2, 128, 64
+        args.n_heads, args.n_layers, args.steps = 2, 2, 2
+
+    cfg = tr.TransformerConfig(
+        vocab=1024, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=4 * args.d_model,
+        max_len=args.seq_len)
+    print(f"backend: {jax.default_backend()}", file=sys.stderr)
+    for name, att in [("local", functools.partial(local_attention,
+                                                  causal=True)),
+                      ("flash", functools.partial(flash_attention,
+                                                  causal=True))]:
+        try:
+            toks, compile_s = bench_step(cfg, args.batch, args.seq_len,
+                                         att, args.steps)
+            print(json.dumps({
+                "metric": f"transformer_lm_{name}", "value": round(toks, 1),
+                "unit": "tokens/sec",
+                "B": args.batch, "T": args.seq_len,
+                "d_model": args.d_model, "layers": args.n_layers,
+                "compile_s": round(compile_s, 1)}), flush=True)
+        except Exception as e:  # OOM at long T is a RESULT for dense attn
+            print(json.dumps({
+                "metric": f"transformer_lm_{name}", "value": None,
+                "error": f"{type(e).__name__}: {e}"[:160]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,21 +24,24 @@ def _python_blocks():
 def test_tutorial_blocks_execute(tmp_path):
     from mxnet_tpu import _native, recordio
 
-    if _native.lib() is None:
-        pytest.skip("native runtime unavailable (ImageRecordIter block)")
     blocks = _python_blocks()
     assert len(blocks) >= 5, "tutorial lost its code blocks?"
 
-    # the data-pipeline block reads train.rec from cwd
-    rs = np.random.RandomState(0)
-    w = recordio.MXRecordIO(str(tmp_path / "train.rec"), "w")
-    for i in range(8):
-        img = (rs.rand(224, 224, 3) * 255).astype(np.uint8)
-        enc = b"RAW0" + struct.pack("<I", 3) + \
-            np.asarray(img.shape, np.int32).tobytes() + img.tobytes()
-        w.write(recordio.pack(recordio.IRHeader(0, float(i % 10), i, 0),
-                              enc))
-    w.close()
+    if _native.lib() is None:
+        # only the ImageRecordIter block needs the native runtime — keep
+        # verifying the other blocks (Module/Gluon/mesh/deploy) regardless
+        blocks = [b for b in blocks if "ImageRecordIter" not in b]
+    else:
+        # the data-pipeline block reads train.rec from cwd
+        rs = np.random.RandomState(0)
+        w = recordio.MXRecordIO(str(tmp_path / "train.rec"), "w")
+        for i in range(8):
+            img = (rs.rand(224, 224, 3) * 255).astype(np.uint8)
+            enc = b"RAW0" + struct.pack("<I", 3) + \
+                np.asarray(img.shape, np.int32).tobytes() + img.tobytes()
+            w.write(recordio.pack(recordio.IRHeader(0, float(i % 10), i, 0),
+                                  enc))
+        w.close()
 
     script = "\n\n".join(blocks)
     env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu",

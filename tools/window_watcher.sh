@@ -30,6 +30,17 @@ print('PROBE_OK', d[0].platform)" 2>/tmp/window_watcher_probe.err | grep -q PROB
     timeout 2700 python tools/tpu_parity.py 2>&1 | tail -8
     echo "== bench.py =="
     BENCH_RETRY_BUDGET=600 timeout 4000 python bench.py 2>/tmp/bench_watch_err.txt
+    echo "== transformer lm bench =="
+    # write to /tmp and promote only on success — a timeout must not leave
+    # an empty artifact (same rule as the perf_sweep file above)
+    if timeout 1500 python benchmark/python/transformer/lm_bench.py \
+        --steps 5 > /tmp/tf_bench.jsonl 2>/tmp/tf_bench_err.txt \
+        && [ -s /tmp/tf_bench.jsonl ]; then
+      cp /tmp/tf_bench.jsonl TRANSFORMER_BENCH_r05.jsonl
+      cat TRANSFORMER_BENCH_r05.jsonl
+    else
+      echo "transformer bench produced no artifact"
+    fi
     echo "$(date -u +%FT%TZ) measurement list DONE"
     exit 0
   fi
