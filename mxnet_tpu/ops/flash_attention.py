@@ -104,7 +104,10 @@ def _fwd_call(q3, k3, v3, t_real, causal, bq, bk, scale, interpret):
                   pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
                   pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0))],
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype),
+        # inside shard_map (Ulysses impl="flash") the output must carry the
+        # inputs' varying-mesh-axes annotation or check_vma rejects it
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype,
+                                       vma=jax.typeof(q3).vma),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32)],
@@ -134,9 +137,11 @@ def _bwd_scan(q3, k3, v3, o3, g3, t_real, causal, scale, bk):
         return (m_new, l), None
 
     nk = t // bk
-    (m, l), _ = jax.lax.scan(lse_body,
-                             (jnp.full((bh, t), _NEG, jnp.float32),
-                              jnp.zeros((bh, t), jnp.float32)),
+    # carries derive from q so they inherit its varying-mesh-axes (vma)
+    # annotation — plain jnp.zeros carries would fail lax.scan's type check
+    # inside shard_map (the Ulysses impl="flash" path)
+    row0 = jnp.zeros_like(q[:, :, 0])
+    (m, l), _ = jax.lax.scan(lse_body, (row0 + _NEG, row0),
                              jnp.arange(nk))
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
 
@@ -155,8 +160,7 @@ def _bwd_scan(q3, k3, v3, o3, g3, t_real, causal, scale, bk):
         dk = jnp.einsum("btk,btd->bkd", ds, q)
         return dq, (dk, dv)
 
-    dq, (dks, dvs) = jax.lax.scan(grad_body,
-                                  jnp.zeros((bh, t, d), jnp.float32),
+    dq, (dks, dvs) = jax.lax.scan(grad_body, jnp.zeros_like(q),
                                   jnp.arange(nk))
     dk = jnp.moveaxis(dks, 0, 1).reshape(bh, t, d)
     dv = jnp.moveaxis(dvs, 0, 1).reshape(bh, t, d)

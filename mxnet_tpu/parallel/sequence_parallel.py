@@ -22,10 +22,15 @@ from .ring_attention import local_attention
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
 
-def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                      impl: str = "dense"):
     """Call INSIDE shard_map; q,k,v: (B, Tlocal, H, D) sequence-sharded.
 
-    all_to_all: (B, T/n, H, D) → (B, T, H/n, D); local full attention; inverse.
+    all_to_all: (B, T/n, H, D) → (B, T, H/n, D); local full attention;
+    inverse.  ``impl="flash"`` runs the inner full-sequence attention as
+    the streaming Pallas kernel (ops/flash_attention.py) — unlike the ring,
+    Ulysses needs no cross-step bias, so flash composes directly and the
+    per-device attention memory drops from O(T^2) scores to O(T).
     """
     def seq2head(x):
         # split heads across the axis, gather sequence
@@ -34,16 +39,24 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
     def head2seq(x):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
+    if impl == "flash":
+        from ..ops.flash_attention import flash_attention as attn
+    elif impl == "dense":
+        attn = local_attention
+    else:
+        raise ValueError(f"unknown impl {impl!r}")
     qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
-    out = local_attention(qh, kh, vh, causal=causal)
+    out = attn(qh, kh, vh, causal=causal)
     return head2seq(out)
 
 
 def ulysses_attention_sharded(q, k, v, mesh: Optional[Mesh] = None,
-                              axis_name: str = "sp", causal: bool = False):
+                              axis_name: str = "sp", causal: bool = False,
+                              impl: str = "dense"):
     mesh = mesh or get_mesh()
     spec = PartitionSpec(None, axis_name, None, None)
     fn = jax.shard_map(
-        functools.partial(ulysses_attention, axis_name=axis_name, causal=causal),
+        functools.partial(ulysses_attention, axis_name=axis_name,
+                          causal=causal, impl=impl),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
