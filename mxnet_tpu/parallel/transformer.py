@@ -158,13 +158,15 @@ def make_sharded_train_step(mesh: Mesh, cfg: TransformerConfig,
     """
     axes = ("dp", "sp")
     repl, data = P(), P("dp", "sp")
-    if sp_impl == "ulysses":
+    if sp_impl in ("ulysses", "ulysses_flash"):
         from .sequence_parallel import ulysses_attention
         if cfg.n_heads % mesh.shape["sp"]:
             raise ValueError(
                 f"ulysses needs n_heads ({cfg.n_heads}) divisible by "
                 f"sp ({mesh.shape['sp']})")
-        attn_fn = ulysses_attention
+        attn_fn = functools.partial(
+            ulysses_attention,
+            impl="flash" if sp_impl == "ulysses_flash" else "dense")
     elif sp_impl == "ring":
         attn_fn = ring_attention
     else:

@@ -113,13 +113,15 @@ def test_loss_mask_excludes_padding():
         float(only_first), rtol=1e-5)
 
 
-def test_ulysses_step_equals_oracle():
-    """sp_impl="ulysses": all_to_all head-sharding path reproduces the same
+@pytest.mark.parametrize("sp_impl", ["ulysses", "ulysses_flash"])
+def test_ulysses_step_equals_oracle(sp_impl):
+    """sp_impl="ulysses[_flash]": the all_to_all head-sharding path — with
+    dense or streaming-Pallas inner attention — reproduces the same
     single-device step the ring does."""
     params = _params(seed=3)
     tokens, labels, positions = _batch(B=4, T=32)
     mesh = make_mesh({"dp": 2, "sp": 4})  # n_heads=4 % sp=4 == 0
-    step = tr.make_sharded_train_step(mesh, CFG, lr=0.1, sp_impl="ulysses")
+    step = tr.make_sharded_train_step(mesh, CFG, lr=0.1, sp_impl=sp_impl)
     p2 = {k: jnp.array(v) for k, v in params.items()}
     m2 = {k: jnp.zeros_like(v) for k, v in params.items()}
     loss_s, p2, _ = step(p2, m2, *tr.shard_batch(mesh, tokens, labels,
