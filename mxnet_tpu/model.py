@@ -11,7 +11,7 @@ from . import ndarray as nd
 from . import symbol as sym
 from .kvstore import KVStore, create as _create_kv
 
-__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+__all__ = ["BatchEndParam", "FeedForward", "save_checkpoint", "load_checkpoint",
            "_create_kvstore", "_initialize_kvstore", "_update_params_on_kvstore",
            "_update_params"]
 
@@ -117,8 +117,168 @@ def load_checkpoint(prefix, epoch):
 
 
 class FeedForward:
-    """Legacy API shim (reference: model.py FeedForward). Use Module."""
+    """Legacy training API (reference: model.py FeedForward — deprecated
+    there but FUNCTIONAL, and plenty of 1.x scripts still call it).  A
+    thin shell over :class:`mxnet_tpu.module.Module`: fit/predict/score
+    plus the prefix-epoch checkpoint format.  New code should use Module
+    or Gluon."""
 
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "FeedForward is deprecated in the reference; use mxnet_tpu.module.Module")
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as _init_mod
+
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None \
+            else _init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        # reference convention: remaining kwargs are optimizer params
+        # (learning_rate, momentum, wd, ...)
+        self._optimizer_params = kwargs
+        self._module = None
+
+    # -- internals ---------------------------------------------------------------
+    def _as_iter(self, X, y=None, batch_size=None, shuffle=False):
+        from .io import DataIter, NDArrayIter, ResizeIter
+
+        it = X if isinstance(X, DataIter) else NDArrayIter(
+            X, y, batch_size or self.numpy_batch_size, shuffle=shuffle)
+        if self.epoch_size is not None and shuffle:
+            # reference semantics: epoch_size bounds batches/epoch (needed
+            # for infinite record iterators)
+            it = ResizeIter(it, self.epoch_size)
+        return it
+
+    def _bind_module(self, data_iter, for_training):
+        from .module import Module
+
+        label_names = [d.name for d in (data_iter.provide_label or [])] \
+            or None
+        mod = Module(self.symbol, label_names=label_names,
+                     context=self.ctx)
+        mod.bind(data_iter.provide_data,
+                 data_iter.provide_label or None,
+                 for_training=for_training)
+        mod.init_params(initializer=self.initializer,
+                        arg_params=self.arg_params,
+                        aux_params=self.aux_params,
+                        allow_missing=self.arg_params is not None,
+                        allow_extra=self.allow_extra_params)
+        self._module = mod
+        return mod
+
+    # -- API ---------------------------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        from .module import Module
+
+        if self.num_epoch is None:
+            # reference requires it (Module.fit asserts); a silent default
+            # combined with begin_epoch from load() could train 0 epochs
+            raise ValueError("FeedForward: num_epoch must be set to fit")
+        train = self._as_iter(X, y, shuffle=True)
+        label_names = [d.name for d in (train.provide_label or [])] or None
+        mod = Module(self.symbol, label_names=label_names, context=self.ctx,
+                     logger=logger) if logger is not None else             Module(self.symbol, label_names=label_names, context=self.ctx)
+        mod.fit(train, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer,
+                optimizer_params=dict(self._optimizer_params),
+                initializer=self.initializer,
+                arg_params=self.arg_params, aux_params=self.aux_params,
+                allow_missing=self.arg_params is not None,
+                eval_end_callback=eval_end_callback,
+                eval_batch_end_callback=eval_batch_end_callback,
+                monitor=monitor,
+                begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch)
+        self._module = mod
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        import numpy as _np
+
+        if self.arg_params is None:
+            raise ValueError(
+                "FeedForward: no trained parameters — fit() or load() first")
+        data = self._as_iter(X)
+        mod = self._bind_module(data, for_training=False)
+        if return_data:
+            # reference contract: (preds, datas, labels), gathered batchwise
+            datas, labels = [], []
+            if reset:
+                data.reset()
+            for batch in data:
+                n = batch.data[0].shape[0] - (batch.pad or 0)
+                datas.append(batch.data[0].asnumpy()[:n])
+                if batch.label:
+                    labels.append(batch.label[0].asnumpy()[:n])
+                if num_batch is not None and len(datas) >= num_batch:
+                    break
+            data.reset()
+        outs = mod.predict(data, num_batch=num_batch, reset=reset)
+        if isinstance(outs, (list, tuple)):
+            preds = [_np.asarray(o.asnumpy()) for o in outs]
+            preds = preds[0] if len(preds) == 1 else preds
+        else:
+            preds = _np.asarray(outs.asnumpy())
+        if return_data:
+            return (preds, _np.concatenate(datas) if datas else None,
+                    _np.concatenate(labels) if labels else None)
+        return preds
+
+    def score(self, X, eval_metric="acc", num_batch=None, **kwargs):
+        from . import metric as _metric
+
+        if self.arg_params is None:
+            raise ValueError(
+                "FeedForward: no trained parameters — fit() or load() first")
+        data = self._as_iter(X)
+        data.reset()
+        mod = self._bind_module(data, for_training=False)
+        m = _metric.create(eval_metric)
+        mod.score(data, m, num_batch=num_batch)
+        return m.get()[1]
+
+    def save(self, prefix, epoch=None):
+        save_checkpoint(prefix, epoch if epoch is not None
+                        else (self.num_epoch or 0), self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Train and return a model in one call (reference: model.py
+        FeedForward.create — the API the R binding's
+        mx.model.FeedForward.create mirrors)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        return model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                         epoch_end_callback=epoch_end_callback,
+                         batch_end_callback=batch_end_callback,
+                         kvstore=kvstore, logger=logger)

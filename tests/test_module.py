@@ -166,3 +166,36 @@ def test_module_batch_size_change():
                       [nd.array(np.zeros(3))])
     mod.forward(batch, is_train=False)  # triggers rebind to bs=3
     assert mod.get_outputs()[0].shape == (3, 10)
+
+
+def test_feedforward_legacy_api(tmp_path):
+    """The deprecated-but-functional FeedForward shell (reference model.py):
+    fit/predict/score, prefix-epoch checkpoints, and one-call create()."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2, name="ffc"),
+        mx.sym.Variable("softmax_label"), name="softmax")
+    mx.random.seed(0)
+    np.random.seed(0)  # initializer draws from the global stream
+    rs = np.random.RandomState(0)
+    X = rs.rand(200, 8).astype(np.float32)
+    Y = (X.sum(axis=1) > 4).astype(np.float32)
+
+    ff = mx.model.FeedForward(symbol=net, num_epoch=8, optimizer="sgd",
+                              learning_rate=0.5)
+    ff.fit(X=mx.io.NDArrayIter(X, Y, batch_size=20, shuffle=True))
+    preds = ff.predict(mx.io.NDArrayIter(X, batch_size=20))
+    assert (preds.argmax(axis=1) == Y).mean() > 0.85
+    assert ff.score(mx.io.NDArrayIter(X, Y, batch_size=20)) > 0.85
+
+    prefix = str(tmp_path / "ffm")
+    ff.save(prefix, 6)
+    back = mx.model.FeedForward.load(prefix, 6)
+    np.testing.assert_allclose(
+        back.predict(mx.io.NDArrayIter(X, batch_size=20)), preds,
+        rtol=1e-5, atol=1e-6)
+
+    created = mx.model.FeedForward.create(
+        net, X=mx.io.NDArrayIter(X, Y, batch_size=20), num_epoch=2,
+        learning_rate=0.5)
+    assert created.arg_params  # trained params captured
