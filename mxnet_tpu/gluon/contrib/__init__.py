@@ -1,5 +1,6 @@
 """gluon.contrib (reference: python/mxnet/gluon/contrib/ — experimental
-blocks: nn.Concurrent/HybridConcurrent, convolutional RNN cells,
-VariationalDropoutCell)."""
+blocks: nn.Concurrent/HybridConcurrent/SyncBatchNorm, convolutional RNN
+cells in 1/2/3D, VariationalDropoutCell, LSTMPCell, data.IntervalSampler)."""
+from . import data  # noqa: F401
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401

@@ -1,0 +1,31 @@
+"""Contrib data helpers (reference: gluon/contrib/data/sampler.py)."""
+from __future__ import annotations
+
+from ..data.sampler import Sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(Sampler):
+    """Samples [0, length) at fixed intervals; with ``rollover`` (default)
+    every skipped item is eventually visited, offset by offset — e.g.
+    length=13 interval=3 → 0,3,6,9,12, 1,4,7,10, 2,5,8,11 (reference:
+    contrib.data.IntervalSampler)."""
+
+    def __init__(self, length, interval, rollover=True):
+        if interval > length:
+            raise ValueError(
+                f"interval {interval} must be <= length {length}")
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for i in range(self._interval if self._rollover else 1):
+            for j in range(i, self._length, self._interval):
+                yield j
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        return (self._length + self._interval - 1) // self._interval

@@ -6,8 +6,10 @@ from __future__ import annotations
 from ... import ndarray as nd
 from ..rnn.rnn_cell import RecurrentCell, _init
 
-__all__ = ["VariationalDropoutCell", "Conv2DRNNCell", "Conv2DLSTMCell",
-           "Conv2DGRUCell"]
+__all__ = ["VariationalDropoutCell", "LSTMPCell",
+           "Conv1DRNNCell", "Conv1DLSTMCell", "Conv1DGRUCell",
+           "Conv2DRNNCell", "Conv2DLSTMCell", "Conv2DGRUCell",
+           "Conv3DRNNCell", "Conv3DLSTMCell", "Conv3DGRUCell"]
 
 
 class VariationalDropoutCell(RecurrentCell):
@@ -70,19 +72,38 @@ class VariationalDropoutCell(RecurrentCell):
 
 
 class _ConvRNNBase(RecurrentCell):
-    """Convolutional recurrence: gates are convs over (C, H, W) states
-    (reference: contrib/rnn/conv_rnn_cell.py)."""
+    """Convolutional recurrence: gates are convs over spatial states of any
+    dimensionality — input_shape (C, W) / (C, H, W) / (C, D, H, W) selects
+    1D/2D/3D (reference: contrib/rnn/conv_rnn_cell.py _BaseConvRNNCell)."""
 
-    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
-                 h2h_kernel=(3, 3), num_gates=1, activation="tanh",
+    _LAYOUTS = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=None,
+                 h2h_kernel=None, num_gates=1, activation="tanh",
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
-        self._input_shape = tuple(input_shape)   # (C, H, W)
+        self._input_shape = tuple(input_shape)   # (C, *spatial)
+        self._dims = len(self._input_shape) - 1
+        if self._dims not in self._LAYOUTS:
+            raise ValueError(
+                f"input_shape must be (C, *spatial) with 1-3 spatial dims, "
+                f"got {self._input_shape}")
+        expected = getattr(self, "_expected_dims", None)
+        if expected is not None and self._dims != expected:
+            raise ValueError(
+                f"{type(self).__name__} expects {expected} spatial dim(s), "
+                f"got input_shape {self._input_shape}")
         self._hc = int(hidden_channels)
         self._ng = num_gates
-        self._ik = tuple(i2h_kernel)
-        self._hk = tuple(h2h_kernel)
+        self._ik = tuple(i2h_kernel) if i2h_kernel is not None \
+            else (3,) * self._dims
+        self._hk = tuple(h2h_kernel) if h2h_kernel is not None \
+            else (3,) * self._dims
+        if len(self._ik) != self._dims or len(self._hk) != self._dims:
+            raise ValueError(
+                f"kernel rank must match the {self._dims} spatial dims "
+                f"(i2h {self._ik}, h2h {self._hk})")
         # reference conv_rnn_cell.py:70: h2h must be odd — pad=k//2 only
         # preserves the state's spatial size then; an even kernel grew the
         # state each step and crashed at step 2 with a broadcast error
@@ -109,7 +130,8 @@ class _ConvRNNBase(RecurrentCell):
     def state_info(self, batch_size=0):
         shape = (batch_size, self._hc) + self._input_shape[1:]
         n_states = 2 if self._ng == 4 else 1
-        return [{"shape": shape, "__layout__": "NCHW"}] * n_states
+        return [{"shape": shape,
+                 "__layout__": self._LAYOUTS[self._dims]}] * n_states
 
     def _conv(self, x, weight, bias, kernel):
         pad = tuple(k // 2 for k in kernel)
@@ -125,6 +147,8 @@ class _ConvRNNBase(RecurrentCell):
 
 
 class Conv2DRNNCell(_ConvRNNBase):
+    _expected_dims = 2
+
     def __init__(self, input_shape, hidden_channels, **kwargs):
         super().__init__(input_shape, hidden_channels, num_gates=1, **kwargs)
 
@@ -135,6 +159,8 @@ class Conv2DRNNCell(_ConvRNNBase):
 
 
 class Conv2DLSTMCell(_ConvRNNBase):
+    _expected_dims = 2
+
     def __init__(self, input_shape, hidden_channels, **kwargs):
         super().__init__(input_shape, hidden_channels, num_gates=4, **kwargs)
 
@@ -153,6 +179,8 @@ class Conv2DLSTMCell(_ConvRNNBase):
 
 
 class Conv2DGRUCell(_ConvRNNBase):
+    _expected_dims = 2
+
     def __init__(self, input_shape, hidden_channels, **kwargs):
         super().__init__(input_shape, hidden_channels, num_gates=3, **kwargs)
 
@@ -165,3 +193,103 @@ class Conv2DGRUCell(_ConvRNNBase):
         z = nd.sigmoid(isl[1] + hsl[1])
         n = nd.Activation(isl[2] + r * hsl[2], act_type=self._activation)
         return (1 - z) * n + z * h, [(1 - z) * n + z * h]
+
+
+class Conv1DRNNCell(Conv2DRNNCell):
+    """input_shape (C, W); reference contrib.rnn.Conv1DRNNCell."""
+
+    _expected_dims = 1
+
+
+class Conv1DLSTMCell(Conv2DLSTMCell):
+    """input_shape (C, W); reference contrib.rnn.Conv1DLSTMCell."""
+
+    _expected_dims = 1
+
+
+class Conv1DGRUCell(Conv2DGRUCell):
+    """input_shape (C, W); reference contrib.rnn.Conv1DGRUCell."""
+
+    _expected_dims = 1
+
+
+class Conv3DRNNCell(Conv2DRNNCell):
+    """input_shape (C, D, H, W); reference contrib.rnn.Conv3DRNNCell."""
+
+    _expected_dims = 3
+
+
+class Conv3DLSTMCell(Conv2DLSTMCell):
+    """input_shape (C, D, H, W); reference contrib.rnn.Conv3DLSTMCell."""
+
+    _expected_dims = 3
+
+
+class Conv3DGRUCell(Conv2DGRUCell):
+    """input_shape (C, D, H, W); reference contrib.rnn.Conv3DGRUCell."""
+
+    _expected_dims = 3
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a hidden-state projection (reference:
+    contrib/rnn/rnn_cell.py LSTMPCell, the LSTMP of Sak et al. 2014):
+    the recurrent/output state is h = W_r @ h_lstm, so the recurrence
+    runs at projection_size while the cell keeps hidden_size memory."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = int(hidden_size)
+        self._projection_size = int(projection_size)
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=_init(i2h_weight_initializer),
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                init=_init(h2h_weight_initializer))
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                init=_init(h2r_weight_initializer))
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=_init(i2h_bias_initializer))
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=_init(h2h_bias_initializer))
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _param_shape(self, param, args):
+        # deferred input_size: the block machinery calls this on first
+        # forward to size i2h_weight from the batch (like LSTMCell)
+        return (4 * self._hidden_size, args[0].shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        r, c = states
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(r, h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        sl = F.SliceChannel(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(sl[0])
+        f = F.sigmoid(sl[1])
+        g = F.Activation(sl[2], act_type="tanh")
+        o = F.sigmoid(sl[3])
+        c_new = f * c + i * g
+        h_new = o * F.Activation(c_new, act_type="tanh")
+        r_new = F.FullyConnected(h_new, h2r_weight, None,
+                                 num_hidden=self._projection_size,
+                                 no_bias=True)
+        return r_new, [r_new, c_new]

@@ -419,3 +419,48 @@ def test_export_shared_subblock_single_var(tmp_path):
                                      path + "-0000.params")
     np.testing.assert_allclose(back(x).asnumpy(), want, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_contrib_conv_cells_1d_3d_and_lstmp():
+    """The reference's full contrib cell matrix: 1D/3D conv recurrences and
+    the projection LSTM (contrib/rnn/{conv_rnn_cell,rnn_cell}.py)."""
+    C = gluon.contrib.rnn
+    rs = np.random.RandomState(0)
+
+    c1 = C.Conv1DGRUCell((2, 8), 3)
+    c1.initialize()
+    outs, states = c1.unroll(4, nd.array(rs.rand(2, 4, 2, 8)
+                                         .astype(np.float32)),
+                             merge_outputs=False)
+    assert outs[0].shape == (2, 3, 8) and len(states) == 1
+
+    c3 = C.Conv3DRNNCell((2, 3, 4, 5), 2)
+    c3.initialize()
+    outs, states = c3.unroll(3, nd.array(rs.rand(1, 3, 2, 3, 4, 5)
+                                         .astype(np.float32)),
+                             merge_outputs=False)
+    assert outs[0].shape == (1, 2, 3, 4, 5)
+
+    # kernel rank must match the spatial rank
+    with pytest.raises(ValueError):
+        C.Conv1DLSTMCell((2, 8), 3, i2h_kernel=(3, 3))
+
+    # mismatched class/rank must raise
+    with pytest.raises(ValueError):
+        C.Conv3DLSTMCell((2, 8), 3)
+
+    # LSTMP: recurrence at projection_size, memory at hidden_size,
+    # DEFERRED input_size resolves on first forward, gradients flow
+    p = C.LSTMPCell(hidden_size=8, projection_size=3)
+    p.initialize()
+    x = nd.array(rs.rand(2, 6, 4).astype(np.float32))
+    outs, st = p.unroll(6, x, merge_outputs=True)
+    assert outs.shape == (2, 6, 3)
+    assert st[0].shape == (2, 3) and st[1].shape == (2, 8)
+    for prm in p.collect_params().values():
+        prm.data().attach_grad()
+    with autograd.record():
+        o, _ = p.unroll(6, x, merge_outputs=True)
+        o.sum().backward()
+    g = p.h2r_weight.data().grad
+    assert g is not None and np.abs(g.asnumpy()).sum() > 0

@@ -193,3 +193,15 @@ def test_transform_first_with_dataloader_trains_shapes():
     x, y = next(iter(dl))
     assert tuple(x.shape) == (16, 1, 28, 28)
     assert tuple(y.shape) == (16,)
+
+
+def test_interval_sampler():
+    from mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+    assert list(IntervalSampler(13, 3)) == \
+        [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert list(IntervalSampler(13, 3, rollover=False)) == [0, 3, 6, 9, 12]
+    assert len(IntervalSampler(13, 3)) == 13
+    assert len(IntervalSampler(13, 3, rollover=False)) == 5
+    with pytest.raises(ValueError):
+        IntervalSampler(3, 5)
