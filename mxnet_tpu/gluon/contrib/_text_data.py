@@ -1,0 +1,114 @@
+"""Contrib language-model datasets (reference:
+gluon/contrib/data/text.py — WikiText2/WikiText103 with an EOS-joined
+token stream reshaped to (N, seq_len) next-token pairs).
+
+No-egress policy (same as gluon.data.vision): a local copy of the raw
+`wiki.<segment>.tokens` file under ``root`` is used when present; absent
+that, a deterministic synthetic Markov corpus of the same shape is
+generated so pipelines and tests run hermetically.
+"""
+from __future__ import annotations
+
+import collections
+import os
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ..data.dataset import Dataset
+
+__all__ = ["WikiText2", "WikiText103"]
+
+EOS_TOKEN = "<eos>"
+
+
+class _WikiText(Dataset):
+    _name = "wikitext"
+
+    def __init__(self, root=None, segment="train", vocab=None, seq_len=35):
+        self._root = os.path.expanduser(
+            root or os.path.join("~", ".mxnet", "datasets", self._name))
+        self._segment = segment
+        self._seq_len = int(seq_len)
+        self._vocab = vocab
+        self._counter = None
+        self._get_data()
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+    @property
+    def frequencies(self):
+        return self._counter
+
+    _SEGMENT_FILES = {"train": "wiki.train.tokens",
+                      "val": "wiki.valid.tokens",
+                      "valid": "wiki.valid.tokens",
+                      "test": "wiki.test.tokens"}
+
+    def _tokens(self):
+        try:
+            fname = self._SEGMENT_FILES[self._segment]
+        except KeyError:
+            raise ValueError(
+                f"segment must be one of {sorted(set(self._SEGMENT_FILES))}, "
+                f"got {self._segment!r}") from None
+        path = os.path.join(self._root, fname)
+        if os.path.isfile(path):
+            with open(path, encoding="utf8") as f:
+                lines = [ln.strip().split() for ln in f]
+            toks = []
+            for line in lines:
+                if line:
+                    toks.extend(line)
+                    toks.append(EOS_TOKEN)
+            return toks
+        # synthetic fallback: deterministic Markov chain over a small
+        # vocabulary — shaped like the real corpus, no egress needed
+        rs = _np.random.RandomState(0)
+        vocab = [f"w{i}" for i in range(200)]
+        trans = rs.randint(0, 200, size=(200, 3))
+        toks = []
+        t = 0
+        n = 40000 if self._segment == "train" else 4000
+        for i in range(n):
+            toks.append(vocab[t])
+            if i % 19 == 18:
+                toks.append(EOS_TOKEN)
+            t = int(trans[t, rs.randint(3)])
+        return toks
+
+    def _get_data(self):
+        from ...contrib.text import Vocabulary
+
+        toks = self._tokens()
+        if self._counter is None:
+            self._counter = collections.Counter(toks)
+        if self._vocab is None:
+            self._vocab = Vocabulary(counter=self._counter)
+        idx = self._vocab.to_indices(toks)
+        data, label = idx[:-1], idx[1:]
+        n = (len(data) // self._seq_len) * self._seq_len
+        self._data = nd.array(
+            _np.asarray(data[:n], _np.int32).reshape(-1, self._seq_len))
+        self._label = nd.array(
+            _np.asarray(label[:n], _np.int32).reshape(-1, self._seq_len))
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """reference: contrib.data.text.WikiText2 (segments train/val/test)."""
+
+    _name = "wikitext-2"
+
+
+class WikiText103(_WikiText):
+    """reference: contrib.data.text.WikiText103."""
+
+    _name = "wikitext-103"

@@ -2,8 +2,9 @@
 from __future__ import annotations
 
 from ..data.sampler import Sampler
+from ._text_data import WikiText2, WikiText103  # noqa: F401
 
-__all__ = ["IntervalSampler"]
+__all__ = ["IntervalSampler", "WikiText2", "WikiText103"]
 
 
 class IntervalSampler(Sampler):
@@ -13,9 +14,9 @@ class IntervalSampler(Sampler):
     contrib.data.IntervalSampler)."""
 
     def __init__(self, length, interval, rollover=True):
-        if interval > length:
+        if not 1 <= interval <= length:
             raise ValueError(
-                f"interval {interval} must be <= length {length}")
+                f"interval must be in [1, length={length}], got {interval}")
         self._length = length
         self._interval = interval
         self._rollover = rollover

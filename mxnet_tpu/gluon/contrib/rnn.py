@@ -146,11 +146,12 @@ class _ConvRNNBase(RecurrentCell):
         return i2h, h2h
 
 
-class Conv2DRNNCell(_ConvRNNBase):
-    _expected_dims = 2
+class _ConvRNNMixin:
+    _num_gates = 1
 
     def __init__(self, input_shape, hidden_channels, **kwargs):
-        super().__init__(input_shape, hidden_channels, num_gates=1, **kwargs)
+        super().__init__(input_shape, hidden_channels,
+                         num_gates=self._num_gates, **kwargs)
 
     def __call__(self, inputs, states):
         i2h, h2h = self._gates(inputs, states[0])
@@ -158,11 +159,12 @@ class Conv2DRNNCell(_ConvRNNBase):
         return out, [out]
 
 
-class Conv2DLSTMCell(_ConvRNNBase):
-    _expected_dims = 2
+class _ConvLSTMMixin:
+    _num_gates = 4
 
     def __init__(self, input_shape, hidden_channels, **kwargs):
-        super().__init__(input_shape, hidden_channels, num_gates=4, **kwargs)
+        super().__init__(input_shape, hidden_channels,
+                         num_gates=self._num_gates, **kwargs)
 
     def __call__(self, inputs, states):
         h, c = states
@@ -178,11 +180,12 @@ class Conv2DLSTMCell(_ConvRNNBase):
         return h_new, [h_new, c_new]
 
 
-class Conv2DGRUCell(_ConvRNNBase):
-    _expected_dims = 2
+class _ConvGRUMixin:
+    _num_gates = 3
 
     def __init__(self, input_shape, hidden_channels, **kwargs):
-        super().__init__(input_shape, hidden_channels, num_gates=3, **kwargs)
+        super().__init__(input_shape, hidden_channels,
+                         num_gates=self._num_gates, **kwargs)
 
     def __call__(self, inputs, states):
         h = states[0]
@@ -195,37 +198,55 @@ class Conv2DGRUCell(_ConvRNNBase):
         return (1 - z) * n + z * h, [(1 - z) * n + z * h]
 
 
-class Conv1DRNNCell(Conv2DRNNCell):
+class Conv2DRNNCell(_ConvRNNMixin, _ConvRNNBase):
+    """input_shape (C, H, W); reference contrib.rnn.Conv2DRNNCell."""
+
+    _expected_dims = 2
+
+
+class Conv2DLSTMCell(_ConvLSTMMixin, _ConvRNNBase):
+    """input_shape (C, H, W); reference contrib.rnn.Conv2DLSTMCell."""
+
+    _expected_dims = 2
+
+
+class Conv2DGRUCell(_ConvGRUMixin, _ConvRNNBase):
+    """input_shape (C, H, W); reference contrib.rnn.Conv2DGRUCell."""
+
+    _expected_dims = 2
+
+
+class Conv1DRNNCell(_ConvRNNMixin, _ConvRNNBase):
     """input_shape (C, W); reference contrib.rnn.Conv1DRNNCell."""
 
     _expected_dims = 1
 
 
-class Conv1DLSTMCell(Conv2DLSTMCell):
+class Conv1DLSTMCell(_ConvLSTMMixin, _ConvRNNBase):
     """input_shape (C, W); reference contrib.rnn.Conv1DLSTMCell."""
 
     _expected_dims = 1
 
 
-class Conv1DGRUCell(Conv2DGRUCell):
+class Conv1DGRUCell(_ConvGRUMixin, _ConvRNNBase):
     """input_shape (C, W); reference contrib.rnn.Conv1DGRUCell."""
 
     _expected_dims = 1
 
 
-class Conv3DRNNCell(Conv2DRNNCell):
+class Conv3DRNNCell(_ConvRNNMixin, _ConvRNNBase):
     """input_shape (C, D, H, W); reference contrib.rnn.Conv3DRNNCell."""
 
     _expected_dims = 3
 
 
-class Conv3DLSTMCell(Conv2DLSTMCell):
+class Conv3DLSTMCell(_ConvLSTMMixin, _ConvRNNBase):
     """input_shape (C, D, H, W); reference contrib.rnn.Conv3DLSTMCell."""
 
     _expected_dims = 3
 
 
-class Conv3DGRUCell(Conv2DGRUCell):
+class Conv3DGRUCell(_ConvGRUMixin, _ConvRNNBase):
     """input_shape (C, D, H, W); reference contrib.rnn.Conv3DGRUCell."""
 
     _expected_dims = 3

@@ -205,3 +205,52 @@ def test_interval_sampler():
     assert len(IntervalSampler(13, 3, rollover=False)) == 5
     with pytest.raises(ValueError):
         IntervalSampler(3, 5)
+
+
+def test_wikitext_datasets(tmp_path):
+    """contrib.data.WikiText2: local tokens file when present, synthetic
+    Markov corpus otherwise; (data, label) are next-token pairs reshaped to
+    seq_len (reference: gluon/contrib/data/text.py)."""
+    from mxnet_tpu.gluon.contrib.data import WikiText2
+
+    ds = WikiText2(root=str(tmp_path / "none"), segment="test", seq_len=10)
+    assert len(ds) > 10
+    d, l = ds[0]
+    assert d.shape == (10,) and l.shape == (10,)
+    # label is data shifted by one in the flat stream
+    d1, _ = ds[1]
+    np.testing.assert_allclose(l.asnumpy()[:-1], d.asnumpy()[1:])
+    np.testing.assert_allclose(l.asnumpy()[-1], d1.asnumpy()[0])
+    assert len(ds.vocabulary) > 10
+
+    # a provided local corpus wins over the synthetic fallback
+    root = tmp_path / "wt2"
+    root.mkdir()
+    (root / "wiki.test.tokens").write_text(
+        "the cat sat\nthe dog ran\n" * 50, encoding="utf8")
+    ds2 = WikiText2(root=str(root), segment="test", seq_len=5)
+    toks = set(ds2.vocabulary.idx_to_token)
+    assert {"the", "cat", "dog", "<eos>"} <= toks
+    dd, ll = ds2[0]
+    assert ds2.vocabulary.to_tokens(int(dd.asnumpy()[0])) in \
+        {"the", "cat", "sat", "dog", "ran", "<eos>"}
+
+
+def test_interval_sampler_rejects_nonpositive():
+    from mxnet_tpu.gluon.contrib.data import IntervalSampler
+
+    for bad in (0, -2):
+        with pytest.raises(ValueError):
+            IntervalSampler(13, bad)
+
+
+def test_wikitext_segment_validation(tmp_path):
+    from mxnet_tpu.gluon.contrib.data import WikiText2
+
+    with pytest.raises(ValueError):
+        WikiText2(root=str(tmp_path), segment="vaild")  # typo caught
+    # 'val' maps to the reference's wiki.valid.tokens filename
+    (tmp_path / "wiki.valid.tokens").write_text("a b c\n" * 30,
+                                                encoding="utf8")
+    ds = WikiText2(root=str(tmp_path), segment="val", seq_len=4)
+    assert {"a", "b", "c"} <= set(ds.vocabulary.idx_to_token)
