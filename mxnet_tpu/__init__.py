@@ -4,11 +4,13 @@ Top-level namespace mirrors the reference (``python/mxnet/__init__.py``):
 ``mx.nd``, ``mx.sym``, ``mx.autograd``, ``mx.gluon``, ``mx.mod``, ``mx.kv``,
 ``mx.io``, ``mx.optimizer``, ``mx.metric``, ``mx.init``, ``mx.context``.
 """
-__version__ = "0.1.0"
+from .libinfo import __version__
 
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_devices, num_tpus
 from . import base
+from . import libinfo
+from . import registry
 from . import context
 from . import random
 from .random import seed

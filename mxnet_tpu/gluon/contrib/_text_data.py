@@ -56,13 +56,15 @@ class _WikiText(Dataset):
                 f"got {self._segment!r}") from None
         path = os.path.join(self._root, fname)
         if os.path.isfile(path):
-            with open(path, encoding="utf8") as f:
-                lines = [ln.strip().split() for ln in f]
+            # stream line-by-line: WikiText-103's train split is ~100M
+            # tokens, so no list-of-lists intermediate
             toks = []
-            for line in lines:
-                if line:
-                    toks.extend(line)
-                    toks.append(EOS_TOKEN)
+            with open(path, encoding="utf8") as f:
+                for ln in f:
+                    line = ln.strip().split()
+                    if line:
+                        toks.extend(line)
+                        toks.append(EOS_TOKEN)
             return toks
         # synthetic fallback: deterministic Markov chain over a small
         # vocabulary — shaped like the real corpus, no egress needed
@@ -87,13 +89,11 @@ class _WikiText(Dataset):
             self._counter = collections.Counter(toks)
         if self._vocab is None:
             self._vocab = Vocabulary(counter=self._counter)
-        idx = self._vocab.to_indices(toks)
-        data, label = idx[:-1], idx[1:]
-        n = (len(data) // self._seq_len) * self._seq_len
-        self._data = nd.array(
-            _np.asarray(data[:n], _np.int32).reshape(-1, self._seq_len))
-        self._label = nd.array(
-            _np.asarray(label[:n], _np.int32).reshape(-1, self._seq_len))
+        idx = _np.asarray(self._vocab.to_indices(toks), _np.int32)
+        n = ((len(idx) - 1) // self._seq_len) * self._seq_len
+        # numpy slices are views — no further full-corpus copies
+        self._data = nd.array(idx[:n].reshape(-1, self._seq_len))
+        self._label = nd.array(idx[1:n + 1].reshape(-1, self._seq_len))
 
     def __getitem__(self, idx):
         return self._data[idx], self._label[idx]

@@ -1,0 +1,58 @@
+"""Library discovery + version (reference: python/mxnet/libinfo.py —
+find_lib_path locates libmxnet.so for the ctypes layer; here the native
+pair is libmxtpu.so / libmxtpu_rt.so under cpp/build, with the
+amalgamated libmxtpu_all.so accepted as a stand-in for either)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+# the ONE version source: mxnet_tpu/__init__ imports it from here
+# (upstream convention), so the package and libinfo can never disagree
+__version__ = "0.1.0"
+
+
+def _candidates(names):
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = os.environ.get("MXTPU_LIBRARY_PATH")
+    out = []
+    if env and os.path.isfile(env):
+        # upstream MXNET_LIBRARY_PATH convention: the env var may point at
+        # the .so itself, not just a directory
+        out.append(env)
+        env = None
+    roots = [env,
+             os.path.join(os.path.dirname(here), "cpp", "build"),
+             os.path.join(os.path.dirname(here), "amalgamation")]
+    for root in roots:
+        if not root:
+            continue
+        for name in names:
+            p = os.path.join(root, name)
+            if os.path.isfile(p):
+                out.append(p)
+    return out
+
+
+def find_lib_path():
+    """Paths of the native runtime libraries, most specific first.
+
+    Raises like the reference when nothing is found (so binding loaders
+    fail with a clear message instead of a bare OSError later)."""
+    found = _candidates(["libmxtpu.so", "libmxtpu_rt.so",
+                         "libmxtpu_all.so"])
+    if not found:
+        raise RuntimeError(
+            "native library not found: build it with `make -C cpp` (or "
+            "`make -C amalgamation`), or set MXTPU_LIBRARY_PATH")
+    return found
+
+
+def find_include_path():
+    """Directory holding mxtpu.h (reference: find_include_path)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    inc = os.path.join(os.path.dirname(here), "cpp", "include")
+    if not os.path.isdir(inc):
+        raise RuntimeError(f"include path not found at {inc}")
+    return inc

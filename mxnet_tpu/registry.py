@@ -1,0 +1,95 @@
+"""Generic class registry factories (reference: python/mxnet/registry.py —
+the machinery behind optimizer/metric/initializer registration, exposed so
+user code can build its own registered families the same way)."""
+from __future__ import annotations
+
+import json
+import warnings
+
+from .base import MXNetError
+
+__all__ = ["get_registry", "get_register_func", "get_alias_func",
+           "get_create_func"]
+
+_REGISTRIES = {}
+
+
+def _registry_of(base_class):
+    return _REGISTRIES.setdefault(base_class, {})
+
+
+def get_registry(base_class):
+    """A copy of the name → class mapping registered for ``base_class``."""
+    return dict(_registry_of(base_class))
+
+
+def get_register_func(base_class, nickname):
+    """Returns register(klass, name=None) — usable plain or as a decorator;
+    re-registration warns and replaces (reference semantics)."""
+    registry = _registry_of(base_class)
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError(
+                f"can only register subclasses of {base_class.__name__}, "
+                f"got {klass}")
+        key = (name or klass.__name__).lower()
+        if key in registry and registry[key] is not klass:
+            warnings.warn(
+                f"new {nickname} {klass} registered with name {key} is "
+                f"overriding existing {nickname} {registry[key]}")
+        registry[key] = klass
+        return klass
+
+    register.__doc__ = f"Register a {nickname} class."
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """Returns alias(*names) — a decorator adding extra registry names."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Returns create(name_or_instance_or_json, **kwargs) with the
+    reference's three input forms: an instance passes through, a string
+    resolves in the registry, a '["name", {kwargs}]' JSON (the dumps()
+    format) reconstructs."""
+    registry = _registry_of(base_class)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            if len(args) > 1 or kwargs:
+                raise MXNetError(
+                    f"{nickname} instance given: no extra arguments allowed")
+            return args[0]
+        if not args or not isinstance(args[0], str):
+            raise MXNetError(
+                f"{nickname} create expects an instance, a registered "
+                f"name, or a dumps() JSON string")
+        name, rest = args[0], args[1:]
+        if name.startswith("["):
+            if rest or kwargs:
+                raise MXNetError(
+                    f"{nickname} JSON spec given: no extra arguments allowed")
+            name, kw = json.loads(name)
+            return create(name, **kw)
+        key = name.lower()
+        if key not in registry:
+            raise MXNetError(
+                f"{nickname} {name!r} is not registered "
+                f"(known: {sorted(registry)})")
+        return registry[key](*rest, **kwargs)
+
+    create.__doc__ = f"Create a {nickname} instance."
+    return create

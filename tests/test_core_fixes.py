@@ -1483,3 +1483,39 @@ def test_block_apply_fn_does_not_leak_tracer_into_global_stream():
     t.start()
     t.join()
     assert not errs, errs
+
+
+def test_libinfo_and_generic_registry():
+    """Top-level plumbing modules (reference libinfo.py / registry.py)."""
+    import mxnet_tpu.libinfo as li
+    import mxnet_tpu.registry as reg
+
+    libs = li.find_lib_path()
+    assert any(p.endswith("libmxtpu.so") for p in libs)
+    import os
+    assert os.path.isfile(os.path.join(li.find_include_path(), "mxtpu.h"))
+
+    class Base:
+        def __init__(self, x=1):
+            self.x = x
+
+    register = reg.get_register_func(Base, "widget")
+    create = reg.get_create_func(Base, "widget")
+    alias = reg.get_alias_func(Base, "widget")
+
+    @alias("w2", "w3")
+    class MyWidget(Base):
+        pass
+
+    register(MyWidget)
+    assert set(reg.get_registry(Base)) >= {"mywidget", "w2", "w3"}
+    assert isinstance(create("MyWidget"), MyWidget)
+    assert create("w2", x=5).x == 5
+    inst = MyWidget()
+    assert create(inst) is inst
+    import json
+    assert isinstance(create(json.dumps(["w3", {"x": 2}])), MyWidget)
+    with pytest.raises(Exception):
+        create("nope")
+    with pytest.raises(Exception):
+        register(int)  # not a subclass
