@@ -11,6 +11,7 @@ from .context import Context, cpu, gpu, tpu, current_context, num_devices, num_t
 from . import base
 from . import libinfo
 from . import registry
+from . import torch_bridge
 from . import context
 from . import random
 from .random import seed

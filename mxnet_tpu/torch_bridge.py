@@ -1,0 +1,22 @@
+"""PyTorch interop (the modern answer to the reference's python/mxnet/torch.py,
+which bridged Lua-Torch ops behind a build flag; today the zero-copy lingua
+franca is DLPack, and that is what this module speaks)."""
+from __future__ import annotations
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["to_torch", "from_torch"]
+
+
+def to_torch(arr: NDArray):
+    """NDArray → torch.Tensor via DLPack (zero-copy where devices allow)."""
+    import torch
+
+    return torch.from_dlpack(arr)
+
+
+def from_torch(tensor) -> NDArray:
+    """torch.Tensor → NDArray via DLPack."""
+    from . import ndarray as nd
+
+    return nd.from_dlpack(tensor)
