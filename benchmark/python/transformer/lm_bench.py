@@ -25,6 +25,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
 import jax
 import jax.numpy as jnp
 
+BF16 = os.environ.get("LM_BENCH_BF16", "1") == "1"
+
 from mxnet_tpu.ops.flash_attention import flash_attention
 from mxnet_tpu.parallel import transformer as tr
 from mxnet_tpu.parallel.ring_attention import local_attention
@@ -38,7 +40,8 @@ def bench_step(cfg, B, T, attention, steps):
     positions = jnp.arange(T, dtype=jnp.int32)
 
     step = jax.jit(functools.partial(
-        tr.train_step, cfg=cfg, lr=0.1, attention=attention),
+        tr.train_step, cfg=cfg, lr=0.1, attention=attention,
+        compute_dtype=jnp.bfloat16 if BF16 else None),
         donate_argnums=(0, 1))
     momenta = {k: jnp.zeros_like(v) for k, v in params.items()}
     t0 = time.perf_counter()
@@ -73,7 +76,7 @@ def main():
         vocab=1024, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=4 * args.d_model,
         max_len=args.seq_len)
-    print(f"backend: {jax.default_backend()}", file=sys.stderr)
+    print(f"backend: {jax.default_backend()} bf16={BF16}", file=sys.stderr)
     for name, att in [("local", functools.partial(local_attention,
                                                   causal=True)),
                       ("flash", functools.partial(flash_attention,

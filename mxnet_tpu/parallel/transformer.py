@@ -117,9 +117,15 @@ def transformer_lm_apply(params: Params, tokens, positions,
 
 
 def lm_loss(params: Params, tokens, labels, positions,
-            cfg: TransformerConfig, attention=None, mask=None):
+            cfg: TransformerConfig, attention=None, mask=None,
+            compute_dtype=None):
     """Mean next-token cross-entropy; `mask` (B, T) optionally excludes
-    positions (e.g. padding) from the mean."""
+    positions (e.g. padding) from the mean.  ``compute_dtype=jnp.bfloat16``
+    casts params for the forward (f32 master weights stay outside — the
+    MXU recipe bench.py uses for ResNet)."""
+    if compute_dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(compute_dtype), params)
     logits = transformer_lm_apply(params, tokens, positions, cfg, attention)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
@@ -129,11 +135,12 @@ def lm_loss(params: Params, tokens, labels, positions,
 
 
 def train_step(params, momenta, tokens, labels, positions, cfg,
-               lr=0.1, momentum=0.9, attention=None):
+               lr=0.1, momentum=0.9, attention=None, compute_dtype=None):
     """Single-device SGD-momentum step (the oracle for the sharded one)."""
     loss, grads = jax.value_and_grad(lm_loss)(params, tokens, labels,
                                               positions, cfg,
-                                              attention=attention)
+                                              attention=attention,
+                                              compute_dtype=compute_dtype)
     momenta = jax.tree_util.tree_map(lambda m, g: momentum * m + g,
                                      momenta, grads)
     params = jax.tree_util.tree_map(lambda p, m: p - lr * m, params, momenta)
@@ -141,7 +148,8 @@ def train_step(params, momenta, tokens, labels, positions, cfg,
 
 
 def make_sharded_train_step(mesh: Mesh, cfg: TransformerConfig,
-                            lr=0.1, momentum=0.9, sp_impl: str = "ring"):
+                            lr=0.1, momentum=0.9, sp_impl: str = "ring",
+                            compute_dtype=None):
     """One compiled dp×sp training step.
 
     Layout: tokens/labels (B, T) sharded P('dp', 'sp'); positions (T,)
@@ -186,7 +194,8 @@ def make_sharded_train_step(mesh: Mesh, cfg: TransformerConfig,
             # of the GLOBAL mean = (1/n) sum_i local_i, every shard
             # holding B/dp x T/sp tokens)
             return lm_loss(p, tokens, labels, positions, cfg,
-                           attention=attention) / n_shards
+                           attention=attention,
+                           compute_dtype=compute_dtype) / n_shards
 
         loss, grads = jax.value_and_grad(local_loss)(params)
         loss = jax.lax.psum(loss, axes)  # back to the global mean for report
