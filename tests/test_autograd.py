@@ -279,3 +279,40 @@ def test_stale_marked_id_not_misclassified():
         with autograd.record():
             fresh[0] = 1.0  # unmarked, un-taped: must NOT raise
         assert fresh.asnumpy()[0] == 1.0
+
+
+def test_pure_autograd_training_converges():
+    """Train an MLP with NOTHING but nd + autograd + manual SGD (reference:
+    tests/python/train/test_autograd.py) — no gluon, no Module."""
+    rs = np.random.RandomState(0)
+    X = nd.array(rs.rand(256, 10).astype(np.float32))
+    Yv = ((np.asarray(X.asnumpy()) @ rs.randn(10)) > 0).astype(np.float32)
+    Y = nd.array(Yv)
+    w1 = nd.array((rs.randn(16, 10) * 0.3).astype(np.float32))
+    b1 = nd.array(np.zeros(16, np.float32))
+    w2 = nd.array((rs.randn(1, 16) * 0.3).astype(np.float32))
+    b2 = nd.array(np.zeros(1, np.float32))
+    params = [w1, b1, w2, b2]
+    for p in params:
+        p.attach_grad()
+    lr = 0.5
+    first = None
+    for i in range(60):
+        with autograd.record():
+            h = nd.relu(nd.dot(X, w1.T) + b1)
+            logit = (nd.dot(h, w2.T) + b2).reshape((-1,))
+            # stable BCE-with-logits
+            loss = nd.mean(nd.relu(logit) - logit * Y +
+                           nd.log(1 + nd.exp(-nd.abs(logit))))
+        loss.backward()
+        for p in params:
+            p._data = p._data - lr * p.grad._data
+            p.grad[:] = 0
+        first = first if first is not None else float(loss.asscalar())
+    final = float(loss.asscalar())
+    assert final < 0.75 * first, (first, final)
+    pred = (1 / (1 + np.exp(-(np.maximum(X.asnumpy() @ w1.asnumpy().T +
+                                         b1.asnumpy(), 0)
+                              @ w2.asnumpy().T + b2.asnumpy()
+                              ).ravel())) > 0.5)
+    assert (pred == (Yv > 0.5)).mean() > 0.9
