@@ -373,6 +373,10 @@ class NDArray:
     def __rsub__(self, other):
         return self._binary("sub", other, reverse=True)
 
+    def __matmul__(self, other):
+        # numpy-age sugar (the 1.x reference predates it; harmless to add)
+        return self.dot(other)
+
     def __mul__(self, other):
         return self._binary("mul", other)
 

@@ -176,3 +176,10 @@ def test_context_movement():
     c = nd.zeros((2, 2))
     a.copyto(c)
     assert np.all(c.asnumpy() == 1)
+
+
+def test_matmul_operator():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose((a @ b).asnumpy(),
+                               a.asnumpy() @ b.asnumpy())
