@@ -28,7 +28,10 @@ def main():
     ap.add_argument("--num-epochs", type=int, default=5)
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--use-linear", action="store_true", help="L1-SVM instead of L2")
+    ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
+    mx.random.seed(args.seed)
+    np.random.seed(args.seed)  # initializers draw from the global stream
 
     train = mx.io.MNISTIter(batch_size=args.batch_size, flat=True,
                             label_name="svm_label", seed=1)

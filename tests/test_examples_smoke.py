@@ -156,5 +156,5 @@ def test_svm_mnist_learns():
     acc_lines = [ln for ln in out.strip().splitlines() if "'accuracy':" in ln]
     assert acc_lines, out[-500:]
     acc = float(acc_lines[-1].split("'accuracy':")[1].strip(" }"))
-    # init is unseeded in the subprocess; 3 epochs clears 0.85 reliably
-    assert acc > 0.85, out[-500:]
+    # fully seeded run (example seeds mx+numpy): deterministic accuracy
+    assert acc > 0.9, out[-500:]
