@@ -5,7 +5,7 @@ from ..ops.registry import get_op
 from .ndarray import NDArray, invoke
 
 __all__ = ["uniform", "normal", "gamma", "exponential", "poisson",
-           "negative_binomial", "randint", "multinomial", "shuffle", "randn"]
+           "negative_binomial", "generalized_negative_binomial", "randint", "multinomial", "shuffle", "randn"]
 
 
 def _shape(shape):
@@ -49,6 +49,15 @@ def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None):
 def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, out=None):
     return invoke(get_op("_random_negative_binomial"), [],
                   {"k": k, "p": p, "shape": _shape(shape) or (1,), "dtype": dtype}, out=out)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, out=None):
+    """reference: random.generalized_negative_binomial (mean mu, dispersion
+    alpha; variance mu + alpha*mu^2)."""
+    return invoke(get_op("_random_generalized_negative_binomial"), [],
+                  {"mu": mu, "alpha": alpha, "shape": _shape(shape) or (1,),
+                   "dtype": dtype}, out=out)
 
 
 def randint(low, high, shape=None, dtype="int32", ctx=None, out=None):

@@ -253,3 +253,30 @@ def test_bipartite_matching_ascending_threshold():
     r2, c2 = nd.contrib.bipartite_matching(nd.array(cost), is_ascend=True,
                                            threshold=0.15)
     assert list(r2.asnumpy()) == [0, -1]  # only 0.1 clears the bar
+
+
+def test_random_distribution_statistics():
+    """Every nd.random family matches its reference moments at n=2e5
+    (reference: random.py parameterizations — exponential's `scale` IS the
+    mean, gnb variance = mu + alpha*mu^2)."""
+    mx.random.seed(0)
+    n = 200000
+    checks = [
+        (nd.random.uniform(-2, 3, shape=(n,)), 0.5, np.sqrt(25 / 12)),
+        (nd.random.normal(1.5, 2.0, shape=(n,)), 1.5, 2.0),
+        (nd.random.gamma(3.0, 2.0, shape=(n,)), 6.0, np.sqrt(12)),
+        (nd.random.exponential(0.5, shape=(n,)), 0.5, 0.5),
+        (nd.random.poisson(4.0, shape=(n,)), 4.0, 2.0),
+        (nd.random.negative_binomial(5, 0.4, shape=(n,)),
+         5 * 0.6 / 0.4, np.sqrt(5 * 0.6) / 0.4),
+        (nd.random.generalized_negative_binomial(3.0, 0.3, shape=(n,)),
+         3.0, np.sqrt(3 + 0.3 * 9)),
+    ]
+    for arr, want_mean, want_std in checks:
+        v = arr.asnumpy()
+        assert abs(v.mean() - want_mean) / max(abs(want_mean), 1) < 0.03
+        assert abs(v.std() - want_std) / want_std < 0.05
+    p = nd.array(np.array([0.2, 0.3, 0.5], np.float32))
+    draws = nd.random.multinomial(p, shape=(n,)).asnumpy()
+    freq = np.bincount(draws.astype(int), minlength=3) / n
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.01)
