@@ -17,6 +17,8 @@ from . import random
 from .random import seed
 from . import ndarray
 from . import ndarray as nd
+
+random._install_samplers()
 from . import autograd
 from . import engine
 

@@ -101,3 +101,19 @@ def swap_key(new_key):
     old = _get_key()
     _state.key = new_key
     return old
+
+
+def _install_samplers():
+    """Re-export the nd.random samplers at mx.random.* (reference:
+    python/mxnet/random.py exposes uniform/normal/... top-level — the form
+    most 1.x scripts call).  Installed lazily at import-time from
+    __init__ to avoid a circular import with the ndarray package."""
+    import sys
+
+    from .ndarray import random as _ndr
+
+    mod = sys.modules[__name__]
+    for name in _ndr.__all__:
+        if not hasattr(mod, name):
+            setattr(mod, name, getattr(_ndr, name))
+            __all__.append(name)

@@ -15,6 +15,21 @@ for _name in list(OP_REGISTRY):
     if not hasattr(_mod, _name):
         setattr(_mod, _name, _make_sym_wrapper(_name))
 
+# random sub-namespace (reference: symbol/random.py — sym.random.uniform
+# et al. map to the _random_* ops)
+class _SymRandom:
+    pass
+
+
+random = _SymRandom()
+for _name in list(OP_REGISTRY):
+    if _name.startswith("_random_"):
+        setattr(random, _name[len("_random_"):], getattr(_mod, _name))
+# sampling ops the reference exposes under sym.random beyond _random_*
+random.multinomial = getattr(_mod, "multinomial")
+random.shuffle = getattr(_mod, "shuffle")
+
+
 # contrib sub-namespace
 class _Contrib:
     pass

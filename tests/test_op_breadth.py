@@ -280,3 +280,20 @@ def test_random_distribution_statistics():
     draws = nd.random.multinomial(p, shape=(n,)).asnumpy()
     freq = np.bincount(draws.astype(int), minlength=3) / n
     np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.01)
+
+
+def test_top_level_and_symbolic_random_namespaces():
+    """mx.random.* samplers (the 1.x top-level form) and sym.random.*
+    (reference: random.py re-exports + symbol/random.py)."""
+    mx.random.seed(3)
+    v = mx.random.uniform(-1, 1, shape=(500,)).asnumpy()
+    assert -1 <= v.min() and v.max() <= 1
+    for name in ("uniform", "normal", "gamma", "exponential", "poisson",
+                 "negative_binomial", "generalized_negative_binomial",
+                 "multinomial", "randint", "shuffle"):
+        assert hasattr(mx.random, name), name
+        assert hasattr(mx.sym.random, name), name
+    s = mx.sym.random.uniform(low=0, high=2, shape=(3, 5))
+    exe = s.simple_bind(ctx=mx.cpu())
+    out = exe.forward()[0].asnumpy()
+    assert out.shape == (3, 5) and 0 <= out.min() and out.max() <= 2
