@@ -234,6 +234,69 @@ def e2e_throughput(batch_size: int, batches: int = 10, warmup: int = 3):
     return (n1 - n0) * batch_size / (t1 - t0)
 
 
+def serving_latency(requests: int = None, clients: int = None):
+    """p50/p99 request latency + QPS through mxnet_tpu.serving under a
+    concurrent mixed-shape workload (docs/serving.md).  Runs inside the
+    supervised --measure subprocess, so an unreachable device never reaches
+    this code — and any in-measure failure is reported as a structured
+    field, not a crash (same contract as the e2e block)."""
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving, sym
+
+    requests = requests or int(os.environ.get("BENCH_SERVING_REQUESTS", "256"))
+    clients = clients or int(os.environ.get("BENCH_SERVING_CLIENTS", "8"))
+    hidden, width = 256, 64
+    data = sym.Variable("data")
+    pooled = sym.sum(sym.Activation(data, act_type="tanh"), axis=1)
+    net = sym.FullyConnected(
+        sym.Activation(sym.FullyConnected(pooled, num_hidden=hidden, name="fc1"),
+                       act_type="relu"),
+        num_hidden=10, name="fc2")
+    mod = mx.mod.Module(net, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=[("data", (8, 16, width))], for_training=False)
+    mod.init_params(mx.init.Uniform(0.05))
+    shapes = [(8, width), (16, width), (32, width)]
+    svc = serving.InferenceService(
+        mod, serving.ServingConfig(max_batch_size=8, batch_timeout_ms=1.0,
+                                   shape_buckets=shapes, queue_bound=1024))
+    svc.warmup(shapes)
+    per_client = requests // clients
+    errors = []
+
+    def client(tid):
+        rng = np.random.RandomState(tid)
+        try:
+            for i in range(per_client):
+                x = rng.rand(*shapes[(tid + i) % len(shapes)]).astype(np.float32)
+                svc.predict(x, timeout=120)
+        except Exception as e:
+            errors.append(repr(e))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    wall = time.perf_counter() - t0
+    stats = svc.stats()
+    svc.stop()
+    if errors:
+        raise RuntimeError(f"{len(errors)} client errors: {errors[0]}")
+    return {
+        "p50_ms": stats["latency_ms"]["p50"],
+        "p99_ms": stats["latency_ms"]["p99"],
+        "qps": round(per_client * clients / wall, 1),
+        "batch_occupancy": stats["batch_occupancy"],
+        "post_warmup_compiles": stats["compile_cache"]["misses"]
+        - stats.get("warmup_programs", 0),
+        "requests": per_client * clients,
+        "clients": clients,
+    }
+
+
 def main():
     # bs=512 saturates one v5e MXU (measured: 64→752, 256→1537, 512→1665
     # img/s; 1024 OOMs in 16 GB HBM); fall back on allocation failure
@@ -371,6 +434,12 @@ def main():
         except Exception as e:  # the synthetic number must still report
             sys.stderr.write(f"e2e path failed: {type(e).__name__}: {e}\n")
             result["e2e_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_SERVING", "1") == "1":
+        try:
+            result["serving_p99_latency"] = serving_latency()
+        except Exception as e:  # optional block: failure is a field, not rc!=0
+            sys.stderr.write(f"serving bench failed: {type(e).__name__}: {e}\n")
+            result["serving_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

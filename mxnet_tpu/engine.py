@@ -30,7 +30,8 @@ import threading
 from .base import getenv
 
 __all__ = ["set_bulk_size", "bulk", "is_naive", "wait_all", "push",
-           "new_var", "wait_for_var", "host_engine", "NaiveEngine"]
+           "new_var", "wait_for_var", "host_engine", "NaiveEngine",
+           "set_engine_type", "current_engine_type"]
 
 _ENGINE_TYPE = getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
 # process-wide like MXEngineSetBulkSize (a threading.local here meant worker
@@ -41,6 +42,12 @@ _bulk_lock = threading.Lock()
 
 def is_naive() -> bool:
     return _ENGINE_TYPE == "NaiveEngine"
+
+
+def current_engine_type() -> str:
+    """The active engine mode (reflects env, set_engine_type, and any live
+    NaiveEngine scope) — surfaced in serving stats()/debug dumps."""
+    return _ENGINE_TYPE
 
 
 def set_engine_type(name: str) -> None:

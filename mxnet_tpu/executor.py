@@ -15,6 +15,7 @@ reference's in-place aux mutation.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 import numpy as _np
@@ -27,7 +28,32 @@ from .ndarray.ndarray import NDArray
 from .symbol.graph import trace
 from . import random as _random
 
-__all__ = ["Executor"]
+__all__ = ["Executor", "compile_cache_stats", "reset_compile_cache_stats"]
+
+# process-wide compile-cache accounting: every _jit_cache lookup lands here,
+# so a serving layer (or a test) can assert "zero recompiles after warmup"
+# by snapshotting misses across a workload (mxnet_tpu.serving stats use it)
+_cache_stats = {"hits": 0, "misses": 0}
+_cache_stats_lock = threading.Lock()
+
+
+def compile_cache_stats() -> dict:
+    """Process-wide executor compile-cache counters ({"hits", "misses"}).
+    A miss is a program compile (new ``_jit_cache`` signature); a hit reuses
+    an already-compiled program."""
+    with _cache_stats_lock:
+        return dict(_cache_stats)
+
+
+def reset_compile_cache_stats() -> None:
+    with _cache_stats_lock:
+        _cache_stats["hits"] = 0
+        _cache_stats["misses"] = 0
+
+
+def _note_cache(hit: bool) -> None:
+    with _cache_stats_lock:
+        _cache_stats["hits" if hit else "misses"] += 1
 
 
 def _ones_cotangent(x):
@@ -101,6 +127,7 @@ class Executor:
 
     def _get_fwd(self, is_train: bool):
         key = ("fwd", self._signature(is_train))
+        _note_cache(hit=key in self._jit_cache)
         if key not in self._jit_cache:
             entries = self._symbol._entries
 
@@ -117,6 +144,7 @@ class Executor:
 
     def _get_fwdbwd(self):
         key = ("fwdbwd", self._signature(True))
+        _note_cache(hit=key in self._jit_cache)
         if key not in self._jit_cache:
             entries = self._symbol._entries
             gnames = self._grad_arg_names
@@ -143,6 +171,7 @@ class Executor:
 
     def _get_bwd_with_grads(self):
         key = ("bwdg", self._signature(True))
+        _note_cache(hit=key in self._jit_cache)
         if key not in self._jit_cache:
             entries = self._symbol._entries
             gnames = self._grad_arg_names

@@ -113,6 +113,45 @@ class BaseModule:
                 cb(params)
         return eval_metric.get_name_value()
 
+    def _pad_partial_batch(self, eval_batch):
+        """Pad a final partial batch up to the bound batch size.
+
+        An iterator whose last batch is smaller than the bound shape would
+        otherwise force a rebind — a fresh XLA compile for a one-off shape
+        (Executor._jit_cache is keyed by the full shape signature).  Row
+        padding via the serving layer's bucketing helper keeps every batch
+        on the already-compiled program; the extra rows are folded into
+        ``batch.pad`` so the existing output slicing drops them.
+        """
+        try:
+            bound = self.data_shapes
+        except Exception:
+            return eval_batch
+        if (not bound or not eval_batch.data
+                or len(bound) != len(eval_batch.data)):
+            return eval_batch
+        extras = []
+        for (_, bshape), arr in zip(bound, eval_batch.data):
+            if (len(arr.shape) != len(bshape)
+                    or tuple(arr.shape[1:]) != tuple(bshape[1:])
+                    or arr.shape[0] > bshape[0]):
+                return eval_batch  # genuinely new shape: rebind path owns it
+            extras.append(bshape[0] - arr.shape[0])
+        if not any(extras) or len(set(extras)) != 1:
+            return eval_batch
+        from ..io import DataBatch
+        from ..serving.bucketing import pad_batch_rows
+
+        padded = [nd.array(pad_batch_rows(
+            arr.asnumpy() if hasattr(arr, "asnumpy") else _np.asarray(arr),
+            bshape[0]))
+            for (_, bshape), arr in zip(bound, eval_batch.data)]
+        # labels are not fed (prediction path) — keeping them un-padded
+        # would change the executor signature right back
+        return DataBatch(data=padded, label=None,
+                         pad=(eval_batch.pad or 0) + extras[0],
+                         index=eval_batch.index)
+
     def iter_predict(self, eval_data, num_batch=None, reset=True, sparse_row_id_fn=None):
         assert self.binded and self.params_initialized
         if reset:
@@ -121,6 +160,7 @@ class BaseModule:
             if num_batch is not None and nbatch == num_batch:
                 break
             self.prepare(eval_batch, sparse_row_id_fn=sparse_row_id_fn)
+            eval_batch = self._pad_partial_batch(eval_batch)
             self.forward(eval_batch, is_train=False)
             pad = eval_batch.pad
             outputs = [out[0:out.shape[0] - (pad or 0)] for out in self.get_outputs()]
@@ -136,6 +176,7 @@ class BaseModule:
             if num_batch is not None and nbatch == num_batch:
                 break
             self.prepare(eval_batch, sparse_row_id_fn=sparse_row_id_fn)
+            eval_batch = self._pad_partial_batch(eval_batch)
             self.forward(eval_batch, is_train=False)
             pad = eval_batch.pad
             outputs = [out[0:out.shape[0] - (pad or 0)].copy()

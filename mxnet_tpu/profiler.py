@@ -29,10 +29,21 @@ _counters: Dict[str, float] = {}
 def set_config(filename="profile.json", profile_all=False, profile_symbolic=False,
                profile_imperative=False, profile_memory=False, profile_api=False,
                aggregate_stats=False, continuous_dump=False, **kwargs):
-    """Reference: MXSetProcessProfilerConfig."""
+    """Reference: MXSetProcessProfilerConfig.
+
+    All category flags persist (an earlier version silently dropped
+    ``profile_memory``/``profile_api``/``continuous_dump``): the memory and
+    api flags gate their event categories in :func:`_emit`, and
+    ``continuous_dump`` makes :func:`stop` flush the trace to ``filename``
+    automatically (the reference's keep-dumping-without-MXDumpProfile mode).
+    """
     _state["filename"] = filename
     _state["aggregate"] = aggregate_stats
     _state["imperative"] = bool(profile_imperative or profile_all)
+    _state["symbolic"] = bool(profile_symbolic or profile_all)
+    _state["memory"] = bool(profile_memory or profile_all)
+    _state["api"] = bool(profile_api or profile_all)
+    _state["continuous_dump"] = bool(continuous_dump)
 
 
 profiler_set_config = set_config
@@ -69,6 +80,8 @@ def stop(profile_process="worker"):
 
         jax.profiler.stop_trace()
         _state["jax_trace_dir"] = None
+    if _state.get("continuous_dump"):
+        dump()
 
 
 def pause(profile_process="worker"):
@@ -85,8 +98,16 @@ def _op_profiling() -> bool:
     return _state["running"] and _state.get("imperative", False)
 
 
+# event categories gated by their set_config flag; anything else (counters,
+# python scopes, serving spans) records whenever the profiler runs
+_GATED_CATS = {"memory": "memory", "api": "api"}
+
+
 def _emit(ph, name, cat, ts=None, dur=None, args=None, force=False):
     if not _state["running"] and not force:
+        return
+    flag = _GATED_CATS.get(cat)
+    if flag is not None and not _state.get(flag, False):
         return
     ev = {"ph": ph, "name": name, "cat": cat, "pid": os.getpid(),
           "tid": threading.get_ident(),

@@ -1,0 +1,20 @@
+"""mxnet_tpu.serving — online inference serving.
+
+The TPU-native production analogue of the reference's C predict API
+(``include/mxnet/c_predict_api.h``): dynamic micro-batching, shape-bucketed
+executor caching, warmup, backpressure, deadlines, and serving metrics.
+See docs/serving.md.
+"""
+from .batcher import (BACKPRESSURE_POLICIES, DeadlineExceededError,
+                      QueueFullError, RequestShedError, ServingClosedError,
+                      ServingConfig, ServingError)
+from .bucketing import (assemble_batch, batch_buckets, bucket_batch,
+                        bucket_shape, next_pow2, pad_batch_rows, pad_sample)
+from .metrics import ServingMetrics
+from .service import InferenceService
+
+__all__ = ["InferenceService", "ServingConfig", "ServingMetrics",
+           "ServingError", "QueueFullError", "DeadlineExceededError",
+           "RequestShedError", "ServingClosedError", "BACKPRESSURE_POLICIES",
+           "next_pow2", "batch_buckets", "bucket_batch", "bucket_shape",
+           "pad_sample", "pad_batch_rows", "assemble_batch"]

@@ -1,0 +1,120 @@
+"""Shape bucketing and padding helpers for the serving layer.
+
+XLA compiles one program per input-shape signature (``Executor._signature``
+keys ``_jit_cache`` by the full (name, shape, dtype) tuple), so an online
+service facing arbitrary request shapes would recompile on nearly every
+batch.  The classic serving answer (TF-Serving batching, SURVEY.md §7's
+"compile once, execute many" discipline) is to quantize both the batch axis
+and the per-sample dims onto a small fixed ladder of buckets and pad
+requests up to the bucket — every request shape then lands on one of a
+handful of precompiled executors.
+
+These helpers are shared by :class:`mxnet_tpu.serving.InferenceService`
+and by ``Module.predict`` (which pads the odd-shaped final batch up to the
+bound batch size instead of rebinding/recompiling for it).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+__all__ = ["next_pow2", "batch_buckets", "bucket_batch", "bucket_shape",
+           "pad_sample", "pad_batch_rows", "assemble_batch"]
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    n = int(n)
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def batch_buckets(max_batch_size: int) -> List[int]:
+    """The default batch-axis ladder: powers of two up to and including
+    ``max_batch_size`` (the cap itself is kept even when not a power of two,
+    so a full coalesce window never over-pads past the configured maximum)."""
+    out = []
+    b = 1
+    while b < max_batch_size:
+        out.append(b)
+        b <<= 1
+    out.append(int(max_batch_size))
+    return out
+
+
+def bucket_batch(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n; the largest bucket when none fits."""
+    for b in buckets:
+        if b >= n:
+            return int(b)
+    return int(buckets[-1])
+
+
+def bucket_shape(shape: Tuple[int, ...],
+                 shape_buckets: Optional[Iterable[Tuple[int, ...]]] = None
+                 ) -> Tuple[int, ...]:
+    """Map a per-sample shape onto its bucket.
+
+    With an explicit ``shape_buckets`` list the smallest same-rank bucket
+    that fits (every dim >= the sample's) wins; otherwise each dim is
+    rounded up to the next power of two — an open-world default that keeps
+    the compiled-program set logarithmic in observed shape diversity.
+    """
+    shape = tuple(int(d) for d in shape)
+    if shape_buckets:
+        fits = [tuple(int(d) for d in b) for b in shape_buckets
+                if len(b) == len(shape)
+                and all(bd >= sd for bd, sd in zip(b, shape))]
+        if fits:
+            return min(fits, key=lambda b: (_np.prod(b, dtype=_np.int64), b))
+    return tuple(next_pow2(d) for d in shape)
+
+
+def pad_sample(arr: _np.ndarray, target_shape: Tuple[int, ...]) -> _np.ndarray:
+    """Zero-pad the trailing region of every dim up to ``target_shape``.
+
+    Zero padding is the semantically neutral choice for the padded *interior*
+    dims of a sample (masked attention, summed/tanh'd features, etc. ignore
+    zeros); models for which zeros are not neutral should register exact
+    shape buckets instead.
+    """
+    if tuple(arr.shape) == tuple(target_shape):
+        return arr
+    if arr.ndim != len(target_shape):
+        raise ValueError(f"rank mismatch padding {arr.shape} -> {target_shape}")
+    pad = [(0, int(t) - int(s)) for s, t in zip(arr.shape, target_shape)]
+    if any(p[1] < 0 for p in pad):
+        raise ValueError(f"cannot pad {arr.shape} down to {target_shape}")
+    return _np.pad(arr, pad, mode="constant")
+
+
+def pad_batch_rows(arr: _np.ndarray, target_batch: int) -> _np.ndarray:
+    """Pad axis 0 up to ``target_batch`` by repeating the final row.
+
+    Repeating a real sample (the reference ``NDArrayIter`` wrap-around
+    ``pad`` trick) keeps the filler numerically in-distribution — no
+    log(0)/division hazards that all-zero rows could trip — and the rows
+    are discarded after the forward anyway.
+    """
+    n = arr.shape[0]
+    if n == int(target_batch):
+        return arr
+    if n > int(target_batch):
+        raise ValueError(f"cannot pad batch {n} down to {target_batch}")
+    if n == 0:
+        raise ValueError("cannot pad an empty batch")
+    filler = _np.repeat(arr[-1:], int(target_batch) - n, axis=0)
+    return _np.concatenate([arr, filler], axis=0)
+
+
+def assemble_batch(samples: Sequence[_np.ndarray],
+                   sample_bucket: Tuple[int, ...],
+                   batch_bucket: int) -> _np.ndarray:
+    """Stack per-request samples into one device-ready batch: each sample is
+    zero-padded to the sample bucket, the stack row-padded to the batch
+    bucket."""
+    stacked = _np.stack([pad_sample(_np.asarray(s), sample_bucket)
+                         for s in samples], axis=0)
+    return pad_batch_rows(stacked, batch_bucket)

@@ -1,0 +1,118 @@
+"""Serving metrics: counters, latency percentiles, QPS.
+
+Every counter is mirrored through :mod:`mxnet_tpu.profiler` ``Counter``
+objects under a ``serving`` Domain, so a running profiler sees queue depth,
+batch occupancy and request counts as chrome://tracing counter tracks next
+to the operator spans; ``snapshot()`` serves the same numbers as a plain
+dict for ``InferenceService.stats()``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .. import profiler as _profiler
+
+__all__ = ["ServingMetrics", "percentile"]
+
+# sliding-window sizes: big enough for stable tail percentiles, small
+# enough that a long-lived service never grows without bound
+_LATENCY_WINDOW = 4096
+_QPS_WINDOW_SEC = 30.0
+
+
+def percentile(samples: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 100]) over a non-empty list."""
+    if not samples:
+        return None
+    xs = sorted(samples)
+    rank = max(0, min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[rank]
+
+
+class ServingMetrics:
+    def __init__(self, name: str = "serving"):
+        self._lock = threading.Lock()
+        self._domain = _profiler.Domain(name)
+        self._counters: Dict[str, _profiler.Counter] = {}
+        self._totals: Dict[str, float] = {}
+        self._latencies: Deque[float] = deque(maxlen=_LATENCY_WINDOW)
+        self._queue_waits: Deque[float] = deque(maxlen=_LATENCY_WINDOW)
+        self._batch_sizes: Deque[Tuple[int, int]] = deque(maxlen=_LATENCY_WINDOW)
+        self._completions: Deque[float] = deque(maxlen=_LATENCY_WINDOW)
+        self._started = time.perf_counter()
+
+    # -- counters -----------------------------------------------------------------
+    def _counter(self, name: str) -> _profiler.Counter:
+        c = self._counters.get(name)
+        if c is None:
+            c = _profiler.Counter(self._domain, name)
+            self._counters[name] = c
+        return c
+
+    def incr(self, name: str, delta: float = 1) -> None:
+        with self._lock:
+            self._totals[name] = self._totals.get(name, 0) + delta
+            self._counter(name).set_value(self._totals[name])
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._totals[name] = value
+            self._counter(name).set_value(value)
+
+    # -- observations -------------------------------------------------------------
+    def observe_latency(self, seconds: float) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            self._latencies.append(seconds)
+            self._completions.append(now)
+            self._totals["requests_completed"] = \
+                self._totals.get("requests_completed", 0) + 1
+
+    def observe_queue_wait(self, seconds: float) -> None:
+        with self._lock:
+            self._queue_waits.append(seconds)
+
+    def observe_batch(self, real: int, padded: int) -> None:
+        with self._lock:
+            self._batch_sizes.append((int(real), int(padded)))
+            self._totals["batches"] = self._totals.get("batches", 0) + 1
+            self._counter("batches").set_value(self._totals["batches"])
+
+    # -- snapshot -----------------------------------------------------------------
+    def snapshot(self) -> dict:
+        now = time.perf_counter()
+        with self._lock:
+            lat = list(self._latencies)
+            waits = list(self._queue_waits)
+            batches = list(self._batch_sizes)
+            recent = [t for t in self._completions
+                      if now - t <= _QPS_WINDOW_SEC]
+            totals = dict(self._totals)
+        out = dict(totals)
+        out["latency_ms"] = {
+            "p50": _ms(percentile(lat, 50)),
+            "p90": _ms(percentile(lat, 90)),
+            "p99": _ms(percentile(lat, 99)),
+            "max": _ms(max(lat) if lat else None),
+            "count": len(lat),
+        }
+        out["queue_wait_ms_p99"] = _ms(percentile(waits, 99))
+        if batches:
+            real = sum(r for r, _ in batches)
+            padded = sum(p for _, p in batches)
+            out["batch_occupancy"] = round(real / max(1, padded), 4)
+            out["avg_batch_size"] = round(real / len(batches), 2)
+        else:
+            out["batch_occupancy"] = None
+            out["avg_batch_size"] = None
+        window = min(_QPS_WINDOW_SEC, max(now - self._started, 1e-9))
+        out["qps"] = round(len(recent) / window, 2)
+        out["uptime_sec"] = round(now - self._started, 3)
+        return out
+
+
+def _ms(seconds: Optional[float]) -> Optional[float]:
+    return None if seconds is None else round(seconds * 1e3, 3)

@@ -1,0 +1,496 @@
+"""InferenceService: online serving for bound symbols / Modules / Gluon blocks.
+
+The ROADMAP's production north star ("serve heavy traffic from millions of
+users") needs the inference-side analogue of the reference's C predict API
+(``include/mxnet/c_predict_api.h``): keep the compiled XLA program hot and
+the device fed under concurrent request load.  The pieces:
+
+- a dynamic micro-batcher (:mod:`.batcher`) coalescing concurrent
+  ``submit()`` calls up to ``max_batch_size`` / ``batch_timeout_ms``;
+- shape bucketing (:mod:`.bucketing`) so arbitrary request shapes land on a
+  small fixed set of compiled executors — the ``Executor._jit_cache``
+  signature-keying pattern lifted to a serving-wide executor cache;
+- explicit :meth:`InferenceService.warmup` that pre-compiles every
+  (batch-bucket × shape-bucket) program before traffic arrives;
+- a bounded queue with block / reject / shed-oldest backpressure,
+  per-request deadlines, per-request error isolation, and graceful drain;
+- serving metrics (queue depth, batch occupancy, latency percentiles, QPS,
+  compile-cache hits/misses) via :mod:`mxnet_tpu.profiler` counters and a
+  plain :meth:`InferenceService.stats` dict.
+
+``MXNET_ENGINE_TYPE=NaiveEngine`` (or the ``engine.NaiveEngine`` scope)
+turns the whole pipeline synchronous: ``submit()`` executes inline on the
+calling thread — the same serialize-everything debug mode the reference
+engine offers (src/engine/engine.cc:32-58) — while still exercising the
+identical bucketing/padding path so compiled-program behavior matches
+production.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as _np
+
+from .. import engine as _engine
+from .. import profiler as _profiler
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .batcher import (MicroBatcher, Request, ServingClosedError, ServingConfig,
+                      ServingError)
+from .bucketing import assemble_batch, bucket_batch, bucket_shape
+from .metrics import ServingMetrics
+
+__all__ = ["InferenceService"]
+
+
+def _as_sample(x) -> _np.ndarray:
+    if isinstance(x, NDArray):
+        x = x.asnumpy()
+    arr = _np.asarray(x)
+    if arr.dtype == _np.float64:
+        # jax canonicalizes f64->f32 anyway; normalize here so the bucket
+        # key (which includes dtype) is stable across numpy/python inputs
+        arr = arr.astype(_np.float32)
+    return arr
+
+
+class _CompileCounter:
+    """Serving-local compile-cache accounting: one hit/miss pair per adapter,
+    so service.stats() is not polluted by unrelated executors in-process."""
+
+    def __init__(self):
+        self.hits = 0
+        self.misses = 0
+        self._lock = threading.Lock()
+
+    def note(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.hits += 1
+            else:
+                self.misses += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses}
+
+
+# -- model adapters ---------------------------------------------------------------
+class _ExecutorAdapter:
+    """Serve a bound :class:`~mxnet_tpu.executor.Executor` through a
+    signature-keyed cache of reshaped executors (one per bucket shape)."""
+
+    def __init__(self, base_exec, data_names: Sequence[str],
+                 label_shapes: Optional[Sequence[Tuple[str, Tuple[int, ...]]]] = None):
+        self._base = base_exec
+        self.input_names = list(data_names)
+        self._label_shapes = list(label_shapes or [])
+        self._cache: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        self.counter = _CompileCounter()
+
+    def _executor_for(self, sig: tuple):
+        with self._lock:
+            ex = self._cache.get(sig)
+            if ex is not None:
+                self.counter.note(hit=True)
+                return ex
+            self.counter.note(hit=False)
+            shape_kwargs = {name: tuple(shape) for name, shape, _dt in sig}
+            batch = next(iter(shape_kwargs.values()))[0]
+            for lname, lshape in self._label_shapes:
+                # labels are never fed at inference; pin their shape to the
+                # bucket batch so infer_shape has a consistent environment
+                shape_kwargs.setdefault(lname, (batch,) + tuple(lshape[1:]))
+            ex = self._base.reshape(**shape_kwargs)
+            self._cache[sig] = ex
+            return ex
+
+    def run(self, feed: Dict[str, _np.ndarray]) -> List[object]:
+        sig = tuple((n, tuple(feed[n].shape), str(feed[n].dtype))
+                    for n in self.input_names)
+        ex = self._executor_for(sig)
+        outs = ex.forward(is_train=False,
+                          **{n: feed[n] for n in self.input_names})
+        return [o._data for o in outs]
+
+    def refresh_params(self) -> None:
+        """Re-sync parameters from the base executor into every cached bucket
+        executor (call after updating the served model's weights)."""
+        inputs = set(self.input_names) | {n for n, _ in self._label_shapes}
+        params = {n: self._base.arg_dict[n]
+                  for n in self._base.arg_dict if n not in inputs}
+        with self._lock:
+            for ex in self._cache.values():
+                ex.copy_params_from(params, dict(self._base.aux_dict),
+                                    allow_extra_params=True)
+
+    def compiled_signatures(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+
+class _BlockAdapter:
+    """Serve a (hybridized) Gluon block as a pure jitted apply function
+    (``parallel.data_parallel.block_apply_fn``), one compile per bucket."""
+
+    def __init__(self, block):
+        self._block = block
+        self._jit = None
+        self._params = None
+        self.input_names = ["data"]
+        self._seen: set = set()
+        self._lock = threading.Lock()
+        self.counter = _CompileCounter()
+
+    def _materialize(self, x: _np.ndarray) -> None:
+        import jax
+
+        from .. import nd
+        from ..parallel.data_parallel import block_apply_fn
+
+        # deferred-init blocks create their params on first eager call
+        self._block(nd.array(x))
+        apply_fn, params = block_apply_fn(self._block, is_train=False)
+        self._params = params
+        self._jit = jax.jit(apply_fn)
+
+    def run(self, feed: Dict[str, _np.ndarray]) -> List[object]:
+        x = feed["data"]
+        with self._lock:
+            if self._jit is None:
+                self._materialize(x)
+            key = (tuple(x.shape), str(x.dtype))
+            self.counter.note(hit=key in self._seen)
+            self._seen.add(key)
+        out = self._jit(self._params, x, None)
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+
+    def refresh_params(self) -> None:
+        with self._lock:
+            if self._jit is not None:
+                from ..parallel.data_parallel import block_apply_fn
+
+                _, self._params = block_apply_fn(self._block, is_train=False)
+
+    def compiled_signatures(self) -> int:
+        with self._lock:
+            return len(self._seen)
+
+
+class _CallableAdapter:
+    """Serve an arbitrary ``fn(batch NDArray) -> NDArray | list`` — the
+    escape hatch for custom pipelines; caching is whatever fn does."""
+
+    def __init__(self, fn, data_names: Sequence[str]):
+        self._fn = fn
+        self.input_names = list(data_names)
+        self._seen: set = set()
+        self._lock = threading.Lock()
+        self.counter = _CompileCounter()
+
+    def run(self, feed: Dict[str, _np.ndarray]) -> List[object]:
+        key = tuple((n, tuple(feed[n].shape), str(feed[n].dtype))
+                    for n in self.input_names)
+        with self._lock:
+            self.counter.note(hit=key in self._seen)
+            self._seen.add(key)
+        if len(self.input_names) == 1:
+            out = self._fn(NDArray(_jnp(feed[self.input_names[0]])))
+        else:
+            out = self._fn({n: NDArray(_jnp(feed[n]))
+                            for n in self.input_names})
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        return [o._data if isinstance(o, NDArray) else _jnp(o) for o in out]
+
+    def refresh_params(self) -> None:
+        pass
+
+    def compiled_signatures(self) -> int:
+        with self._lock:
+            return len(self._seen)
+
+
+def _jnp(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
+
+
+def _make_adapter(model, data_names):
+    # duck-typed: Module-likes carry a bound executor + data_names; raw
+    # executors carry arg_dict/forward; Gluon blocks carry collect_params
+    if hasattr(model, "_exec") and hasattr(model, "data_names"):
+        if not (getattr(model, "binded", False)
+                and getattr(model, "params_initialized", False)):
+            raise MXNetError("InferenceService: Module must be bound and "
+                             "have initialized params")
+        label_shapes = [(n, tuple(s)) for n, s in (model.label_shapes or [])]
+        return _ExecutorAdapter(model._exec,
+                                data_names or model.data_names,
+                                label_shapes)
+    if hasattr(model, "arg_dict") and hasattr(model, "forward"):
+        return _ExecutorAdapter(model, data_names or ["data"])
+    if hasattr(model, "collect_params") and callable(model):
+        return _BlockAdapter(model)
+    if callable(model):
+        return _CallableAdapter(model, data_names or ["data"])
+    raise MXNetError(f"InferenceService: cannot serve {type(model).__name__}")
+
+
+# -- the service ------------------------------------------------------------------
+class InferenceService:
+    """Concurrent online inference over a bound model.
+
+    Parameters
+    ----------
+    model : Module | Executor | gluon.Block | callable
+        The thing to serve.  Modules must be bound with initialized params;
+        blocks should be initialized (hybridize for best performance).
+    config : ServingConfig, optional
+        Batching/backpressure knobs; defaults read ``TPUMX_SERVING_*`` env.
+    data_names : list of str, optional
+        Input names for executor-backed models (default: the module's own).
+
+    A request is ONE sample (no batch axis), as a numpy/NDArray value or a
+    ``{input_name: value}`` dict; the service batches, pads, executes, and
+    returns per-request outputs with padding stripped.
+    """
+
+    def __init__(self, model, config: Optional[ServingConfig] = None,
+                 data_names: Optional[Sequence[str]] = None):
+        self._config = config or ServingConfig()
+        self._adapter = _make_adapter(model, data_names)
+        self._metrics = ServingMetrics()
+        self._batcher = MicroBatcher(self._config, self._metrics)
+        self._worker: Optional[threading.Thread] = None
+        self._worker_lock = threading.Lock()
+        self._warmed: set = set()
+
+    # -- submission ---------------------------------------------------------------
+    def submit(self, data, deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = None):
+        """Enqueue one request; returns a ``concurrent.futures.Future``.
+
+        ``deadline_ms`` bounds total queue+execute time (default:
+        ``config.default_deadline_ms``); an expired request fails with
+        :class:`DeadlineExceededError` without touching the device.
+        ``timeout`` bounds a *blocking* submit under the ``block``
+        backpressure policy.
+        """
+        if self._batcher.closed:
+            raise ServingClosedError("service is shut down")
+        sample = self._normalize(data)
+        key = self._bucket_key(sample)
+        ms = deadline_ms if deadline_ms is not None \
+            else self._config.default_deadline_ms
+        deadline = None if ms is None else time.perf_counter() + ms / 1e3
+        self._metrics.incr("requests_submitted")
+        if _engine.is_naive():
+            # synchronous debug mode: same pad/bucket/execute path, no
+            # threads — every submit() runs to completion inline
+            req = Request(sample, key, deadline, seq=0)
+            if req.expired():
+                from .batcher import DeadlineExceededError
+
+                req.fail(DeadlineExceededError("deadline exceeded"))
+            else:
+                self._run_batch([req])
+            return req.future
+        self._ensure_worker()
+        from .batcher import QueueFullError
+
+        try:
+            req = self._batcher.put(sample, key, deadline, timeout=timeout)
+        except QueueFullError:
+            self._metrics.incr("requests_rejected")
+            raise
+        return req.future
+
+    def predict(self, data, deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None):
+        """Blocking convenience wrapper: ``submit(...).result(timeout)``."""
+        return self.submit(data, deadline_ms=deadline_ms).result(timeout)
+
+    def _normalize(self, data) -> Dict[str, _np.ndarray]:
+        names = self._adapter.input_names
+        if isinstance(data, dict):
+            missing = [n for n in names if n not in data]
+            if missing:
+                raise MXNetError(f"request missing inputs {missing}")
+            return {n: _as_sample(data[n]) for n in names}
+        if len(names) != 1:
+            raise MXNetError(
+                f"model has inputs {names}; pass a dict request")
+        return {names[0]: _as_sample(data)}
+
+    def _bucket_key(self, sample: Dict[str, _np.ndarray]) -> tuple:
+        return tuple(
+            (n, bucket_shape(sample[n].shape, self._config.shape_buckets),
+             str(sample[n].dtype))
+            for n in self._adapter.input_names)
+
+    # -- warmup -------------------------------------------------------------------
+    def warmup(self, sample_shapes: Optional[Sequence] = None,
+               dtype=_np.float32) -> int:
+        """Pre-compile every (shape bucket × batch bucket) program.
+
+        ``sample_shapes``: representative per-sample shapes (tuples, or
+        ``{input: shape}`` dicts for multi-input models); defaults to
+        ``config.shape_buckets``.  Returns the number of programs compiled
+        by this call.  Run before taking traffic: with a covering warmup, a
+        steady-state service performs **zero** XLA compiles.
+        """
+        shapes = sample_shapes if sample_shapes is not None \
+            else self._config.shape_buckets
+        if not shapes:
+            raise MXNetError("warmup needs sample_shapes (or a config with "
+                             "shape_buckets)")
+        names = self._adapter.input_names
+        todo = []
+        queued = set()
+        for s in shapes:
+            if isinstance(s, dict):
+                per_input = {n: bucket_shape(tuple(s[n]),
+                                             self._config.shape_buckets)
+                             for n in names}
+            else:
+                if len(names) != 1:
+                    raise MXNetError("multi-input model: warmup shapes must "
+                                     "be dicts")
+                per_input = {names[0]: bucket_shape(
+                    tuple(s), self._config.shape_buckets)}
+            for b in self._config.batch_buckets:
+                sig = (b, tuple(sorted(per_input.items())))
+                if sig not in self._warmed and sig not in queued:
+                    queued.add(sig)
+                    todo.append((b, per_input, sig))
+        compiled = 0
+        for b, per_input, sig in todo:
+            feed = {n: _np.zeros((b,) + sh, dtype=dtype)
+                    for n, sh in per_input.items()}
+            with _profiler.scope("serving.warmup", cat="serving"):
+                self._adapter.run(feed)
+            self._warmed.add(sig)
+            compiled += 1
+        if compiled:
+            self._metrics.incr("warmup_programs", compiled)
+        return compiled
+
+    # -- dispatch -----------------------------------------------------------------
+    def _ensure_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        with self._worker_lock:
+            if self._worker is None or not self._worker.is_alive():
+                t = threading.Thread(target=self._worker_loop,
+                                     name="tpumx-serving-dispatch",
+                                     daemon=True)
+                self._worker = t
+                t.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            batch = self._batcher.get_batch()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            try:
+                self._run_batch(batch)
+            except Exception as exc:  # noqa: BLE001 — the worker must outlive
+                # any per-batch surprise; strand no future
+                for r in batch:
+                    r.fail(ServingError(f"dispatch failed: {exc!r}"))
+
+    def _run_batch(self, requests: List[Request],
+                   _isolated: bool = False) -> None:
+        live = [r for r in requests if not r.future.cancelled()]
+        if not live:
+            return
+        cfg = self._config
+        n = len(live)
+        padded = bucket_batch(n, cfg.batch_buckets)
+        t0 = time.perf_counter()
+        try:
+            feed = {}
+            for name, sample_bucket, _dt in live[0].bucket_key:
+                feed[name] = assemble_batch(
+                    [r.data[name] for r in live], sample_bucket, padded)
+            with _profiler.scope("serving.batch", cat="serving"):
+                outs = self._adapter.run(feed)
+        except Exception as exc:  # noqa: BLE001 — isolate, then surface
+            if n == 1 or _isolated:
+                self._metrics.incr("requests_failed", n)
+                for r in live:
+                    r.fail(exc if isinstance(exc, ServingError)
+                           else ServingError(f"inference failed: {exc!r}"))
+                return
+            # error isolation: a batch-level failure is retried one request
+            # at a time so only the genuinely poisonous request(s) fail
+            self._metrics.incr("batch_retries_isolated")
+            for r in live:
+                self._run_batch([r], _isolated=True)
+            return
+        now = time.perf_counter()
+        self._metrics.observe_batch(real=n, padded=padded)
+        for i, r in enumerate(live):
+            rows = [out[i] for out in outs]
+            result = NDArray(rows[0]) if len(rows) == 1 \
+                else [NDArray(x) for x in rows]
+            try:
+                r.future.set_result(result)
+            except Exception:  # cancelled/raced — drop on the floor
+                continue
+            self._metrics.observe_latency(now - r.t_submit)
+            self._metrics.observe_queue_wait(t0 - r.t_submit)
+
+    # -- introspection ------------------------------------------------------------
+    def stats(self) -> dict:
+        """One coherent snapshot of the service's health counters."""
+        from .. import executor as _executor
+
+        out = self._metrics.snapshot()
+        out["queue_depth"] = self._batcher.depth()
+        out["compile_cache"] = self._adapter.counter.snapshot()
+        out["compiled_signatures"] = self._adapter.compiled_signatures()
+        out["process_compile_cache"] = _executor.compile_cache_stats()
+        out["engine"] = _engine.current_engine_type()
+        out["closed"] = self._batcher.closed
+        out["config"] = {
+            "max_batch_size": self._config.max_batch_size,
+            "batch_timeout_ms": self._config.batch_timeout_ms,
+            "queue_bound": self._config.queue_bound,
+            "backpressure": self._config.backpressure,
+            "batch_buckets": list(self._config.batch_buckets),
+            "shape_buckets": self._config.shape_buckets,
+        }
+        return out
+
+    def refresh_params(self) -> None:
+        """Push updated model weights into every cached bucket executor."""
+        self._adapter.refresh_params()
+
+    # -- lifecycle ----------------------------------------------------------------
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Stop accepting requests, finish the backlog, stop the worker."""
+        self.stop(drain=True, timeout=timeout)
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Shut down.  ``drain=True`` completes queued requests first;
+        ``drain=False`` fails them with :class:`ServingClosedError`."""
+        self._batcher.close(drain=drain)
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join(timeout)
+
+    close = stop
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)

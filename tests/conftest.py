@@ -40,6 +40,10 @@ def pytest_configure(config):
         "markers",
         "slow: example-family smoke runs too slow for the default tier "
         "(run with `pytest -m slow tests/test_examples_smoke.py`)")
+    config.addinivalue_line(
+        "markers",
+        "serving: online inference serving subsystem (mxnet_tpu.serving; "
+        "select with `pytest -m serving`)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,462 @@
+"""Serving subsystem tests: dynamic batching, bucketed executor cache,
+backpressure, deadlines, isolation, drain (ISSUE 2 acceptance)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, serving, sym
+from mxnet_tpu.serving import (DeadlineExceededError, InferenceService,
+                               QueueFullError, RequestShedError,
+                               ServingClosedError, ServingConfig, ServingError)
+
+pytestmark = pytest.mark.serving
+
+
+def _varlen_sym():
+    """tanh -> sum over the (padded) length axis -> FC: zero padding of the
+    length axis is exactly neutral, so bucket padding preserves outputs."""
+    data = sym.Variable("data")
+    pooled = sym.sum(sym.Activation(data, act_type="tanh"), axis=1)
+    return sym.FullyConnected(pooled, num_hidden=5, name="fc")
+
+
+def _varlen_module(batch=4):
+    mod = mx.mod.Module(_varlen_sym(), data_names=("data",), label_names=None,
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("data", (batch, 4, 8))], for_training=False)
+    mod.init_params(mx.init.Uniform(0.1))
+    return mod
+
+
+def _oracle(mod):
+    """Pure-numpy forward for the varlen symbol."""
+    args, _ = mod.get_params()
+    w = args["fc_weight"].asnumpy()
+    b = args["fc_bias"].asnumpy()
+
+    def f(x):
+        return np.tanh(x).sum(axis=0) @ w.T + b
+
+    return f
+
+
+def _service(mod, **over):
+    kw = dict(max_batch_size=4, batch_timeout_ms=5.0,
+              shape_buckets=[(4, 8), (8, 8)])
+    kw.update(over)
+    return InferenceService(mod, ServingConfig(**kw))
+
+
+# -- acceptance: mixed-shape concurrent workload, zero post-warmup compiles ------
+def test_mixed_shape_concurrent_zero_recompiles():
+    mod = _varlen_module()
+    oracle = _oracle(mod)
+    svc = _service(mod)
+    svc.warmup([(3, 8), (5, 8), (8, 8)])
+    warm = svc.stats()
+    assert warm["compile_cache"]["misses"] > 0  # warmup actually compiled
+    misses0 = warm["compile_cache"]["misses"]
+    proc_misses0 = warm["process_compile_cache"]["misses"]
+
+    shapes = [(3, 8), (5, 8), (7, 8)]  # >= 3 request shapes
+    errors = []
+
+    def client(tid):
+        rng = np.random.RandomState(7 + tid)
+        try:
+            for i in range(8):
+                x = rng.rand(*shapes[(tid + i) % len(shapes)]).astype(np.float32)
+                got = svc.predict(x, timeout=30).asnumpy()
+                np.testing.assert_allclose(got, oracle(x), rtol=1e-4, atol=1e-5)
+        except Exception as e:  # surface through the main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+
+    stats = svc.stats()
+    # 4 threads x 8 requests = 32 served, zero new XLA programs
+    assert stats["requests_completed"] >= 32
+    assert stats["compile_cache"]["misses"] == misses0
+    assert stats["compile_cache"]["hits"] > 0
+    assert stats["process_compile_cache"]["misses"] == proc_misses0
+    # stats snapshot is populated
+    assert stats["latency_ms"]["p50"] is not None
+    assert stats["latency_ms"]["p99"] is not None
+    assert stats["batch_occupancy"] is not None and 0 < stats["batch_occupancy"] <= 1
+    assert stats["queue_depth"] == 0
+    assert stats["qps"] > 0
+    svc.stop()
+
+
+def test_batch_coalescing():
+    mod = _varlen_module()
+    svc = _service(mod, batch_timeout_ms=50.0)
+    svc.warmup([(4, 8)])
+    futs = [svc.submit(np.ones((4, 8), np.float32)) for _ in range(8)]
+    for f in futs:
+        f.result(30)
+    stats = svc.stats()
+    # 8 same-bucket requests submitted within one coalesce window must not
+    # run as 8 singleton batches
+    assert stats["batches"] < 8
+    assert stats["avg_batch_size"] > 1
+    svc.stop()
+
+
+def test_bucket_padding_correctness_single():
+    mod = _varlen_module()
+    oracle = _oracle(mod)
+    svc = _service(mod, batch_timeout_ms=0.0)
+    svc.warmup([(3, 8), (5, 8), (8, 8)])
+    for L in (1, 2, 3, 4, 5, 6, 7, 8):
+        x = np.random.rand(L, 8).astype(np.float32)
+        np.testing.assert_allclose(svc.predict(x, timeout=30).asnumpy(),
+                                   oracle(x), rtol=1e-4, atol=1e-5)
+    svc.stop()
+
+
+# -- deadlines --------------------------------------------------------------------
+def test_deadline_expiry_returns_timeout_error():
+    gate = threading.Event()
+
+    def slow_model(x):
+        gate.wait(5)
+        return x * 2
+
+    svc = InferenceService(slow_model,
+                           ServingConfig(max_batch_size=1, batch_timeout_ms=0.0,
+                                         queue_bound=8))
+    first = svc.submit(np.ones((2,), np.float32))     # occupies the worker
+    time.sleep(0.05)                                  # worker is now blocked
+    doomed = svc.submit(np.ones((2,), np.float32), deadline_ms=1.0)
+    time.sleep(0.05)
+    gate.set()
+    first.result(10)
+    with pytest.raises(DeadlineExceededError):
+        doomed.result(10)
+    assert svc.stats().get("requests_expired", 0) >= 1
+    svc.stop()
+
+
+def test_default_deadline_from_config():
+    gate = threading.Event()
+
+    def slow_model(x):
+        gate.wait(5)
+        return x
+
+    svc = InferenceService(slow_model,
+                           ServingConfig(max_batch_size=1, batch_timeout_ms=0.0,
+                                         default_deadline_ms=1.0, queue_bound=8))
+    first = svc.submit(np.ones((2,), np.float32), deadline_ms=10000)
+    time.sleep(0.05)
+    doomed = svc.submit(np.ones((2,), np.float32))    # inherits 1ms default
+    time.sleep(0.05)
+    gate.set()
+    first.result(10)
+    with pytest.raises(DeadlineExceededError):
+        doomed.result(10)
+    svc.stop()
+
+
+# -- error isolation --------------------------------------------------------------
+def test_error_isolation_failing_request_spares_batch():
+    def touchy_model(x):
+        if (x.asnumpy() < 0).any():
+            raise ValueError("poison")
+        return x * 2
+
+    svc = InferenceService(touchy_model,
+                           ServingConfig(max_batch_size=4,
+                                         batch_timeout_ms=200.0,
+                                         shape_buckets=[(3,)]))
+    good = [svc.submit(np.full((3,), i + 1, np.float32)) for i in range(3)]
+    bad = svc.submit(np.full((3,), -1, np.float32))
+    for i, f in enumerate(good):
+        np.testing.assert_allclose(f.result(30).asnumpy(), (i + 1) * 2.0)
+    with pytest.raises(ServingError):
+        bad.result(30)
+    stats = svc.stats()
+    assert stats.get("batch_retries_isolated", 0) >= 1
+    assert stats.get("requests_failed", 0) == 1
+    svc.stop()
+
+
+# -- backpressure -----------------------------------------------------------------
+def _stalled_service(policy, queue_bound=2):
+    gate = threading.Event()
+
+    def slow_model(x):
+        gate.wait(10)
+        return x
+
+    svc = InferenceService(slow_model,
+                           ServingConfig(max_batch_size=1, batch_timeout_ms=0.0,
+                                         queue_bound=queue_bound,
+                                         backpressure=policy))
+    # first request occupies the worker; the next `queue_bound` fill the queue
+    inflight = [svc.submit(np.zeros((1,), np.float32))]
+    time.sleep(0.05)
+    inflight += [svc.submit(np.zeros((1,), np.float32))
+                 for _ in range(queue_bound)]
+    return svc, gate, inflight
+
+
+def test_backpressure_reject():
+    svc, gate, inflight = _stalled_service("reject")
+    with pytest.raises(QueueFullError):
+        svc.submit(np.zeros((1,), np.float32))
+    assert svc.stats().get("requests_rejected", 0) >= 0  # counted at admission
+    gate.set()
+    for f in inflight:
+        f.result(30)
+    svc.stop()
+
+
+def test_backpressure_block_timeout():
+    svc, gate, inflight = _stalled_service("block")
+    with pytest.raises(QueueFullError):
+        svc.submit(np.zeros((1,), np.float32), timeout=0.05)
+    gate.set()
+    for f in inflight:
+        f.result(30)
+    svc.stop()
+
+
+def test_backpressure_shed_oldest():
+    svc, gate, inflight = _stalled_service("shed_oldest")
+    fresh = svc.submit(np.zeros((1,), np.float32))
+    gate.set()
+    # the oldest *queued* request (inflight[1]) was shed to admit `fresh`
+    with pytest.raises(RequestShedError):
+        inflight[1].result(30)
+    inflight[0].result(30)
+    for f in inflight[2:]:
+        f.result(30)
+    fresh.result(30)
+    assert svc.stats().get("requests_shed", 0) >= 1
+    svc.stop()
+
+
+# -- drain / shutdown -------------------------------------------------------------
+def test_graceful_drain_completes_backlog():
+    def slowish(x):
+        time.sleep(0.02)
+        return x + 1
+
+    svc = InferenceService(slowish,
+                           ServingConfig(max_batch_size=2, batch_timeout_ms=1.0,
+                                         queue_bound=64))
+    futs = [svc.submit(np.full((2,), i, np.float32)) for i in range(10)]
+    svc.drain(timeout=30)
+    for i, f in enumerate(futs):
+        assert f.done()
+        np.testing.assert_allclose(f.result(0).asnumpy(), i + 1.0)
+    with pytest.raises(ServingClosedError):
+        svc.submit(np.zeros((2,), np.float32))
+
+
+def test_stop_without_drain_fails_pending():
+    gate = threading.Event()
+
+    def slow_model(x):
+        gate.wait(10)
+        return x
+
+    svc = InferenceService(slow_model,
+                           ServingConfig(max_batch_size=1, batch_timeout_ms=0.0,
+                                         queue_bound=8))
+    first = svc.submit(np.zeros((1,), np.float32))
+    time.sleep(0.05)
+    pending = svc.submit(np.zeros((1,), np.float32))
+    svc._batcher.close(drain=False)
+    gate.set()
+    first.result(30)   # in-flight work still completes
+    with pytest.raises(ServingClosedError):
+        pending.result(30)
+    svc.stop()
+
+
+def test_context_manager_drains():
+    with InferenceService(lambda x: x * 3,
+                          ServingConfig(max_batch_size=2)) as svc:
+        f = svc.submit(np.ones((2,), np.float32))
+    np.testing.assert_allclose(f.result(0).asnumpy(), 3.0)
+
+
+# -- NaiveEngine synchronous debug mode -------------------------------------------
+def test_naive_engine_synchronous_mode():
+    mod = _varlen_module()
+    oracle = _oracle(mod)
+    svc = _service(mod)
+    svc.warmup([(4, 8)])
+    with mx.engine.NaiveEngine():
+        x = np.random.rand(4, 8).astype(np.float32)
+        f = svc.submit(x)
+        assert f.done()  # completed inline on the calling thread
+        np.testing.assert_allclose(f.result(0).asnumpy(), oracle(x),
+                                   rtol=1e-4, atol=1e-5)
+        assert svc.stats()["engine"] == "NaiveEngine"
+    assert svc._worker is None  # no dispatch thread was ever started
+    svc.stop()
+
+
+# -- gluon block + callable adapters ----------------------------------------------
+def test_serving_gluon_block():
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(6, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    svc = InferenceService(net, ServingConfig(max_batch_size=4,
+                                              batch_timeout_ms=1.0,
+                                              shape_buckets=[(8,)]))
+    svc.warmup([(8,)])
+    misses0 = svc.stats()["compile_cache"]["misses"]
+    x = np.random.rand(8).astype(np.float32)
+    got = svc.predict(x, timeout=30).asnumpy()
+    want = net(nd.array(x[None])).asnumpy()[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert svc.stats()["compile_cache"]["misses"] == misses0
+    svc.stop()
+
+
+# -- bucketing helpers ------------------------------------------------------------
+def test_bucketing_helpers():
+    assert serving.next_pow2(1) == 1
+    assert serving.next_pow2(5) == 8
+    assert serving.batch_buckets(8) == [1, 2, 4, 8]
+    assert serving.batch_buckets(6) == [1, 2, 4, 6]
+    assert serving.bucket_batch(3, [1, 2, 4, 8]) == 4
+    assert serving.bucket_batch(99, [1, 2, 4, 8]) == 8
+    assert serving.bucket_shape((3, 8), [(4, 8), (8, 8)]) == (4, 8)
+    assert serving.bucket_shape((5, 8), [(4, 8), (8, 8)]) == (8, 8)
+    assert serving.bucket_shape((3, 5)) == (4, 8)  # pow2 fallback
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    padded = serving.pad_sample(x, (4, 3))
+    assert padded.shape == (4, 3) and (padded[2:] == 0).all()
+    rows = serving.pad_batch_rows(x, 5)
+    assert rows.shape == (5, 3)
+    np.testing.assert_array_equal(rows[2:], np.tile(x[-1], (3, 1)))
+    batch = serving.assemble_batch([np.ones((2, 3), np.float32)], (2, 4), 4)
+    assert batch.shape == (4, 2, 4)
+    with pytest.raises(ValueError):
+        serving.pad_sample(np.ones((5, 3)), (4, 3))
+
+
+def test_serving_config_env_defaults(monkeypatch):
+    monkeypatch.setenv("TPUMX_SERVING_MAX_BATCH_SIZE", "16")
+    monkeypatch.setenv("TPUMX_SERVING_BATCH_TIMEOUT_MS", "7.5")
+    monkeypatch.setenv("TPUMX_SERVING_QUEUE_BOUND", "99")
+    monkeypatch.setenv("TPUMX_SERVING_BACKPRESSURE", "reject")
+    monkeypatch.setenv("TPUMX_SERVING_DEADLINE_MS", "250")
+    cfg = ServingConfig()
+    assert cfg.max_batch_size == 16
+    assert cfg.batch_timeout_ms == 7.5
+    assert cfg.queue_bound == 99
+    assert cfg.backpressure == "reject"
+    assert cfg.default_deadline_ms == 250.0
+    assert cfg.batch_buckets == [1, 2, 4, 8, 16]
+    with pytest.raises(ValueError):
+        ServingConfig(backpressure="bogus")
+
+
+# -- Module.predict partial-batch padding (satellite) -----------------------------
+class _PartialTailIter(mx.io.DataIter):
+    """Yields full batches then one smaller final batch (the shape-breaking
+    case NDArrayIter's wrap-around padding hides)."""
+
+    def __init__(self, X, batch_size):
+        super().__init__(batch_size)
+        self.X = X
+        self.pos = 0
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc("data", (self.batch_size,) + self.X.shape[1:])]
+
+    @property
+    def provide_label(self):
+        return []
+
+    def reset(self):
+        self.pos = 0
+
+    def __next__(self):
+        if self.pos >= len(self.X):
+            raise StopIteration
+        chunk = self.X[self.pos:self.pos + self.batch_size]
+        self.pos += self.batch_size
+        return mx.io.DataBatch(data=[nd.array(chunk)], label=None, pad=None)
+
+
+def test_module_predict_pads_partial_final_batch():
+    from mxnet_tpu import executor as _executor
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(sym.Activation(data, act_type="relu"),
+                             num_hidden=3, name="fc")
+    mod = mx.mod.Module(net, data_names=("data",), label_names=None,
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("data", (16, 6))], for_training=False)
+    mod.init_params(mx.init.Uniform(0.1))
+
+    X = np.random.rand(40, 6).astype(np.float32)  # 16 + 16 + 8 (partial)
+    out1 = mod.predict(_PartialTailIter(X, 16))
+    assert out1.shape == (40, 3)
+
+    # second pass: every shape (including the padded tail) is already
+    # compiled — zero new XLA programs
+    before = _executor.compile_cache_stats()["misses"]
+    out2 = mod.predict(_PartialTailIter(X, 16))
+    assert _executor.compile_cache_stats()["misses"] == before
+    np.testing.assert_allclose(out1.asnumpy(), out2.asnumpy(), rtol=1e-6)
+
+    # oracle: a directly-bound full-width forward over the exact rows
+    args, _ = mod.get_params()
+    w, b = args["fc_weight"].asnumpy(), args["fc_bias"].asnumpy()
+    want = np.maximum(X, 0) @ w.T + b
+    np.testing.assert_allclose(out1.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+# -- profiler satellite ------------------------------------------------------------
+def test_profiler_set_config_persists_flags(tmp_path):
+    from mxnet_tpu import profiler
+
+    fn = str(tmp_path / "prof.json")
+    profiler.set_config(filename=fn, profile_memory=True, profile_api=True,
+                        continuous_dump=True)
+    assert profiler._state["memory"] and profiler._state["api"]
+    assert profiler._state["continuous_dump"]
+    profiler.start()
+    profiler._emit("C", "pool_mem", "memory", args={"pool_mem": 1})
+    profiler._emit("X", "api_call", "api", ts=0.0, dur=1.0)
+    profiler.stop()  # continuous_dump flushes without an explicit dump()
+    names = [e["name"] for e in profiler._events]
+    assert "pool_mem" in names and "api_call" in names
+    import json as _json
+
+    with open(fn) as f:
+        assert "pool_mem" in _json.dumps(_json.load(f))
+
+    # flags off: the categories are gated out
+    profiler._events.clear()
+    profiler.set_config(filename=fn)
+    profiler.start()
+    profiler._emit("C", "gated_mem", "memory", args={"gated_mem": 1})
+    profiler._emit("X", "gated_api", "api", ts=0.0, dur=1.0)
+    profiler._emit("X", "open_span", "python", ts=0.0, dur=1.0)
+    profiler.stop()
+    names = [e["name"] for e in profiler._events]
+    assert "gated_mem" not in names and "gated_api" not in names
+    assert "open_span" in names
+    profiler._events.clear()
