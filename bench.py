@@ -182,12 +182,17 @@ def supervise():
 
 
 def e2e_throughput(batch_size: int, batches: int = 10, warmup: int = 3):
-    """images/sec through Module.fit + native ImageRecordIter + tpu_sync —
-    the north-star path itself (train_imagenet.py, common/fit.py)."""
+    """(images/sec, fused) through Module.fit + native ImageRecordIter +
+    tpu_sync — the north-star path itself (train_imagenet.py, common/fit.py).
+    ``fused`` reports whether Module.fit ran on the fused whole-train-step
+    program; BENCH_FUSED=0 forces the legacy per-param path for comparison."""
     import argparse
     import glob
     import shutil
     import tempfile
+
+    if os.environ.get("BENCH_FUSED") == "0":
+        os.environ["TPUMX_FUSED_STEP"] = "0"
 
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.join(here, "example", "image-classification"))
@@ -231,7 +236,8 @@ def e2e_throughput(batch_size: int, batches: int = 10, warmup: int = 3):
     if len(usable) < 2:
         raise RuntimeError(f"too few batches measured: {len(marks)}")
     (n0, t0), (n1, t1) = usable[0], usable[-1]
-    return (n1 - n0) * batch_size / (t1 - t0)
+    return ((n1 - n0) * batch_size / (t1 - t0),
+            getattr(mod, "_fused_step_count", 0) > 0)
 
 
 def serving_latency(requests: int = None, clients: int = None):
@@ -424,9 +430,10 @@ def main():
     mode = os.environ.get("BENCH_MODE", "both")
     if mode in ("both", "e2e"):
         try:
-            e2e = e2e_throughput(batch_size)
+            e2e, e2e_fused = e2e_throughput(batch_size)
             result["e2e_value"] = round(e2e, 2)
             result["e2e_vs_synthetic"] = round(e2e / img_per_sec, 4)
+            result["fused"] = bool(e2e_fused)
             if mode == "e2e":
                 result["metric"] = "resnet50_train_throughput_e2e"
                 result["value"] = round(e2e, 2)

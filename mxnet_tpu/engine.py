@@ -29,9 +29,9 @@ import threading
 
 from .base import getenv
 
-__all__ = ["set_bulk_size", "bulk", "is_naive", "wait_all", "push",
-           "new_var", "wait_for_var", "host_engine", "NaiveEngine",
-           "set_engine_type", "current_engine_type"]
+__all__ = ["set_bulk_size", "bulk_size", "bulk", "fusion_hint", "is_naive",
+           "wait_all", "push", "new_var", "wait_for_var", "host_engine",
+           "NaiveEngine", "set_engine_type", "current_engine_type"]
 
 _ENGINE_TYPE = getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
 # process-wide like MXEngineSetBulkSize (a threading.local here meant worker
@@ -68,6 +68,21 @@ def bulk_size() -> int:
     return _bulk_size
 
 
+# how many bulk() scopes are currently open; bulking only acts as a
+# multi-step fusion hint inside an explicit scope — the process-wide
+# default of 15 must not silently turn one train step into 15
+_bulk_depth = 0
+
+
+def fusion_hint() -> int:
+    """Multi-step fusion hint for ``Executor.fused_step``: the bulk size when
+    inside an explicit ``bulk()`` scope, else 1.  A hint of k fuses k whole
+    train steps into one device program via ``lax.fori_loop`` (the reference's
+    op-bulking knob, threaded_engine.h:469-507, applied at step granularity)."""
+    with _bulk_lock:
+        return _bulk_size if _bulk_depth > 0 else 1
+
+
 class _BulkScope:
     """Reusable bulk scope (reference engine.py returns an object that can
     be stored and re-entered, not a single-use generator)."""
@@ -77,10 +92,16 @@ class _BulkScope:
         self._old: list = []
 
     def __enter__(self):
+        global _bulk_depth
         self._old.append(set_bulk_size(self._size))
+        with _bulk_lock:
+            _bulk_depth += 1
         return self
 
     def __exit__(self, *exc):
+        global _bulk_depth
+        with _bulk_lock:
+            _bulk_depth -= 1
         set_bulk_size(self._old.pop())
 
 

@@ -16,11 +16,17 @@ reference's in-place aux mutation.
 from __future__ import annotations
 
 import threading
+import warnings
 from typing import Dict, List, Optional
 
 import numpy as _np
 import jax
 import jax.numpy as jnp
+
+# the CPU backend ignores donation (tests run there); the per-compile warning
+# would otherwise drown every fused-step test run
+warnings.filterwarnings("ignore",
+                        message="Some donated buffers were not usable")
 
 from .base import MXNetError
 from .context import Context
@@ -123,6 +129,12 @@ class Executor:
         for n in self._arg_names:
             a = self.arg_dict[n]
             sig.append((n, a.shape, str(a.dtype)))
+        # aux states are program inputs too: a rebind changing only aux
+        # shapes/dtypes must key a fresh program, not reuse (or miscount) the
+        # cached one
+        for n in self._aux_names:
+            a = self.aux_dict[n]
+            sig.append(("aux", n, a.shape, str(a.dtype)))
         return tuple(sig)
 
     def _get_fwd(self, is_train: bool):
@@ -272,6 +284,159 @@ class Executor:
                 g._data = g._data + gn
             else:
                 g._data = gn
+
+    # -- fused whole-train-step ---------------------------------------------------
+    def _get_fused_step(self, optimizer, mults_by_name, num_steps: int):
+        reqs = tuple(sorted((n, self.grad_req.get(n, "write"))
+                            for n in self._grad_arg_names))
+        key = ("fused_step", self._signature(True), int(num_steps),
+               optimizer.fused_static_key(),
+               tuple(sorted(mults_by_name.items())), reqs)
+        _note_cache(hit=key in self._jit_cache)
+        if key not in self._jit_cache:
+            entries = self._symbol._entries
+            gnames = list(self._grad_arg_names)
+            req_of = dict(reqs)
+
+            def one_step(pvals, svals, gprev, other_vals, aux_vals,
+                         lr_i, wd, t_i, rng):
+                def f(gvals):
+                    env = dict(other_vals)
+                    env.update(gvals)
+                    env.update(aux_vals)
+                    aux_updates: Dict[str, object] = {}
+                    outs = trace(entries, env, True, rng,
+                                 collect_aux=aux_updates)
+                    return outs, aux_updates
+
+                (outs, aux_updates), vjp = jax.vjp(f, pvals)
+                cts = ([_ones_cotangent(o) for o in outs],
+                       {k: _np.zeros(v.shape, jax.dtypes.float0)
+                        if not jnp.issubdtype(v.dtype, jnp.inexact)
+                        else jnp.zeros_like(v)
+                        for k, v in aux_updates.items()})
+                (grads,) = vjp(cts)
+                new_grads = {}
+                for n in gnames:
+                    g = grads.get(n)
+                    if g is None:  # no gradient path reached this argument
+                        g = jnp.zeros_like(pvals[n])
+                    if req_of[n] == "add":
+                        g = gprev[n] + g
+                    new_grads[n] = g
+                new_p, new_s = {}, {}
+                for n in gnames:
+                    lm, wm, dt = mults_by_name[n]
+                    new_p[n], new_s[n] = optimizer.update_step(
+                        pvals[n], new_grads[n], svals[n],
+                        lr_i * lm, wd * wm, t_i + dt)
+                return outs, aux_updates, new_grads, new_p, new_s
+
+            def fused(pvals, gvals, svals, other_vals, aux_vals,
+                      lr_vec, wd, t_vec, rng):
+                rng0 = jax.random.fold_in(rng, 0) if num_steps > 1 else rng
+                outs, auxu, grads, p, s = one_step(
+                    pvals, svals, gvals, other_vals, aux_vals,
+                    lr_vec[0], wd, t_vec[0], rng0)
+                if num_steps > 1:
+                    aux_full = dict(aux_vals)
+                    aux_full.update(auxu)
+
+                    def body(i, carry):
+                        p, s, aux, grads, outs = carry
+                        o2, au, g2, p2, s2 = one_step(
+                            p, s, grads, other_vals, aux,
+                            lr_vec[i], wd, t_vec[i],
+                            jax.random.fold_in(rng, i))
+                        aux2 = dict(aux)
+                        aux2.update(au)
+                        return (p2, s2, aux2, g2, o2)
+
+                    p, s, aux_full, grads, outs = jax.lax.fori_loop(
+                        1, num_steps, body, (p, s, aux_full, grads, outs))
+                    auxu = {k: aux_full[k] for k in auxu}
+                return outs, auxu, grads, p, s
+
+            self._jit_cache[key] = jax.jit(fused, donate_argnums=(0, 1, 2))
+        return self._jit_cache[key]
+
+    def fused_step(self, optimizer, states: Dict[str, object],
+                   updates, feed: Optional[Dict[str, object]] = None,
+                   num_steps: Optional[int] = None) -> List[NDArray]:
+        """One donated XLA program per train step: forward + backward + the
+        full optimizer update + aux-state commit (SURVEY.md §7 taken to its
+        limit — the reference's ``CreateCachedSegOpr`` bulking over the whole
+        step).
+
+        ``updates`` is a list of ``(arg_name, optimizer_index)`` covering
+        exactly the gradient-taking arguments; ``states`` maps each arg name
+        to its optimizer state as created by ``Optimizer.create_state``
+        (NDArray structures — updated in place, so checkpoint round-trips keep
+        working).  Param, grad, and state buffers are DONATED to the program:
+        any outside alias of those exact buffers is dead after this call
+        (docs/fused_step.md).
+
+        ``num_steps`` fuses k whole steps into one dispatch via
+        ``lax.fori_loop`` over the same batch; when None it reads
+        ``engine.fusion_hint()`` (the bulk-scope knob, default 1).
+        """
+        from . import engine as _engine
+        from .optimizer import (_pack_state, _unpack_state_into,
+                                fused_counts_uniform, fused_update_plan,
+                                uniquify_donated)
+
+        if self._grouped is not None:
+            raise MXNetError("fused_step does not support group2ctx placement")
+        unames = [n for n, _ in updates]
+        if set(unames) != set(self._grad_arg_names):
+            raise MXNetError(
+                "fused_step: updates must cover exactly the gradient-taking "
+                f"arguments {self._grad_arg_names}, got {sorted(unames)}")
+        for k, v in (feed or {}).items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"fused_step: unknown argument {k!r}")
+            self.arg_dict[k]._data = v._data if isinstance(v, NDArray) \
+                else jnp.asarray(v)
+        if num_steps is None:
+            num_steps = _engine.fusion_hint()
+        num_steps = max(1, int(num_steps))
+        if not fused_counts_uniform(optimizer, [idx for _, idx in updates]):
+            raise MXNetError(
+                "fused_step: params carry mixed update counts; use the "
+                "legacy per-param update path")
+        lr_vec, wd, t_vec, mults_by_idx = fused_update_plan(
+            optimizer, [idx for _, idx in updates], num_steps)
+        mults_by_name = {n: mults_by_idx[idx] for n, idx in updates}
+        fn = self._get_fused_step(optimizer, mults_by_name, num_steps)
+        gnames = self._grad_arg_names
+        pvals = {n: self.arg_dict[n]._data for n in gnames}
+        gvals = {n: self.grad_dict[n]._data for n in gnames}
+        svals = {n: _pack_state(states[n]) for n in gnames}
+        pvals, gvals, svals = uniquify_donated((pvals, gvals, svals))
+        other = {n: self.arg_dict[n]._data for n in self._arg_names
+                 if n not in pvals}
+        aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
+        rng = _random.next_key()
+        outs, aux_updates, new_grads, new_p, new_s = fn(
+            pvals, gvals, svals, other, aux_vals, lr_vec, wd, t_vec, rng)
+        self._outputs = [NDArray(o) for o in outs]
+        for k, v in aux_updates.items():
+            self.aux_dict[k]._data = v
+        for n in gnames:
+            self.arg_dict[n]._data = new_p[n]
+            self.grad_dict[n]._data = new_grads[n]
+            _unpack_state_into(states[n], new_s[n])
+        self._cached_grads = None
+        self._last_rng = rng
+        if _engine.is_naive():  # NaiveEngine forces sync, as everywhere else
+            for o in self._outputs:
+                o.wait_to_read()
+            for n in gnames:
+                self.arg_dict[n].wait_to_read()
+        if self._monitor_callback is not None:
+            for name, out in zip(self._out_names, self._outputs):
+                self._monitor_callback(name, out)
+        return self._outputs
 
     # -- params & misc ------------------------------------------------------------
     def copy_params_from(self, arg_params: Dict[str, NDArray],

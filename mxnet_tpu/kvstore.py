@@ -118,6 +118,13 @@ class KVStore:
     def _barrier_before_exit(self):
         pass
 
+    def _fused_step_ok(self) -> bool:
+        """Whether skipping this store's per-param push/pull round-trip in
+        favor of the fused whole-step program preserves semantics.  Only a
+        single-worker local-family store with no gradient compression
+        qualifies: its reduce of one contribution is a copy."""
+        return False
+
 
 class KVStoreLocal(KVStore):
     """Single-process multi-device store (reference: src/kvstore/kvstore_local.h).
@@ -133,6 +140,9 @@ class KVStoreLocal(KVStore):
         super().__init__()
         self._type = "device" if device_reduce else "local"
         self._store: Dict = {}
+
+    def _fused_step_ok(self) -> bool:
+        return self._grad_compression is None and self.num_workers == 1
 
     def init(self, key, value):
         keys = _as_list(key)

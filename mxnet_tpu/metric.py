@@ -7,6 +7,7 @@ import math
 from typing import List, Optional
 
 import numpy as _np
+import jax.numpy as jnp
 
 from .base import Registry
 from .ndarray.ndarray import NDArray
@@ -26,6 +27,13 @@ def _to_np(x):
     return _np.asarray(x)
 
 
+def _to_dev(x):
+    """Device-side view of a metric input: no host transfer, no sync."""
+    if isinstance(x, NDArray):
+        return x._data
+    return jnp.asarray(x)
+
+
 def check_label_shapes(labels, preds, shape=False):
     if len(labels) != len(preds):
         raise ValueError(f"labels/preds count mismatch: {len(labels)} vs {len(preds)}")
@@ -42,7 +50,30 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError
 
-    def update_dict(self, label, pred):
+    def update_device(self, labels, preds):
+        """Non-blocking twin of :meth:`update`: accumulate as device-side jnp
+        scalars (no ``asnumpy()``), synced to host only at :meth:`get`.
+        Metrics without a device formulation fall back to the blocking
+        update — behavior is unchanged, just eager."""
+        self.update(labels, preds)
+
+    def _dev_accumulate(self, metric_sum, num):
+        """Fold one batch into the device-side accumulator.  ``metric_sum``
+        is a jnp scalar (async); ``num`` is the host-known instance count."""
+        self._dev_sum = metric_sum if self._dev_sum is None \
+            else self._dev_sum + metric_sum
+        self._dev_num += int(num)
+
+    def _drain_device(self):
+        """Sync any device-side accumulation into sum_metric/num_inst (the
+        single host transfer of the epoch on the fused fit path)."""
+        if getattr(self, "_dev_sum", None) is not None:
+            self.sum_metric += float(self._dev_sum)
+            self.num_inst += self._dev_num
+            self._dev_sum = None
+            self._dev_num = 0
+
+    def update_dict(self, label, pred, device=False):
         if self.output_names is not None:
             pred = [pred[n] for n in self.output_names if n in pred]
         else:
@@ -51,13 +82,19 @@ class EvalMetric:
             label = [label[n] for n in self.label_names if n in label]
         else:
             label = list(label.values())
-        self.update(label, pred)
+        if device:
+            self.update_device(label, pred)
+        else:
+            self.update(label, pred)
 
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._dev_sum = None
+        self._dev_num = 0
 
     def get(self):
+        self._drain_device()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
@@ -95,6 +132,10 @@ class CompositeEvalMetric(EvalMetric):
         for m in self.metrics:
             m.update(labels, preds)
 
+    def update_device(self, labels, preds):
+        for m in self.metrics:
+            m.update_device(labels, preds)
+
     def reset(self):
         for m in getattr(self, "metrics", []):
             m.reset()
@@ -128,6 +169,16 @@ class Accuracy(EvalMetric):
             self.sum_metric += float((p == l).sum())
             self.num_inst += l.size
 
+    def update_device(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            p = _to_dev(pred)
+            l = _to_dev(label).astype(jnp.int32)
+            if p.shape != l.shape:
+                p = jnp.argmax(p, axis=self.axis)
+            hits = (p.astype(jnp.int32).reshape(-1) == l.reshape(-1)).sum()
+            self._dev_accumulate(hits, l.size)
+
 
 @register("top_k_accuracy", "top_k_acc")
 class TopKAccuracy(EvalMetric):
@@ -146,6 +197,15 @@ class TopKAccuracy(EvalMetric):
             hits = (topk == l[:, None]).any(axis=-1)
             self.sum_metric += float(hits.sum())
             self.num_inst += l.size
+
+    def update_device(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            p = _to_dev(pred)
+            l = _to_dev(label).astype(jnp.int32).reshape(-1)
+            topk = jnp.argsort(-p.reshape(l.shape[0], -1),
+                               axis=-1)[:, :self.top_k]
+            hits = (topk == l[:, None]).any(axis=-1).sum()
+            self._dev_accumulate(hits, l.size)
 
 
 @register("f1")
@@ -289,6 +349,15 @@ class MAE(EvalMetric):
             self.sum_metric += float(_np.abs(l - p).mean())
             self.num_inst += 1
 
+    def update_device(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            p, l = _to_dev(pred), _to_dev(label)
+            if l.ndim == 1:
+                l = l.reshape(-1, 1)
+            if p.ndim == 1:
+                p = p.reshape(-1, 1)
+            self._dev_accumulate(jnp.abs(l - p).mean(), 1)
+
 
 @register("mse")
 class MSE(EvalMetric):
@@ -306,6 +375,15 @@ class MSE(EvalMetric):
                 p = p.reshape(-1, 1)
             self.sum_metric += float(((l - p) ** 2).mean())
             self.num_inst += 1
+
+    def update_device(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            p, l = _to_dev(pred), _to_dev(label)
+            if l.ndim == 1:
+                l = l.reshape(-1, 1)
+            if p.ndim == 1:
+                p = p.reshape(-1, 1)
+            self._dev_accumulate(((l - p) ** 2).mean(), 1)
 
 
 @register("rmse")
@@ -325,6 +403,15 @@ class RMSE(EvalMetric):
             self.sum_metric += float(math.sqrt(((l - p) ** 2).mean()))
             self.num_inst += 1
 
+    def update_device(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            p, l = _to_dev(pred), _to_dev(label)
+            if l.ndim == 1:
+                l = l.reshape(-1, 1)
+            if p.ndim == 1:
+                p = p.reshape(-1, 1)
+            self._dev_accumulate(jnp.sqrt(((l - p) ** 2).mean()), 1)
+
 
 @register("ce", "cross-entropy")
 class CrossEntropy(EvalMetric):
@@ -341,6 +428,14 @@ class CrossEntropy(EvalMetric):
             prob = p[_np.arange(l.size), l]
             self.sum_metric += float(-_np.log(prob + self.eps).sum())
             self.num_inst += l.size
+
+    def update_device(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            p = _to_dev(pred)
+            l = _to_dev(label).astype(jnp.int32).reshape(-1)
+            p = p.reshape(-1, p.shape[-1])
+            prob = jnp.take_along_axis(p, l[:, None], axis=1)[:, 0]
+            self._dev_accumulate(-jnp.log(prob + self.eps).sum(), l.size)
 
 
 @register("nll_loss")
@@ -376,6 +471,11 @@ class Loss(EvalMetric):
             p = _to_np(pred)
             self.sum_metric += float(p.sum())
             self.num_inst += p.size
+
+    def update_device(self, _, preds):
+        for pred in preds:
+            p = _to_dev(pred)
+            self._dev_accumulate(p.sum(), p.size)
 
 
 @register("torch")

@@ -13,7 +13,30 @@ from .kvstore import KVStore, create as _create_kv
 
 __all__ = ["BatchEndParam", "FeedForward", "save_checkpoint", "load_checkpoint",
            "_create_kvstore", "_initialize_kvstore", "_update_params_on_kvstore",
-           "_update_params"]
+           "_update_params", "_fused_step_allowed"]
+
+
+def _fused_step_allowed(optimizer, kvstore, update_on_kvstore,
+                        num_device: int) -> bool:
+    """Whether a Module may route fit/update through the fused whole-step
+    program (Executor.fused_step): local-only parameter handling, a
+    fused-capable optimizer, and no behavior the fused trace can't reproduce.
+    ``TPUMX_FUSED_STEP=0`` restores the legacy per-param path everywhere."""
+    import os
+
+    if os.environ.get("TPUMX_FUSED_STEP", "1") == "0":
+        return False
+    if num_device != 1:
+        return False
+    if optimizer is None or not getattr(optimizer, "fused_step_supported", False):
+        return False
+    if getattr(optimizer, "multi_precision", False):
+        return False
+    if update_on_kvstore:
+        return False
+    if kvstore is not None and not kvstore._fused_step_ok():
+        return False
+    return True
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])

@@ -81,6 +81,13 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _try_fused_step(self, data_batch) -> bool:
+        """Run forward+backward+optimizer as one donated XLA program when the
+        concrete module supports it (Module overrides).  Returns True when the
+        batch was handled; False routes fit() to the legacy
+        forward_backward()+update() pair."""
+        return False
+
     def score(self, eval_data, eval_metric, num_batch=None, batch_end_callback=None,
               score_end_callback=None, reset=True, epoch=0, sparse_row_id_fn=None):
         assert self.binded and self.params_initialized
@@ -233,8 +240,9 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                if not self._try_fused_step(data_batch):
+                    self.forward_backward(data_batch)
+                    self.update()
                 if isinstance(data_batch, list):
                     self.update_metric(eval_metric,
                                        [db.label for db in data_batch],
@@ -263,7 +271,12 @@ class BaseModule:
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
 
             arg_p, aux_p = self.get_params()
-            self.set_params(arg_p, aux_p)
+            if not getattr(self, "_fused_step_count", 0):
+                # under the fused path params already live in the executor and
+                # get_params snapshots are deep copies; writing them back
+                # would re-alias executor buffers with the user's snapshot,
+                # which the next step's donation would invalidate
+                self.set_params(arg_p, aux_p)
             if epoch_end_callback is not None:
                 for cb in _as_list(epoch_end_callback):
                     cb(epoch, self.symbol, arg_p, aux_p)

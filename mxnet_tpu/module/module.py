@@ -12,13 +12,15 @@ import logging
 from typing import Dict, List, Optional, Tuple
 
 import numpy as _np
+import jax.numpy as jnp
 
 from .. import ndarray as nd
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
 from ..initializer import InitDesc, Uniform
-from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
-                     _update_params_on_kvstore, load_checkpoint, save_checkpoint)
+from ..model import (_create_kvstore, _fused_step_allowed, _initialize_kvstore,
+                     _update_params, _update_params_on_kvstore, load_checkpoint,
+                     save_checkpoint)
 from ..ndarray.ndarray import NDArray
 from ..optimizer import Optimizer, Updater, create as _create_optimizer, get_updater
 from .base_module import BaseModule, _check_input_names
@@ -58,6 +60,8 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._compression_params = compression_params
+        self._fused_step_count = 0
+        self._shared_bound = False
         _check_input_names(symbol, self._data_names, "data", True)
         _check_input_names(symbol, self._label_names, "label", False)
         _check_input_names(symbol, self._state_names, "state", True)
@@ -151,6 +155,9 @@ class Module(BaseModule):
                 req[n] = grad_req
         self._exec = self._symbol.simple_bind(
             ctx=self._context[0], grad_req=req, **shape_kwargs)
+        # shared binding may alias param buffers with another module's
+        # executor — donation in the fused path would invalidate them
+        self._shared_bound = shared_module is not None
         if shared_module is not None and shared_module._exec is not None:
             self._exec.copy_params_from(*shared_module.get_params())
         if self._arg_params is not None:
@@ -169,7 +176,10 @@ class Module(BaseModule):
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
-                arr._data = arg_params[name]._data.astype(arr._data.dtype)
+                # copy=True: the executor must own its param buffers uniquely
+                # (same-dtype astype aliases, and the fused step DONATES them)
+                arr._data = jnp.array(arg_params[name]._data,
+                                      dtype=arr._data.dtype, copy=True)
             elif arg_params is not None and not allow_missing:
                 # a partial checkpoint with allow_missing=False must raise,
                 # not silently fall through to the initializer (reference
@@ -186,7 +196,8 @@ class Module(BaseModule):
         for name in self._aux_names:
             arr = self._exec.aux_dict[name]
             if aux_params is not None and name in aux_params:
-                arr._data = aux_params[name]._data.astype(arr._data.dtype)
+                arr._data = jnp.array(aux_params[name]._data,
+                                      dtype=arr._data.dtype, copy=True)
             elif aux_params is not None and not allow_missing:
                 raise MXNetError(
                     f"aux state {name} not present in aux_params "
@@ -205,6 +216,13 @@ class Module(BaseModule):
     def get_params(self):
         assert self.params_initialized
         self._sync_params_from_exec()
+        if self._fused_step_count:
+            # NDArray.copy() shares the device buffer; under the fused path
+            # the executor's buffers are donated every step, so a snapshot
+            # must own fresh device memory to survive the next step
+            deep = lambda v: NDArray(jnp.array(v._data, copy=True))
+            return ({k: deep(v) for k, v in self._arg_params.items()},
+                    {k: deep(v) for k, v in self._aux_params.items()})
         return ({k: v.copy() for k, v in self._arg_params.items()},
                 {k: v.copy() for k, v in self._aux_params.items()})
 
@@ -294,6 +312,66 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         self._exec.backward(out_grads=out_grads)
 
+    # -- fused whole-train-step ---------------------------------------------------
+    def _fused_ready(self) -> bool:
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized):
+            return False
+        if not _fused_step_allowed(self._optimizer, self._kvstore,
+                                   self._update_on_kvstore,
+                                   len(self._context)):
+            return False
+        if self._updater is None or self._shared_bound or self.inputs_need_grad:
+            return False
+        if self._exec is None or self._exec._grouped is not None:
+            return False
+        if self._exec._monitor_callback is not None:
+            return False  # per-step introspection wants the legacy path
+        # every gradient-taking argument must be a parameter we can update
+        if set(self._exec._grad_arg_names) - set(self._param_names):
+            return False
+        return True
+
+    def _try_fused_step(self, data_batch) -> bool:
+        """Forward + backward + full optimizer update as ONE donated XLA
+        program (Executor.fused_step).  Optimizer state lives in the legacy
+        Updater's slots (device-side, updated in place) so
+        save/load_optimizer_states round-trip unchanged."""
+        if not self._fused_ready():
+            return False
+        from ..optimizer import fused_counts_uniform
+
+        grad_names = set(self._exec._grad_arg_names)
+        idx_of = {n: i for i, n in enumerate(self._param_names)
+                  if n in grad_names}
+        if not fused_counts_uniform(self._optimizer, list(idx_of.values())):
+            return False
+        feed = {}
+        for (name, _), arr in zip(self._data_shapes, data_batch.data):
+            feed[name] = arr
+        if self._label_shapes and data_batch.label:
+            for (name, _), arr in zip(self._label_shapes, data_batch.label):
+                feed[name] = arr
+        cur = dict(self._data_shapes)
+        new_shapes = {n: tuple(a.shape) for n, a in
+                      zip([s[0] for s in self._data_shapes], data_batch.data)}
+        if any(cur[n] != s for n, s in new_shapes.items()):
+            self._reshape_exec(data_batch)
+        updates, states = [], {}
+        for name, idx in idx_of.items():
+            # idx: the legacy i*num_device+k slot scheme, num_device == 1
+            if idx not in self._updater.states:
+                self._updater.states[idx] = \
+                    self._optimizer.create_state_multi_precision(
+                        idx, self._exec.arg_dict[name])
+            updates.append((name, idx))
+            states[name] = self._updater.states[idx]
+        self._exec.fused_step(self._optimizer, states, updates,
+                              feed=feed, num_steps=1)
+        self._params_dirty = True
+        self._fused_step_count += 1
+        return True
+
     def update(self):
         assert self.binded and self.params_initialized and self.optimizer_initialized
         self._params_dirty = True
@@ -316,9 +394,12 @@ class Module(BaseModule):
         return [self._exec.grad_dict.get(n) for n in self._data_names]
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
+        # device=True: metrics that can accumulate device-side do so without
+        # asnumpy() — the host sync happens once, at get()/epoch boundaries
         eval_metric.update_dict(
             dict(zip(self._label_names, labels or [])),
-            dict(zip(self._output_names, self._exec.outputs)))
+            dict(zip(self._output_names, self._exec.outputs)),
+            device=True)
 
     # -- states -------------------------------------------------------------------
     def get_states(self, merge_multi_context=True):

@@ -13,6 +13,7 @@ import pickle
 from typing import Dict, Optional
 
 import numpy as _np
+import jax
 import jax.numpy as jnp
 
 from .base import Registry
@@ -95,29 +96,72 @@ class Optimizer:
         self._index_update_count[index] += 1
         self.num_update = max(self._index_update_count[index], self.num_update)
 
-    def _get_lr(self, index):
-        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+    def _get_lr_mult(self, index):
         # gluon Parameters (Trainer wires them in via param_dict) take
         # precedence, like the reference's _get_lrs
         if index in self.param_dict:
-            return lr * getattr(self.param_dict[index], "lr_mult", 1.0)
+            return getattr(self.param_dict[index], "lr_mult", 1.0)
         name = self.idx2name.get(index, index if isinstance(index, str) else None)
-        return lr * self.lr_mult.get(name, 1.0)
+        return self.lr_mult.get(name, 1.0)
+
+    def _get_wd_mult(self, index):
+        if index in self.param_dict:
+            return getattr(self.param_dict[index], "wd_mult", 1.0)
+        name = self.idx2name.get(index, index if isinstance(index, str) else None)
+        return self.wd_mult.get(name, 1.0)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        return lr * self._get_lr_mult(index)
 
     def _get_wd(self, index):
-        if index in self.param_dict:
-            return self.wd * getattr(self.param_dict[index], "wd_mult", 1.0)
-        name = self.idx2name.get(index, index if isinstance(index, str) else None)
-        return self.wd * self.wd_mult.get(name, 1.0)
+        return self.wd * self._get_wd_mult(index)
 
-    def _preprocess_grad(self, grad):
-        g = grad._data * self.rescale_grad
+    def _preprocess_grad_data(self, g):
+        g = g * self.rescale_grad
         if self.clip_gradient is not None:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         return g
 
+    def _preprocess_grad(self, grad):
+        return self._preprocess_grad_data(grad._data)
+
     def _needs_master(self, weight):
         return self.multi_precision and weight.dtype in (_np.float16, jnp.bfloat16)
+
+    # -- fused (jit-traceable) update API -----------------------------------------
+    # A fused-capable optimizer also expresses its update as a pure function
+    # over jnp values so the whole train step (forward + backward + every
+    # parameter's update) traces into ONE donated XLA program
+    # (Executor.fused_step) instead of a Python loop of per-param dispatches —
+    # the reference's CreateCachedSegOpr bulking taken to the optimizer.
+    fused_step_supported = False
+
+    def fused_static_key(self):
+        """Hyperparameters baked into a fused trace as constants; part of the
+        compile-cache key so changing them recompiles rather than reusing a
+        stale program."""
+        return (type(self).__name__, float(self.rescale_grad),
+                None if self.clip_gradient is None else float(self.clip_gradient))
+
+    def fused_host_lr(self, lr, t):
+        """Step-count-dependent lr correction, applied HOST-side in float64 —
+        exactly as the imperative :meth:`update` computes it — before the lr
+        enters the trace.  Keeps fused/legacy parity at the ulp level for
+        bias-corrected optimizers (Adam); default is identity."""
+        return lr
+
+    def update_step(self, weight, grad, state, lr, wd, t=None):
+        """Functional twin of :meth:`update`: ``(new_weight, new_state)`` from
+        jnp values (weight/grad arrays, state pytree of arrays as laid out by
+        ``create_state`` with NDArray leaves replaced by their buffers).
+        ``lr``/``wd`` arrive as traced scalars with the scheduler value,
+        per-param multipliers, and :meth:`fused_host_lr` correction already
+        applied; ``t`` is the traced per-param update count (for optimizers
+        whose math needs it in-trace).  Must be side-effect free and
+        jit-traceable."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support the fused update path")
 
     # -- API ----------------------------------------------------------------------
     def create_state(self, index, weight):
@@ -146,6 +190,8 @@ class Optimizer:
 class SGD(Optimizer):
     """SGD with momentum + lazy sparse updates (reference: optimizer.py:494)."""
 
+    fused_step_supported = True
+
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -155,6 +201,16 @@ class SGD(Optimizer):
         if self.momentum == 0.0:
             return None
         return NDArray(jnp.zeros_like(weight._data))
+
+    def fused_static_key(self):
+        return super().fused_static_key() + (float(self.momentum),)
+
+    def update_step(self, weight, grad, state, lr, wd, t=None):
+        g = self._preprocess_grad_data(grad) + wd * weight
+        if state is None:
+            return weight - lr * g, None
+        mom = self.momentum * state - lr * g
+        return weight + mom, mom
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -302,6 +358,8 @@ class DCASGD(Optimizer):
 class NAG(Optimizer):
     """Nesterov accelerated SGD (reference: optimizer.py NAG)."""
 
+    fused_step_supported = True
+
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -310,6 +368,16 @@ class NAG(Optimizer):
         if self.momentum == 0.0:
             return None
         return NDArray(jnp.zeros_like(weight._data))
+
+    def fused_static_key(self):
+        return super().fused_static_key() + (float(self.momentum),)
+
+    def update_step(self, weight, grad, state, lr, wd, t=None):
+        g = self._preprocess_grad_data(grad) + wd * weight
+        if state is None:
+            return weight - lr * g, None
+        m = self.momentum * state + g
+        return weight - lr * (g + self.momentum * m), m
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -341,6 +409,8 @@ class SGLD(Optimizer):
 
 @register
 class Adam(Optimizer):
+    fused_step_supported = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -350,6 +420,22 @@ class Adam(Optimizer):
     def create_state(self, index, weight):
         z = jnp.zeros_like(weight._data)
         return (NDArray(z), NDArray(z))
+
+    def fused_static_key(self):
+        return super().fused_static_key() + (
+            float(self.beta1), float(self.beta2), float(self.epsilon))
+
+    def fused_host_lr(self, lr, t):
+        # same float64 host math as update(); the traced path applying a
+        # pre-rounded f32 lr then matches the legacy loop at the ulp level
+        return lr * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+
+    def update_step(self, weight, grad, state, lr, wd, t=None):
+        g = self._preprocess_grad_data(grad) + wd * weight
+        m, v = state
+        m2 = self.beta1 * m + (1 - self.beta1) * g
+        v2 = self.beta2 * v + (1 - self.beta2) * g * g
+        return weight - lr * m2 / (jnp.sqrt(v2) + self.epsilon), (m2, v2)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -365,12 +451,24 @@ class Adam(Optimizer):
 
 @register
 class AdaGrad(Optimizer):
+    fused_step_supported = True
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
         return NDArray(jnp.zeros_like(weight._data))
+
+    def fused_static_key(self):
+        return super().fused_static_key() + (float(self.float_stable_eps),)
+
+    def update_step(self, weight, grad, state, lr, wd, t=None):
+        g = self._preprocess_grad_data(grad)
+        s2 = state + g * g
+        w2 = weight - lr * (g / jnp.sqrt(s2 + self.float_stable_eps)
+                            + wd * weight)
+        return w2, s2
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -386,6 +484,8 @@ class AdaGrad(Optimizer):
 
 @register
 class RMSProp(Optimizer):
+    fused_step_supported = True
+
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8,
                  centered=False, clip_weights=None, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -398,6 +498,28 @@ class RMSProp(Optimizer):
         if self.centered:
             return (NDArray(z), NDArray(z), NDArray(z))  # n, g, delta
         return NDArray(z)
+
+    def fused_static_key(self):
+        return super().fused_static_key() + (
+            float(self.gamma1), float(self.gamma2), float(self.epsilon),
+            bool(self.centered),
+            None if self.clip_weights is None else float(self.clip_weights))
+
+    def update_step(self, weight, grad, state, lr, wd, t=None):
+        g = self._preprocess_grad_data(grad) + wd * weight
+        if self.centered:
+            n, mg, delta = state
+            n2 = (1 - self.gamma1) * g * g + self.gamma1 * n
+            mg2 = (1 - self.gamma1) * g + self.gamma1 * mg
+            d2 = self.gamma2 * delta - lr * g / jnp.sqrt(
+                n2 - mg2 * mg2 + self.epsilon)
+            w2, s2 = weight + d2, (n2, mg2, d2)
+        else:
+            n2 = (1 - self.gamma1) * g * g + self.gamma1 * state
+            w2, s2 = weight - lr * g / jnp.sqrt(n2 + self.epsilon), n2
+        if self.clip_weights:
+            w2 = jnp.clip(w2, -self.clip_weights, self.clip_weights)
+        return w2, s2
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -420,6 +542,8 @@ class RMSProp(Optimizer):
 
 @register
 class AdaDelta(Optimizer):
+    fused_step_supported = True
+
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho, self.epsilon = rho, epsilon
@@ -427,6 +551,18 @@ class AdaDelta(Optimizer):
     def create_state(self, index, weight):
         z = jnp.zeros_like(weight._data)
         return (NDArray(z), NDArray(z))
+
+    def fused_static_key(self):
+        return super().fused_static_key() + (float(self.rho), float(self.epsilon))
+
+    def update_step(self, weight, grad, state, lr, wd, t=None):
+        g = self._preprocess_grad_data(grad) + wd * weight
+        acc_g, acc_delta = state
+        a2 = self.rho * acc_g + (1 - self.rho) * g * g
+        delta = jnp.sqrt(acc_delta + self.epsilon) / jnp.sqrt(
+            a2 + self.epsilon) * g
+        d2 = self.rho * acc_delta + (1 - self.rho) * delta * delta
+        return weight - delta, (a2, d2)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -529,6 +665,104 @@ class Test(Optimizer):
 ccSGD = SGD  # reference alias
 
 
+# -- fused-update plumbing ---------------------------------------------------------
+def _pack_state(s):
+    """create_state structures (NDArray leaves, tuples, None) -> a jax pytree
+    of raw device buffers, suitable as a jit argument."""
+    if s is None:
+        return None
+    if isinstance(s, (tuple, list)):
+        return tuple(_pack_state(x) for x in s)
+    if isinstance(s, NDArray):
+        return s._data
+    return s
+
+
+def _unpack_state_into(s, new):
+    """Write a fused program's returned state pytree back into the NDArray
+    leaves of the original create_state structure (in place, so Updater
+    serialization and checkpoint round-trips keep working unchanged)."""
+    if s is None:
+        return
+    if isinstance(s, (tuple, list)):
+        for a, b in zip(s, new):
+            _unpack_state_into(a, b)
+    elif isinstance(s, NDArray):
+        s._data = new
+
+
+def uniquify_donated(trees):
+    """Return ``trees`` with any REPEATED device buffer replaced by a fresh
+    copy.  jax constant caching can hand identical zero-filled buffers to
+    several same-shaped arrays (fresh grad/state buffers especially); donating
+    such a buffer twice in one program is an XLA error.  First occurrence is
+    kept (and donated), later ones are copied — a one-time cost on the first
+    step only, since program outputs are always distinct."""
+    seen = set()
+
+    def fix(x):
+        try:
+            ptr = x.unsafe_buffer_pointer()
+        except Exception:
+            ptr = id(x)
+        if ptr in seen:
+            return jnp.array(x, copy=True)
+        seen.add(ptr)
+        return x
+
+    return jax.tree_util.tree_map(fix, trees)
+
+
+def fused_counts_uniform(optimizer, indices) -> bool:
+    """A fused step applies one shared host-side lr correction per inner
+    step, which is only exact when every fused param carries the same update
+    count.  Mixed counts (a user interleaving partial legacy updates) must
+    take the per-param loop."""
+    counts = {optimizer._index_update_count.get(i, optimizer.begin_num_update)
+              for i in indices}
+    return len(counts) <= 1
+
+
+def fused_update_plan(optimizer, indices, num_steps=1):
+    """Host-side bookkeeping for a fused step covering ``indices``: bump the
+    per-param update counts exactly as the legacy per-param loop would
+    (``num_steps`` times), and return the traced scalars + static per-param
+    multipliers the trace needs:
+
+    ``(lr_vec, wd, t_vec, mults)`` where ``lr_vec``/``t_vec`` have one entry
+    per inner step (base scheduler lr and the lead param's update count) and
+    ``mults[index] = (lr_mult, wd_mult, count_delta)`` are Python floats baked
+    into the program as constants (part of the compile-cache key)."""
+    lrs, ts = [], []
+    for _ in range(max(1, int(num_steps))):
+        for idx in indices:
+            optimizer._update_count(idx)
+        base = float(optimizer.lr_scheduler(optimizer.num_update)) \
+            if optimizer.lr_scheduler else float(optimizer.lr)
+        t = optimizer._index_update_count[indices[0]]
+        lrs.append(float(optimizer.fused_host_lr(base, t)))
+        ts.append(float(t))
+    mults = {}
+    for idx in indices:
+        mults[idx] = (float(optimizer._get_lr_mult(idx)),
+                      float(optimizer._get_wd_mult(idx)),
+                      float(optimizer._index_update_count[idx] - ts[-1]))
+    return (jnp.asarray(lrs, jnp.float32), jnp.float32(optimizer.wd),
+            jnp.asarray(ts, jnp.float32), mults)
+
+
+# compiled all-params optimizer programs for the standalone update path
+# (Module.update / kvstore updaters); keyed by optimizer statics + shapes so
+# distinct instances with identical hyperparameters share one program
+_FUSED_UPDATE_CACHE: Dict[tuple, object] = {}
+
+
+def _note_compile_cache(hit: bool) -> None:
+    from . import executor as _executor
+
+    _executor._note_cache(hit)
+
+
 class Updater:
     """Applies an optimizer to (index, grad, weight) triples, creating state
     lazily (reference: optimizer.py:1498 get_updater)."""
@@ -540,10 +774,76 @@ class Updater:
 
     def __call__(self, index, grad, weight):
         if isinstance(index, (list, tuple)):
-            for i, g, w in zip(index, grad, weight):
-                self._update(i, g, w)
+            if not self._batch_fused(list(index), list(grad), list(weight)):
+                for i, g, w in zip(index, grad, weight):
+                    self._update(i, g, w)
         else:
             self._update(index, grad, weight)
+
+    def _batch_fused(self, indices, grads, weights) -> bool:
+        """Apply the whole batch of (index, grad, weight) updates as ONE jitted
+        program over list pytrees (optimizer state donated) instead of a
+        Python loop of per-param dispatches.  Returns False — caller falls
+        back to the loop — whenever the optimizer, the buffers, or the
+        environment can't take the fused path; the loop remains the semantic
+        ground truth."""
+        import os
+
+        opt = self.optimizer
+        if (not indices or os.environ.get("TPUMX_FUSED_STEP", "1") == "0"
+                or not getattr(opt, "fused_step_supported", False)
+                or opt.multi_precision):
+            return False
+        from .ndarray import sparse as _sparse
+
+        if any(isinstance(a, _sparse.BaseSparseNDArray)
+               for a in list(weights) + list(grads)):
+            return False
+        try:  # mixed device placement (multi-device slots) stays on the loop
+            devs = {tuple(sorted(d.id for d in w._data.devices()))
+                    for w in weights}
+            if len(devs) != 1:
+                return False
+        except Exception:
+            return False
+        if not fused_counts_uniform(opt, indices):
+            return False
+        for i, w in zip(indices, weights):
+            if i not in self.states:
+                self.states[i] = opt.create_state_multi_precision(i, w)
+        lr_vec, wd, t_vec, mults = fused_update_plan(opt, indices)
+        w_vals = [w._data for w in weights]
+        g_vals = [g._data for g in grads]
+        s_vals = uniquify_donated(
+            tuple(_pack_state(self.states[i]) for i in indices))
+        key = (opt.fused_static_key(),
+               tuple(mults[i] for i in indices),
+               tuple((v.shape, str(v.dtype)) for v in w_vals),
+               tuple((v.shape, str(v.dtype)) for v in g_vals))
+        _note_compile_cache(hit=key in _FUSED_UPDATE_CACHE)
+        if key not in _FUSED_UPDATE_CACHE:
+            mult_list = [mults[i] for i in indices]
+
+            def fused(w_vals, g_vals, s_vals, lr, wd, t):
+                new_w, new_s = [], []
+                for k in range(len(w_vals)):
+                    lm, wm, dt = mult_list[k]
+                    w2, s2 = opt.update_step(w_vals[k], g_vals[k], s_vals[k],
+                                             lr[0] * lm, wd * wm, t[0] + dt)
+                    new_w.append(w2)
+                    new_s.append(s2)
+                return new_w, tuple(new_s)
+
+            # donate only the state (Updater-private, never aliased); weights
+            # and grads stay readable — callers legitimately hold them
+            # (kvstore values, grad buffers reused by the next backward)
+            _FUSED_UPDATE_CACHE[key] = jax.jit(fused, donate_argnums=(2,))
+        new_w, new_s = _FUSED_UPDATE_CACHE[key](
+            w_vals, g_vals, s_vals, lr_vec, wd, t_vec)
+        for k, (i, w) in enumerate(zip(indices, weights)):
+            w._data = new_w[k]
+            _unpack_state_into(self.states[i], new_s[k])
+        return True
 
     def _update(self, index, grad, weight):
         if index not in self.states:

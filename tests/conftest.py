@@ -44,6 +44,10 @@ def pytest_configure(config):
         "markers",
         "serving: online inference serving subsystem (mxnet_tpu.serving; "
         "select with `pytest -m serving`)")
+    config.addinivalue_line(
+        "markers",
+        "fused: fused whole-train-step execution (Executor.fused_step, "
+        "docs/fused_step.md; select with `pytest -m fused`)")
 
 
 def pytest_collection_modifyitems(config, items):

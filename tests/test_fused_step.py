@@ -1,0 +1,318 @@
+"""Fused whole-train-step execution (docs/fused_step.md): numerical parity
+with the legacy per-param path, compile-cache discipline, donation safety,
+and the env/bulk satellites."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, nd, sym
+from mxnet_tpu.executor import compile_cache_stats
+from mxnet_tpu.io import DataBatch
+
+pytestmark = pytest.mark.fused
+
+
+def _mlp_sym(nh=16, classes=4):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=nh, name="fc1"),
+                       act_type="relu")
+    out = sym.FullyConnected(h, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(out, label, name="softmax")
+
+
+def _bn_sym(nh=16, classes=4):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    h = sym.BatchNorm(sym.FullyConnected(data, num_hidden=nh, name="fc1"),
+                      name="bn1")
+    out = sym.FullyConnected(sym.Activation(h, act_type="relu"),
+                             num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(out, label, name="softmax")
+
+
+def _toy_iter(n=320, dim=8, classes=4, batch=32, shuffle=False):
+    r = np.random.RandomState(0)
+    Y = r.randint(0, classes, n).astype(np.float32)
+    X = r.rand(n, dim).astype(np.float32) * 0.3
+    for c in range(classes):
+        X[Y == c, c] += 1.0
+    return mx.io.NDArrayIter(X, Y, batch_size=batch, shuffle=shuffle)
+
+
+def _fit(monkeypatch, fused, optimizer, opt_params, symbol=None, num_epoch=1):
+    monkeypatch.setenv("TPUMX_FUSED_STEP", "1" if fused else "0")
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = mx.mod.Module(symbol or _mlp_sym(), context=mx.cpu())
+    mod.fit(_toy_iter(), num_epoch=num_epoch, optimizer=optimizer,
+            optimizer_params=opt_params)
+    arg, aux = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in arg.items()}, \
+        {k: v.asnumpy() for k, v in aux.items()}
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", (("learning_rate", 0.5),)),
+    ("sgd", (("learning_rate", 0.5), ("momentum", 0.9))),
+    ("adam", (("learning_rate", 0.05),)),
+    ("adagrad", (("learning_rate", 0.1),)),
+    ("rmsprop", (("learning_rate", 0.01),)),
+], ids=["sgd", "sgd_momentum", "adam", "adagrad", "rmsprop"])
+def test_fused_parity_10_steps(monkeypatch, optimizer, opt_params):
+    """Fused fit == legacy fit over 10 fixed-shape steps, rtol 1e-5."""
+    m_legacy, legacy, _ = _fit(monkeypatch, False, optimizer, opt_params)
+    m_fused, fused, _ = _fit(monkeypatch, True, optimizer, opt_params)
+    assert m_legacy._fused_step_count == 0
+    assert m_fused._fused_step_count == 10
+    for k in legacy:
+        np.testing.assert_allclose(fused[k], legacy[k], rtol=1e-5, atol=1e-7,
+                                   err_msg=f"{optimizer}: {k}")
+
+
+def test_fused_parity_batchnorm_aux(monkeypatch):
+    """Through a BatchNorm net: params AND the functionally-committed aux
+    running stats match the legacy path.  (SGD here: BN makes fc1_bias a
+    zero-gradient parameter, and adaptive optimizers dividing by
+    sqrt(state)~eps amplify ulp noise chaotically on it — see
+    docs/fused_step.md; adaptive-optimizer parity is covered on the clean
+    MLP above.)"""
+    params = (("learning_rate", 0.1), ("momentum", 0.9))
+    m0, legacy, legacy_aux = _fit(monkeypatch, False, "sgd", params, _bn_sym())
+    m1, fused, fused_aux = _fit(monkeypatch, True, "sgd", params, _bn_sym())
+    assert m1._fused_step_count == 10
+    for k in legacy:
+        np.testing.assert_allclose(fused[k], legacy[k], rtol=1e-5, atol=1e-6)
+    assert legacy_aux  # BatchNorm must expose moving_mean/var
+    for k in legacy_aux:
+        np.testing.assert_allclose(fused_aux[k], legacy_aux[k],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fused_env_roundtrip(monkeypatch):
+    """TPUMX_FUSED_STEP=0 -> legacy path -> =1 again: same results, and the
+    flag actually routes (step counters prove which path ran)."""
+    _, legacy1, _ = _fit(monkeypatch, False, "sgd", (("learning_rate", 0.5),))
+    m, fused, _ = _fit(monkeypatch, True, "sgd", (("learning_rate", 0.5),))
+    assert m._fused_step_count == 10
+    _, legacy2, _ = _fit(monkeypatch, False, "sgd", (("learning_rate", 0.5),))
+    for k in legacy1:
+        np.testing.assert_array_equal(legacy1[k], legacy2[k])
+        np.testing.assert_allclose(fused[k], legacy1[k], rtol=1e-5, atol=1e-7)
+
+
+def test_fused_unsupported_optimizer_falls_back(monkeypatch):
+    """A non-fused-capable optimizer must train via the legacy loop (and
+    still learn)."""
+    m, _, _ = _fit(monkeypatch, True, "signum", (("learning_rate", 0.05),))
+    assert m._fused_step_count == 0
+    acc = dict(m.score(_toy_iter(), "acc"))["accuracy"]
+    assert acc > 0.5
+
+
+def test_fused_compile_cache_discipline(monkeypatch):
+    """N fused steps at fixed shapes: exactly ONE fused-program miss; the
+    remaining N-1 lookups hit."""
+    monkeypatch.setenv("TPUMX_FUSED_STEP", "1")
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    before = compile_cache_stats()
+    mod.fit(_toy_iter(), num_epoch=2, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),))
+    after = compile_cache_stats()
+    assert mod._fused_step_count == 20
+    assert after["misses"] - before["misses"] == 1
+    assert after["hits"] - before["hits"] == 19
+
+
+def test_use_after_donate_safety(monkeypatch):
+    """No NDArray handle the framework (or a get_params caller) holds may
+    observe a donated buffer: snapshots stay valid and unchanged across
+    subsequent donating steps, and every executor/updater handle stays
+    readable."""
+    monkeypatch.setenv("TPUMX_FUSED_STEP", "1")
+    mx.random.seed(0)
+    np.random.seed(0)
+    it = _toy_iter()
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.5), ("momentum", 0.9)))
+    assert mod._fused_step_count == 10
+    arg_snap, aux_snap = mod.get_params()
+    frozen = {k: v.asnumpy().copy() for k, v in arg_snap.items()}
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.5), ("momentum", 0.9)),
+            force_init=False)
+    # the snapshot survives further donating steps, bit-for-bit
+    for k, v in arg_snap.items():
+        np.testing.assert_array_equal(v.asnumpy(), frozen[k])
+    # every live framework handle is readable (donation rebound them)
+    for n, a in mod._exec.arg_dict.items():
+        assert np.isfinite(a.asnumpy()).all(), n
+    for n, g in mod._exec.grad_dict.items():
+        assert g.asnumpy().shape == mod._exec.arg_dict[n].shape
+    for idx, state in mod._updater.states.items():
+        leaves = state if isinstance(state, tuple) else (state,)
+        for leaf in leaves:
+            if leaf is not None:
+                assert np.isfinite(leaf.asnumpy()).all()
+    # params kept training after the snapshot (donated buffers were consumed,
+    # not silently reused as stale weights)
+    trained, _ = mod.get_params()
+    assert any(not np.array_equal(trained[k].asnumpy(), frozen[k])
+               for k in frozen)
+
+
+def test_signature_includes_aux_states(monkeypatch):
+    """Regression (executor.py _signature): aux shapes/dtypes are part of the
+    compile-cache key — a rebind changing ONLY aux shapes must not report a
+    cache hit on a stale program."""
+    ex = _bn_sym().simple_bind(ctx=mx.cpu(), data=(8, 8),
+                               softmax_label=(8,))
+    sig = ex._signature(True)
+    aux_entries = [s for s in sig if isinstance(s, tuple) and s[0] == "aux"]
+    assert {e[1] for e in aux_entries} == set(ex._aux_names)
+    ex._get_fwd(False)
+    before = compile_cache_stats()
+    ex._get_fwd(False)
+    mid = compile_cache_stats()
+    assert mid["hits"] - before["hits"] == 1  # unchanged aux: a hit
+    import jax.numpy as jnp
+
+    name = ex._aux_names[0]
+    ex.aux_dict[name]._data = jnp.zeros((32,), jnp.float32)
+    ex._get_fwd(False)
+    after = compile_cache_stats()
+    assert after["misses"] - mid["misses"] == 1  # aux-only change: a miss
+
+
+def test_engine_exports_bulk_size_and_fusion_hint():
+    """Satellite: engine.bulk_size is exported, and the fusion hint is 1
+    outside an explicit bulk scope, k inside."""
+    assert "bulk_size" in engine.__all__
+    assert engine.bulk_size() == 15  # process default untouched
+    assert engine.fusion_hint() == 1
+    with engine.bulk(3):
+        assert engine.bulk_size() == 3
+        assert engine.fusion_hint() == 3
+        with engine.bulk(5):
+            assert engine.fusion_hint() == 5
+        assert engine.fusion_hint() == 3
+    assert engine.fusion_hint() == 1
+    assert engine.bulk_size() == 15
+
+
+def test_fused_multi_step_bulk(monkeypatch):
+    """k=3 whole steps fused into ONE dispatch via the bulk hint equal 3
+    sequential legacy steps on the same batch, for one compile."""
+    r = np.random.RandomState(0)
+    batch = DataBatch([nd.array(r.rand(16, 8).astype(np.float32))],
+                      [nd.array(r.randint(0, 4, 16).astype(np.float32))])
+
+    def build(env):
+        monkeypatch.setenv("TPUMX_FUSED_STEP", env)
+        mx.random.seed(0)
+        np.random.seed(0)
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod.bind(data_shapes=[("data", (16, 8))],
+                 label_shapes=[("softmax_label", (16,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.1),))
+        return mod
+
+    m0 = build("0")
+    for _ in range(3):
+        m0.forward_backward(batch)
+        m0.update()
+    legacy, _ = m0.get_params()
+
+    m1 = build("1")
+    opt = m1._optimizer
+    updates, states = [], {}
+    for i, n in enumerate(m1._param_names):
+        updates.append((n, i))
+        states[n] = opt.create_state_multi_precision(
+            i, m1._exec.arg_dict[n])
+    before = compile_cache_stats()
+    with engine.bulk(3):
+        m1._exec.fused_step(opt, states, updates,
+                            feed={"data": batch.data[0],
+                                  "softmax_label": batch.label[0]})
+    after = compile_cache_stats()
+    assert after["misses"] - before["misses"] == 1
+    assert opt.num_update == 3  # counts advanced per inner step
+    for n in legacy:
+        np.testing.assert_allclose(m1._exec.arg_dict[n].asnumpy(),
+                                   legacy[n].asnumpy(),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_module_update_routes_through_fused_updater(monkeypatch):
+    """Manual forward_backward()+update() applies all params in one fused
+    optimizer program (Updater batch path) and matches the per-param loop."""
+    r = np.random.RandomState(0)
+    batch = DataBatch([nd.array(r.rand(16, 8).astype(np.float32))],
+                      [nd.array(r.randint(0, 4, 16).astype(np.float32))])
+
+    def run(env):
+        monkeypatch.setenv("TPUMX_FUSED_STEP", env)
+        mx.random.seed(0)
+        np.random.seed(0)
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod.bind(data_shapes=[("data", (16, 8))],
+                 label_shapes=[("softmax_label", (16,))])
+        mod.init_params()
+        mod.init_optimizer(optimizer="adam",
+                           optimizer_params=(("learning_rate", 0.05),))
+        for _ in range(5):
+            mod.forward_backward(batch)
+            mod.update()
+        arg, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in arg.items()}
+
+    legacy = run("0")
+    fused = run("1")
+    for k in legacy:
+        np.testing.assert_allclose(fused[k], legacy[k], rtol=1e-5, atol=1e-7)
+
+
+def test_update_metric_no_asnumpy_on_fit_path(monkeypatch):
+    """Acceptance: update_metric no longer syncs per batch on the fit path —
+    the blocking Accuracy.update must never run; the device accumulation
+    drains once at get()."""
+    from mxnet_tpu import metric as metric_mod
+
+    def boom(self, labels, preds):  # pragma: no cover - must not be called
+        raise AssertionError("blocking Accuracy.update called on fit path")
+
+    monkeypatch.setattr(metric_mod.Accuracy, "update", boom)
+    monkeypatch.setenv("TPUMX_FUSED_STEP", "1")
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(_toy_iter(shuffle=True), num_epoch=6, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.5),))
+    assert mod._fused_step_count == 60
+    acc = dict(mod.score(_toy_iter(), mx.metric.create("acc")))["accuracy"]
+    assert acc > 0.9
+
+
+def test_metric_device_accumulation_matches_blocking():
+    """Device-side accumulation is lazy (no instances counted until get())
+    and numerically identical to the blocking numpy path."""
+    preds = nd.array(np.random.RandomState(3).rand(64, 4).astype(np.float32))
+    labels = nd.array(np.random.RandomState(4).randint(0, 4, 64)
+                      .astype(np.float32))
+    blocking = mx.metric.create("acc")
+    blocking.update([labels], [preds])
+    lazy = mx.metric.create("acc")
+    lazy.update_dict({"softmax_label": labels}, {"softmax_output": preds},
+                     device=True)
+    assert lazy.num_inst == 0  # nothing synced yet
+    assert lazy.get() == blocking.get()
+    lazy.reset()
+    assert lazy.get()[1] != lazy.get()[1]  # NaN after reset (empty)
