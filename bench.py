@@ -240,6 +240,145 @@ def e2e_throughput(batch_size: int, batches: int = 10, warmup: int = 3):
             getattr(mod, "_fused_step_count", 0) > 0)
 
 
+def multichip_train_throughput(ndev: int = None):
+    """images/sec/chip + allreduce bus bandwidth at ndev>1 — the SPMD fused
+    train step (docs/multichip.md): Module.fit over a dp mesh with kvstore
+    `tpu_sync`, batch sharded on the dp axis, gradients psum'd in-program.
+
+    Also reports the LEGACY host-staged kvstore reduce bandwidth
+    (KVStoreLocal._reduce, the path the SPMD program replaces) so the
+    MULTICHIP_r*.json trend shows both sides.  On a host without a
+    multi-chip backend the caller runs this in a virtual-device subprocess
+    (numbers are wiring checks there, not bandwidth).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.parallel.collectives import shard_map_compat
+    from mxnet_tpu.parallel.mesh import dp_mesh
+
+    devs = jax.devices()
+    ndev = min(ndev or int(os.environ.get("BENCH_MULTICHIP_DEVICES", "8")),
+               len(devs))
+    if ndev < 2:
+        raise RuntimeError(f"multichip bench needs >=2 devices, have {len(devs)}")
+    batch = int(os.environ.get("BENCH_MULTICHIP_BATCH", "256"))
+    steps = int(os.environ.get("BENCH_MULTICHIP_STEPS", "16"))
+    dim, hidden, classes = 512, 1024, 64
+
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=hidden, name="fc1"),
+                       act_type="relu")
+    h = sym.Activation(sym.FullyConnected(h, num_hidden=hidden, name="fc2"),
+                       act_type="relu")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(h, num_hidden=classes, name="fc3"), label,
+        name="softmax")
+
+    rs = np.random.RandomState(0)
+    n = batch * steps
+    it = mx.io.NDArrayIter(rs.rand(n, dim).astype(np.float32),
+                           rs.randint(0, classes, n).astype(np.float32),
+                           batch_size=batch)
+    ctx_fn = mx.cpu if devs[0].platform == "cpu" else mx.tpu
+    mod = mx.mod.Module(net, context=[ctx_fn(i) for i in range(ndev)])
+    marks = []
+    mod.fit(it, num_epoch=2, optimizer="sgd", kvstore="tpu_sync",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            batch_end_callback=lambda p: marks.append(
+                (p.epoch * steps + p.nbatch, time.perf_counter())))
+    fused = getattr(mod, "_fused_step_count", 0) > 0
+    # epoch 2 only: epoch 1 pays the compile
+    usable = [m for m in marks if m[0] >= steps]
+    (n0, t0), (n1, t1) = usable[0], usable[-1]
+    img_per_sec_chip = (n1 - n0) * batch / (t1 - t0) / ndev
+
+    # in-program allreduce bus bandwidth (the tpu_sync reduce primitive)
+    mesh = dp_mesh(ndev)
+    elems = int(float(os.environ.get("BENCH_MULTICHIP_MB", "4")) * 1e6 / 4)
+    x = jnp.ones((ndev, elems), jnp.float32)
+    fn = jax.jit(shard_map_compat(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                                  in_specs=jax.sharding.PartitionSpec("dp"),
+                                  out_specs=jax.sharding.PartitionSpec("dp"),
+                                  check=True))
+    fn(x).block_until_ready()
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    busbw = 4 * elems * 2 * (ndev - 1) / ndev / dt / 1e9
+
+    # legacy host-staged kvstore reduce (what the SPMD program replaces;
+    # exercises the batched-transfer + jitted tree-reduction hot path)
+    kv = mx.kv.create("device")
+    kv.init("g", nd.zeros((elems,)))
+    vals = []
+    for i in range(ndev):
+        v = nd.ones((elems,))
+        v._data = jax.device_put(v._data, devs[i])
+        vals.append(v)
+    out_nd = nd.zeros((elems,))
+    kv.push("g", vals)
+    kv.pull("g", out=out_nd)
+    out_nd.wait_to_read()  # warm the jitted reduction
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        kv.push("g", vals)
+        kv.pull("g", out=out_nd)
+    out_nd.wait_to_read()
+    dt = (time.perf_counter() - t0) / iters
+    host_reduce = 4 * elems * 2 * (ndev - 1) / ndev / dt / 1e9
+
+    return {
+        "n_devices": ndev,
+        "images_per_sec_per_chip": round(img_per_sec_chip, 2),
+        "batch": batch,
+        "fused_spmd": bool(fused),
+        "allreduce_busbw_gbps": round(busbw, 3),
+        "kvstore_host_reduce_gbps": round(host_reduce, 3),
+        "platform": devs[0].platform,
+    }
+
+
+def _multichip_block():
+    """The multichip measurement for main(): inline when this process
+    already sees >=2 devices, else in a virtual-CPU-mesh subprocess (the
+    tests/conftest.py recipe) so a 1-chip host still reports the trend."""
+    import jax
+
+    ndev = int(os.environ.get("BENCH_MULTICHIP_DEVICES", "8"))
+    if len(jax.devices()) >= 2:
+        return multichip_train_throughput()
+    import re
+    import subprocess
+
+    env = dict(os.environ)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={ndev}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the live tunnel
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--multichip"],
+        capture_output=True, text=True, env=env, timeout=900)
+    for line in proc.stdout.splitlines():
+        try:
+            cand = json.loads(line)
+            if isinstance(cand, dict) and "n_devices" in cand:
+                return cand
+        except ValueError:
+            continue
+    raise RuntimeError(
+        f"multichip subprocess rc={proc.returncode}: "
+        f"{(proc.stderr or proc.stdout).strip()[-300:]}")
+
+
 def serving_latency(requests: int = None, clients: int = None):
     """p50/p99 request latency + QPS through mxnet_tpu.serving under a
     concurrent mixed-shape workload (docs/serving.md).  Runs inside the
@@ -447,11 +586,19 @@ def main():
         except Exception as e:  # optional block: failure is a field, not rc!=0
             sys.stderr.write(f"serving bench failed: {type(e).__name__}: {e}\n")
             result["serving_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_MULTICHIP", "1") == "1":
+        try:
+            result["multichip_train_throughput"] = _multichip_block()
+        except Exception as e:  # optional block: failure is a field, not rc!=0
+            sys.stderr.write(f"multichip bench failed: {type(e).__name__}: {e}\n")
+            result["multichip_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    if "--measure" in sys.argv:
+    if "--multichip" in sys.argv:
+        print(json.dumps(multichip_train_throughput()))
+    elif "--measure" in sys.argv:
         main()
     else:
         supervise()

@@ -85,6 +85,16 @@ class Executor:
         self._cached_grads: Optional[Dict[str, object]] = None
         self._monitor_callback = None
         self._jit_cache: Dict[tuple, object] = {}
+        # SPMD data-parallel annotation (set_spmd): when a mesh is attached,
+        # fused_step compiles ONE shard_map program over it — batch args
+        # sharded on the dp axis, params/optimizer state replicated+donated,
+        # gradients allreduced in-program (docs/multichip.md)
+        self._spmd_mesh = None
+        self._spmd_axis = "dp"
+        self._spmd_batch_args: frozenset = frozenset()
+        self._spmd_out_is_batch: List[bool] = []
+        self._spmd_active = False  # a fused SPMD step has run (buffers live
+        # replicated/sharded on the mesh; eager paths must reconcile)
         self._grad_arg_names = sorted(
             n for n in self._arg_names if self.grad_req.get(n, "null") != "null"
             and n in self.grad_dict)
@@ -123,6 +133,55 @@ class Executor:
     def output_dict(self) -> Dict[str, NDArray]:
         return dict(zip(self._out_names, self._outputs))
 
+    # -- SPMD annotation ----------------------------------------------------------
+    def set_spmd(self, mesh, batch_args, axis: str = "dp") -> None:
+        """Attach a data-parallel mesh to this executor (or detach with
+        ``mesh=None``).  ``batch_args`` are the argument names carrying the
+        batch dimension (data + labels): they shard on ``axis``; every other
+        input of the fused-step program stays replicated.  The mesh becomes
+        part of ``_signature`` so a program compiled for N devices is never
+        served to a rebind with a different device count."""
+        if mesh is None:
+            self._spmd_mesh = None
+            self._spmd_batch_args = frozenset()
+            self._spmd_out_is_batch = []
+            return
+        ndev = int(mesh.shape[axis])
+        batch_args = frozenset(batch_args)
+        bdims = set()
+        for n in batch_args:
+            if n not in self.arg_dict:
+                raise MXNetError(f"set_spmd: unknown batch argument {n!r}")
+            shape = self.arg_dict[n].shape
+            if not shape:
+                raise MXNetError(f"set_spmd: batch argument {n!r} is scalar")
+            bdims.add(shape[0])
+        if len(bdims) != 1:
+            raise MXNetError(
+                f"set_spmd: batch arguments disagree on the leading "
+                f"(batch) dimension: {sorted(bdims)}")
+        (batch,) = bdims
+        if batch % ndev:
+            raise MXNetError(
+                f"set_spmd: batch size {batch} not divisible by the dp "
+                f"mesh size {ndev}")
+        # which outputs carry the batch dimension (static, from whole-graph
+        # shape inference at the bound global shapes): those reassemble
+        # sharded on the dp axis; the rest are made replica-invariant via
+        # pmean inside the program
+        shape_kwargs = {n: self.arg_dict[n].shape for n in self._arg_names}
+        _, out_shapes, _ = self._symbol.infer_shape(**shape_kwargs)
+        self._spmd_out_is_batch = [
+            bool(s) and len(s) > 0 and s[0] == batch for s in out_shapes]
+        self._spmd_mesh = mesh
+        self._spmd_axis = axis
+        self._spmd_batch_args = batch_args
+
+    def _spmd_ndev(self) -> int:
+        if self._spmd_mesh is None:
+            return 1
+        return int(self._spmd_mesh.shape[self._spmd_axis])
+
     # -- compilation --------------------------------------------------------------
     def _signature(self, is_train: bool) -> tuple:
         sig = [is_train]
@@ -135,6 +194,13 @@ class Executor:
         for n in self._aux_names:
             a = self.aux_dict[n]
             sig.append(("aux", n, a.shape, str(a.dtype)))
+        if self._spmd_mesh is not None:
+            # mesh shape + participating device count: an 8-device SPMD
+            # program must never be served to a 1-device rebind (nor a dp=4
+            # one to dp=8 after a TPUMX_DP_DEVICES change)
+            sig.append(("mesh", self._spmd_axis, self._spmd_ndev(),
+                        int(self._spmd_mesh.devices.size),
+                        tuple(sorted(self._spmd_batch_args))))
         return tuple(sig)
 
     def _get_fwd(self, is_train: bool):
@@ -209,6 +275,34 @@ class Executor:
         aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
         return arg_vals, aux_vals
 
+    def _spmd_place_eager(self):
+        """Reconcile buffer placement for the NON-fused paths (plain
+        forward/backward, eval/score) after the fused SPMD step replicated
+        params over the mesh: a single-device feed would otherwise make the
+        jitted program reject the mixed device sets.  Batch args shard on
+        the dp axis when divisible (GSPMD then partitions the eval across
+        the mesh for free); everything else replicates.  Every device_put is
+        a no-op once placement is right."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh, axis = self._spmd_mesh, self._spmd_axis
+        ndev = self._spmd_ndev()
+        shard = NamedSharding(mesh, PartitionSpec(axis))
+        repl = NamedSharding(mesh, PartitionSpec())
+        for n in self._arg_names:
+            a = self.arg_dict[n]
+            if a._data is None:
+                continue
+            if n in self._spmd_batch_args and a.shape \
+                    and a.shape[0] % ndev == 0:
+                a._data = jax.device_put(a._data, shard)
+            else:
+                a._data = jax.device_put(a._data, repl)
+        for n in self._aux_names:
+            a = self.aux_dict[n]
+            if a._data is not None:
+                a._data = jax.device_put(a._data, repl)
+
     # -- execution ----------------------------------------------------------------
     def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
         for k, v in kwargs.items():
@@ -218,6 +312,8 @@ class Executor:
                 self.arg_dict[k]._data = v._data
             else:
                 self.arg_dict[k]._data = jnp.asarray(v)
+        if self._spmd_active and self._spmd_mesh is not None:
+            self._spmd_place_eager()
         arg_vals, aux_vals = self._collect_vals()
         rng = _random.next_key()
         self._cached_grads = None
@@ -264,6 +360,15 @@ class Executor:
             arg_vals, aux_vals = self._collect_vals()
             cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                    for g in out_grads]
+            if self._spmd_active and self._spmd_mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                mesh, axis = self._spmd_mesh, self._spmd_axis
+                ndev = self._spmd_ndev()
+                cts = [jax.device_put(c, NamedSharding(
+                    mesh, PartitionSpec(axis)
+                    if c.shape and c.shape[0] % ndev == 0 else
+                    PartitionSpec())) for c in cts]
             if self._grouped is not None:
                 env = dict(arg_vals)
                 env.update(aux_vals)
@@ -286,17 +391,36 @@ class Executor:
                 g._data = gn
 
     # -- fused whole-train-step ---------------------------------------------------
-    def _get_fused_step(self, optimizer, mults_by_name, num_steps: int):
+    def _get_fused_step(self, optimizer, mults_by_name, num_steps: int,
+                        kvstore=None):
+        spmd = self._spmd_ndev() > 1
         reqs = tuple(sorted((n, self.grad_req.get(n, "write"))
                             for n in self._grad_arg_names))
         key = ("fused_step", self._signature(True), int(num_steps),
                optimizer.fused_static_key(),
                tuple(sorted(mults_by_name.items())), reqs)
+        if spmd:
+            key = key + ("spmd", type(kvstore).__name__ if kvstore is not None
+                         else None)
         _note_cache(hit=key in self._jit_cache)
         if key not in self._jit_cache:
             entries = self._symbol._entries
             gnames = list(self._grad_arg_names)
             req_of = dict(reqs)
+            axis = self._spmd_axis if spmd else None
+            if spmd and kvstore is not None \
+                    and hasattr(kvstore, "reduce_in_program"):
+                # tpu_sync: the store IS the collective boundary — its
+                # in-trace hook emits the psum (kvstore.py)
+                def allreduce(g):
+                    return kvstore.reduce_in_program(g, axis)
+            elif spmd:
+                from .parallel.collectives import allreduce as _psum
+
+                def allreduce(g):
+                    return {n: _psum(v, axis) for n, v in g.items()}
+            else:
+                allreduce = None
 
             def one_step(pvals, svals, gprev, other_vals, aux_vals,
                          lr_i, wd, t_i, rng):
@@ -316,6 +440,20 @@ class Executor:
                         else jnp.zeros_like(v)
                         for k, v in aux_updates.items()})
                 (grads,) = vjp(cts)
+                if allreduce is not None:
+                    # in-program allreduce over the dp axis: per-shard grad
+                    # sums combine into the full-batch gradient, exactly what
+                    # the 1-device trace computes (rescale_grad then divides
+                    # by the GLOBAL batch in the optimizer, unchanged)
+                    grads = allreduce(
+                        {n: grads[n] for n in gnames if grads.get(n) is not None})
+                    # per-shard batch stats (BatchNorm running averages):
+                    # average across replicas so the committed aux carry is
+                    # replica-invariant
+                    aux_updates = {
+                        k: (jax.lax.pmean(v, axis)
+                            if jnp.issubdtype(v.dtype, jnp.inexact) else v)
+                        for k, v in aux_updates.items()}
                 new_grads = {}
                 for n in gnames:
                     g = grads.get(n)
@@ -357,12 +495,54 @@ class Executor:
                     auxu = {k: aux_full[k] for k in auxu}
                 return outs, auxu, grads, p, s
 
-            self._jit_cache[key] = jax.jit(fused, donate_argnums=(0, 1, 2))
+            if spmd:
+                from jax.sharding import PartitionSpec as P
+
+                from .parallel.collectives import shard_map_compat
+
+                mesh = self._spmd_mesh
+                out_is_batch = list(self._spmd_out_is_batch)
+
+                def shard_step(pvals, gvals, svals, batch_vals, const_vals,
+                               aux_vals, lr_vec, wd, t_vec, rng):
+                    # decorrelate per-shard randomness (dropout etc.); nets
+                    # without in-graph randomness stay bitwise replica-equal
+                    rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+                    other_vals = dict(const_vals)
+                    other_vals.update(batch_vals)
+                    outs, auxu, grads, p, s = fused(
+                        pvals, gvals, svals, other_vals, aux_vals,
+                        lr_vec, wd, t_vec, rng)
+                    # non-batch-major outputs (scalar losses etc.) must leave
+                    # the program replica-invariant; batch-major ones
+                    # reassemble to the global batch via the out_spec
+                    outs = [o if ob else jax.lax.pmean(o, axis)
+                            for o, ob in zip(outs, out_is_batch)]
+                    return outs, auxu, grads, p, s
+
+                def fused_spmd(pvals, gvals, svals, batch_vals, const_vals,
+                               aux_vals, lr_vec, wd, t_vec, rng):
+                    out_specs = ([P(axis) if ob else P()
+                                  for ob in out_is_batch],
+                                 P(), P(), P(), P())
+                    return shard_map_compat(
+                        shard_step, mesh=mesh,
+                        in_specs=(P(), P(), P(), P(axis), P(), P(),
+                                  P(), P(), P(), P()),
+                        out_specs=out_specs, check=False)(
+                        pvals, gvals, svals, batch_vals, const_vals,
+                        aux_vals, lr_vec, wd, t_vec, rng)
+
+                self._jit_cache[key] = jax.jit(fused_spmd,
+                                               donate_argnums=(0, 1, 2))
+            else:
+                self._jit_cache[key] = jax.jit(fused, donate_argnums=(0, 1, 2))
         return self._jit_cache[key]
 
     def fused_step(self, optimizer, states: Dict[str, object],
                    updates, feed: Optional[Dict[str, object]] = None,
-                   num_steps: Optional[int] = None) -> List[NDArray]:
+                   num_steps: Optional[int] = None,
+                   kvstore=None) -> List[NDArray]:
         """One donated XLA program per train step: forward + backward + the
         full optimizer update + aux-state commit (SURVEY.md §7 taken to its
         limit — the reference's ``CreateCachedSegOpr`` bulking over the whole
@@ -379,6 +559,12 @@ class Executor:
         ``num_steps`` fuses k whole steps into one dispatch via
         ``lax.fori_loop`` over the same batch; when None it reads
         ``engine.fusion_hint()`` (the bulk-scope knob, default 1).
+
+        With an SPMD mesh attached (``set_spmd``), the program is a
+        ``shard_map`` over it: batch args shard on the dp axis, everything
+        else is replicated, gradients allreduce in-program via psum —
+        routed through ``kvstore.reduce_in_program`` when the bound store
+        (``tpu_sync``) provides the hook (docs/multichip.md).
         """
         from . import engine as _engine
         from .optimizer import (_pack_state, _unpack_state_into,
@@ -407,18 +593,52 @@ class Executor:
         lr_vec, wd, t_vec, mults_by_idx = fused_update_plan(
             optimizer, [idx for _, idx in updates], num_steps)
         mults_by_name = {n: mults_by_idx[idx] for n, idx in updates}
-        fn = self._get_fused_step(optimizer, mults_by_name, num_steps)
+        spmd = self._spmd_ndev() > 1
+        fn = self._get_fused_step(optimizer, mults_by_name, num_steps,
+                                  kvstore=kvstore if spmd else None)
         gnames = self._grad_arg_names
         pvals = {n: self.arg_dict[n]._data for n in gnames}
         gvals = {n: self.grad_dict[n]._data for n in gnames}
         svals = {n: _pack_state(states[n]) for n in gnames}
-        pvals, gvals, svals = uniquify_donated((pvals, gvals, svals))
         other = {n: self.arg_dict[n]._data for n in self._arg_names
                  if n not in pvals}
         aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
         rng = _random.next_key()
-        outs, aux_updates, new_grads, new_p, new_s = fn(
-            pvals, gvals, svals, other, aux_vals, lr_vec, wd, t_vec, rng)
+        if spmd:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            mesh, axis = self._spmd_mesh, self._spmd_axis
+            ndev = self._spmd_ndev()
+            batch_vals = {n: other.pop(n) for n in list(other)
+                          if n in self._spmd_batch_args}
+            for n, v in batch_vals.items():
+                if not v.shape or v.shape[0] % ndev:
+                    raise MXNetError(
+                        f"fused_step: batch dim of {n!r} ({v.shape}) not "
+                        f"divisible by the dp mesh size {ndev}")
+            shard = NamedSharding(mesh, PartitionSpec(axis))
+            repl = NamedSharding(mesh, PartitionSpec())
+            # dedup donated buffers BEFORE replication: single-device buffer
+            # pointers are readable here, while multi-shard arrays only fall
+            # back to id() (constant-cache aliases would then slip through
+            # and XLA rejects a twice-donated buffer)
+            pvals, gvals, svals = uniquify_donated((pvals, gvals, svals))
+            # one device_put per array, no per-device Python splits: the
+            # batch lands sharded on the dp axis, everything else replicated
+            # (both are no-ops after the first step — program outputs carry
+            # these shardings already)
+            batch_vals = {n: jax.device_put(v, shard)
+                          for n, v in batch_vals.items()}
+            pvals, gvals, svals, other, aux_vals = jax.device_put(
+                (pvals, gvals, svals, other, aux_vals), repl)
+            self._spmd_active = True
+            outs, aux_updates, new_grads, new_p, new_s = fn(
+                pvals, gvals, svals, batch_vals, other, aux_vals,
+                lr_vec, wd, t_vec, rng)
+        else:
+            pvals, gvals, svals = uniquify_donated((pvals, gvals, svals))
+            outs, aux_updates, new_grads, new_p, new_s = fn(
+                pvals, gvals, svals, other, aux_vals, lr_vec, wd, t_vec, rng)
         self._outputs = [NDArray(o) for o in outs]
         for k, v in aux_updates.items():
             self.aux_dict[k]._data = v

@@ -57,10 +57,16 @@ def _load_label(batch, targets, slices=None):
 
 
 class DataParallelExecutorManager:
-    """Per-device executor group for the legacy FeedForward path
-    (reference: executor_manager.py DataParallelExecutorManager). Each device
-    slice binds its own executor; params are shared (one copy — XLA handles
-    device placement)."""
+    """Multi-device executor for the legacy FeedForward path (reference:
+    executor_manager.py DataParallelExecutorManager).
+
+    TPU-native: instead of the reference's per-device executor replicas with
+    host-sliced batches, ONE executor is bound and — with several contexts —
+    annotated with a dp mesh (Executor.set_spmd): batches land sharded on the
+    batch axis via a single device_put, params/aux replicate over the mesh,
+    and XLA partitions the whole fwd/bwd program across the devices
+    (gradient allreduce inserted by the compiler).  The per-device slicing
+    (`_split_input_slice`) survives only for API compatibility."""
 
     def __init__(self, symbol, ctx, train_data, arg_names, param_names,
                  aux_names, work_load_list=None, logger=None,
@@ -86,6 +92,41 @@ class DataParallelExecutorManager:
                                         **data_shapes, **label_shapes)
         self._data_names = list(data_shapes)
         self._label_names = list(label_shapes)
+        self._mesh = None
+        if num_device > 1:
+            try:
+                from .parallel.mesh import dp_mesh
+
+                mesh = dp_mesh(num_device,
+                               devices=[c.jax_device for c in self.ctx])
+                self._exec.set_spmd(
+                    mesh, batch_args=self._data_names + self._label_names)
+                self._mesh = mesh
+                self._replicate_params()
+            except Exception as e:  # indivisible batch etc.: single-device
+                if logger is not None:
+                    logger.warning("SPMD executor unavailable (%s); running "
+                                   "on %s only", e, self.ctx[0])
+                self._mesh = None
+                self._exec.set_spmd(None, batch_args=())
+
+    def _replicate_params(self):
+        """Replicate every non-batch buffer over the dp mesh so the sharded
+        batch and the params agree on a device set (GSPMD then partitions
+        the compiled programs across it)."""
+        if self._mesh is None:
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(self._mesh, PartitionSpec())
+        batch_names = set(self._data_names) | set(self._label_names)
+        for d in (self._exec.arg_dict, self._exec.grad_dict,
+                  self._exec.aux_dict):
+            for n, a in d.items():
+                if n not in batch_names and a is not None \
+                        and a._data is not None:
+                    a._data = jax.device_put(a._data, repl)
 
     @property
     def param_arrays(self):
@@ -114,6 +155,8 @@ class DataParallelExecutorManager:
         for name, arr in aux_params.items():
             if name in auxmap:
                 auxmap[name][:] = arr
+        # fresh host values land single-device; restore mesh placement
+        self._replicate_params()
 
     def copy_to(self, arg_params, aux_params):
         argmap = dict(zip(self.symbol.list_arguments(), self._exec.arg_arrays))
@@ -125,6 +168,10 @@ class DataParallelExecutorManager:
             aux_params[name] = arr.copy()
 
     def load_data_batch(self, data_batch):
+        if self._mesh is not None:
+            from .io import shard_data_batch
+
+            shard_data_batch(data_batch, self._mesh)
         self._batch = data_batch
 
     def forward(self, is_train=False):

@@ -23,7 +23,31 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter", "ImageRecordIterNative",
-           "LibSVMIter"]
+           "LibSVMIter", "shard_data_batch"]
+
+
+def shard_data_batch(batch: "DataBatch", mesh, axis: str = "dp") -> "DataBatch":
+    """Place a batch over a data-parallel mesh for the SPMD fused train step.
+
+    One ``jax.device_put`` with a ``NamedSharding`` on the batch axis per
+    array — the input pipeline never materializes per-device Python splits
+    (the reference's ``_split_input_slice`` host slicing).  Arrays are
+    re-placed IN PLACE on the batch's NDArrays so every downstream consumer
+    (executor feed, device-side metrics comparing labels against sharded
+    outputs) sees consistently-sharded values.  Arrays whose leading dim
+    doesn't divide by the mesh size are left untouched (the caller falls
+    back to the legacy path for those batches).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    ndev = int(mesh.shape[axis])
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+    for arr in list(batch.data or []) + list(batch.label or []):
+        if isinstance(arr, NDArray) and arr._data is not None \
+                and arr.shape and arr.shape[0] % ndev == 0:
+            arr._data = jax.device_put(arr._data, sharding)
+    return batch
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):

@@ -37,6 +37,23 @@ def _as_list(x):
     return x if isinstance(x, (list, tuple)) else [x]
 
 
+def _tree_sum(xs):
+    """Balanced pairwise sum of a list of same-shaped arrays — log-depth, so
+    XLA can fuse it into one reduction program instead of a serial add chain."""
+    xs = list(xs)
+    while len(xs) > 1:
+        nxt = [xs[i] + xs[i + 1] for i in range(0, len(xs) - 1, 2)]
+        if len(xs) % 2:
+            nxt.append(xs[-1])
+        xs = nxt
+    return xs[0]
+
+
+# one jit object is enough: jax re-traces (and caches) per (count, shape,
+# dtype) signature, so every gradient key shares this entry point
+_tree_sum_jit = jax.jit(_tree_sum)
+
+
 class KVStore:
     """Abstract base mirroring the reference KVStore API."""
 
@@ -125,6 +142,15 @@ class KVStore:
         qualifies: its reduce of one contribution is a copy."""
         return False
 
+    @property
+    def supports_spmd_fused(self) -> bool:
+        """Whether this store may act as the collective boundary of the
+        multi-device SPMD fused train step (docs/multichip.md): its reduce
+        must be expressible as an in-program XLA allreduce over the dp mesh
+        axis.  Device-reduce stores (`tpu_sync`, `device`) qualify; host-side
+        (`local`) and parameter-server (`dist_*`) stores do not."""
+        return False
+
 
 class KVStoreLocal(KVStore):
     """Single-process multi-device store (reference: src/kvstore/kvstore_local.h).
@@ -143,6 +169,12 @@ class KVStoreLocal(KVStore):
 
     def _fused_step_ok(self) -> bool:
         return self._grad_compression is None and self.num_workers == 1
+
+    @property
+    def supports_spmd_fused(self) -> bool:
+        return (self._type in ("device", "tpu_sync")
+                and self._grad_compression is None
+                and self.num_workers == 1)
 
     def init(self, key, value):
         keys = _as_list(key)
@@ -204,14 +236,18 @@ class KVStoreLocal(KVStore):
             # distinct touched rows, however many devices/pushes contribute
             # (overflow semantics in ndarray/sparse.py module docs)
             return _sparse.RowSparseNDArray(values, idx, vals[0].shape).compact()
-        # one fused XLA reduction; inputs migrate to the first buffer's device
+        # one fused XLA reduction; inputs migrate to the first buffer's device.
+        # Hot path (the legacy multi-device reduce): ONE batched device_put of
+        # every contribution followed by ONE jitted log-depth tree reduction,
+        # instead of the former per-value device_put-then-add Python chain
+        # (N-1 dispatches + N-1 serial transfers per key).
         datas = [v._data for v in vals]
         if compress:
             datas = [self._compress(key, i, d) for i, d in enumerate(datas)]
-        acc = datas[0]
-        for d in datas[1:]:
-            acc = acc + jax.device_put(d, list(acc.devices())[0])
-        return NDArray(acc)
+        dev = list(datas[0].devices())[0]
+        if any(list(d.devices()) != [dev] for d in datas[1:]):
+            datas = jax.device_put(datas, dev)
+        return NDArray(_tree_sum_jit(datas))
 
     def push(self, key, value, priority=0):
         keys = _as_list(key)
@@ -242,18 +278,37 @@ class KVStoreLocal(KVStore):
             src = self._store.get(k)
             if src is None:
                 raise MXNetError(f"kvstore: key {k!r} not initialized")
-            for dst in _as_list(o):
-                if isinstance(src, _sparse.BaseSparseNDArray):
+            dsts = _as_list(o)
+            if isinstance(src, _sparse.BaseSparseNDArray):
+                for dst in dsts:
                     if isinstance(dst, _sparse.BaseSparseNDArray):
                         src.copyto(dst)
                     else:
                         dst._data = self._to_dst_device(
                             src._to_dense_jax(), dst)
-                else:
-                    # copy INTO the destination's device (reference
-                    # CopyFromTo keeps dst context); rebinding to the
-                    # store's buffer would collapse per-device placement
-                    dst._data = self._to_dst_device(src._data, dst)
+            else:
+                # copy INTO the destination's device (reference CopyFromTo
+                # keeps dst context); rebinding to the store's buffer would
+                # collapse per-device placement.  The broadcast is batched:
+                # one transfer per distinct destination device, shared by
+                # every dst living there, not one transfer per dst.
+                per_dev = {}
+                for dst in dsts:
+                    dev = self._dst_device(dst)
+                    if dev not in per_dev:
+                        per_dev[dev] = src._data if dev is None else \
+                            self._to_dst_device(src._data, dst)
+                    dst._data = per_dev[dev]
+
+    @staticmethod
+    def _dst_device(dst):
+        try:
+            if dst._data is None:
+                return None
+            devs = list(dst._data.devices())
+            return devs[0] if len(devs) == 1 else tuple(devs)
+        except Exception:
+            return None
 
     @staticmethod
     def _to_dst_device(buf, dst):
@@ -305,6 +360,9 @@ class KVStoreTPUSync(KVStoreLocal):
     collectives.
     """
 
+    #: mesh axis the in-program collectives run over (parallel/mesh.dp_mesh)
+    spmd_axis = "dp"
+
     def __init__(self):
         super().__init__(device_reduce=True)
         self._type = "tpu_sync"
@@ -316,6 +374,31 @@ class KVStoreTPUSync(KVStoreLocal):
     @property
     def rank(self):
         return int(os.environ.get("TPUMX_RANK", "0"))
+
+    # -- in-trace collective hooks -------------------------------------------------
+    # Called from INSIDE an SPMD trace (the fused data-parallel train step,
+    # executor.py _get_fused_step): these are the real collective boundary —
+    # the reference's nccl AllReduce/Broadcast (kvstore_nccl.h:285,402)
+    # become jax.lax.psum / masked-psum over the dp mesh axis, lowered to
+    # ICI allreduce by XLA.  No host round-trip, no per-key dispatch.
+    def reduce_in_program(self, tree, axis: Optional[str] = None):
+        """Allreduce (sum) a gradient pytree over the dp axis — jit/shard_map
+        trace context only."""
+        from .parallel import collectives
+
+        axis = axis or self.spmd_axis
+        return jax.tree_util.tree_map(
+            lambda g: collectives.allreduce(g, axis), tree)
+
+    def broadcast_in_program(self, tree, axis: Optional[str] = None,
+                             src: int = 0):
+        """Broadcast rank ``src``'s shard of a pytree to every member of the
+        dp axis — jit/shard_map trace context only."""
+        from .parallel import collectives
+
+        axis = axis or self.spmd_axis
+        return jax.tree_util.tree_map(
+            lambda x: collectives.broadcast(x, axis, src=src), tree)
 
 
 def create(name: str = "local") -> KVStore:

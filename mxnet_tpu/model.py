@@ -21,13 +21,24 @@ def _fused_step_allowed(optimizer, kvstore, update_on_kvstore,
     """Whether a Module may route fit/update through the fused whole-step
     program (Executor.fused_step): local-only parameter handling, a
     fused-capable optimizer, and no behavior the fused trace can't reproduce.
-    ``TPUMX_FUSED_STEP=0`` restores the legacy per-param path everywhere."""
+    ``TPUMX_FUSED_STEP=0`` restores the legacy per-param path everywhere.
+
+    With several devices the fused step becomes an SPMD data-parallel
+    program (batch sharded over a dp mesh, gradients psum'd in-program —
+    docs/multichip.md); that path additionally needs a collective-capable
+    store (`tpu_sync`/`device`) and can be disabled on its own with
+    ``TPUMX_FUSED_STEP_SPMD=0`` (falls back to the legacy per-device
+    executor-group/kvstore reduce path)."""
     import os
 
     if os.environ.get("TPUMX_FUSED_STEP", "1") == "0":
         return False
     if num_device != 1:
-        return False
+        if os.environ.get("TPUMX_FUSED_STEP_SPMD", "1") == "0":
+            return False
+        if kvstore is None or not getattr(kvstore, "supports_spmd_fused",
+                                          False):
+            return False
     if optimizer is None or not getattr(optimizer, "fused_step_supported", False):
         return False
     if getattr(optimizer, "multi_precision", False):
@@ -60,9 +71,29 @@ def _create_kvstore(kvstore, num_device: int, arg_params):
                     update_on_kvstore = False
     else:
         raise TypeError("kvstore must be KVStore, str or None")
+    if kv is not None and kv.type == "tpu_sync":
+        # tpu_sync is a collective boundary, not a parameter server: its
+        # reduce lowers to an in-program allreduce and the optimizer update
+        # runs once per replica (SPMD fused step) or locally (legacy path) —
+        # never ON the store
+        update_on_kvstore = False
+    elif kv is not None and kv.type == "device" and num_device > 1 \
+            and _spmd_enabled():
+        # the device-reduce store also qualifies as an SPMD collective
+        # boundary; the update must then run in-program (off-store).  With
+        # either escape hatch set, the reference's update-on-device-store
+        # behavior is preserved exactly.
+        update_on_kvstore = False
     if kv is None:
         update_on_kvstore = False
     return kv, update_on_kvstore
+
+
+def _spmd_enabled() -> bool:
+    import os
+
+    return (os.environ.get("TPUMX_FUSED_STEP", "1") != "0"
+            and os.environ.get("TPUMX_FUSED_STEP_SPMD", "1") != "0")
 
 
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,

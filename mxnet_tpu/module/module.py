@@ -119,6 +119,22 @@ class Module(BaseModule):
     def _shape_kwargs(self):
         return dict(self._data_shapes + self._label_shapes)
 
+    def _dp_size(self) -> int:
+        """Effective data-parallel width: ``TPUMX_DP_DEVICES`` when set (>1),
+        else the number of bound contexts.  >1 routes fit through the SPMD
+        fused step (docs/multichip.md)."""
+        import os
+
+        env = os.environ.get("TPUMX_DP_DEVICES", "")
+        if env:
+            try:
+                n = int(env)
+            except ValueError:
+                n = 0
+            if n > 1:
+                return n
+        return len(self._context)
+
     # -- binding ------------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -155,6 +171,7 @@ class Module(BaseModule):
                 req[n] = grad_req
         self._exec = self._symbol.simple_bind(
             ctx=self._context[0], grad_req=req, **shape_kwargs)
+        self._maybe_attach_spmd_mesh()
         # shared binding may alias param buffers with another module's
         # executor — donation in the fused path would invalidate them
         self._shared_bound = shared_module is not None
@@ -163,6 +180,36 @@ class Module(BaseModule):
         if self._arg_params is not None:
             self._exec.copy_params_from(self._arg_params, self._aux_params,
                                         allow_extra_params=True)
+
+    def _maybe_attach_spmd_mesh(self):
+        """Annotate the executor with a dp mesh when this Module is bound for
+        multi-device training (several contexts, or ``TPUMX_DP_DEVICES``):
+        the SPMD fused step then shards the batch across the mesh and
+        allreduces gradients in-program, replacing the reference's per-device
+        executor groups + host kvstore reduce.  Best-effort: anything the
+        SPMD program can't express (indivisible batch, RNN carry states,
+        un-inferable output shapes) leaves the annotation off and fit takes
+        the legacy path."""
+        import os
+
+        ndev = self._dp_size()
+        if (ndev <= 1 or not self.for_training or self._state_names
+                or os.environ.get("TPUMX_FUSED_STEP", "1") == "0"
+                or os.environ.get("TPUMX_FUSED_STEP_SPMD", "1") == "0"):
+            return
+        try:
+            from ..parallel.mesh import dp_mesh
+
+            devices = None
+            if len(self._context) > 1:
+                devices = [c.jax_device for c in self._context]
+            mesh = dp_mesh(ndev, devices=devices)
+            self._exec.set_spmd(
+                mesh, batch_args=self._data_names + self._label_names)
+        except Exception as e:
+            self.logger.warning(
+                "SPMD fused step unavailable (%s); multi-device fit will use "
+                "the legacy executor-group path", e)
 
     # -- params -------------------------------------------------------------------
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
@@ -232,8 +279,11 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
+        # effective dp width (TPUMX_DP_DEVICES can widen a single-context
+        # module): a >1 width must materialize the collective store rather
+        # than collapse to kv=None the way num_device==1 does
         kv, update_on_kvstore = _create_kvstore(
-            kvstore, len(self._context),
+            kvstore, self._dp_size(),
             {n: self._exec.arg_dict[n] for n in self._param_names})
         batch_size = self._data_shapes[0][1][0] if self._data_shapes else 1
         if kv and "dist" in kv.type and "_sync" in kv.type:
@@ -317,9 +367,9 @@ class Module(BaseModule):
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized):
             return False
+        ndev = self._dp_size()
         if not _fused_step_allowed(self._optimizer, self._kvstore,
-                                   self._update_on_kvstore,
-                                   len(self._context)):
+                                   self._update_on_kvstore, ndev):
             return False
         if self._updater is None or self._shared_bound or self.inputs_need_grad:
             return False
@@ -330,6 +380,14 @@ class Module(BaseModule):
         # every gradient-taking argument must be a parameter we can update
         if set(self._exec._grad_arg_names) - set(self._param_names):
             return False
+        if ndev > 1:
+            # multi-device: the SPMD mesh must be attached and the global
+            # batch must shard evenly across it
+            if self._exec._spmd_ndev() != ndev:
+                return False
+            batch = self._data_shapes[0][1][0] if self._data_shapes else 0
+            if not batch or batch % ndev:
+                return False
         return True
 
     def _try_fused_step(self, data_batch) -> bool:
@@ -342,7 +400,13 @@ class Module(BaseModule):
         from ..optimizer import fused_counts_uniform
 
         grad_names = set(self._exec._grad_arg_names)
-        idx_of = {n: i for i, n in enumerate(self._param_names)
+        # idx: the legacy i*num_device+k slot scheme (k=0 slot), where
+        # num_device is the CONTEXT count exactly as init_optimizer's
+        # idx2name uses it — lr_mult/wd_mult lookups and optimizer-state
+        # checkpoints stay compatible with the per-device updater layout
+        # (TPUMX_DP_DEVICES widens the mesh, not the slot scheme)
+        nslot = len(self._context)
+        idx_of = {n: i * nslot for i, n in enumerate(self._param_names)
                   if n in grad_names}
         if not fused_counts_uniform(self._optimizer, list(idx_of.values())):
             return False
@@ -357,9 +421,16 @@ class Module(BaseModule):
                       zip([s[0] for s in self._data_shapes], data_batch.data)}
         if any(cur[n] != s for n, s in new_shapes.items()):
             self._reshape_exec(data_batch)
+        if self._dp_size() > 1 and self._exec._spmd_mesh is not None:
+            # one device_put per array with a NamedSharding on the batch
+            # axis, mutating the batch's NDArrays in place: executor feed AND
+            # device-side metrics (labels vs sharded outputs) stay consistent
+            from ..io import shard_data_batch
+
+            shard_data_batch(data_batch, self._exec._spmd_mesh,
+                             self._exec._spmd_axis)
         updates, states = [], {}
         for name, idx in idx_of.items():
-            # idx: the legacy i*num_device+k slot scheme, num_device == 1
             if idx not in self._updater.states:
                 self._updater.states[idx] = \
                     self._optimizer.create_state_multi_precision(
@@ -367,7 +438,8 @@ class Module(BaseModule):
             updates.append((name, idx))
             states[name] = self._updater.states[idx]
         self._exec.fused_step(self._optimizer, states, updates,
-                              feed=feed, num_steps=1)
+                              feed=feed, num_steps=1,
+                              kvstore=self._kvstore)
         self._params_dirty = True
         self._fused_step_count += 1
         return True
@@ -395,7 +467,26 @@ class Module(BaseModule):
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         # device=True: metrics that can accumulate device-side do so without
-        # asnumpy() — the host sync happens once, at get()/epoch boundaries
+        # asnumpy() — the host sync happens once, at get()/epoch boundaries.
+        # Under SPMD the outputs live sharded on the dp mesh: labels must
+        # join them there (sharded on the batch axis, or replicated when the
+        # final batch doesn't divide) so the device-side comparison stays one
+        # in-program computation — per-shard counts combined by an XLA psum,
+        # never a per-batch host sync.
+        if (self._exec is not None and self._exec._spmd_active
+                and self._exec._spmd_mesh is not None):
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            mesh = self._exec._spmd_mesh
+            axis = self._exec._spmd_axis
+            ndev = self._exec._spmd_ndev()
+            for l in labels or []:
+                if isinstance(l, NDArray) and l._data is not None:
+                    spec = PartitionSpec(axis) if l.shape \
+                        and l.shape[0] % ndev == 0 else PartitionSpec()
+                    l._data = jax.device_put(
+                        l._data, NamedSharding(mesh, spec))
         eval_metric.update_dict(
             dict(zip(self._label_names, labels or [])),
             dict(zip(self._output_names, self._exec.outputs)),

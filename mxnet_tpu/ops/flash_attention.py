@@ -106,8 +106,13 @@ def _fwd_call(q3, k3, v3, t_real, causal, bq, bk, scale, interpret):
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
         # inside shard_map (Ulysses impl="flash") the output must carry the
         # inputs' varying-mesh-axes annotation or check_vma rejects it
-        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype,
-                                       vma=jax.typeof(q3).vma),
+        # (jax.typeof/vma only exist on jax versions that HAVE check_vma;
+        # older releases use check_rep, where a plain ShapeDtypeStruct is
+        # exactly right)
+        out_shape=(jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype,
+                                        vma=jax.typeof(q3).vma)
+                   if hasattr(jax, "typeof")
+                   else jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype)),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32)],

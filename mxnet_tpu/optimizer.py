@@ -704,7 +704,11 @@ def uniquify_donated(trees):
         try:
             ptr = x.unsafe_buffer_pointer()
         except Exception:
-            ptr = id(x)
+            try:  # multi-device (replicated/sharded) array: key on the
+                # first addressable shard's buffer — aliases share shards
+                ptr = x.addressable_shards[0].data.unsafe_buffer_pointer()
+            except Exception:
+                ptr = id(x)
         if ptr in seen:
             return jnp.array(x, copy=True)
         seen.add(ptr)

@@ -15,7 +15,8 @@ import numpy as _np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-__all__ = ["MeshConfig", "make_mesh", "get_mesh", "local_mesh", "sharding_for"]
+__all__ = ["MeshConfig", "make_mesh", "get_mesh", "local_mesh", "sharding_for",
+           "dp_mesh"]
 
 _current_mesh: Optional[Mesh] = None
 
@@ -55,6 +56,23 @@ def make_mesh(axes: Dict[str, int] = None, devices=None, **axis_kwargs) -> Mesh:
     mesh = Mesh(dev_array, names)
     set_mesh(mesh)
     return mesh
+
+
+def dp_mesh(ndev: int, devices=None, axis_name: str = "dp") -> Mesh:
+    """One-axis data-parallel mesh over ``ndev`` devices — the single mesh
+    source of truth for the SPMD fused train step (Executor/Module) and the
+    ``tpu_sync`` kvstore's in-program collectives.
+
+    Does NOT install itself as the ambient mesh: the fused step owns its mesh
+    explicitly, and clobbering a user's `make_mesh` (say an ep-only MoE mesh)
+    from inside `Module.bind` would be spooky action at a distance.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if ndev > len(devices):
+        raise ValueError(
+            f"dp mesh wants {ndev} devices, only {len(devices)} present")
+    return Mesh(_np.asarray(list(devices)[:ndev]), (axis_name,))
 
 
 def local_mesh(axis_name: str = "dp") -> Mesh:

@@ -31,7 +31,9 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
 
     Returns (M, ...) outputs valid on the LAST stage (others zeros).
     """
-    n = lax.axis_size(axis_name)
+    from .collectives import axis_size
+
+    n = axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     M = x_microbatches.shape[0]
     T = M + n - 1
@@ -39,9 +41,11 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
     outputs = jnp.zeros_like(x_microbatches)
     # mark carries as device-varying over the pp axis up front: the loop body
     # makes them varying (rank-dependent writes), and lax.fori_loop requires
-    # carry types to be invariant across iterations
-    state = lax.pcast(state, (axis_name,), to="varying")
-    outputs = lax.pcast(outputs, (axis_name,), to="varying")
+    # carry types to be invariant across iterations.  Older jax has neither
+    # lax.pcast nor vma tracking — there the zeros carries are already fine.
+    if hasattr(lax, "pcast"):
+        state = lax.pcast(state, (axis_name,), to="varying")
+        outputs = lax.pcast(outputs, (axis_name,), to="varying")
 
     def tick(t, carry):
         state, outputs = carry
@@ -80,7 +84,9 @@ def pipeline_apply_sharded(stage_fn: Callable, stacked_params, x_microbatches,
         # outputs are zeros except on the last stage → psum replicates them
         return lax.psum(out, axis_name)
 
-    fn = jax.shard_map(inner, mesh=mesh,
-                       in_specs=(pspec, PartitionSpec()),
-                       out_specs=PartitionSpec())
+    from .collectives import shard_map_compat
+
+    fn = shard_map_compat(inner, mesh=mesh,
+                          in_specs=(pspec, PartitionSpec()),
+                          out_specs=PartitionSpec(), check=False)
     return fn(stacked_params, x_microbatches)

@@ -55,8 +55,10 @@ def ulysses_attention_sharded(q, k, v, mesh: Optional[Mesh] = None,
                               impl: str = "dense"):
     mesh = mesh or get_mesh()
     spec = PartitionSpec(None, axis_name, None, None)
-    fn = jax.shard_map(
+    from .collectives import shard_map_compat
+
+    fn = shard_map_compat(
         functools.partial(ulysses_attention, axis_name=axis_name,
                           causal=causal, impl=impl),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check=False)
     return fn(q, k, v)

@@ -198,6 +198,11 @@ def make_sharded_train_step(mesh: Mesh, cfg: TransformerConfig,
                            compute_dtype=compute_dtype) / n_shards
 
         loss, grads = jax.value_and_grad(local_loss)(params)
+        # EXPLICIT allreduce of the param cotangents: with replication
+        # checking off (shard_map_compat check=False, the only mode every
+        # jax generation accepts for this graph) no auto-psum is inserted on
+        # the backward, so each shard holds only its local contribution here
+        grads = jax.tree_util.tree_map(lambda g: jax.lax.psum(g, axes), grads)
         loss = jax.lax.psum(loss, axes)  # back to the global mean for report
         momenta = jax.tree_util.tree_map(lambda m, g: momentum * m + g,
                                          momenta, grads)
@@ -205,10 +210,12 @@ def make_sharded_train_step(mesh: Mesh, cfg: TransformerConfig,
                                         params, momenta)
         return loss, params, momenta
 
-    fn = jax.shard_map(
+    from .collectives import shard_map_compat
+
+    fn = shard_map_compat(
         shard_step, mesh=mesh,
         in_specs=(repl, repl, data, data, P("sp")),
-        out_specs=(repl, repl, repl))
+        out_specs=(repl, repl, repl), check=False)
     return jax.jit(fn, donate_argnums=(0, 1))
 
 

@@ -48,6 +48,11 @@ def pytest_configure(config):
         "markers",
         "fused: fused whole-train-step execution (Executor.fused_step, "
         "docs/fused_step.md; select with `pytest -m fused`)")
+    config.addinivalue_line(
+        "markers",
+        "spmd: multi-device SPMD data-parallel training (shard_map fused "
+        "step over the dp mesh, docs/multichip.md; select with "
+        "`pytest -m spmd`)")
 
 
 def pytest_collection_modifyitems(config, items):
