@@ -43,6 +43,8 @@ from . import image
 from . import kvstore
 from . import kvstore as kv
 
+from . import amp
+
 from . import module
 from . import module as mod
 from .module import Module

@@ -1,0 +1,160 @@
+"""mxnet_tpu.amp — automatic mixed precision (docs/amp.md).
+
+Reference lineage: the MXNet fp16/AMP stack (``contrib.amp`` casting lists,
+``optimizer.py:494`` fp32-master-weight SGD) and *Mixed Precision Training*
+(Micikevicius et al., 2018).  Three cooperating pieces:
+
+1. **Casting policy** — :func:`convert_symbol` rewrites a symbolic graph so
+   matmul/conv-family ops run in bf16/fp16 while softmax/norm/loss ops stay
+   f32 (minimal cast insertion, not blanket casting); :func:`init` applies
+   the same policy to a Gluon block tree via param casts + forward wrappers.
+2. **Traced dynamic loss scaling** — :class:`LossScaler`, threaded INSIDE
+   ``Executor.fused_step`` so scale-apply, unscale, the all-finite check,
+   the skip-update ``lax.cond`` and the scale update never break the
+   one-program-per-step property (on the SPMD path the finite check is
+   psum-combined across the dp mesh).
+3. **Fused master weights** — ``multi_precision`` optimizers now ride the
+   fused/SPMD step too: ``(master_f32, state)`` pytrees flow through the
+   donated update and the low-precision weight is recast from the master
+   every step (``optimizer.fused_apply_update``).
+
+Enablement for the Module stack is env-driven (``TPUMX_AMP=1``,
+``TPUMX_AMP_DTYPE``, ``TPUMX_AMP_LOSS_SCALE`` — docs/env_vars.md); the
+functions here are the explicit API.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..base import MXNetError, canonical_dtype
+from .convert import convert_symbol, count_amp_casts, remove_amp_cast
+from .lists import (FP32_OPS, TARGET_DTYPE_OPS, _GLUON_FP32_BLOCKS,
+                    _GLUON_TARGET_BLOCKS)
+from .loss_scaler import LossScaler
+
+__all__ = ["convert_symbol", "remove_amp_cast", "count_amp_casts",
+           "LossScaler", "AmpConfig", "enabled", "target_dtype",
+           "active_config", "make_loss_scaler", "init"]
+
+
+def enabled() -> bool:
+    """Whether env-driven AMP is on (``TPUMX_AMP=1``; default off)."""
+    return os.environ.get("TPUMX_AMP", "0") == "1"
+
+
+def target_dtype() -> str:
+    """The env-selected compute dtype (``TPUMX_AMP_DTYPE``, default
+    bfloat16 — the TPU-native choice; float16 needs loss scaling)."""
+    return canonical_dtype(os.environ.get("TPUMX_AMP_DTYPE", "bfloat16"))
+
+
+class AmpConfig:
+    """Resolved AMP settings for one Module bind: compute dtype + loss-scale
+    policy (``"dynamic"``, a static float, or ``None`` for no scaling)."""
+
+    def __init__(self, dtype: str, loss_scale):
+        self.dtype = dtype
+        self.loss_scale = loss_scale
+
+    def __repr__(self):
+        return f"AmpConfig(dtype={self.dtype!r}, loss_scale={self.loss_scale!r})"
+
+
+def active_config() -> Optional[AmpConfig]:
+    """The env-driven config, or None when AMP is off.
+
+    ``TPUMX_AMP_LOSS_SCALE`` values: unset → ``dynamic`` for float16 and
+    no scaling for bfloat16 (bf16 shares f32's exponent range, so overflow
+    is a non-issue — docs/amp.md); ``dynamic``; a float for a fixed static
+    scale; ``0``/``none``/``off`` to disable scaling explicitly.
+    """
+    if not enabled():
+        return None
+    dtype = target_dtype()
+    raw = os.environ.get("TPUMX_AMP_LOSS_SCALE", "").strip().lower()
+    if raw in ("", None):
+        loss_scale = "dynamic" if dtype == "float16" else None
+    elif raw in ("0", "none", "off", "false"):
+        loss_scale = None
+    elif raw == "dynamic":
+        loss_scale = "dynamic"
+    else:
+        try:
+            loss_scale = float(raw)
+        except ValueError:
+            raise MXNetError(
+                f"TPUMX_AMP_LOSS_SCALE={raw!r}: expected 'dynamic', a float, "
+                "or 'none'")
+        if loss_scale <= 0:
+            loss_scale = None
+    return AmpConfig(dtype, loss_scale)
+
+
+def make_loss_scaler(cfg: Optional[AmpConfig]) -> Optional[LossScaler]:
+    """A LossScaler for the config's policy (None when scaling is off)."""
+    if cfg is None or cfg.loss_scale is None:
+        return None
+    if cfg.loss_scale == "dynamic":
+        return LossScaler(dynamic=True)
+    return LossScaler(init_scale=float(cfg.loss_scale), dynamic=False)
+
+
+# -- Gluon -----------------------------------------------------------------------
+def _wrap_forward_cast(block, dtype):
+    """Instance-level forward wrapper casting float NDArray inputs to
+    ``dtype`` (the cast hook: ``self.forward`` resolves through the instance
+    first in ``Block.__call__``, so leaf blocks see pre-cast inputs without
+    mutating the caller's arrays)."""
+    import numpy as _np
+
+    from ..base import np_dtype
+    from ..ndarray.ndarray import NDArray
+
+    target = np_dtype(dtype)
+    orig = block.forward
+
+    def forward(*args, **kwargs):
+        cast_args = tuple(
+            a.astype(target)
+            if isinstance(a, NDArray)
+            and _np.issubdtype(_np.dtype(a.dtype), _np.floating)
+            and _np.dtype(a.dtype) != target else a
+            for a in args)
+        return orig(*cast_args, **kwargs)
+
+    block.forward = forward
+    block._amp_dtype = str(target)
+
+
+def init(block, target_dtype: str = "bfloat16"):
+    """Apply the AMP policy to a Gluon block tree, in place.
+
+    Leaf blocks on the low-precision list (Dense/Conv*) get their parameters
+    cast to ``target_dtype`` and a forward cast hook for inputs; blocks on
+    the f32 list (BatchNorm/LayerNorm/...) keep f32 parameters and receive a
+    cast-to-f32 input hook.  Everything else is dtype-propagating.  Training
+    a converted block wants ``multi_precision=True`` on the optimizer (f32
+    master weights — the fused update supports them end-to-end).  Returns
+    the block.
+    """
+    dtype = canonical_dtype(target_dtype)
+    if dtype not in ("bfloat16", "float16"):
+        raise MXNetError(
+            f"amp.init: target_dtype must be bfloat16 or float16, "
+            f"got {target_dtype!r}")
+
+    def visit(b):
+        cls = type(b).__name__
+        if getattr(b, "_amp_dtype", None) is not None:
+            return
+        if cls in _GLUON_TARGET_BLOCKS:
+            for p in b._reg_params.values():
+                if p is not None:
+                    p.cast(dtype)
+            _wrap_forward_cast(b, dtype)
+        elif cls in _GLUON_FP32_BLOCKS:
+            _wrap_forward_cast(b, "float32")
+
+    block.apply(visit)
+    return block

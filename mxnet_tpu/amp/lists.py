@@ -1,0 +1,68 @@
+"""AMP op / block casting lists (reference: python/mxnet/contrib/amp/lists —
+FP16_FUNCS / FP32_FUNCS split, Micikevicius et al. 2018 §3).
+
+Three buckets govern :func:`mxnet_tpu.amp.convert_symbol`:
+
+- ``TARGET_DTYPE_OPS``: compute-bound ops the MXU runs ~2x faster in
+  bf16/fp16 (matmul-family, conv-family, RNN).  Their float inputs are cast
+  to the target dtype; accumulation stays f32 (``preferred_element_type`` /
+  implicit MXU accumulation — ops/nn.py module docs).
+- ``FP32_OPS``: numerically fragile ops (softmax family, losses, norms,
+  wide reductions, exp/log) whose inputs are cast back to f32 when a
+  low-precision value would otherwise reach them.
+- everything else is dtype-propagating: it runs in whatever precision its
+  inputs arrive in, and no cast is inserted.
+
+The gluon-side analogue (``_GLUON_TARGET_BLOCKS`` / ``_GLUON_FP32_BLOCKS``)
+keys on Block class names for :func:`mxnet_tpu.amp.init`.
+"""
+
+# ops cast TO the target low-precision dtype (the fast MXU path)
+TARGET_DTYPE_OPS = (
+    "FullyConnected",
+    "Convolution",
+    "Deconvolution",
+    "RNN",
+    "dot",
+    "batch_dot",
+)
+
+# ops forced back to f32 (reductions, exponentials, losses, normalization
+# statistics — the overflow/cancellation-prone tail of the graph)
+FP32_OPS = (
+    "softmax",
+    "log_softmax",
+    "softmin",
+    "SoftmaxActivation",
+    "SoftmaxOutput",
+    "softmax_cross_entropy",
+    "BatchNorm",
+    "LayerNorm",
+    "InstanceNorm",
+    "L2Normalization",
+    "LRN",
+    "norm",
+    "sum",
+    "mean",
+    "prod",
+    "exp",
+    "log",
+    "smooth_l1",
+    "LinearRegressionOutput",
+    "MAERegressionOutput",
+    "LogisticRegressionOutput",
+    "MakeLoss",
+)
+
+# gluon Block class names for amp.init (leaf blocks only)
+_GLUON_TARGET_BLOCKS = (
+    "Dense",
+    "Conv1D", "Conv2D", "Conv3D",
+    "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
+)
+
+_GLUON_FP32_BLOCKS = (
+    "BatchNorm",
+    "LayerNorm",
+    "InstanceNorm",
+)

@@ -1,0 +1,112 @@
+"""Dynamic loss scaling, traced inside the fused train step.
+
+Reference lineage: *Mixed Precision Training* (Micikevicius et al., 2018 §4)
+and the reference MXNet's ``contrib.amp`` dynamic scaler.  TPU-native twist
+(docs/amp.md): every piece — scale-apply on the cotangent seed, gradient
+unscale, the all-finite check, the skip-update ``lax.cond`` and the scale
+update itself — is traced INSIDE ``Executor.fused_step``, so an AMP train
+step remains ONE donated, cached XLA program.  The scaler's cross-step state
+is a tiny functional pytree ``(scale, good_steps)`` of f32 scalars threaded
+in and out of the program; hyperparameters are static trace constants and
+part of the fused compile-cache key (:meth:`LossScaler.static_key`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    """Functional loss scaler.
+
+    ``dynamic=True`` (the default) grows the scale by ``growth_factor``
+    after ``growth_interval`` consecutive finite steps and backs it off by
+    ``backoff_factor`` on any overflow (nonfinite gradient), always skipping
+    that step's parameter update.  ``dynamic=False`` keeps a constant scale
+    but still skips nonfinite steps.
+    """
+
+    def __init__(self, init_scale: float = 2.0 ** 15,
+                 growth_factor: float = 2.0, backoff_factor: float = 0.5,
+                 growth_interval: int = 2000, dynamic: bool = True,
+                 max_scale: float = 2.0 ** 24, min_scale: float = 1.0):
+        self.init_scale = float(init_scale)
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.growth_interval = int(growth_interval)
+        self.dynamic = bool(dynamic)
+        self.max_scale = float(max_scale)
+        self.min_scale = float(min_scale)
+        self._state = None  # (scale, good_steps) f32 device scalars
+
+    # -- host-side state management ------------------------------------------------
+    def static_key(self) -> tuple:
+        """Hyperparameters baked into the fused trace as constants (compile
+        cache key component — changing them must recompile)."""
+        return ("loss_scaler", self.init_scale, self.growth_factor,
+                self.backoff_factor, self.growth_interval, self.dynamic,
+                self.max_scale, self.min_scale)
+
+    def state(self) -> tuple:
+        """The functional ``(scale, good_steps)`` pytree fed to the fused
+        program (created lazily on first use)."""
+        if self._state is None:
+            self._state = (jnp.float32(self.init_scale), jnp.float32(0.0))
+        return self._state
+
+    def set_state(self, state) -> None:
+        """Commit the fused program's returned scaler state."""
+        self._state = tuple(state)
+
+    def reset(self) -> None:
+        self._state = None
+
+    @property
+    def scale_value(self) -> float:
+        """Host read of the current scale (syncs the device scalar)."""
+        return float(self.state()[0])
+
+    @property
+    def good_steps(self) -> int:
+        return int(float(self.state()[1]))
+
+    # -- trace-side pieces (called inside the fused program) ------------------------
+    @staticmethod
+    def scale_cotangent(ct, scale):
+        """Apply the loss scale to one (inexact) output cotangent seed."""
+        return (ct * scale).astype(ct.dtype)
+
+    @staticmethod
+    def unscale(grad, scale):
+        """Undo the scale on one gradient (dtype-preserving; inf/nan stay
+        nonfinite, so unscale-before-check and check-before-unscale agree)."""
+        return (grad.astype(jnp.float32) / scale).astype(grad.dtype)
+
+    @staticmethod
+    def nonfinite_count(grads: dict):
+        """Total count of nonfinite gradient elements (f32 scalar — summable
+        across the dp mesh by a psum, unlike a boolean)."""
+        total = jnp.float32(0.0)
+        for g in grads.values():
+            if jnp.issubdtype(g.dtype, jnp.inexact):
+                total = total + jnp.sum(
+                    (~jnp.isfinite(g.astype(jnp.float32))).astype(jnp.float32))
+        return total
+
+    def next_state(self, state, finite):
+        """The traced scale update: backoff on overflow, growth after
+        ``growth_interval`` clean steps (no-op for ``dynamic=False``)."""
+        scale, good = state
+        if not self.dynamic:
+            return (scale, jnp.where(finite, good + 1.0, jnp.float32(0.0)))
+        grown = good + 1.0 >= float(self.growth_interval)
+        scale_ok = jnp.where(
+            grown, jnp.minimum(scale * self.growth_factor, self.max_scale),
+            scale)
+        good_ok = jnp.where(grown, jnp.float32(0.0), good + 1.0)
+        return (jnp.where(finite, scale_ok,
+                          jnp.maximum(scale * self.backoff_factor,
+                                      self.min_scale)),
+                jnp.where(finite, good_ok, jnp.float32(0.0)))

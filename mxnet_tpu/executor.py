@@ -392,7 +392,8 @@ class Executor:
 
     # -- fused whole-train-step ---------------------------------------------------
     def _get_fused_step(self, optimizer, mults_by_name, num_steps: int,
-                        kvstore=None):
+                        kvstore=None, scaler=None,
+                        master_names: frozenset = frozenset()):
         spmd = self._spmd_ndev() > 1
         reqs = tuple(sorted((n, self.grad_req.get(n, "write"))
                             for n in self._grad_arg_names))
@@ -402,6 +403,14 @@ class Executor:
         if spmd:
             key = key + ("spmd", type(kvstore).__name__ if kvstore is not None
                          else None)
+        if scaler is not None or master_names:
+            # AMP components key their own programs: toggling the scaler or
+            # the master-weight layout must compile fresh, while the plain
+            # f32 key (and its cached program) stays byte-identical to the
+            # pre-AMP layout
+            key = key + ("amp",
+                         None if scaler is None else scaler.static_key(),
+                         tuple(sorted(master_names)))
         _note_cache(hit=key in self._jit_cache)
         if key not in self._jit_cache:
             entries = self._symbol._entries
@@ -422,8 +431,10 @@ class Executor:
             else:
                 allreduce = None
 
+            from .optimizer import fused_apply_update
+
             def one_step(pvals, svals, gprev, other_vals, aux_vals,
-                         lr_i, wd, t_i, rng):
+                         lr_i, wd, t_i, rng, sc=None):
                 def f(gvals):
                     env = dict(other_vals)
                     env.update(gvals)
@@ -434,7 +445,17 @@ class Executor:
                     return outs, aux_updates
 
                 (outs, aux_updates), vjp = jax.vjp(f, pvals)
-                cts = ([_ones_cotangent(o) for o in outs],
+                if scaler is None:
+                    out_cts = [_ones_cotangent(o) for o in outs]
+                else:
+                    # loss scaling: the scale rides the cotangent seed, so
+                    # every gradient leaves the vjp pre-multiplied by it
+                    # (Micikevicius et al. 2018 §4; docs/amp.md)
+                    out_cts = [scaler.scale_cotangent(_ones_cotangent(o),
+                                                      sc[0])
+                               if jnp.issubdtype(o.dtype, jnp.inexact)
+                               else _ones_cotangent(o) for o in outs]
+                cts = (out_cts,
                        {k: _np.zeros(v.shape, jax.dtypes.float0)
                         if not jnp.issubdtype(v.dtype, jnp.inexact)
                         else jnp.zeros_like(v)
@@ -454,6 +475,25 @@ class Executor:
                         k: (jax.lax.pmean(v, axis)
                             if jnp.issubdtype(v.dtype, jnp.inexact) else v)
                         for k, v in aux_updates.items()}
+                finite = None
+                if scaler is not None:
+                    # all-finite check on the (scaled, already-reduced)
+                    # grads; under SPMD the count is additionally combined
+                    # over the dp mesh through the same collective boundary
+                    # so every replica takes the SAME skip/apply branch
+                    nonfin = scaler.nonfinite_count(
+                        {n: g for n, g in grads.items() if g is not None})
+                    if allreduce is not None:
+                        if kvstore is not None and hasattr(
+                                kvstore, "all_finite_in_program"):
+                            nonfin = kvstore.all_finite_in_program(nonfin,
+                                                                   axis)
+                        else:
+                            nonfin = allreduce({"_amp_nonfinite": nonfin})[
+                                "_amp_nonfinite"]
+                    finite = nonfin == 0
+                    grads = {n: scaler.unscale(g, sc[0])
+                             for n, g in grads.items() if g is not None}
                 new_grads = {}
                 for n in gnames:
                     g = grads.get(n)
@@ -462,38 +502,89 @@ class Executor:
                     if req_of[n] == "add":
                         g = gprev[n] + g
                     new_grads[n] = g
-                new_p, new_s = {}, {}
-                for n in gnames:
-                    lm, wm, dt = mults_by_name[n]
-                    new_p[n], new_s[n] = optimizer.update_step(
-                        pvals[n], new_grads[n], svals[n],
-                        lr_i * lm, wd * wm, t_i + dt)
-                return outs, aux_updates, new_grads, new_p, new_s
 
-            def fused(pvals, gvals, svals, other_vals, aux_vals,
-                      lr_vec, wd, t_vec, rng):
+                def apply_updates(_):
+                    new_p, new_s = {}, {}
+                    for n in gnames:
+                        lm, wm, dt = mults_by_name[n]
+                        new_p[n], new_s[n] = fused_apply_update(
+                            optimizer, pvals[n], new_grads[n], svals[n],
+                            lr_i * lm, wd * wm, t_i + dt, n in master_names)
+                    return new_p, new_s
+
+                if scaler is None:
+                    new_p, new_s = apply_updates(None)
+                    return outs, aux_updates, new_grads, new_p, new_s
+                # overflow: skip the whole update (params, optimizer state,
+                # AND the BatchNorm running-stat commit — a nonfinite batch
+                # must not poison the aux carry), then back the scale off
+                new_p, new_s = jax.lax.cond(
+                    finite, apply_updates,
+                    lambda _: ({n: pvals[n] for n in gnames},
+                               {n: svals[n] for n in gnames}), None)
+                aux_updates = {
+                    k: (jnp.where(finite, v, aux_vals[k].astype(v.dtype))
+                        if jnp.issubdtype(v.dtype, jnp.inexact) else v)
+                    for k, v in aux_updates.items()}
+                return (outs, aux_updates, new_grads, new_p, new_s,
+                        scaler.next_state(sc, finite))
+
+            def fused_core(pvals, gvals, svals, other_vals, aux_vals,
+                           lr_vec, wd, t_vec, rng, sc_state):
                 rng0 = jax.random.fold_in(rng, 0) if num_steps > 1 else rng
-                outs, auxu, grads, p, s = one_step(
-                    pvals, svals, gvals, other_vals, aux_vals,
-                    lr_vec[0], wd, t_vec[0], rng0)
+                res = one_step(pvals, svals, gvals, other_vals, aux_vals,
+                               lr_vec[0], wd, t_vec[0], rng0, sc_state)
+                if scaler is None:
+                    outs, auxu, grads, p, s = res
+                    sc = None
+                else:
+                    outs, auxu, grads, p, s, sc = res
                 if num_steps > 1:
                     aux_full = dict(aux_vals)
                     aux_full.update(auxu)
 
                     def body(i, carry):
-                        p, s, aux, grads, outs = carry
-                        o2, au, g2, p2, s2 = one_step(
-                            p, s, grads, other_vals, aux,
-                            lr_vec[i], wd, t_vec[i],
-                            jax.random.fold_in(rng, i))
+                        if scaler is None:
+                            p, s, aux, grads, outs = carry
+                            o2, au, g2, p2, s2 = one_step(
+                                p, s, grads, other_vals, aux,
+                                lr_vec[i], wd, t_vec[i],
+                                jax.random.fold_in(rng, i))
+                            sc2 = ()
+                        else:
+                            p, s, aux, grads, outs, sc = carry
+                            o2, au, g2, p2, s2, sc2 = one_step(
+                                p, s, grads, other_vals, aux,
+                                lr_vec[i], wd, t_vec[i],
+                                jax.random.fold_in(rng, i), sc)
                         aux2 = dict(aux)
                         aux2.update(au)
-                        return (p2, s2, aux2, g2, o2)
+                        return (p2, s2, aux2, g2, o2) if scaler is None \
+                            else (p2, s2, aux2, g2, o2, sc2)
 
-                    p, s, aux_full, grads, outs = jax.lax.fori_loop(
-                        1, num_steps, body, (p, s, aux_full, grads, outs))
+                    carry0 = (p, s, aux_full, grads, outs) if scaler is None \
+                        else (p, s, aux_full, grads, outs, sc)
+                    res = jax.lax.fori_loop(1, num_steps, body, carry0)
+                    if scaler is None:
+                        p, s, aux_full, grads, outs = res
+                    else:
+                        p, s, aux_full, grads, outs, sc = res
                     auxu = {k: aux_full[k] for k in auxu}
-                return outs, auxu, grads, p, s
+                if scaler is None:
+                    return outs, auxu, grads, p, s
+                return outs, auxu, grads, p, s, sc
+
+            if scaler is None:
+                def fused(pvals, gvals, svals, other_vals, aux_vals,
+                          lr_vec, wd, t_vec, rng):
+                    return fused_core(pvals, gvals, svals, other_vals,
+                                      aux_vals, lr_vec, wd, t_vec, rng, None)
+            else:
+                def fused(pvals, gvals, svals, other_vals, aux_vals,
+                          lr_vec, wd, t_vec, rng, sc_state):
+                    return fused_core(pvals, gvals, svals, other_vals,
+                                      aux_vals, lr_vec, wd, t_vec, rng,
+                                      sc_state)
 
             if spmd:
                 from jax.sharding import PartitionSpec as P
@@ -504,34 +595,38 @@ class Executor:
                 out_is_batch = list(self._spmd_out_is_batch)
 
                 def shard_step(pvals, gvals, svals, batch_vals, const_vals,
-                               aux_vals, lr_vec, wd, t_vec, rng):
+                               aux_vals, lr_vec, wd, t_vec, rng, *sc):
                     # decorrelate per-shard randomness (dropout etc.); nets
                     # without in-graph randomness stay bitwise replica-equal
                     rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
                     other_vals = dict(const_vals)
                     other_vals.update(batch_vals)
-                    outs, auxu, grads, p, s = fused(
-                        pvals, gvals, svals, other_vals, aux_vals,
-                        lr_vec, wd, t_vec, rng)
+                    res = fused(pvals, gvals, svals, other_vals, aux_vals,
+                                lr_vec, wd, t_vec, rng, *sc)
+                    outs, rest = res[0], res[1:]
                     # non-batch-major outputs (scalar losses etc.) must leave
                     # the program replica-invariant; batch-major ones
                     # reassemble to the global batch via the out_spec
                     outs = [o if ob else jax.lax.pmean(o, axis)
                             for o, ob in zip(outs, out_is_batch)]
-                    return outs, auxu, grads, p, s
+                    return (outs,) + tuple(rest)
 
                 def fused_spmd(pvals, gvals, svals, batch_vals, const_vals,
-                               aux_vals, lr_vec, wd, t_vec, rng):
+                               aux_vals, lr_vec, wd, t_vec, rng, *sc):
                     out_specs = ([P(axis) if ob else P()
                                   for ob in out_is_batch],
                                  P(), P(), P(), P())
+                    in_specs = (P(), P(), P(), P(axis), P(), P(),
+                                P(), P(), P(), P())
+                    if scaler is not None:
+                        out_specs = out_specs + (P(),)
+                        in_specs = in_specs + (P(),)
                     return shard_map_compat(
                         shard_step, mesh=mesh,
-                        in_specs=(P(), P(), P(), P(axis), P(), P(),
-                                  P(), P(), P(), P()),
+                        in_specs=in_specs,
                         out_specs=out_specs, check=False)(
                         pvals, gvals, svals, batch_vals, const_vals,
-                        aux_vals, lr_vec, wd, t_vec, rng)
+                        aux_vals, lr_vec, wd, t_vec, rng, *sc)
 
                 self._jit_cache[key] = jax.jit(fused_spmd,
                                                donate_argnums=(0, 1, 2))
@@ -542,7 +637,7 @@ class Executor:
     def fused_step(self, optimizer, states: Dict[str, object],
                    updates, feed: Optional[Dict[str, object]] = None,
                    num_steps: Optional[int] = None,
-                   kvstore=None) -> List[NDArray]:
+                   kvstore=None, loss_scaler=None) -> List[NDArray]:
         """One donated XLA program per train step: forward + backward + the
         full optimizer update + aux-state commit (SURVEY.md §7 taken to its
         limit — the reference's ``CreateCachedSegOpr`` bulking over the whole
@@ -565,6 +660,14 @@ class Executor:
         else is replicated, gradients allreduce in-program via psum —
         routed through ``kvstore.reduce_in_program`` when the bound store
         (``tpu_sync``) provides the hook (docs/multichip.md).
+
+        AMP (docs/amp.md): ``loss_scaler`` (an ``amp.LossScaler``) threads
+        scale-apply / grad-unscale / the all-finite check / the skip-update
+        ``lax.cond`` / the scale update through the SAME single program —
+        its tiny ``(scale, good_steps)`` state rides as an extra program
+        input/output.  ``multi_precision`` optimizers whose states carry
+        ``(master_f32, inner)`` pytrees (low-precision weights) update the
+        f32 master in-program and recast the weight from it each step.
         """
         from . import engine as _engine
         from .optimizer import (_pack_state, _unpack_state_into,
@@ -594,8 +697,15 @@ class Executor:
             optimizer, [idx for _, idx in updates], num_steps)
         mults_by_name = {n: mults_by_idx[idx] for n, idx in updates}
         spmd = self._spmd_ndev() > 1
+        # static per-param master-weight layout (create_state_multi_precision
+        # returns (master_f32, inner) exactly when _needs_master holds)
+        master_names = frozenset(
+            n for n, _ in updates
+            if optimizer._needs_master(self.arg_dict[n]))
         fn = self._get_fused_step(optimizer, mults_by_name, num_steps,
-                                  kvstore=kvstore if spmd else None)
+                                  kvstore=kvstore if spmd else None,
+                                  scaler=loss_scaler,
+                                  master_names=master_names)
         gnames = self._grad_arg_names
         pvals = {n: self.arg_dict[n]._data for n in gnames}
         gvals = {n: self.grad_dict[n]._data for n in gnames}
@@ -604,6 +714,7 @@ class Executor:
                  if n not in pvals}
         aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
         rng = _random.next_key()
+        sc_args = () if loss_scaler is None else (loss_scaler.state(),)
         if spmd:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -629,16 +740,20 @@ class Executor:
             # these shardings already)
             batch_vals = {n: jax.device_put(v, shard)
                           for n, v in batch_vals.items()}
-            pvals, gvals, svals, other, aux_vals = jax.device_put(
-                (pvals, gvals, svals, other, aux_vals), repl)
+            pvals, gvals, svals, other, aux_vals, sc_args = jax.device_put(
+                (pvals, gvals, svals, other, aux_vals, sc_args), repl)
             self._spmd_active = True
-            outs, aux_updates, new_grads, new_p, new_s = fn(
-                pvals, gvals, svals, batch_vals, other, aux_vals,
-                lr_vec, wd, t_vec, rng)
+            res = fn(pvals, gvals, svals, batch_vals, other, aux_vals,
+                     lr_vec, wd, t_vec, rng, *sc_args)
         else:
             pvals, gvals, svals = uniquify_donated((pvals, gvals, svals))
-            outs, aux_updates, new_grads, new_p, new_s = fn(
-                pvals, gvals, svals, other, aux_vals, lr_vec, wd, t_vec, rng)
+            res = fn(pvals, gvals, svals, other, aux_vals, lr_vec, wd, t_vec,
+                     rng, *sc_args)
+        if loss_scaler is None:
+            outs, aux_updates, new_grads, new_p, new_s = res
+        else:
+            outs, aux_updates, new_grads, new_p, new_s, new_sc = res
+            loss_scaler.set_state(new_sc)
         self._outputs = [NDArray(o) for o in outs]
         for k, v in aux_updates.items():
             self.aux_dict[k]._data = v

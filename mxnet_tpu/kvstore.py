@@ -400,6 +400,17 @@ class KVStoreTPUSync(KVStoreLocal):
         return jax.tree_util.tree_map(
             lambda x: collectives.broadcast(x, axis, src=src), tree)
 
+    def all_finite_in_program(self, nonfinite_count, axis: Optional[str] = None):
+        """Combine a per-shard AMP nonfinite-gradient count over the dp axis
+        (the loss-scaler finite check, docs/amp.md): a psum through the same
+        collective boundary as the gradients, so every replica sees the SAME
+        total and takes the same skip/apply branch of the fused step's
+        ``lax.cond``.  jit/shard_map trace context only."""
+        from .parallel import collectives
+
+        axis = axis or self.spmd_axis
+        return collectives.allreduce(nonfinite_count, axis)
+
 
 def create(name: str = "local") -> KVStore:
     """Factory (reference: src/kvstore/kvstore.cc:40-77 + python/mxnet/kvstore.py)."""

@@ -41,8 +41,8 @@ def _fused_step_allowed(optimizer, kvstore, update_on_kvstore,
             return False
     if optimizer is None or not getattr(optimizer, "fused_step_supported", False):
         return False
-    if getattr(optimizer, "multi_precision", False):
-        return False
+    # multi_precision is fused-capable since the AMP PR: (master_f32, state)
+    # pytrees flow through the donated update (optimizer.fused_apply_update)
     if update_on_kvstore:
         return False
     if kvstore is not None and not kvstore._fused_step_ok():
@@ -144,8 +144,16 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
-    """Write prefix-symbol.json + prefix-%04d.params (reference: model.py:384)."""
+    """Write prefix-symbol.json + prefix-%04d.params (reference: model.py:384).
+
+    ``remove_amp_cast`` (default True, matching the reference) strips any
+    AMP-policy cast nodes before serialization so the checkpoint stays an
+    original-precision graph portable to non-AMP consumers (docs/amp.md)."""
     if symbol is not None:
+        if remove_amp_cast:
+            from .amp import remove_amp_cast as _strip
+
+            symbol = _strip(symbol)
         symbol.save(f"{prefix}-symbol.json")
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})

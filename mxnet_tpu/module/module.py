@@ -62,6 +62,8 @@ class Module(BaseModule):
         self._compression_params = compression_params
         self._fused_step_count = 0
         self._shared_bound = False
+        self._amp_cfg = None      # resolved at bind (env TPUMX_AMP*)
+        self._loss_scaler = None  # created at init_optimizer when needed
         _check_input_names(symbol, self._data_names, "data", True)
         _check_input_names(symbol, self._label_names, "label", False)
         _check_input_names(symbol, self._state_names, "state", True)
@@ -159,6 +161,19 @@ class Module(BaseModule):
         self._label_shapes = _norm(label_shapes)
         shape_kwargs = self._shape_kwargs()
 
+        # AMP casting policy (env-driven, docs/amp.md): bind a CONVERTED
+        # symbol — matmul/conv inputs cast to the target dtype in-graph,
+        # softmax/norm/loss inputs forced back to f32 — while self._symbol
+        # (arguments, checkpoints, user introspection) stays the original.
+        # TPUMX_AMP=0/unset leaves this path untouched.
+        from .. import amp as _amp
+
+        self._amp_cfg = _amp.active_config()
+        bind_symbol = self._symbol
+        if self._amp_cfg is not None:
+            bind_symbol = _amp.convert_symbol(self._symbol,
+                                              self._amp_cfg.dtype)
+
         req = {}
         for n in self._symbol.list_arguments():
             if n in self._data_names:
@@ -169,7 +184,7 @@ class Module(BaseModule):
                 req[n] = "null"
             else:
                 req[n] = grad_req
-        self._exec = self._symbol.simple_bind(
+        self._exec = bind_symbol.simple_bind(
             ctx=self._context[0], grad_req=req, **shape_kwargs)
         self._maybe_attach_spmd_mesh()
         # shared binding may alias param buffers with another module's
@@ -321,6 +336,20 @@ class Module(BaseModule):
                 update_on_kvstore=update_on_kvstore)
         if not update_on_kvstore:
             self._updater = get_updater(self._optimizer)
+        # traced loss scaling (docs/amp.md): created once per optimizer init
+        # so its (scale, good_steps) device state persists across batches,
+        # epochs, AND rebinds (_reshape_exec re-runs bind, not this)
+        from .. import amp as _amp
+
+        self._loss_scaler = _amp.make_loss_scaler(self._amp_cfg)
+        if self._loss_scaler is not None and not _fused_step_allowed(
+                self._optimizer, self._kvstore, self._update_on_kvstore,
+                self._dp_size()):
+            self.logger.warning(
+                "AMP loss scaling requires the fused train step; this "
+                "configuration falls back to the legacy path and trains "
+                "UNSCALED (docs/amp.md)")
+            self._loss_scaler = None
         self.optimizer_initialized = True
         if hasattr(self, "_preload_opt_states"):
             self.load_optimizer_states(self._preload_opt_states)
@@ -439,7 +468,8 @@ class Module(BaseModule):
             states[name] = self._updater.states[idx]
         self._exec.fused_step(self._optimizer, states, updates,
                               feed=feed, num_steps=1,
-                              kvstore=self._kvstore)
+                              kvstore=self._kvstore,
+                              loss_scaler=self._loss_scaler)
         self._params_dirty = True
         self._fused_step_count += 1
         return True

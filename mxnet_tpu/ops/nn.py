@@ -6,9 +6,10 @@ surface, as XLA emitters.  Convolutions lower through ``lax.conv_general_dilated
 which XLA tiles onto the MXU.  Mixed precision: matmuls request f32
 accumulation via ``preferred_element_type``; convs rely on the MXU's implicit
 f32 accumulation for bf16 (jax's conv transpose rule rejects an explicit
-``preferred_element_type``), and fp16 convs are computed in f32 and cast back
-— together the TPU-native analogue of the reference's
-fp16-with-fp32-master-weights path (``python/mxnet/optimizer.py:494``).
+``preferred_element_type``), fp16 convs and ALL low-precision deconvs are
+computed in f32 and cast back — together the TPU-native analogue of the
+reference's fp16-with-fp32-master-weights path
+(``python/mxnet/optimizer.py:494``; see also mxnet_tpu.amp / docs/amp.md).
 
 Data layout: the public ops accept the reference's default NCHW ("NCHW" attr)
 but also "NHWC"; internally XLA's layout assignment owns the physical layout,
@@ -151,6 +152,13 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=
     wt = wt.reshape(num_group, ci // num_group, og, *kernel_dims)
     wt = jnp.swapaxes(wt, 1, 2)                  # (g, out/g, in/g, *k)
     wt = wt.reshape(num_group * og, ci // num_group, *kernel_dims)
+    # the conv-transpose lowering can't request preferred_element_type (jax's
+    # transpose rule rejects it), so a bf16/fp16 deconv would accumulate in
+    # low precision on non-MXU backends: compute in f32 and cast back, like
+    # the fp16 Convolution path above
+    in_dtype = data.dtype
+    if in_dtype in (jnp.float16, jnp.bfloat16):
+        data, wt = data.astype(jnp.float32), wt.astype(jnp.float32)
     out = lax.conv_general_dilated(
         data, wt,
         window_strides=(1,) * k,
@@ -160,6 +168,8 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=
         dimension_numbers=dn,
         feature_group_count=int(num_group),
     )
+    if out.dtype != in_dtype:
+        out = out.astype(in_dtype)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -381,19 +391,25 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
 
     Forward emits softmax probabilities; the custom backward (grad = p - onehot)
     is expressed via a custom_vjp so autograd matches the reference exactly,
-    including ignore_label masking and normalization modes.
+    including ignore_label masking and normalization modes.  ``out_grad=True``
+    (reference softmax_output-inl.h kOut grad multiply) makes the head honor
+    the incoming cotangent — the hook AMP loss scaling rides (amp.convert_symbol
+    flips it so the scaled seed propagates; a ones seed is a no-op).
     """
+    from ..symbol.graph import attr_bool
+
     return _softmax_output_vjp(data, label, float(grad_scale), float(ignore_label),
                                bool(multi_output), bool(use_ignore),
-                               str(normalization), float(smooth_alpha))
+                               str(normalization), float(smooth_alpha),
+                               attr_bool(out_grad))
 
 
 from functools import partial
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8))
 def _softmax_output_vjp(data, label, grad_scale, ignore_label, multi_output,
-                        use_ignore, normalization, smooth_alpha):
+                        use_ignore, normalization, smooth_alpha, out_grad=False):
     return _softmax_fwd_only(data, multi_output)
 
 
@@ -404,18 +420,20 @@ def _softmax_fwd_only(data, multi_output):
 
 
 def _so_fwd(data, label, grad_scale, ignore_label, multi_output, use_ignore,
-            normalization, smooth_alpha):
+            normalization, smooth_alpha, out_grad=False):
     out = _softmax_fwd_only(data, multi_output)
     return out, (out, label)
 
 
 def _so_bwd(grad_scale, ignore_label, multi_output, use_ignore, normalization,
-            smooth_alpha, res, g):
+            smooth_alpha, out_grad, res, g):
     out, label = res
     # probability labels (label.shape == data.shape): grad = scale*(p - label),
     # no ignore/normalization (softmax_output-inl.h:154-160)
     if tuple(label.shape) == tuple(out.shape):
         grad = (out - label.astype(out.dtype)) * grad_scale
+        if out_grad:
+            grad = grad * g.astype(grad.dtype)
         return (grad.astype(out.dtype), jnp.zeros_like(label))
     if multi_output and out.ndim > 2:
         nclass = out.shape[1]
@@ -460,6 +478,9 @@ def _so_bwd(grad_scale, ignore_label, multi_output, use_ignore, normalization,
     else:  # 'null'
         denom = float(spatial)
     grad = grad * (grad_scale / denom)
+    if out_grad:  # honor the incoming cotangent (reference out_grad=True;
+        # the AMP loss-scale seed enters here — docs/amp.md)
+        grad = grad * g.astype(grad.dtype)
     return (grad.astype(out.dtype), jnp.zeros_like(label))
 
 
@@ -557,40 +578,52 @@ def bilinear_resize(data, height=1, width=1, scale_height=None, scale_width=None
 # ---------------------------------------------------------------------------
 
 @register("LinearRegressionOutput")
-def linear_regression_output(data, label, grad_scale=1.0):
-    return _regression_vjp(data, label, float(grad_scale), "linear")
+def linear_regression_output(data, label, grad_scale=1.0, out_grad=False):
+    from ..symbol.graph import attr_bool
+
+    return _regression_vjp(data, label, float(grad_scale), "linear",
+                           attr_bool(out_grad))
 
 
 @register("MAERegressionOutput")
-def mae_regression_output(data, label, grad_scale=1.0):
-    return _regression_vjp(data, label, float(grad_scale), "mae")
+def mae_regression_output(data, label, grad_scale=1.0, out_grad=False):
+    from ..symbol.graph import attr_bool
+
+    return _regression_vjp(data, label, float(grad_scale), "mae",
+                           attr_bool(out_grad))
 
 
 @register("LogisticRegressionOutput")
-def logistic_regression_output(data, label, grad_scale=1.0):
-    return _regression_vjp(data, label, float(grad_scale), "logistic")
+def logistic_regression_output(data, label, grad_scale=1.0, out_grad=False):
+    from ..symbol.graph import attr_bool
+
+    return _regression_vjp(data, label, float(grad_scale), "logistic",
+                           attr_bool(out_grad))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def _regression_vjp(data, label, grad_scale, kind):
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _regression_vjp(data, label, grad_scale, kind, out_grad=False):
     if kind == "logistic":
         return jax.nn.sigmoid(data)
     return data
 
 
-def _reg_fwd(data, label, grad_scale, kind):
-    out = _regression_vjp(data, label, grad_scale, kind)
+def _reg_fwd(data, label, grad_scale, kind, out_grad=False):
+    out = _regression_vjp(data, label, grad_scale, kind, out_grad)
     return out, (out, label)
 
 
-def _reg_bwd(grad_scale, kind, res, g):
+def _reg_bwd(grad_scale, kind, out_grad, res, g):
     out, label = res
     lab = label.reshape(out.shape)
     if kind == "mae":
         grad = jnp.sign(out - lab)
     else:
         grad = out - lab
-    return (grad * grad_scale, jnp.zeros_like(label))
+    grad = grad * grad_scale
+    if out_grad:  # honor the cotangent (the AMP loss-scale entry point)
+        grad = grad * g.astype(grad.dtype)
+    return (grad, jnp.zeros_like(label))
 
 
 _regression_vjp.defvjp(_reg_fwd, _reg_bwd)

@@ -99,6 +99,16 @@ def cast(x, dtype="float32"):
     return x.astype(np_dtype(dtype))
 
 
+@register("amp_cast")
+def amp_cast(x, dtype="float32"):
+    """AMP-inserted cast (amp.convert_symbol).  Same math as ``cast`` but a
+    distinct op name so ``amp.remove_amp_cast`` can strip exactly the casts
+    the policy added, never a user's own Cast nodes."""
+    from ..base import np_dtype
+
+    return x.astype(np_dtype(dtype))
+
+
 @register("clip")
 def clip(x, a_min=None, a_max=None):
     return jnp.clip(x, a_min, a_max)

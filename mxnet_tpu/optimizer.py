@@ -691,6 +691,23 @@ def _unpack_state_into(s, new):
         s._data = new
 
 
+def fused_apply_update(optimizer, weight, grad, state, lr, wd, t, has_master):
+    """One traced parameter update, master-weight aware (docs/amp.md).
+
+    ``has_master`` is the STATIC per-param flag (part of every fused
+    compile-cache key): when set, ``state`` is the ``(master_f32, inner)``
+    pytree laid out by ``create_state_multi_precision`` — the update runs on
+    the f32 master exactly like the legacy ``update_multi_precision`` loop
+    (grad upcast, master stepped, low-precision weight recast from the
+    master), all inside the donated fused program."""
+    if not has_master:
+        return optimizer.update_step(weight, grad, state, lr, wd, t)
+    master, inner = state
+    new_master, new_inner = optimizer.update_step(
+        master, grad.astype(master.dtype), inner, lr, wd, t)
+    return new_master.astype(weight.dtype), (new_master, new_inner)
+
+
 def uniquify_donated(trees):
     """Return ``trees`` with any REPEATED device buffer replaced by a fresh
     copy.  jax constant caching can hand identical zero-filled buffers to
@@ -795,8 +812,7 @@ class Updater:
 
         opt = self.optimizer
         if (not indices or os.environ.get("TPUMX_FUSED_STEP", "1") == "0"
-                or not getattr(opt, "fused_step_supported", False)
-                or opt.multi_precision):
+                or not getattr(opt, "fused_step_supported", False)):
             return False
         from .ndarray import sparse as _sparse
 
@@ -820,7 +836,10 @@ class Updater:
         g_vals = [g._data for g in grads]
         s_vals = uniquify_donated(
             tuple(_pack_state(self.states[i]) for i in indices))
-        key = (opt.fused_static_key(),
+        # static per-slot master-weight flags (multi_precision low-precision
+        # params carry (master_f32, state) — docs/amp.md); part of the key
+        has_master = tuple(opt._needs_master(w) for w in weights)
+        key = (opt.fused_static_key(), has_master,
                tuple(mults[i] for i in indices),
                tuple((v.shape, str(v.dtype)) for v in w_vals),
                tuple((v.shape, str(v.dtype)) for v in g_vals))
@@ -832,8 +851,9 @@ class Updater:
                 new_w, new_s = [], []
                 for k in range(len(w_vals)):
                     lm, wm, dt = mult_list[k]
-                    w2, s2 = opt.update_step(w_vals[k], g_vals[k], s_vals[k],
-                                             lr[0] * lm, wd * wm, t[0] + dt)
+                    w2, s2 = fused_apply_update(
+                        opt, w_vals[k], g_vals[k], s_vals[k],
+                        lr[0] * lm, wd * wm, t[0] + dt, has_master[k])
                     new_w.append(w2)
                     new_s.append(s2)
                 return new_w, tuple(new_s)

@@ -72,7 +72,8 @@ class ServingConfig:
                  backpressure: Optional[str] = None,
                  default_deadline_ms: Optional[float] = None,
                  batch_buckets: Optional[List[int]] = None,
-                 shape_buckets: Optional[List[Tuple[int, ...]]] = None):
+                 shape_buckets: Optional[List[Tuple[int, ...]]] = None,
+                 amp_dtype: Optional[str] = None):
         from .bucketing import batch_buckets as _ladder
 
         self.max_batch_size = int(
@@ -106,6 +107,13 @@ class ServingConfig:
                               if batch_buckets else _ladder(self.max_batch_size))
         self.shape_buckets = ([tuple(int(d) for d in s) for s in shape_buckets]
                               if shape_buckets else None)
+        # low-precision inference (docs/amp.md): executor-backed models are
+        # served through an amp.convert_symbol'd graph — every bucketed
+        # executor in the cache compiles the bf16/fp16 program
+        env_amp = os.environ.get("TPUMX_SERVING_AMP_DTYPE")
+        self.amp_dtype: Optional[str] = (
+            str(amp_dtype) if amp_dtype is not None
+            else (env_amp or None))
 
     def __repr__(self):
         return (f"ServingConfig(max_batch_size={self.max_batch_size}, "

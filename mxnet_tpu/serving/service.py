@@ -80,10 +80,25 @@ class _CompileCounter:
 # -- model adapters ---------------------------------------------------------------
 class _ExecutorAdapter:
     """Serve a bound :class:`~mxnet_tpu.executor.Executor` through a
-    signature-keyed cache of reshaped executors (one per bucket shape)."""
+    signature-keyed cache of reshaped executors (one per bucket shape).
+
+    ``amp_dtype`` (ServingConfig.amp_dtype / TPUMX_SERVING_AMP_DTYPE) serves
+    the AMP-converted graph instead: matmul/conv-family ops run bf16/fp16,
+    softmax/norm outputs stay f32, and every bucketed executor in the cache
+    compiles the low-precision program.  Parameters are SHARED with the
+    original executor (the cast happens in-graph), so ``refresh_params``
+    after a weight update keeps working unchanged (docs/amp.md)."""
 
     def __init__(self, base_exec, data_names: Sequence[str],
-                 label_shapes: Optional[Sequence[Tuple[str, Tuple[int, ...]]]] = None):
+                 label_shapes: Optional[Sequence[Tuple[str, Tuple[int, ...]]]] = None,
+                 amp_dtype: Optional[str] = None):
+        if amp_dtype:
+            from .. import amp as _amp
+
+            conv = _amp.convert_symbol(base_exec._symbol, amp_dtype)
+            base_exec = conv.bind(
+                ctx=base_exec._ctx, args=base_exec.arg_dict, args_grad=None,
+                grad_req="null", aux_states=base_exec.aux_dict)
         self._base = base_exec
         self.input_names = list(data_names)
         self._label_shapes = list(label_shapes or [])
@@ -220,7 +235,7 @@ def _jnp(x):
     return jnp.asarray(x)
 
 
-def _make_adapter(model, data_names):
+def _make_adapter(model, data_names, amp_dtype=None):
     # duck-typed: Module-likes carry a bound executor + data_names; raw
     # executors carry arg_dict/forward; Gluon blocks carry collect_params
     if hasattr(model, "_exec") and hasattr(model, "data_names"):
@@ -231,9 +246,10 @@ def _make_adapter(model, data_names):
         label_shapes = [(n, tuple(s)) for n, s in (model.label_shapes or [])]
         return _ExecutorAdapter(model._exec,
                                 data_names or model.data_names,
-                                label_shapes)
+                                label_shapes, amp_dtype=amp_dtype)
     if hasattr(model, "arg_dict") and hasattr(model, "forward"):
-        return _ExecutorAdapter(model, data_names or ["data"])
+        return _ExecutorAdapter(model, data_names or ["data"],
+                                amp_dtype=amp_dtype)
     if hasattr(model, "collect_params") and callable(model):
         return _BlockAdapter(model)
     if callable(model):
@@ -263,7 +279,8 @@ class InferenceService:
     def __init__(self, model, config: Optional[ServingConfig] = None,
                  data_names: Optional[Sequence[str]] = None):
         self._config = config or ServingConfig()
-        self._adapter = _make_adapter(model, data_names)
+        self._adapter = _make_adapter(model, data_names,
+                                      amp_dtype=self._config.amp_dtype)
         self._metrics = ServingMetrics()
         self._batcher = MicroBatcher(self._config, self._metrics)
         self._worker: Optional[threading.Thread] = None

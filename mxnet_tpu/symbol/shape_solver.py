@@ -147,7 +147,22 @@ def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]],
     def _complete(sh) -> bool:
         return sh is not None and all(d > 0 for d in sh)
 
+    def _deref(e: SymbolEntry) -> SymbolEntry:
+        """See through AMP cast nodes (amp.convert_symbol): a cast is
+        shape-transparent, and the param/label shape rules must reach the
+        underlying VARIABLE (e.g. FullyConnected's weight) through it."""
+        while e.node.kind == "op" and e.node.op.name == "amp_cast":
+            e = e.node.inputs[0]
+        return e
+
     for node in topo_order(entries):
+        if node.kind == "op" and node.op.name == "amp_cast":
+            # transparent for shapes; may be deferred (its var input gets
+            # its shape from the consuming op's param rule, below)
+            src = _deref(node.inputs[0])
+            if id(src.node) in shapes:
+                shapes[id(node)] = (shapes[id(src.node)][src.index],)
+            continue
         if node.kind == "var":
             if _complete(var_shape.get(node.name)):
                 shapes[id(node)] = (tuple(var_shape[node.name]),)
@@ -172,6 +187,7 @@ def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]],
         # (inferred from the data shape like the reference) and a var with
         # 0-dims a known weight can pin (backward inference)
         for i, e in enumerate(node.inputs[:n_data]):
+            e = _deref(e)
             if id(e.node) not in shapes:
                 if (i == n_data - 1 and op.name in _LABEL_OPS
                         and e.node.kind == "var" and in_shapes):
@@ -183,7 +199,8 @@ def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]],
                 if e.node.kind == "var" and e.node.name in var_shape:
                     pshapes = {
                         slot: var_shape[pe.node.name]
-                        for slot, pe in zip(extra, node.inputs[n_data:])
+                        for slot, pe in ((s, _deref(p)) for s, p in
+                                         zip(extra, node.inputs[n_data:]))
                         if pe.node.kind == "var"
                         and _complete(var_shape.get(pe.node.name))}
                     cand = _invert_data_shape(op.name, node.attrs,
@@ -205,6 +222,7 @@ def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]],
         # are validated against the op rule — a typo'd weight must raise,
         # not silently build a wrong-sized model
         for slot, e in zip(extra, node.inputs[n_data:]):
+            e = _deref(e)
             given = var_shape.get(e.node.name) \
                 if e.node.kind == "var" else None
             if id(e.node) in shapes and given is None:
@@ -263,6 +281,7 @@ def solve_shapes(symbol, known: Dict[str, Tuple[int, ...]],
         aux_shapes.append(tuple(var_shape[n.name]))
     out_shapes = []
     for e in entries:
+        e = _deref(e)
         if id(e.node) in shapes:
             out_shapes.append(shapes[id(e.node)][e.index])
         elif partial:

@@ -53,6 +53,11 @@ def pytest_configure(config):
         "spmd: multi-device SPMD data-parallel training (shard_map fused "
         "step over the dp mesh, docs/multichip.md; select with "
         "`pytest -m spmd`)")
+    config.addinivalue_line(
+        "markers",
+        "amp: automatic mixed precision (mxnet_tpu.amp — casting policy, "
+        "traced loss scaling, fused master weights, docs/amp.md; select "
+        "with `pytest -m amp`)")
 
 
 def pytest_collection_modifyitems(config, items):
