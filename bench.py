@@ -466,6 +466,73 @@ def serving_latency(requests: int = None, clients: int = None):
     }
 
 
+def telemetry_overhead(batch: int = None, steps: int = None):
+    """Fused-step wall time with device-side telemetry ON vs OFF
+    (docs/observability.md): the SAME bound module stepped through
+    ``_try_fused_step`` under ``TPUMX_TELEMETRY=1`` then ``0`` — each env
+    value keys its own cached program — reporting ``overhead_pct``
+    (acceptance: < 3%).  ``BENCH_TELEMETRY=0`` skips the block."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    batch = batch or int(os.environ.get("BENCH_TELEMETRY_BATCH", "512"))
+    steps = steps or int(os.environ.get("BENCH_TELEMETRY_STEPS", "30"))
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=1024, name="fc1"),
+                       act_type="relu")
+    h = sym.Activation(sym.FullyConnected(h, num_hidden=1024, name="fc2"),
+                       act_type="relu")
+    net = sym.SoftmaxOutput(sym.FullyConnected(h, num_hidden=64, name="fc3"),
+                            label, name="softmax")
+    r = np.random.RandomState(0)
+    X = r.rand(batch, 512).astype(np.float32)
+    Y = r.randint(0, 64, batch).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch)
+    mod = mx.mod.Module(net, context=mx.cpu()
+                        if jax.default_backend() == "cpu" else None)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    batch0 = next(iter(it))
+    prev = os.environ.get("TPUMX_TELEMETRY")
+
+    def leg(env_val):
+        os.environ["TPUMX_TELEMETRY"] = env_val
+        if not mod._try_fused_step(batch0):  # compile + warm this leg's key
+            raise RuntimeError("fused step unavailable for telemetry bench")
+        mod._exec.outputs[0].wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            mod._try_fused_step(batch0)
+        mod._exec.outputs[0].wait_to_read()
+        return (time.perf_counter() - t0) / steps
+
+    try:
+        t_on = leg("1")
+        t_off = leg("0")
+        # interleave a second pass to cancel clock/thermal drift
+        t_on = min(t_on, leg("1"))
+        t_off = min(t_off, leg("0"))
+    finally:
+        if prev is None:
+            os.environ.pop("TPUMX_TELEMETRY", None)
+        else:
+            os.environ["TPUMX_TELEMETRY"] = prev
+    return {
+        "with_ms": round(t_on * 1e3, 4),
+        "without_ms": round(t_off * 1e3, 4),
+        "overhead_pct": round((t_on - t_off) / t_off * 100.0, 2),
+        "steps": steps,
+        "batch": batch,
+    }
+
+
 def main():
     # bs=512 saturates one v5e MXU (measured: 64→752, 256→1537, 512→1665
     # img/s; 1024 OOMs in 16 GB HBM); fall back on allocation failure
@@ -655,6 +722,21 @@ def main():
         except Exception as e:  # optional block: failure is a field, not rc!=0
             sys.stderr.write(f"multichip bench failed: {type(e).__name__}: {e}\n")
             result["multichip_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_TELEMETRY", "1") == "1":
+        try:
+            result["telemetry_overhead"] = telemetry_overhead()
+        except Exception as e:  # optional block: failure is a field, not rc!=0
+            sys.stderr.write(f"telemetry bench failed: {type(e).__name__}: {e}\n")
+            result["telemetry_error"] = f"{type(e).__name__}: {e}"
+    try:
+        # every bench result carries the process registry (docs/
+        # observability.md): compile-cache counters, serving p50/p99/QPS,
+        # train telemetry — the run's health next to its headline number
+        from mxnet_tpu import observability as _obs
+
+        result["registry"] = _obs.snapshot()
+    except Exception as e:
+        result["registry_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))
 
 

@@ -56,6 +56,7 @@ from .model import save_checkpoint, load_checkpoint
 
 from . import parallel
 from . import profiler
+from . import observability
 from . import serving
 from . import contrib
 from . import executor_manager

@@ -45,7 +45,15 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Logs samples/sec every `frequent` batches (reference: callback.py:120)."""
+    """Logs samples/sec every `frequent` batches (reference: callback.py:120).
+
+    Beyond printing, each window is recorded into the observability
+    registry (``train_throughput_samples_per_sec`` gauge +
+    ``train_batch_window_seconds`` histogram of the per-batch average), so
+    a dashboard sees throughput without scraping logs.  Recording uses only
+    the host clock and counters already on hand — it adds NO device sync
+    (asserted in tests/test_observability.py).
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -54,6 +62,18 @@ class Speedometer:
         self.tic = 0
         self.last_count = 0
         self.auto_reset = auto_reset
+
+    def _record(self, speed, elapsed):
+        from . import observability as _obs
+
+        reg = _obs.registry()
+        reg.gauge("train_throughput_samples_per_sec",
+                  help="Speedometer window throughput").set(speed)
+        if elapsed > 0:
+            reg.histogram(
+                "train_batch_window_seconds",
+                help="per-batch wall time averaged over a Speedometer "
+                     "window").observe(elapsed / self.frequent)
 
     def __call__(self, param):
         count = param.nbatch
@@ -64,11 +84,12 @@ class Speedometer:
             if count % self.frequent == 0:
                 # coarse clocks / very fast batches can land two logs on
                 # one tick (reference #11504): report inf, don't crash
+                elapsed = time.time() - self.tic
                 try:
-                    speed = self.frequent * self.batch_size \
-                        / (time.time() - self.tic)
+                    speed = self.frequent * self.batch_size / elapsed
                 except ZeroDivisionError:
                     speed = float("inf")
+                self._record(speed, elapsed)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

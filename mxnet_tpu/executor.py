@@ -33,6 +33,7 @@ from .context import Context
 from .ndarray.ndarray import NDArray
 from .symbol.graph import trace
 from . import random as _random
+from .observability import tracing as _tracing
 
 __all__ = ["Executor", "compile_cache_stats", "reset_compile_cache_stats"]
 
@@ -40,26 +41,55 @@ __all__ = ["Executor", "compile_cache_stats", "reset_compile_cache_stats"]
 # so a serving layer (or a test) can assert "zero recompiles after warmup"
 # by snapshotting misses across a workload (mxnet_tpu.serving stats use it)
 _cache_stats = {"hits": 0, "misses": 0}
+_cache_by_site: dict = {}
 _cache_stats_lock = threading.Lock()
 
 
 def compile_cache_stats() -> dict:
-    """Process-wide executor compile-cache counters ({"hits", "misses"}).
-    A miss is a program compile (new ``_jit_cache`` signature); a hit reuses
-    an already-compiled program."""
+    """Process-wide executor compile-cache counters ({"hits", "misses"}),
+    plus a ``"by_site"`` breakdown per program kind (fwd/fwdbwd/bwdg/
+    fused_step).  A miss is a program compile (new ``_jit_cache``
+    signature); a hit reuses an already-compiled program.  Under
+    ``TPUMX_EXPLAIN_RECOMPILES``/``TPUMX_FREEZE_COMPILES`` every miss is
+    additionally explained (and, post-warmup, refused) by
+    :mod:`mxnet_tpu.observability.recompile`."""
     with _cache_stats_lock:
-        return dict(_cache_stats)
+        out = dict(_cache_stats)
+        out["by_site"] = {k: dict(v) for k, v in _cache_by_site.items()}
+        return out
 
 
 def reset_compile_cache_stats() -> None:
     with _cache_stats_lock:
         _cache_stats["hits"] = 0
         _cache_stats["misses"] = 0
+        _cache_by_site.clear()
 
 
-def _note_cache(hit: bool) -> None:
+_recompile_mod = None
+
+
+def _note_cache(hit: bool, site=None, key=None) -> None:
+    """Count a cache lookup; with a ``site``, also feed the recompile
+    explainer/watchdog — which may raise :class:`FreezeCompilesError` on a
+    post-warmup miss BEFORE any compile work happens."""
+    kind = site[0] if isinstance(site, tuple) and site else None
     with _cache_stats_lock:
         _cache_stats["hits" if hit else "misses"] += 1
+        if kind is not None:
+            per = _cache_by_site.setdefault(kind, {"hits": 0, "misses": 0})
+            per["hits" if hit else "misses"] += 1
+    if site is None:
+        return
+    global _recompile_mod
+    if _recompile_mod is None:
+        from .observability import recompile as _r
+
+        _recompile_mod = _r
+    if hit:
+        _recompile_mod.note_hit(site)
+    else:
+        _recompile_mod.note_miss(site, key)
 
 
 def _ones_cotangent(x):
@@ -95,6 +125,11 @@ class Executor:
         self._spmd_out_is_batch: List[bool] = []
         self._spmd_active = False  # a fused SPMD step has run (buffers live
         # replicated/sharded on the mesh; eager paths must reconcile)
+        # device-side train telemetry (docs/observability.md): last-step
+        # scalars + cross-step accumulators, all LAZY device values — no
+        # host sync until telemetry.publish() at a log boundary
+        self._telemetry_last: Optional[Dict[str, object]] = None
+        self._telemetry_accum: Dict[str, object] = {}
         self._grad_arg_names = sorted(
             n for n in self._arg_names if self.grad_req.get(n, "null") != "null"
             and n in self.grad_dict)
@@ -183,6 +218,12 @@ class Executor:
         return int(self._spmd_mesh.shape[self._spmd_axis])
 
     # -- compilation --------------------------------------------------------------
+    def _site(self, kind: str) -> tuple:
+        """Recompile-explainer call-site identity: program kind + the
+        symbol's output names — stable across rebinds of the SAME model
+        (where recompile bugs bite) yet distinct between models."""
+        return (kind,) + tuple(self._out_names)
+
     def _signature(self, is_train: bool) -> tuple:
         sig = [is_train]
         for n in self._arg_names:
@@ -205,7 +246,8 @@ class Executor:
 
     def _get_fwd(self, is_train: bool):
         key = ("fwd", self._signature(is_train))
-        _note_cache(hit=key in self._jit_cache)
+        _note_cache(hit=key in self._jit_cache, site=self._site("fwd"),
+                    key=key)
         if key not in self._jit_cache:
             entries = self._symbol._entries
 
@@ -222,7 +264,8 @@ class Executor:
 
     def _get_fwdbwd(self):
         key = ("fwdbwd", self._signature(True))
-        _note_cache(hit=key in self._jit_cache)
+        _note_cache(hit=key in self._jit_cache, site=self._site("fwdbwd"),
+                    key=key)
         if key not in self._jit_cache:
             entries = self._symbol._entries
             gnames = self._grad_arg_names
@@ -249,7 +292,8 @@ class Executor:
 
     def _get_bwd_with_grads(self):
         key = ("bwdg", self._signature(True))
-        _note_cache(hit=key in self._jit_cache)
+        _note_cache(hit=key in self._jit_cache, site=self._site("bwdg"),
+                    key=key)
         if key not in self._jit_cache:
             entries = self._symbol._entries
             gnames = self._grad_arg_names
@@ -393,7 +437,8 @@ class Executor:
     # -- fused whole-train-step ---------------------------------------------------
     def _get_fused_step(self, optimizer, mults_by_name, num_steps: int,
                         kvstore=None, scaler=None,
-                        master_names: frozenset = frozenset()):
+                        master_names: frozenset = frozenset(),
+                        telemetry: bool = False):
         spmd = self._spmd_ndev() > 1
         reqs = tuple(sorted((n, self.grad_req.get(n, "write"))
                             for n in self._grad_arg_names))
@@ -411,7 +456,13 @@ class Executor:
             key = key + ("amp",
                          None if scaler is None else scaler.static_key(),
                          tuple(sorted(master_names)))
-        _note_cache(hit=key in self._jit_cache)
+        if telemetry:
+            # telemetry outputs key their own program; with TPUMX_TELEMETRY=0
+            # this component is absent and key + traced program are
+            # byte-identical to the pre-telemetry layout
+            key = key + ("telemetry",)
+        _note_cache(hit=key in self._jit_cache,
+                    site=self._site("fused_step"), key=key)
         if key not in self._jit_cache:
             entries = self._symbol._entries
             gnames = list(self._grad_arg_names)
@@ -570,9 +621,22 @@ class Executor:
                     else:
                         p, s, aux_full, grads, outs, sc = res
                     auxu = {k: aux_full[k] for k in auxu}
-                if scaler is None:
-                    return outs, auxu, grads, p, s
-                return outs, auxu, grads, p, s, sc
+                ret = (outs, auxu, grads, p, s) if scaler is None \
+                    else (outs, auxu, grads, p, s, sc)
+                if telemetry:
+                    # device-side train telemetry as extra program outputs
+                    # (docs/observability.md): grads are post-allreduce and
+                    # params post-update (replica-invariant under SPMD); the
+                    # step-loss mean pmeans over the dp axis inside
+                    # compute_in_program so every replica reports the
+                    # global-batch value
+                    from .observability import telemetry as _obs_tele
+
+                    ret = ret + (_obs_tele.compute_in_program(
+                        outs, grads, p,
+                        scaler_state=sc if scaler is not None else None,
+                        pmean_axis=axis),)
+                return ret
 
             if scaler is None:
                 def fused(pvals, gvals, svals, other_vals, aux_vals,
@@ -621,6 +685,10 @@ class Executor:
                     if scaler is not None:
                         out_specs = out_specs + (P(),)
                         in_specs = in_specs + (P(),)
+                    if telemetry:
+                        # replica-invariant scalars (norms on the allreduced
+                        # grads, pmean'd loss): replicated out-spec
+                        out_specs = out_specs + (P(),)
                     return shard_map_compat(
                         shard_step, mesh=mesh,
                         in_specs=in_specs,
@@ -702,10 +770,14 @@ class Executor:
         master_names = frozenset(
             n for n, _ in updates
             if optimizer._needs_master(self.arg_dict[n]))
+        from .observability import telemetry as _obs_tele
+
+        tele_on = _obs_tele.enabled()
         fn = self._get_fused_step(optimizer, mults_by_name, num_steps,
                                   kvstore=kvstore if spmd else None,
                                   scaler=loss_scaler,
-                                  master_names=master_names)
+                                  master_names=master_names,
+                                  telemetry=tele_on)
         gnames = self._grad_arg_names
         pvals = {n: self.arg_dict[n]._data for n in gnames}
         gvals = {n: self.grad_dict[n]._data for n in gnames}
@@ -743,12 +815,17 @@ class Executor:
             pvals, gvals, svals, other, aux_vals, sc_args = jax.device_put(
                 (pvals, gvals, svals, other, aux_vals, sc_args), repl)
             self._spmd_active = True
-            res = fn(pvals, gvals, svals, batch_vals, other, aux_vals,
-                     lr_vec, wd, t_vec, rng, *sc_args)
+            with _tracing.span("executor.fused_step", cat="executor"):
+                res = fn(pvals, gvals, svals, batch_vals, other, aux_vals,
+                         lr_vec, wd, t_vec, rng, *sc_args)
         else:
             pvals, gvals, svals = uniquify_donated((pvals, gvals, svals))
-            res = fn(pvals, gvals, svals, other, aux_vals, lr_vec, wd, t_vec,
-                     rng, *sc_args)
+            with _tracing.span("executor.fused_step", cat="executor"):
+                res = fn(pvals, gvals, svals, other, aux_vals, lr_vec, wd,
+                         t_vec, rng, *sc_args)
+        if tele_on:
+            res, tele_vals = res[:-1], res[-1]
+            self._note_telemetry(tele_vals)
         if loss_scaler is None:
             outs, aux_updates, new_grads, new_p, new_s = res
         else:
@@ -772,6 +849,32 @@ class Executor:
             for name, out in zip(self._out_names, self._outputs):
                 self._monitor_callback(name, out)
         return self._outputs
+
+    # -- train telemetry ----------------------------------------------------------
+    def _note_telemetry(self, vals: Dict[str, object]) -> None:
+        """Fold one fused step's telemetry outputs into the executor-held
+        device scalars: nonfinite/skip counts accumulate (lazy jnp adds, no
+        sync), everything else keeps the last-step value."""
+        from .observability import telemetry as _obs_tele
+
+        self._telemetry_last = dict(vals)
+        for k in _obs_tele.ACCUMULATING:
+            v = vals.get(k)
+            if v is None:
+                continue
+            prev = self._telemetry_accum.get(k)
+            self._telemetry_accum[k] = v if prev is None else prev + v
+
+    def telemetry_snapshot(self) -> Dict[str, object]:
+        """The current telemetry DEVICE scalars (last-step values, with the
+        nonfinite/skip counters replaced by their cross-step totals).  Hand
+        to ``observability.telemetry.publish`` at a log boundary — that is
+        the single host sync."""
+        if self._telemetry_last is None:
+            return {}
+        out = dict(self._telemetry_last)
+        out.update(self._telemetry_accum)
+        return out
 
     # -- params & misc ------------------------------------------------------------
     def copy_params_from(self, arg_params: Dict[str, NDArray],

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from .base import MXNetError, getenv
 from .ndarray.ndarray import NDArray
 from .ndarray import sparse as _sparse
+from .observability import tracing as _tracing
 
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreTPUSync", "create"]
 
@@ -255,18 +256,25 @@ class KVStoreLocal(KVStore):
         if len(keys) == 1 and (not isinstance(value, (list, tuple))
                                or not isinstance(value[0], (list, tuple))):
             values = [values] if not isinstance(values[0], (list, tuple)) else values
-        for k, v in zip(keys, values):
-            vlist = _as_list(v)
-            merged = self._reduce(vlist, key=k)
-            if k not in self._store:
-                raise MXNetError(f"kvstore: key {k!r} not initialized")
-            if self._updater is not None:
-                weight = self._store[k]
-                self._updater(k, merged, weight)
-            else:
-                self._store[k] = merged
+        with _tracing.span("kvstore.push", cat="kvstore",
+                           args={"keys": len(keys)}):
+            for k, v in zip(keys, values):
+                vlist = _as_list(v)
+                merged = self._reduce(vlist, key=k)
+                if k not in self._store:
+                    raise MXNetError(f"kvstore: key {k!r} not initialized")
+                if self._updater is not None:
+                    weight = self._store[k]
+                    self._updater(k, merged, weight)
+                else:
+                    self._store[k] = merged
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        with _tracing.span("kvstore.pull", cat="kvstore"):
+            self._pull_impl(key, out=out, priority=priority,
+                            ignore_sparse=ignore_sparse)
+
+    def _pull_impl(self, key, out=None, priority=0, ignore_sparse=True):
         keys = _as_list(key)
         outs = _as_list(out)
         if len(keys) == 1 and not isinstance(out, (list, tuple)):

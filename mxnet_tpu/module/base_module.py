@@ -12,6 +12,7 @@ import numpy as _np
 
 from .. import metric as _metric
 from .. import ndarray as nd
+from .. import observability as _obs
 from ..base import MXNetError
 from ..initializer import Uniform
 from ..model import BatchEndParam
@@ -228,8 +229,15 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        # step-time observability (docs/observability.md): host wall-clock
+        # per batch into the registry histogram — dispatch time only, no
+        # device sync added to the fit hot path
+        step_hist = _obs.registry().histogram(
+            "train_step_seconds",
+            help="Module.fit per-batch host wall time (dispatch, no sync)")
         train_data.reset()  # defensive: support reused/exhausted iterators
         for epoch in range(begin_epoch, num_epoch):
+          with _obs.span(f"fit.epoch[{epoch}]", cat="fit"):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
@@ -240,15 +248,18 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
-                if not self._try_fused_step(data_batch):
-                    self.forward_backward(data_batch)
-                    self.update()
-                if isinstance(data_batch, list):
-                    self.update_metric(eval_metric,
-                                       [db.label for db in data_batch],
-                                       pre_sliced=True)
-                else:
-                    self.update_metric(eval_metric, data_batch.label)
+                step_tic = time.perf_counter()
+                with _obs.span("fit.batch", cat="fit"):
+                    if not self._try_fused_step(data_batch):
+                        self.forward_backward(data_batch)
+                        self.update()
+                    if isinstance(data_batch, list):
+                        self.update_metric(eval_metric,
+                                           [db.label for db in data_batch],
+                                           pre_sliced=True)
+                    else:
+                        self.update_metric(eval_metric, data_batch.label)
+                step_hist.observe(time.perf_counter() - step_tic)
                 try:
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch, sparse_row_id_fn=sparse_row_id_fn)

@@ -472,6 +472,14 @@ class Module(BaseModule):
                               loss_scaler=self._loss_scaler)
         self._params_dirty = True
         self._fused_step_count += 1
+        # telemetry stays device-side across steps; only every
+        # TPUMX_TELEMETRY_EVERY-th step materializes the handful of scalars
+        # into registry gauges — the no-per-batch-asnumpy property holds
+        if self._exec._telemetry_last is not None:
+            from ..observability import telemetry as _tele
+
+            if self._fused_step_count % _tele.every() == 0:
+                _tele.publish(self._exec.telemetry_snapshot())
         return True
 
     def update(self):

@@ -1,0 +1,118 @@
+"""Device-side train telemetry: computed INSIDE the fused step, fetched
+rarely.
+
+The fused-step line of work (PR 3-5) ended per-batch ``asnumpy()`` on the
+fit path; telemetry must not reintroduce it.  So the signals a degrading
+run shows first — gradient global-norm, parameter norm, step loss, the AMP
+loss-scale value and nonfinite/skip counts — are computed as extra outputs
+*inside* the donated fused program (pmean'd over the dp mesh on the SPMD
+path so every replica reports the same value), kept as device scalars
+across steps, and only materialized to host floats every
+``TPUMX_TELEMETRY_EVERY`` steps at a log boundary (:func:`publish`).
+
+``TPUMX_TELEMETRY=0`` removes the telemetry outputs entirely: the fused /
+SPMD compile keys and traced programs are byte-identical to a build without
+this subsystem (bitwise-verified in tests/test_observability.py).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+__all__ = ["enabled", "every", "compute_in_program", "publish",
+           "ACCUMULATING"]
+
+#: telemetry keys accumulated across steps (device-side adds); the rest are
+#: instantaneous last-step values
+ACCUMULATING = ("nonfinite_grad_count", "skip_step")
+
+_PUBLISH_NAME = {
+    "grad_norm": "train_grad_norm",
+    "param_norm": "train_param_norm",
+    "loss": "train_loss",
+    "loss_scale": "train_loss_scale",
+    "nonfinite_grad_count": "train_nonfinite_grads_total",
+    "skip_step": "train_skip_steps_total",
+}
+
+
+def enabled() -> bool:
+    """Telemetry on by default; ``TPUMX_TELEMETRY=0`` is the escape hatch
+    that keeps fused programs byte-identical to the pre-telemetry layout."""
+    return os.environ.get("TPUMX_TELEMETRY", "1") != "0"
+
+
+def every() -> int:
+    """Steps between host fetches of the device scalars (default 50)."""
+    try:
+        return max(1, int(os.environ.get("TPUMX_TELEMETRY_EVERY", "50")))
+    except ValueError:
+        return 50
+
+
+def compute_in_program(outs, grads: Dict[str, object],
+                       params: Dict[str, object], scaler_state=None,
+                       pmean_axis: Optional[str] = None) -> Dict[str, object]:
+    """Build the telemetry dict of f32 scalars — TRACE CONTEXT ONLY (called
+    from inside ``Executor._get_fused_step``'s traced function).
+
+    ``grads``/``params`` are the post-allreduce gradients and updated
+    params (replica-invariant under SPMD already); the step loss is the
+    mean of the first inexact output — per-shard batch outputs are pmean'd
+    over ``pmean_axis`` so the reported value is the global-batch mean.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+
+    def _sqsum(tree):
+        total = f32(0.0)
+        for v in tree.values():
+            if jnp.issubdtype(v.dtype, jnp.inexact):
+                total = total + jnp.sum(jnp.square(v.astype(f32)))
+        return total
+
+    nonfin = f32(0.0)
+    for g in grads.values():
+        if jnp.issubdtype(g.dtype, jnp.inexact):
+            nonfin = nonfin + jnp.sum(
+                (~jnp.isfinite(g.astype(f32))).astype(f32))
+    loss = f32(0.0)
+    for o in outs:
+        if jnp.issubdtype(o.dtype, jnp.inexact):
+            loss = jnp.mean(o.astype(f32))
+            if pmean_axis is not None:
+                loss = jax.lax.pmean(loss, pmean_axis)
+            break
+    tele = {
+        "grad_norm": jnp.sqrt(_sqsum(grads)),
+        "param_norm": jnp.sqrt(_sqsum(params)),
+        "loss": loss,
+        "nonfinite_grad_count": nonfin,
+        "skip_step": (nonfin > 0).astype(f32),
+    }
+    if scaler_state is not None:
+        tele["loss_scale"] = scaler_state[0].astype(f32)
+    return tele
+
+
+def publish(values: Dict[str, object], prefix: str = "") -> Dict[str, float]:
+    """Materialize device telemetry scalars to host floats (THE one sync —
+    call at log boundaries only) and set them as registry gauges.  Returns
+    the float dict."""
+    from . import registry
+
+    reg = registry()
+    out = {}
+    for k, v in values.items():
+        try:
+            fv = float(v)
+        except (TypeError, ValueError):
+            continue
+        out[k] = fv
+        name = _PUBLISH_NAME.get(k, f"train_{k}")
+        reg.gauge(prefix + name,
+                  help="device-side fused-train-step telemetry "
+                       "(docs/observability.md)").set(fv)
+    return out

@@ -1,0 +1,97 @@
+"""Structured tracing: nested host spans that land in BOTH trace streams.
+
+A :class:`span` is a context manager that emits
+
+- a chrome://tracing complete event into :mod:`mxnet_tpu.profiler`'s event
+  stream (same file the reference's engine ops land in), and
+- a ``jax.profiler.TraceAnnotation`` around the region, so when
+  ``TPUMX_JAX_TRACE_DIR`` drives a device trace the host span shows up on
+  the same perfetto timeline as the XLA device slices it caused.
+
+Spans nest: a thread-local stack names each span's parent in the event
+``args``, so ``fit.epoch > fit.batch > executor.fused_step >
+kvstore.push`` reads as a tree in the viewer (docs/observability.md).
+
+Cost discipline: with the profiler stopped a span is two
+``time.perf_counter`` calls and a list push/pop — cheap enough for
+per-batch scopes on the fit hot path.  Whether to emit is captured at
+*entry* (same rule as ``profiler.scope`` after this PR's fix): a span that
+started under a stopped profiler emits nothing even if ``start()`` lands
+before it exits, and one that started under a running profiler is recorded
+even if ``stop()`` lands inside it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .. import profiler as _profiler
+
+__all__ = ["span", "current_span", "span_stack"]
+
+_tls = threading.local()
+
+
+def span_stack():
+    """The calling thread's open-span name stack (outermost first)."""
+    return list(getattr(_tls, "stack", ()))
+
+
+def current_span() -> Optional[str]:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+class span:
+    """``with span("serving.execute", cat="serving", args={...}):`` — one
+    nested slice in the unified timeline."""
+
+    __slots__ = ("name", "cat", "args", "_t0", "_active", "_jax_ctx")
+
+    def __init__(self, name: str, cat: str = "obs", args: Optional[dict] = None):
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        # capture at entry; honored both ways at exit (profiler.scope fix)
+        self._active = _profiler._state["running"]
+        self._jax_ctx = None
+        if self._active:
+            try:
+                import jax
+
+                ann = jax.profiler.TraceAnnotation(self.name)
+                ann.__enter__()
+                self._jax_ctx = ann
+            except Exception:  # no jax profiler on this backend: host-only
+                self._jax_ctx = None
+        parent = stack[-1] if stack else None
+        stack.append(self.name)
+        if self._active and parent is not None:
+            self.args = dict(self.args or ())
+            self.args.setdefault("parent", parent)
+        self._t0 = time.perf_counter() * 1e6
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter() * 1e6
+        stack = getattr(_tls, "stack", None)
+        if stack:
+            stack.pop()
+        if self._jax_ctx is not None:
+            try:
+                self._jax_ctx.__exit__(*exc)
+            except Exception:
+                pass
+        # force=True (never a flip of the shared running flag) records a
+        # span that was entered under a live profiler even if stop() landed
+        # inside it; one entered while stopped stays unrecorded either way
+        if self._active:
+            _profiler._emit("X", self.name, self.cat, ts=self._t0,
+                            dur=t1 - self._t0, args=self.args, force=True)
+        return False

@@ -189,16 +189,24 @@ class Counter:
         self.domain = domain
         self.name = name
         self._value = value
+        # per-counter lock: increment/decrement are read-modify-write and
+        # raced from multiple threads (serving worker + submitters); the
+        # unguarded `self._value + delta` lost updates
+        self._vlock = threading.Lock()
 
     def set_value(self, value):
-        self._value = value
+        with self._vlock:
+            self._value = value
         _emit("C", self.name, self.domain.name, args={self.name: value})
 
     def increment(self, delta=1):
-        self.set_value(self._value + delta)
+        with self._vlock:
+            self._value += delta
+            value = self._value
+        _emit("C", self.name, self.domain.name, args={self.name: value})
 
     def decrement(self, delta=1):
-        self.set_value(self._value - delta)
+        self.increment(-delta)
 
     __iadd__ = lambda self, d: (self.increment(d), self)[1]
     __isub__ = lambda self, d: (self.decrement(d), self)[1]
@@ -227,8 +235,13 @@ class scope:
                                            # even if stop() lands inside it
 
     def __exit__(self, *exc):
-        # force=True (not a flip of the shared running flag, which would
-        # race other threads' emits past stop()) records a span that was
-        # entered under a live profiler even if stop() landed inside it
-        _emit("X", self._name, self._cat, ts=self._t0,
-              dur=time.perf_counter() * 1e6 - self._t0, force=self._active)
+        # the captured entry state decides BOTH ways: a span entered under a
+        # live profiler is recorded even if stop() landed inside it
+        # (force=True, never a flip of the shared running flag, which would
+        # race other threads' emits past stop()); one entered while the
+        # profiler was stopped stays unrecorded even if start() landed
+        # before exit — its t0 predates the trace and would emit a phantom
+        # pre-start() slice
+        if self._active:
+            _emit("X", self._name, self._cat, ts=self._t0,
+                  dur=time.perf_counter() * 1e6 - self._t0, force=True)

@@ -73,7 +73,8 @@ class ServingConfig:
                  default_deadline_ms: Optional[float] = None,
                  batch_buckets: Optional[List[int]] = None,
                  shape_buckets: Optional[List[Tuple[int, ...]]] = None,
-                 amp_dtype: Optional[str] = None):
+                 amp_dtype: Optional[str] = None,
+                 metrics_port: Optional[int] = None):
         from .bucketing import batch_buckets as _ladder
 
         self.max_batch_size = int(
@@ -114,6 +115,16 @@ class ServingConfig:
         self.amp_dtype: Optional[str] = (
             str(amp_dtype) if amp_dtype is not None
             else (env_amp or None))
+        # Prometheus exposition endpoint (docs/observability.md): when set,
+        # InferenceService serves the process registry's /metrics on this
+        # port (0 = ephemeral) via observability.exposition
+        env_mport = os.environ.get("TPUMX_SERVING_METRICS_PORT")
+        if metrics_port is not None:
+            self.metrics_port: Optional[int] = int(metrics_port)
+        elif env_mport not in (None, ""):
+            self.metrics_port = int(env_mport)
+        else:
+            self.metrics_port = None
 
     def __repr__(self):
         return (f"ServingConfig(max_batch_size={self.max_batch_size}, "

@@ -1,10 +1,17 @@
 """Serving metrics: counters, latency percentiles, QPS.
 
-Every counter is mirrored through :mod:`mxnet_tpu.profiler` ``Counter``
-objects under a ``serving`` Domain, so a running profiler sees queue depth,
-batch occupancy and request counts as chrome://tracing counter tracks next
-to the operator spans; ``snapshot()`` serves the same numbers as a plain
-dict for ``InferenceService.stats()``.
+Backed by the process-wide :mod:`mxnet_tpu.observability` registry (this
+PR's refactor — API unchanged): every counter/gauge lands in a
+``serving_*`` family labeled by service name, latencies and queue waits
+feed registry histograms, and a pull-style collector publishes the
+sliding-window values (QPS, p50/p99) as gauges — so
+``observability.snapshot()`` and a Prometheus scrape show serving health
+next to train telemetry.  Counters are still mirrored through
+:mod:`mxnet_tpu.profiler` ``Counter`` objects under a ``serving`` Domain,
+so a running profiler sees queue depth, batch occupancy and request counts
+as chrome://tracing counter tracks next to the operator spans;
+``snapshot()`` serves the same numbers as a plain dict for
+``InferenceService.stats()``.
 """
 from __future__ import annotations
 
@@ -13,6 +20,7 @@ import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
+from .. import observability as _obs
 from .. import profiler as _profiler
 
 __all__ = ["ServingMetrics", "percentile"]
@@ -35,6 +43,7 @@ def percentile(samples: List[float], q: float) -> Optional[float]:
 class ServingMetrics:
     def __init__(self, name: str = "serving"):
         self._lock = threading.Lock()
+        self._name = name
         self._domain = _profiler.Domain(name)
         self._counters: Dict[str, _profiler.Counter] = {}
         self._totals: Dict[str, float] = {}
@@ -43,6 +52,17 @@ class ServingMetrics:
         self._batch_sizes: Deque[Tuple[int, int]] = deque(maxlen=_LATENCY_WINDOW)
         self._completions: Deque[float] = deque(maxlen=_LATENCY_WINDOW)
         self._started = time.perf_counter()
+        self._labels = {"service": name}
+        reg = _obs.registry()
+        self._lat_hist = reg.histogram(
+            "serving_latency_seconds", labels=self._labels,
+            help="end-to-end request latency")
+        self._wait_hist = reg.histogram(
+            "serving_queue_wait_seconds", labels=self._labels,
+            help="time a request spent queued before execution")
+        # sliding-window gauges (QPS, tail latencies) materialize lazily at
+        # snapshot/scrape time; weakly referenced so a dead service drops out
+        reg.add_collector(self._collect)
 
     # -- counters -----------------------------------------------------------------
     def _counter(self, name: str) -> _profiler.Counter:
@@ -56,11 +76,15 @@ class ServingMetrics:
         with self._lock:
             self._totals[name] = self._totals.get(name, 0) + delta
             self._counter(name).set_value(self._totals[name])
+        _obs.registry().counter(f"serving_{name}",
+                                labels=self._labels).inc(delta)
 
     def gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._totals[name] = value
             self._counter(name).set_value(value)
+        _obs.registry().gauge(f"serving_{name}",
+                              labels=self._labels).set(value)
 
     # -- observations -------------------------------------------------------------
     def observe_latency(self, seconds: float) -> None:
@@ -70,16 +94,37 @@ class ServingMetrics:
             self._completions.append(now)
             self._totals["requests_completed"] = \
                 self._totals.get("requests_completed", 0) + 1
+        self._lat_hist.observe(seconds)
+        _obs.registry().counter("serving_requests_completed",
+                                labels=self._labels).inc()
 
     def observe_queue_wait(self, seconds: float) -> None:
         with self._lock:
             self._queue_waits.append(seconds)
+        self._wait_hist.observe(seconds)
 
     def observe_batch(self, real: int, padded: int) -> None:
         with self._lock:
             self._batch_sizes.append((int(real), int(padded)))
             self._totals["batches"] = self._totals.get("batches", 0) + 1
             self._counter("batches").set_value(self._totals["batches"])
+        _obs.registry().counter("serving_batches", labels=self._labels).inc()
+
+    # -- registry collector (sliding-window values as gauges) ---------------------
+    def _collect(self) -> None:
+        snap = self.snapshot()
+        reg = _obs.registry()
+        reg.gauge("serving_qps", labels=self._labels,
+                  help="completions over the sliding QPS window"
+                  ).set(snap["qps"])
+        for q in ("p50", "p99"):
+            v = snap["latency_ms"][q]
+            if v is not None:
+                reg.gauge("serving_latency_ms",
+                          labels=dict(self._labels, quantile=q)).set(v)
+        occ = snap.get("batch_occupancy")
+        if occ is not None:
+            reg.gauge("serving_batch_occupancy", labels=self._labels).set(occ)
 
     # -- snapshot -----------------------------------------------------------------
     def snapshot(self) -> dict:

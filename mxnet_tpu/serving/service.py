@@ -34,7 +34,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import numpy as _np
 
 from .. import engine as _engine
-from .. import profiler as _profiler
+from .. import observability as _obs
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .batcher import (MicroBatcher, Request, ServingClosedError, ServingConfig,
@@ -286,6 +286,13 @@ class InferenceService:
         self._worker: Optional[threading.Thread] = None
         self._worker_lock = threading.Lock()
         self._warmed: set = set()
+        # optional Prometheus endpoint (TPUMX_SERVING_METRICS_PORT /
+        # ServingConfig.metrics_port): the process-wide registry — serving
+        # AND train metrics — scraped over stdlib HTTP
+        self._metrics_server = None
+        if self._config.metrics_port is not None:
+            self._metrics_server = _obs.exposition.start_http_server(
+                self._config.metrics_port)
 
     # -- submission ---------------------------------------------------------------
     def submit(self, data, deadline_ms: Optional[float] = None,
@@ -321,7 +328,9 @@ class InferenceService:
         from .batcher import QueueFullError
 
         try:
-            req = self._batcher.put(sample, key, deadline, timeout=timeout)
+            with _obs.span("serving.enqueue", cat="serving"):
+                req = self._batcher.put(sample, key, deadline,
+                                        timeout=timeout)
         except QueueFullError:
             self._metrics.incr("requests_rejected")
             raise
@@ -389,12 +398,16 @@ class InferenceService:
         for b, per_input, sig in todo:
             feed = {n: _np.zeros((b,) + sh, dtype=dtype)
                     for n, sh in per_input.items()}
-            with _profiler.scope("serving.warmup", cat="serving"):
+            with _obs.span("serving.warmup", cat="serving"):
                 self._adapter.run(feed)
             self._warmed.add(sig)
             compiled += 1
         if compiled:
             self._metrics.incr("warmup_programs", compiled)
+        # a covering warmup is the zero-recompile contract's starting line:
+        # with TPUMX_FREEZE_COMPILES=1, any LATER compile-cache miss raises
+        # instead of silently stalling traffic on XLA (observability.recompile)
+        _obs.mark_warm()
         return compiled
 
     # -- dispatch -----------------------------------------------------------------
@@ -433,12 +446,16 @@ class InferenceService:
         padded = bucket_batch(n, cfg.batch_buckets)
         t0 = time.perf_counter()
         try:
-            feed = {}
-            for name, sample_bucket, _dt in live[0].bucket_key:
-                feed[name] = assemble_batch(
-                    [r.data[name] for r in live], sample_bucket, padded)
-            with _profiler.scope("serving.batch", cat="serving"):
-                outs = self._adapter.run(feed)
+            with _obs.span("serving.batch", cat="serving",
+                           args={"real": n, "padded": padded}):
+                with _obs.span("serving.assemble", cat="serving"):
+                    feed = {}
+                    for name, sample_bucket, _dt in live[0].bucket_key:
+                        feed[name] = assemble_batch(
+                            [r.data[name] for r in live], sample_bucket,
+                            padded)
+                with _obs.span("serving.execute", cat="serving"):
+                    outs = self._adapter.run(feed)
         except Exception as exc:  # noqa: BLE001 — isolate, then surface
             if n == 1 or _isolated:
                 self._metrics.incr("requests_failed", n)
@@ -454,16 +471,17 @@ class InferenceService:
             return
         now = time.perf_counter()
         self._metrics.observe_batch(real=n, padded=padded)
-        for i, r in enumerate(live):
-            rows = [out[i] for out in outs]
-            result = NDArray(rows[0]) if len(rows) == 1 \
-                else [NDArray(x) for x in rows]
-            try:
-                r.future.set_result(result)
-            except Exception:  # cancelled/raced — drop on the floor
-                continue
-            self._metrics.observe_latency(now - r.t_submit)
-            self._metrics.observe_queue_wait(t0 - r.t_submit)
+        with _obs.span("serving.reply", cat="serving"):
+            for i, r in enumerate(live):
+                rows = [out[i] for out in outs]
+                result = NDArray(rows[0]) if len(rows) == 1 \
+                    else [NDArray(x) for x in rows]
+                try:
+                    r.future.set_result(result)
+                except Exception:  # cancelled/raced — drop on the floor
+                    continue
+                self._metrics.observe_latency(now - r.t_submit)
+                self._metrics.observe_queue_wait(t0 - r.t_submit)
 
     # -- introspection ------------------------------------------------------------
     def stats(self) -> dict:
@@ -503,6 +521,9 @@ class InferenceService:
         w = self._worker
         if w is not None and w.is_alive():
             w.join(timeout)
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
 
     close = stop
 

@@ -58,6 +58,12 @@ def pytest_configure(config):
         "amp: automatic mixed precision (mxnet_tpu.amp — casting policy, "
         "traced loss scaling, fused master weights, docs/amp.md; select "
         "with `pytest -m amp`)")
+    config.addinivalue_line(
+        "markers",
+        "observability: unified runtime observability (mxnet_tpu."
+        "observability — metrics registry, structured tracing, recompile "
+        "explainer, device-side train telemetry, docs/observability.md; "
+        "select with `pytest -m observability`)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,488 @@
+"""Unified runtime observability (docs/observability.md): metrics registry
+semantics + Prometheus exposition, structured tracing, the recompile
+explainer/watchdog, device-side fused-train-step telemetry (1-dev vs SPMD),
+the TPUMX_TELEMETRY=0 byte-identical escape hatch, and the profiler
+Counter/scope satellite fixes.
+
+Runs on the conftest-forced 8-virtual-CPU-device backend, like the spmd/amp
+suites.
+"""
+import json
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, observability as obs, profiler, sym
+from mxnet_tpu.executor import compile_cache_stats
+from mxnet_tpu.io import DataBatch
+from mxnet_tpu.observability import (FreezeCompilesError, MetricsRegistry,
+                                     exposition, recompile, telemetry,
+                                     tracing)
+
+pytestmark = pytest.mark.observability
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    """Every test sees a fresh explainer state and leaves no warm flag."""
+    recompile.reset()
+    yield
+    recompile.reset()
+
+
+def _mlp_sym(nh=16, classes=4):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=nh, name="fc1"),
+                       act_type="relu")
+    out = sym.FullyConnected(h, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(out, label, name="softmax")
+
+
+def _toy_iter(n=320, dim=8, classes=4, batch=32):
+    r = np.random.RandomState(0)
+    Y = r.randint(0, classes, n).astype(np.float32)
+    X = r.rand(n, dim).astype(np.float32) * 0.3
+    for c in range(classes):
+        X[Y == c, c] += 1.0
+    return mx.io.NDArrayIter(X, Y, batch_size=batch)
+
+
+def _fit(monkeypatch, telemetry_env=None, dp=None, kvstore="local",
+         tele_every="1"):
+    if telemetry_env is None:
+        monkeypatch.delenv("TPUMX_TELEMETRY", raising=False)
+    else:
+        monkeypatch.setenv("TPUMX_TELEMETRY", telemetry_env)
+    monkeypatch.setenv("TPUMX_TELEMETRY_EVERY", tele_every)
+    if dp is None:
+        monkeypatch.delenv("TPUMX_DP_DEVICES", raising=False)
+    else:
+        monkeypatch.setenv("TPUMX_DP_DEVICES", str(dp))
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(_toy_iter(), num_epoch=1, optimizer="sgd", kvstore=kvstore,
+            optimizer_params=(("learning_rate", 0.5),))
+    arg, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in arg.items()}
+
+
+# ---------------------------------------------------------------------------
+# metrics registry semantics
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_gauge_histogram_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", labels={"svc": "a"})
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters are monotonic
+    # distinct label sets are distinct children; same labels return the
+    # same child
+    assert reg.counter("req_total", labels={"svc": "b"}).value == 0
+    assert reg.counter("req_total", labels={"svc": "a"}) is c
+    g = reg.gauge("depth")
+    g.set(7)
+    g.dec(2)
+    assert g.value == 5
+    h = reg.histogram("lat_seconds")
+    for v in (0.002, 0.004, 0.02, 0.2, 2.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(2.226)
+    assert h.percentile(50) == pytest.approx(0.02)
+    assert h.percentile(99) == pytest.approx(2.0)
+    # a name can't change type
+    with pytest.raises(ValueError):
+        reg.gauge("req_total")
+    snap = reg.snapshot()
+    assert snap["counters"]['req_total{svc="a"}'] == 3.5
+    assert snap["gauges"]["depth"] == 5
+    assert snap["histograms"]["lat_seconds"]["p99"] == pytest.approx(2.0)
+    json.dumps(snap)  # JSON-safe
+
+
+def test_registry_thread_safety():
+    """The registry counter's read-modify-write is atomic: concurrent
+    increments from 8 threads lose nothing."""
+    reg = MetricsRegistry()
+    c = reg.counter("hot_total")
+    h = reg.histogram("hot_seconds")
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert h.count == 8000
+
+
+def test_prometheus_exposition_format():
+    """The exposition text is valid format 0.0.4: HELP/TYPE per family,
+    escaped labels, cumulative monotonic buckets ending at +Inf, trailing
+    newline."""
+    reg = MetricsRegistry()
+    reg.counter("requests_total", labels={"svc": 'a"b'},
+                help="total requests").inc(3)
+    reg.gauge("queue_depth").set(2)
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    text = reg.to_prometheus()
+    assert text.endswith("\n")
+    assert "# HELP requests_total total requests\n" in text
+    assert "# TYPE requests_total counter\n" in text
+    assert 'requests_total{svc="a\\"b"} 3\n' in text
+    assert "# TYPE lat_seconds histogram" in text
+    buckets = re.findall(r'lat_seconds_bucket\{le="([^"]+)"\} (\d+)', text)
+    assert [b[0] for b in buckets] == ["0.01", "0.1", "1", "+Inf"]
+    counts = [int(b[1]) for b in buckets]
+    assert counts == sorted(counts) and counts[-1] == 4  # cumulative
+    assert "lat_seconds_sum" in text and "lat_seconds_count 4" in text
+    # every non-comment line parses as <name>{labels}? <value>
+    for line in text.strip().split("\n"):
+        if line.startswith("#"):
+            continue
+        assert re.match(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \S+$', line), line
+
+
+def test_dump_prometheus_and_http_endpoint(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("written_total").inc(9)
+    path = str(tmp_path / "metrics.prom")
+    reg.dump_prometheus(path)
+    assert "written_total 9" in open(path).read()
+    with exposition.start_http_server(port=0, registry=reg) as srv:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read().decode()
+        assert "written_total 9" in body
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/snapshot", timeout=5).read())
+        assert snap["counters"]["written_total"] == 9
+
+
+# ---------------------------------------------------------------------------
+# structured tracing
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_emits_into_profiler_stream():
+    profiler.set_state("run")
+    try:
+        with tracing.span("outer", cat="t"):
+            with tracing.span("inner", cat="t"):
+                assert tracing.span_stack() == ["outer", "inner"]
+                assert tracing.current_span() == "inner"
+    finally:
+        profiler.set_state("stop")
+    events = json.loads(profiler.dumps(format="json", reset=True))["traceEvents"]
+    spans = {e["name"]: e for e in events if e["ph"] == "X"}
+    assert "outer" in spans and "inner" in spans
+    assert spans["inner"]["args"]["parent"] == "outer"
+    # nested slice is contained in the parent slice
+    assert spans["inner"]["ts"] >= spans["outer"]["ts"]
+    assert (spans["inner"]["ts"] + spans["inner"]["dur"]
+            <= spans["outer"]["ts"] + spans["outer"]["dur"] + 1.0)
+
+
+def test_span_entered_while_stopped_never_emits():
+    """Satellite: entry state rules both ways — a span (and profiler.scope)
+    entered under a stopped profiler emits nothing even when start() lands
+    before exit."""
+    profiler.set_state("stop")
+    profiler.dumps(format="json", reset=True)
+    with tracing.span("ghost"):
+        profiler.set_state("run")
+    with profiler.scope("ghost_scope"):
+        pass  # entered running: recorded
+    profiler.set_state("stop")
+    events = json.loads(profiler.dumps(format="json", reset=True))["traceEvents"]
+    names = [e["name"] for e in events if e["ph"] == "X"]
+    assert "ghost" not in names
+    assert "ghost_scope" in names
+
+
+def test_profiler_scope_started_mid_scope_leak_fixed():
+    """Satellite: profiler.scope entered while stopped must not emit a span
+    with a pre-start() timestamp when start() lands before __exit__."""
+    profiler.set_state("stop")
+    profiler.dumps(format="json", reset=True)
+    s = profiler.scope("leaky")
+    s.__enter__()
+    profiler.set_state("run")   # start() lands inside the open scope
+    s.__exit__(None, None, None)
+    profiler.set_state("stop")
+    events = json.loads(profiler.dumps(format="json", reset=True))["traceEvents"]
+    assert "leaky" not in [e["name"] for e in events if e["ph"] == "X"]
+
+
+def test_profiler_counter_increment_is_atomic():
+    """Satellite: Counter.increment/decrement are read-modify-write under a
+    lock — 8 threads of mixed +1/-1 traffic land exactly."""
+    dom = profiler.Domain("t")
+    c = profiler.Counter(dom, "hot")
+
+    def worker(i):
+        for _ in range(1000):
+            if i % 2:
+                c.increment(2)
+            else:
+                c.decrement(1)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c._value == 4 * 1000 * 2 - 4 * 1000 * 1
+
+
+# ---------------------------------------------------------------------------
+# recompile explainer / freeze watchdog
+# ---------------------------------------------------------------------------
+
+def _bind_fc(batch):
+    out = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc1")
+    args = {"data": nd.array(np.zeros((batch, 8), np.float32)),
+            "fc1_weight": nd.array(np.zeros((4, 8), np.float32)),
+            "fc1_bias": nd.array(np.zeros(4, np.float32))}
+    return out.bind(ctx=mx.cpu(), args=args, args_grad=None, grad_req="null")
+
+
+def test_recompile_explainer_names_batch_dim_change():
+    """A forced shape-change recompile at the same call-site is explained
+    with the changed signature component, human-readably."""
+    _bind_fc(32).forward(is_train=False)
+    _bind_fc(48).forward(is_train=False)
+    exps = recompile.last_explanations()
+    assert exps[0]["causes"] == ["first compile at this site"]
+    assert any("batch dim 32→48 (data)" in c for e in exps
+               for c in e["causes"]), exps
+
+
+def test_explain_key_diff_dtype_and_mesh():
+    old = ("fwd", (True, ("data", (32, 8), "float32"),
+                   ("mesh", "dp", 1, 1, ("data",))))
+    new = ("fwd", (True, ("data", (32, 8), "bfloat16"),
+                   ("mesh", "dp", 8, 8, ("data",))))
+    causes = obs.explain_key_diff(old, new)
+    assert any("dtype float32→bfloat16" in c and "data" in c for c in causes)
+    assert "mesh 1→8" in causes
+
+
+def test_explain_recompiles_logs_cause(monkeypatch, caplog):
+    monkeypatch.setenv("TPUMX_EXPLAIN_RECOMPILES", "1")
+    with caplog.at_level("WARNING", logger="mxnet_tpu.observability"):
+        _bind_fc(16).forward(is_train=False)
+        _bind_fc(24).forward(is_train=False)
+    assert any("batch dim 16→24" in r.getMessage()
+               for r in caplog.records), caplog.records
+
+
+def test_freeze_compiles_raises_post_warmup_miss(monkeypatch):
+    """TPUMX_FREEZE_COMPILES=1: after mark_warm(), a compile-cache miss
+    raises BEFORE compiling; warmup-phase compiles stay legal."""
+    monkeypatch.setenv("TPUMX_FREEZE_COMPILES", "1")
+    ex = _bind_fc(32)
+    ex.forward(is_train=False)  # pre-warm: allowed
+    obs.mark_warm()
+    ex.forward(is_train=False)  # cache hit: still fine post-warmup
+    with pytest.raises(FreezeCompilesError, match="batch dim"):
+        _bind_fc(64).forward(is_train=False)
+
+
+def test_serving_warmup_marks_warm(monkeypatch):
+    """InferenceService.warmup() flips the process warm flag the freeze
+    watchdog keys on."""
+    from mxnet_tpu.serving import InferenceService, ServingConfig
+
+    assert not recompile.is_warm()
+    out = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc1")
+    args = {"data": nd.array(np.zeros((4, 8), np.float32)),
+            "fc1_weight": nd.array(np.zeros((4, 8), np.float32)),
+            "fc1_bias": nd.array(np.zeros(4, np.float32))}
+    ex = out.bind(ctx=mx.cpu(), args=args, args_grad=None, grad_req="null")
+    svc = InferenceService(ex, config=ServingConfig(max_batch_size=4))
+    try:
+        svc.warmup(sample_shapes=[(8,)])
+        assert recompile.is_warm()
+    finally:
+        svc.stop()
+
+
+def test_compile_cache_stats_by_site():
+    before = compile_cache_stats()
+    _bind_fc(32).forward(is_train=False)
+    after = compile_cache_stats()
+    assert after["misses"] - before["misses"] == 1
+    fwd_before = before["by_site"].get("fwd", {"misses": 0})["misses"]
+    assert after["by_site"]["fwd"]["misses"] - fwd_before == 1
+
+
+# ---------------------------------------------------------------------------
+# device-side train telemetry
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fused
+def test_telemetry_published_from_fit(monkeypatch):
+    """Telemetry computed inside the donated fused program lands in the
+    registry as gauges at the TPUMX_TELEMETRY_EVERY boundary — grad norm,
+    param norm, loss, nonfinite/skip counters all present and finite."""
+    mod, _ = _fit(monkeypatch)
+    assert mod._fused_step_count == 10
+    snap = obs.snapshot()["gauges"]
+    for k in ("train_grad_norm", "train_param_norm", "train_loss",
+              "train_nonfinite_grads_total", "train_skip_steps_total"):
+        assert k in snap, sorted(snap)
+        assert np.isfinite(snap[k])
+    assert snap["train_grad_norm"] > 0
+    assert snap["train_nonfinite_grads_total"] == 0
+    assert snap["train_skip_steps_total"] == 0
+    # step-time from the fit loop is in the same snapshot
+    hist = obs.snapshot()["histograms"]
+    assert hist["train_step_seconds"]["count"] >= 10
+
+
+@pytest.mark.spmd
+def test_telemetry_spmd_matches_single_device(monkeypatch):
+    """The SPMD (TPUMX_DP_DEVICES=2) telemetry — norms on the allreduced
+    grads, pmean'd loss — reports the same values as the 1-device run."""
+    mod1, p1 = _fit(monkeypatch)
+    t1 = telemetry.publish(mod1._exec.telemetry_snapshot())
+    mod2, p2 = _fit(monkeypatch, dp=2, kvstore="tpu_sync")
+    t2 = telemetry.publish(mod2._exec.telemetry_snapshot())
+    assert mod2._exec._spmd_ndev() == 2
+    assert set(t1) == set(t2)
+    for k in t1:
+        assert t2[k] == pytest.approx(t1[k], rel=1e-4, abs=1e-6), k
+    for k in p1:
+        np.testing.assert_allclose(p2[k], p1[k], rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.fused
+def test_telemetry_cache_discipline(monkeypatch):
+    """Telemetry ON: a 2-epoch fit is still ONE program — 1 miss + 19 hits
+    at fixed shapes."""
+    monkeypatch.setenv("TPUMX_TELEMETRY", "1")
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    before = compile_cache_stats()
+    mod.fit(_toy_iter(), num_epoch=2, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),))
+    after = compile_cache_stats()
+    assert mod._fused_step_count == 20
+    assert after["misses"] - before["misses"] == 1
+    assert after["hits"] - before["hits"] == 19
+
+
+@pytest.mark.fused
+def test_telemetry_off_is_byte_identical(monkeypatch):
+    """TPUMX_TELEMETRY=0: the fused compile keys carry no telemetry
+    component (the pre-telemetry program layout) and training is
+    BITWISE-identical to telemetry ON — the extra outputs never perturb the
+    math."""
+    mod_off, p_off = _fit(monkeypatch, telemetry_env="0")
+    for key in mod_off._exec._jit_cache:
+        assert "telemetry" not in key, key
+    assert mod_off._exec._telemetry_last is None
+    mod_on, p_on = _fit(monkeypatch, telemetry_env="1")
+    assert any("telemetry" in key for key in mod_on._exec._jit_cache)
+    for k in p_off:
+        np.testing.assert_array_equal(p_off[k], p_on[k])
+
+
+@pytest.mark.spmd
+def test_telemetry_off_spmd_key_unchanged(monkeypatch):
+    """The SPMD fused key with TPUMX_TELEMETRY=0 is exactly the pre-
+    telemetry key (same tuple the PR 4/5 programs cached under)."""
+    mod, _ = _fit(monkeypatch, telemetry_env="0", dp=2, kvstore="tpu_sync")
+    keys = [k for k in mod._exec._jit_cache if k[0] == "fused_step"]
+    assert keys and all("telemetry" not in k for k in keys)
+    assert all("spmd" in k for k in keys)
+
+
+def test_telemetry_escape_hatch_reads_env(monkeypatch):
+    monkeypatch.delenv("TPUMX_TELEMETRY", raising=False)
+    assert telemetry.enabled()
+    monkeypatch.setenv("TPUMX_TELEMETRY", "0")
+    assert not telemetry.enabled()
+    monkeypatch.setenv("TPUMX_TELEMETRY_EVERY", "7")
+    assert telemetry.every() == 7
+
+
+# ---------------------------------------------------------------------------
+# Speedometer / fit wiring
+# ---------------------------------------------------------------------------
+
+def test_speedometer_records_into_registry_without_device_sync(monkeypatch):
+    """Satellite: Speedometer publishes throughput/step-time to the registry
+    using only the host clock — no NDArray.asnumpy()/wait_to_read() (device
+    sync) happens inside the callback."""
+    from mxnet_tpu.model import BatchEndParam
+    from mxnet_tpu.ndarray.ndarray import NDArray as _ND
+
+    syncs = {"n": 0}
+
+    def count_sync(self, *a, **k):
+        syncs["n"] += 1
+        raise AssertionError("device sync inside Speedometer")
+
+    speedo = mx.callback.Speedometer(batch_size=32, frequent=2)
+    monkeypatch.setattr(_ND, "asnumpy", count_sync)
+    monkeypatch.setattr(_ND, "wait_to_read", count_sync)
+    import time as _time
+
+    for nbatch in range(1, 5):
+        _time.sleep(0.002)  # a nonzero window so the histogram records
+        speedo(BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=None,
+                             locals=None))
+    monkeypatch.undo()
+    assert syncs["n"] == 0
+    snap = obs.snapshot()
+    assert snap["gauges"]["train_throughput_samples_per_sec"] > 0
+    assert snap["histograms"]["train_batch_window_seconds"]["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# one snapshot to rule them all (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_serving_and_train_metrics_in_one_snapshot(monkeypatch):
+    """serving p50/p99/QPS and train grad-norm/step-time are all readable
+    from one observability.snapshot() AND from valid Prometheus text."""
+    from mxnet_tpu.serving.metrics import ServingMetrics
+
+    sm = ServingMetrics("svc_under_test")
+    sm.incr("requests_submitted", 3)
+    for v in (0.004, 0.01, 0.02):
+        sm.observe_latency(v)
+    _fit(monkeypatch)  # train telemetry + step-time
+    snap = obs.snapshot()
+    assert snap["counters"][
+        'serving_requests_submitted{service="svc_under_test"}'] == 3
+    lat = snap["histograms"][
+        'serving_latency_seconds{service="svc_under_test"}']
+    assert lat["count"] == 3 and lat["p50"] == pytest.approx(0.01)
+    assert snap["gauges"][
+        'serving_qps{service="svc_under_test"}'] >= 0
+    assert snap["gauges"][
+        'serving_latency_ms{quantile="p99",service="svc_under_test"}'] \
+        == pytest.approx(20.0, rel=0.01)
+    assert "train_grad_norm" in snap["gauges"]
+    assert snap["histograms"]["train_step_seconds"]["count"] >= 10
+    text = obs.to_prometheus()
+    assert "serving_latency_seconds_bucket" in text
+    assert "train_grad_norm" in text
