@@ -466,6 +466,79 @@ def serving_latency(requests: int = None, clients: int = None):
     }
 
 
+def lm_decode_throughput(requests: int = None, clients: int = None):
+    """Continuous-batching generation under concurrent load
+    (docs/generation.md): tokens/sec/chip, p50/p99 time-to-first-token and
+    p99 inter-token latency through mxnet_tpu.serving.generation's paged
+    decode loop, plus the engine's own health stats.  ``BENCH_DECODE=0``
+    skips the block; the process registry snapshot rides on the result JSON
+    like every other block."""
+    import threading
+
+    import jax
+    from mxnet_tpu.parallel import transformer as tr
+    from mxnet_tpu.serving.generation import (GenerationConfig,
+                                              GenerationService)
+
+    requests = requests or int(os.environ.get("BENCH_DECODE_REQUESTS", "48"))
+    clients = clients or int(os.environ.get("BENCH_DECODE_CLIENTS", "8"))
+    new_tokens = int(os.environ.get("BENCH_DECODE_NEW_TOKENS", "32"))
+    cfg = tr.TransformerConfig(vocab=512, d_model=256, n_heads=8,
+                               n_layers=4, d_ff=1024, max_len=512)
+    params = tr.transformer_lm_init(cfg, jax.random.PRNGKey(0))
+    svc = GenerationService(
+        params, cfg,
+        GenerationConfig(max_slots=8, block_size=32, num_blocks=256,
+                         seq_buckets=[64, 128, 256],
+                         max_new_tokens=new_tokens, queue_bound=1024))
+    warmed = svc.warmup()
+    per_client = requests // clients
+    errors = []
+
+    def client(tid):
+        rng = np.random.RandomState(tid)
+        try:
+            for i in range(per_client):
+                prompt = rng.randint(0, cfg.vocab,
+                                     int(rng.choice([24, 60, 120, 200])))
+                svc.generate(prompt, max_new_tokens=new_tokens,
+                             temperature=0.8 if (tid + i) % 2 else 0.0,
+                             top_k=40, seed=tid * 1000 + i, timeout=600)
+        except Exception as e:
+            errors.append(repr(e))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(900)
+    wall = time.perf_counter() - t0
+    stats = svc.stats()
+    compile_stats = svc.compile_stats()
+    svc.stop()
+    if errors:
+        raise RuntimeError(f"{len(errors)} client errors: {errors[0]}")
+    total_tokens = stats["counts"]["tokens"]
+    n_chips = max(1, len(jax.local_devices()))
+    return {
+        "tokens_per_sec": round(total_tokens / wall, 1),
+        "tokens_per_sec_per_chip": round(total_tokens / wall / n_chips, 1),
+        "ttft_p50_ms": stats["ttft_ms"]["p50"],
+        "ttft_p99_ms": stats["ttft_ms"]["p99"],
+        "inter_token_p99_ms": stats["inter_token_ms"]["p99"],
+        "requests": per_client * clients,
+        "clients": clients,
+        "new_tokens_per_request": new_tokens,
+        "decode_iterations": stats["iterations"],
+        "kv_block_peak_occupancy": stats["kv_blocks"]["peak_occupancy"],
+        "warmed_programs": warmed,
+        "post_warmup_compiles": sum(
+            st["misses"] for st in compile_stats.values()) - warmed,
+    }
+
+
 def telemetry_overhead(batch: int = None, steps: int = None):
     """Fused-step wall time with device-side telemetry ON vs OFF
     (docs/observability.md): the SAME bound module stepped through
@@ -728,6 +801,12 @@ def main():
         except Exception as e:  # optional block: failure is a field, not rc!=0
             sys.stderr.write(f"telemetry bench failed: {type(e).__name__}: {e}\n")
             result["telemetry_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_DECODE", "1") == "1":
+        try:
+            result["lm_decode_throughput"] = lm_decode_throughput()
+        except Exception as e:  # optional block: failure is a field, not rc!=0
+            sys.stderr.write(f"decode bench failed: {type(e).__name__}: {e}\n")
+            result["decode_error"] = f"{type(e).__name__}: {e}"
     try:
         # every bench result carries the process registry (docs/
         # observability.md): compile-cache counters, serving p50/p99/QPS,

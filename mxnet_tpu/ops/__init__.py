@@ -3,6 +3,7 @@ from .registry import Op, register, get_op, list_ops, OP_REGISTRY
 from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
+from . import sampling  # noqa: F401
 from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import detection  # noqa: F401

@@ -1,0 +1,152 @@
+"""Token-sampling ops for autoregressive generation.
+
+The functional core (`temperature_scale` / `top_k_mask` / `top_p_mask` /
+`sample_logits`) is what the generation engine traces inside its compiled
+decode program: every knob is a *per-row array*, so one program serves any
+mix of greedy / temperature / top-k / top-p requests sharing a decode batch
+— no recompile when a request's sampling config differs from its slot
+neighbours.  The registry entries expose the same math as framework ops
+(scalar-attr form), with numpy-parity tests in tests/test_generation.py.
+
+Conventions (vLLM/HF-compatible):
+- ``temperature <= 0`` means greedy (argmax of the raw logits; top-k/top-p
+  are ignored, matching the usual serving API contract);
+- ``top_k <= 0`` or ``top_k >= vocab`` disables top-k; ties at the k-th
+  logit are all kept (the mask is a value threshold, not a rank cut);
+- ``top_p >= 1`` disables nucleus filtering; the kept set is the smallest
+  prefix of the probability-sorted vocab whose mass reaches ``top_p``
+  (the first token is always kept, so ``top_p <= 0`` degenerates to top-1);
+- sampling is Gumbel-max over the filtered, temperature-scaled logits —
+  exactly categorical sampling, but expressible as one argmax so greedy and
+  stochastic rows share a single traced expression.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["temperature_scale", "top_k_mask", "top_p_mask", "sample_logits",
+           "fold_keys", "NEG_INF"]
+
+#: same finite -inf stand-in the attention masks use (exp() underflows to
+#: exactly 0.0 in f32, and finite values keep XLA's max/where paths simple)
+NEG_INF = -1e30
+
+
+def temperature_scale(logits, temperature):
+    """``logits / temperature`` with per-row (or scalar) temperature;
+    rows with ``temperature <= 0`` pass through unscaled (the greedy
+    branch selects on raw logits anyway)."""
+    logits = jnp.asarray(logits, jnp.float32)
+    t = jnp.asarray(temperature, jnp.float32)
+    t = jnp.broadcast_to(t, logits.shape[:-1])[..., None]
+    return jnp.where(t > 0, logits / jnp.where(t > 0, t, 1.0), logits)
+
+
+def top_k_mask(logits, k):
+    """Mask all but the top-k logits per row to :data:`NEG_INF`.
+
+    ``k`` is a per-row int array (or scalar); ``k <= 0`` or ``k >= vocab``
+    keeps the row unfiltered.  Ties with the k-th value are kept."""
+    logits = jnp.asarray(logits, jnp.float32)
+    vocab = logits.shape[-1]
+    kk = jnp.asarray(k, jnp.int32)
+    kk = jnp.broadcast_to(kk, logits.shape[:-1])
+    kk = jnp.where((kk <= 0) | (kk > vocab), vocab, kk)
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    thresh = jnp.take_along_axis(sorted_desc, (kk - 1)[..., None], axis=-1)
+    return jnp.where(logits >= thresh, logits, NEG_INF)
+
+
+def top_p_mask(logits, p):
+    """Nucleus filtering: keep the smallest probability-sorted prefix with
+    cumulative mass >= ``p`` (per-row array or scalar); the argmax token is
+    always kept; ``p >= 1`` disables the filter."""
+    logits = jnp.asarray(logits, jnp.float32)
+    pp = jnp.asarray(p, jnp.float32)
+    pp = jnp.broadcast_to(pp, logits.shape[:-1])[..., None]
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    # keep while the EXCLUSIVE prefix mass is still < p (so the token that
+    # crosses the threshold is included), and always keep rank 0
+    exclusive = jnp.cumsum(probs, axis=-1) - probs
+    keep = (exclusive < pp) | (
+        jnp.arange(logits.shape[-1]) == 0)
+    count = jnp.sum(keep.astype(jnp.int32), axis=-1, keepdims=True)
+    thresh = jnp.take_along_axis(sorted_desc, count - 1, axis=-1)
+    return jnp.where(logits >= thresh, logits, NEG_INF)
+
+
+def fold_keys(seeds, counters):
+    """Per-row PRNG keys from (request seed, token position) — a request's
+    randomness depends only on its own seed and the position being sampled,
+    NEVER on which decode slots it happens to share a batch with (the
+    continuous-batching determinism contract)."""
+    seeds = jnp.asarray(seeds, jnp.uint32)
+    counters = jnp.asarray(counters, jnp.uint32)
+    return jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
+    )(seeds, counters)
+
+
+def sample_logits(logits, seeds, counters, temperature, top_k, top_p):
+    """One traced sampling step over a batch of logit rows.
+
+    logits (B, V); seeds/counters/temperature/top_k/top_p all (B,).
+    Rows with ``temperature <= 0`` take the raw argmax (greedy); the rest
+    apply top-k then top-p filtering, temperature, and Gumbel-max draw.
+    Returns int32 token ids (B,).
+    """
+    logits = jnp.asarray(logits, jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filtered = top_p_mask(top_k_mask(logits, top_k), top_p)
+    scaled = temperature_scale(filtered, temperature)
+    keys = fold_keys(seeds, counters)
+    gumbel = jax.vmap(
+        lambda kd, row: jax.random.gumbel(kd, row.shape))(keys, scaled)
+    sampled = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32),
+                         greedy.shape)
+    return jnp.where(t > 0, sampled, greedy)
+
+
+# -- registry entries (scalar-attr op forms) ---------------------------------------
+@register("_sampling_greedy", differentiable=False,
+          aliases=("sample_greedy",))
+def sampling_greedy(logits):
+    """Greedy decoding: per-row argmax token ids (int32)."""
+    return jnp.argmax(jnp.asarray(logits, jnp.float32),
+                      axis=-1).astype(jnp.int32)
+
+
+@register("_sampling_temperature", rng=True, differentiable=False,
+          aliases=("sample_temperature",))
+def sampling_temperature(logits, rng_key=None, temperature=1.0):
+    """Temperature sampling: Gumbel-max over ``logits / temperature``;
+    ``temperature <= 0`` falls back to greedy."""
+    logits = jnp.asarray(logits, jnp.float32)
+    if float(temperature) <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = temperature_scale(logits, float(temperature))
+    gumbel = jax.random.gumbel(rng_key, logits.shape)
+    return jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+
+
+@register("_sampling_top_k", rng=True, differentiable=False,
+          aliases=("sample_top_k",))
+def sampling_top_k(logits, rng_key=None, k=0, temperature=1.0):
+    """Top-k sampling: mask to the k largest logits per row, then
+    temperature-sample (``k <= 0`` disables the filter)."""
+    return sampling_temperature(top_k_mask(logits, int(k)), rng_key=rng_key,
+                                temperature=temperature)
+
+
+@register("_sampling_top_p", rng=True, differentiable=False,
+          aliases=("sample_top_p",))
+def sampling_top_p(logits, rng_key=None, p=1.0, temperature=1.0):
+    """Nucleus (top-p) sampling: mask to the smallest probability prefix
+    with mass >= p, then temperature-sample (``p >= 1`` disables)."""
+    return sampling_temperature(top_p_mask(logits, float(p)), rng_key=rng_key,
+                                temperature=temperature)

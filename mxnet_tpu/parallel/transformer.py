@@ -101,7 +101,14 @@ def transformer_lm_apply(params: Params, tokens, positions,
     if attention is None:
         attention = functools.partial(local_attention, causal=True)
     B, T = tokens.shape
-    x = params["tok_emb"][tokens] + params["pos_emb"][positions][None, :, :]
+    if T == 1:
+        # single-position decode path: a one-row dynamic slice instead of a
+        # gather against the full (max_len, d_model) table
+        pe = jax.lax.dynamic_slice_in_dim(params["pos_emb"],
+                                          positions[0], 1, axis=0)
+    else:
+        pe = params["pos_emb"][positions]
+    x = params["tok_emb"][tokens] + pe[None, :, :]
     for i in range(cfg.n_layers):
         g = lambda n: params[f"l{i}_{n}"]  # noqa: B023 — read immediately
         h = _ln(x, g("ln1_g"), g("ln1_b"))
@@ -114,6 +121,97 @@ def transformer_lm_apply(params: Params, tokens, positions,
         x = x + jax.nn.gelu(h @ g("w1") + g("b1")) @ g("w2") + g("b2")
     x = _ln(x, params["lnf_g"], params["lnf_b"])
     return x @ params["tok_emb"].T  # tied embeddings
+
+
+def transformer_lm_decode(params: Params, tokens, positions, lengths,
+                          k_pool, v_pool, block_tables,
+                          cfg: TransformerConfig, compute_dtype=None):
+    """Cache-aware forward: read/write a paged per-layer KV cache.
+
+    The generation engine's one model step, serving BOTH phases
+    (docs/generation.md): *prefill* feeds a whole (padded) prompt chunk and
+    fills cache positions ``[0, lengths)``; *decode* feeds T=1 single
+    queries per slot against their already-filled caches.  Every shape is
+    static per (batch, T, table-width) signature, so sequences growing
+    inside their block tables never recompile.
+
+    Parameters
+    ----------
+    tokens : (B, T) int32 — the chunk fed this call (right-padded).
+    positions : (B, T) int32 — GLOBAL positions of those tokens (query i of
+        row b sits at ``positions[b, i]``); padded entries may hold any
+        in-range value.
+    lengths : (B,) int32 — valid query count per row; rows with 0 are
+        inactive decode slots (their writes are routed to the reserved null
+        block 0 and their outputs are garbage).
+    k_pool, v_pool : (n_layers, num_blocks, block_size, n_heads, d_head) —
+        the paged cache pool; block 0 is the null/scratch block.
+    block_tables : (B, W) int32 — logical block j of row b lives in
+        physical block ``block_tables[b, j]``; the gathered context covers
+        global positions ``[0, W * block_size)``.
+
+    Returns ``(logits (B, T, vocab) float32, k_pool, v_pool)`` — pools are
+    functionally updated (pass with donation to update in place).  A query
+    at position p attends to cache entries at positions <= p, INCLUDING the
+    k/v written from this very chunk — so a bucketed prefill followed by
+    T=1 decode steps reproduces `transformer_lm_apply` logits exactly
+    (tests/test_generation.py asserts rtol 1e-5, f32 and bf16).
+    """
+    if compute_dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(compute_dtype), params)
+    B, T = tokens.shape
+    n_layers, num_blocks, block_size, n_heads, d_head = k_pool.shape
+    W = block_tables.shape[1]
+    positions = jnp.clip(jnp.asarray(positions, jnp.int32), 0,
+                         cfg.max_len - 1)
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < \
+        jnp.asarray(lengths, jnp.int32)[:, None]            # (B, T)
+    # write coordinates, shared by every layer: logical block -> physical
+    # block via the table; invalid (padded / inactive-slot) queries write
+    # into the reserved null block 0 instead of clobbering real cache
+    logical = jnp.clip(positions // block_size, 0, W - 1)
+    phys = jnp.where(valid,
+                     jnp.take_along_axis(block_tables, logical, axis=1), 0)
+    offs = positions % block_size
+    # gathered context is in LOGICAL order: flat index j holds position j
+    ctx_pos = jnp.arange(W * block_size, dtype=jnp.int32)
+    attn_mask = ctx_pos[None, None, :] <= positions[:, :, None]  # (B,T,W*bs)
+    # bit-identical scale to local_attention's (f32 sqrt, not host f64)
+    scale = 1.0 / jnp.sqrt(cfg.d_head).astype(jnp.float32)
+
+    x = params["tok_emb"][tokens] + jnp.take(params["pos_emb"], positions,
+                                             axis=0)
+    for i in range(cfg.n_layers):
+        g = lambda n: params[f"l{i}_{n}"]  # noqa: B023 — read immediately
+        h = _ln(x, g("ln1_g"), g("ln1_b"))
+        qkv = h @ g("wqkv")
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, T, cfg.n_heads, cfg.d_head)
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        k_pool = k_pool.at[i, phys, offs].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[i, phys, offs].set(v.astype(v_pool.dtype))
+        k_ctx = k_pool[i][block_tables].reshape(B, W * block_size,
+                                                cfg.n_heads, cfg.d_head)
+        v_ctx = v_pool[i][block_tables].reshape(B, W * block_size,
+                                                cfg.n_heads, cfg.d_head)
+        # same numerics as ring_attention.local_attention (f32 scores and
+        # accumulation), with the causal mask generalized to cache-position
+        # <= query-position — padded/unwritten slots land at exactly 0
+        # probability (exp(-1e30 - m) underflows), so bucketed table widths
+        # never perturb real rows
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_ctx,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(attn_mask[:, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_ctx.dtype), v_ctx,
+                       preferred_element_type=jnp.float32).astype(q.dtype)
+        x = x + o.reshape(B, T, cfg.d_model) @ g("wo")
+        h = _ln(x, g("ln2_g"), g("ln2_b"))
+        x = x + jax.nn.gelu(h @ g("w1") + g("b1")) @ g("w2") + g("b2")
+    x = _ln(x, params["lnf_g"], params["lnf_b"])
+    logits = x @ params["tok_emb"].T
+    return logits.astype(jnp.float32), k_pool, v_pool
 
 
 def lm_loss(params: Params, tokens, labels, positions,

@@ -9,12 +9,20 @@ from .batcher import (BACKPRESSURE_POLICIES, DeadlineExceededError,
                       QueueFullError, RequestShedError, ServingClosedError,
                       ServingConfig, ServingError)
 from .bucketing import (assemble_batch, batch_buckets, bucket_batch,
-                        bucket_shape, next_pow2, pad_batch_rows, pad_sample)
+                        bucket_seq_len, bucket_shape, next_pow2,
+                        pad_batch_rows, pad_sample, pad_tokens_right,
+                        seq_buckets)
 from .metrics import ServingMetrics
 from .service import InferenceService
+from .generation import (GenerationConfig, GenerationService,
+                         GenerationStream)
+from . import generation
 
 __all__ = ["InferenceService", "ServingConfig", "ServingMetrics",
            "ServingError", "QueueFullError", "DeadlineExceededError",
            "RequestShedError", "ServingClosedError", "BACKPRESSURE_POLICIES",
            "next_pow2", "batch_buckets", "bucket_batch", "bucket_shape",
-           "pad_sample", "pad_batch_rows", "assemble_batch"]
+           "pad_sample", "pad_batch_rows", "assemble_batch",
+           "seq_buckets", "bucket_seq_len", "pad_tokens_right",
+           "GenerationService", "GenerationConfig", "GenerationStream",
+           "generation"]

@@ -20,7 +20,8 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 import numpy as _np
 
 __all__ = ["next_pow2", "batch_buckets", "bucket_batch", "bucket_shape",
-           "pad_sample", "pad_batch_rows", "assemble_batch"]
+           "pad_sample", "pad_batch_rows", "assemble_batch",
+           "seq_buckets", "bucket_seq_len", "pad_tokens_right"]
 
 
 def next_pow2(n: int) -> int:
@@ -50,6 +51,60 @@ def bucket_batch(n: int, buckets: Sequence[int]) -> int:
         if b >= n:
             return int(b)
     return int(buckets[-1])
+
+
+def seq_buckets(max_seq_len: int, min_bucket: int = 16) -> List[int]:
+    """The sequence-length ladder: powers of two from ``min_bucket`` up to
+    and including ``max_seq_len`` (the cap itself is kept even when not a
+    power of two, mirroring :func:`batch_buckets`).  Shared by generation
+    prefill bucketing and ``Module.predict``-style right-padding — a prompt
+    of length T lands on the smallest bucket >= T and is right-padded to it.
+    """
+    max_seq_len = int(max_seq_len)
+    if max_seq_len < 1:
+        raise ValueError("max_seq_len must be >= 1")
+    out: List[int] = []
+    b = min(int(min_bucket), max_seq_len)
+    while b < max_seq_len:
+        out.append(b)
+        b <<= 1
+    out.append(max_seq_len)
+    return out
+
+
+def bucket_seq_len(t: int, buckets: Sequence[int]) -> int:
+    """Smallest seq-len bucket >= t.
+
+    Unlike :func:`bucket_batch` (whose clamp-to-top fallback is safe for the
+    batch axis because the batcher never coalesces past ``max_batch_size``),
+    an over-long *sequence* cannot be truncated without changing the result
+    — so a t beyond the largest bucket raises ``ValueError`` instead of
+    silently clamping.
+    """
+    t = int(t)
+    if t < 1:
+        raise ValueError(f"sequence length must be >= 1, got {t}")
+    for b in buckets:
+        if b >= t:
+            return int(b)
+    raise ValueError(
+        f"sequence length {t} exceeds the largest configured bucket "
+        f"{max(buckets)}; raise the bucket ladder (or max_len) to serve it")
+
+
+def pad_tokens_right(tokens, bucket: int, pad_id: int = 0) -> _np.ndarray:
+    """Right-pad a 1-D token sequence to ``bucket`` with ``pad_id`` —
+    the padding semantics every seq-bucket consumer shares (padded tail
+    positions are masked out of attention/writes by the consumer)."""
+    arr = _np.asarray(tokens)
+    if arr.ndim != 1:
+        raise ValueError(f"expected a 1-D token sequence, got shape {arr.shape}")
+    if arr.shape[0] > int(bucket):
+        raise ValueError(f"cannot pad {arr.shape[0]} tokens down to {bucket}")
+    if arr.shape[0] == int(bucket):
+        return arr
+    return _np.pad(arr, (0, int(bucket) - arr.shape[0]), mode="constant",
+                   constant_values=pad_id)
 
 
 def bucket_shape(shape: Tuple[int, ...],

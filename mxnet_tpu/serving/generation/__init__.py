@@ -1,0 +1,15 @@
+"""mxnet_tpu.serving.generation — continuous-batching LM generation.
+
+The autoregressive-decoding leg of the serving subsystem (ROADMAP item 2,
+docs/generation.md): iteration-level scheduling (Orca) over a paged KV
+cache (vLLM's PagedAttention memory model), built in tpu-mx's
+zero-recompile bucketed-program idiom on top of the transformer LM in
+:mod:`mxnet_tpu.parallel.transformer`.
+"""
+from .engine import GenerationConfig, GenerationService, GenerationStream
+from .kv_cache import BlockAllocator, PagedKVCache, blocks_for
+from .programs import GenerationPrograms
+
+__all__ = ["GenerationService", "GenerationConfig", "GenerationStream",
+           "PagedKVCache", "BlockAllocator", "GenerationPrograms",
+           "blocks_for"]

@@ -1,0 +1,764 @@
+"""GenerationService — continuous-batching autoregressive decoding.
+
+The scheduling model is Orca's iteration-level scheduling fused with
+vLLM's paged KV cache, recast in tpu-mx's zero-recompile idiom
+(docs/generation.md):
+
+- the engine owns ``max_slots`` *decode slots*; every loop iteration it
+  (1) evicts finished/cancelled/expired requests (freeing their cache
+  blocks), (2) admits waiting requests into free slots — FIFO, each
+  reserving its worst-case block budget up front — running one bucketed
+  *prefill* program per admission, then (3) runs ONE *decode* program over
+  all occupied slots, advancing every running request by one token.  A
+  short request finishing never waits for a long neighbour, and a queued
+  request starts the moment a slot and blocks free up — admission and
+  eviction happen every token, not every batch;
+- prefill is bucketed on the :func:`~mxnet_tpu.serving.bucketing.seq_buckets`
+  ladder (B=1, T=bucket); decode runs at fixed batch ``max_slots`` with the
+  block-table width bucketed on its own pow2 ladder — so the entire
+  steady-state program set is finite, enumerated by :meth:`warmup`, and
+  guarded by ``TPUMX_FREEZE_COMPILES=1`` after ``mark_warm()``;
+- tokens stream back per request through :class:`GenerationStream`
+  (iterator and/or ``on_token`` callback), with the queue-bound
+  backpressure policies and deadline semantics of
+  :class:`~mxnet_tpu.serving.InferenceService`;
+- observability: ``serving.prefill``/``serving.decode`` spans, gauges for
+  tokens/sec, KV-block occupancy and running/waiting requests, TTFT and
+  inter-token latency histograms — all in the process registry.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ... import observability as _obs
+from ...base import getenv
+from ..batcher import (BACKPRESSURE_POLICIES, DeadlineExceededError,
+                       QueueFullError, RequestShedError, ServingClosedError,
+                       ServingError)
+from ..bucketing import (batch_buckets, bucket_batch, bucket_seq_len,
+                         pad_tokens_right, seq_buckets)
+from .kv_cache import PagedKVCache, blocks_for
+from .programs import GenerationPrograms
+
+__all__ = ["GenerationConfig", "GenerationService", "GenerationStream"]
+
+_WAITING, _RUNNING, _FINISHED, _CANCELLED, _FAILED = (
+    "waiting", "running", "finished", "cancelled", "failed")
+
+
+class GenerationConfig:
+    """Knobs for :class:`GenerationService`; every default reads its
+    ``TPUMX_GEN_*`` environment variable first (docs/env_vars.md)."""
+
+    def __init__(self, max_slots: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 max_new_tokens: Optional[int] = None,
+                 queue_bound: Optional[int] = None,
+                 backpressure: Optional[str] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 amp_dtype: Optional[str] = None,
+                 eos_token: Optional[int] = None):
+        self.max_slots = int(max_slots if max_slots is not None
+                             else getenv("TPUMX_GEN_SLOTS", 4))
+        if self.max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.block_size = int(block_size if block_size is not None
+                              else getenv("TPUMX_GEN_BLOCK_SIZE", 16))
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = int(num_blocks if num_blocks is not None
+                              else getenv("TPUMX_GEN_NUM_BLOCKS", 128))
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is reserved)")
+        self.max_new_tokens = int(
+            max_new_tokens if max_new_tokens is not None
+            else getenv("TPUMX_GEN_MAX_NEW_TOKENS", 64))
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.queue_bound = int(queue_bound if queue_bound is not None
+                               else getenv("TPUMX_GEN_QUEUE_BOUND", 256))
+        if self.queue_bound < 1:
+            raise ValueError("queue_bound must be >= 1")
+        self.backpressure = (backpressure if backpressure is not None
+                             else getenv("TPUMX_GEN_BACKPRESSURE", "block"))
+        if self.backpressure not in BACKPRESSURE_POLICIES:
+            raise ValueError(
+                f"backpressure must be one of {BACKPRESSURE_POLICIES}, "
+                f"got {self.backpressure!r}")
+        env_deadline = os.environ.get("TPUMX_GEN_DEADLINE_MS")
+        if default_deadline_ms is not None:
+            self.default_deadline_ms: Optional[float] = float(default_deadline_ms)
+        elif env_deadline:
+            self.default_deadline_ms = float(env_deadline)
+        else:
+            self.default_deadline_ms = None
+        # low-precision decode: params cast in-program, the KV pool stored
+        # in the compute dtype (docs/amp.md's serving leg for generation)
+        env_amp = (os.environ.get("TPUMX_GEN_AMP_DTYPE")
+                   or os.environ.get("TPUMX_SERVING_AMP_DTYPE"))
+        self.amp_dtype: Optional[str] = (
+            str(amp_dtype) if amp_dtype is not None else (env_amp or None))
+        self.seq_buckets = (sorted(int(b) for b in seq_buckets)
+                            if seq_buckets else None)
+        self.eos_token = None if eos_token is None else int(eos_token)
+
+    def __repr__(self):
+        return (f"GenerationConfig(max_slots={self.max_slots}, "
+                f"block_size={self.block_size}, "
+                f"num_blocks={self.num_blocks}, "
+                f"seq_buckets={self.seq_buckets}, "
+                f"max_new_tokens={self.max_new_tokens}, "
+                f"backpressure={self.backpressure!r}, "
+                f"amp_dtype={self.amp_dtype!r})")
+
+
+class _GenRequest:
+    """Engine-internal per-request state."""
+
+    __slots__ = ("rid", "prompt_len", "seq_tokens", "bucket", "max_new",
+                 "temperature", "top_k", "top_p", "seed", "eos_token",
+                 "deadline", "on_token", "state", "blocks", "ctx_len",
+                 "n_generated", "out_queue", "done_event", "error",
+                 "finish_reason", "t_submit", "t_first", "t_last",
+                 "cancel_requested")
+
+    def __init__(self, rid, prompt, bucket, max_new, temperature, top_k,
+                 top_p, seed, eos_token, deadline, on_token):
+        self.rid = rid
+        self.prompt_len = len(prompt)
+        self.seq_tokens: List[int] = [int(t) for t in prompt]
+        self.bucket = bucket
+        self.max_new = max_new
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed) & 0xFFFFFFFF
+        self.eos_token = eos_token
+        self.deadline = deadline
+        self.on_token = on_token
+        self.state = _WAITING
+        self.blocks: Optional[List[int]] = None
+        self.ctx_len = 0
+        self.n_generated = 0
+        self.out_queue: "queue.Queue" = queue.Queue()
+        self.done_event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.finish_reason: Optional[str] = None
+        self.t_submit = time.perf_counter()
+        self.t_first: Optional[float] = None
+        self.t_last: Optional[float] = None
+        self.cancel_requested = False
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (now if now is not None else time.perf_counter()) \
+            >= self.deadline
+
+    @property
+    def generated(self) -> List[int]:
+        return self.seq_tokens[self.prompt_len:]
+
+
+class GenerationStream:
+    """Per-request handle: iterate generated tokens as they stream, or
+    block on :meth:`result` for the full list."""
+
+    def __init__(self, req: _GenRequest):
+        self._req = req
+
+    @property
+    def request_id(self) -> int:
+        return self._req.rid
+
+    def __iter__(self):
+        while True:
+            kind, payload = self._req.out_queue.get()
+            if kind == "tok":
+                yield payload
+            elif kind == "done":
+                return
+            else:  # "error"
+                raise payload
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the request finishes; the generated token ids."""
+        if not self._req.done_event.wait(timeout):
+            raise TimeoutError(
+                f"generation request {self._req.rid} still running "
+                f"after {timeout}s")
+        if self._req.error is not None:
+            raise self._req.error
+        return list(self._req.generated)
+
+    def cancel(self) -> None:
+        """Ask the engine to evict this request at its next iteration."""
+        self._req.cancel_requested = True
+
+    @property
+    def finished(self) -> bool:
+        return self._req.done_event.is_set()
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return self._req.finish_reason
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self._req.t_first is None:
+            return None
+        return (self._req.t_first - self._req.t_submit) * 1e3
+
+
+class GenerationService:
+    """Continuous-batching LM generation over a paged KV cache.
+
+    Parameters
+    ----------
+    params : dict of jnp arrays
+        Transformer LM parameters (``transformer_lm_init`` layout).
+    model_cfg : :class:`~mxnet_tpu.parallel.transformer.TransformerConfig`
+    config : :class:`GenerationConfig`, optional
+    start : bool
+        When False the engine loop is not launched until :meth:`start` —
+        useful to enqueue a deterministic initial backlog (tests) or to
+        :meth:`warmup` before taking traffic.
+    """
+
+    _TPS_WINDOW = 5.0  # seconds of token timestamps behind the tokens/sec gauge
+
+    def __init__(self, params, model_cfg, config: Optional[GenerationConfig]
+                 = None, start: bool = True):
+        import jax.numpy as jnp
+
+        self._model_cfg = model_cfg
+        self._config = config or GenerationConfig()
+        cfg = self._config
+        compute_dtype = None
+        if cfg.amp_dtype:
+            compute_dtype = jnp.dtype(cfg.amp_dtype)
+        self._cache = PagedKVCache(
+            model_cfg.n_layers, model_cfg.n_heads, model_cfg.d_head,
+            cfg.num_blocks, cfg.block_size,
+            dtype=compute_dtype or jnp.float32)
+        self._programs = GenerationPrograms(params, model_cfg,
+                                            compute_dtype=compute_dtype)
+        # prefill ladder: bounded by the model's position table — a prompt
+        # must also leave room for at least one generated token
+        max_prompt = model_cfg.max_len - 1
+        self._seq_buckets = (cfg.seq_buckets if cfg.seq_buckets
+                             else seq_buckets(max_prompt))
+        if self._seq_buckets[-1] > max_prompt:
+            raise ValueError(
+                f"largest seq bucket {self._seq_buckets[-1]} exceeds the "
+                f"model's max prompt length {max_prompt}")
+        # decode block-table widths: pow2 ladder up to the blocks needed to
+        # address max_len positions (the cap itself kept, like batch_buckets)
+        self._width_buckets = batch_buckets(
+            blocks_for(model_cfg.max_len, cfg.block_size))
+
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._waiting: "deque[_GenRequest]" = deque()
+        self._slots: List[Optional[_GenRequest]] = [None] * cfg.max_slots
+        self._closed = False
+        self._drain = True
+        self._next_rid = 0
+        self._iteration = 0
+        self._membership: "deque[Tuple[int, Tuple[int, ...]]]" = \
+            deque(maxlen=4096)
+        self._worker: Optional[threading.Thread] = None
+        self._worker_lock = threading.Lock()
+        self._autostart = bool(start)
+
+        self._counts = {"submitted": 0, "finished": 0, "cancelled": 0,
+                        "failed": 0, "rejected": 0, "expired": 0,
+                        "shed": 0, "tokens": 0}
+        self._peak_occupancy = 0.0
+        self._ttft: "deque[float]" = deque(maxlen=4096)
+        self._itl: "deque[float]" = deque(maxlen=4096)
+        self._token_times: "deque[float]" = deque(maxlen=8192)
+
+        reg = _obs.registry()
+        self._g_running = reg.gauge("generation_running_requests")
+        self._g_waiting = reg.gauge("generation_waiting_requests")
+        self._g_blocks_used = reg.gauge("generation_kv_blocks_used")
+        self._g_blocks_free = reg.gauge("generation_kv_blocks_free")
+        self._g_occupancy = reg.gauge("generation_kv_block_occupancy")
+        self._g_tps = reg.gauge("generation_tokens_per_sec")
+        self._c_tokens = reg.counter("generation_tokens_total")
+        self._c_requests = reg.counter("generation_requests_total")
+        self._h_ttft = reg.histogram("generation_ttft_seconds")
+        self._h_itl = reg.histogram("generation_inter_token_seconds")
+
+    # -- submission ---------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               seed: int = 0, eos_token: Optional[int] = "__config__",
+               deadline_ms: Optional[float] = None,
+               on_token: Optional[Callable[[int, int], None]] = None,
+               timeout: Optional[float] = None) -> GenerationStream:
+        """Enqueue one generation request; returns a stream handle.
+
+        ``prompt``: 1-D int token ids.  ``temperature <= 0`` is greedy;
+        ``top_k``/``top_p`` follow :mod:`mxnet_tpu.ops.sampling` semantics.
+        ``seed`` keys the request's private sampling randomness (its tokens
+        are independent of which requests share its decode batch).
+        ``deadline_ms`` bounds total queue+generate time.  ``on_token(rid,
+        token)`` is called from the engine thread per token.  ``timeout``
+        bounds a *blocking* submit under the ``block`` policy.
+        """
+        cfg = self._config
+        if self._closed:
+            raise ServingClosedError("generation service is shut down")
+        prompt = _np.asarray(prompt, dtype=_np.int64).ravel()
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if _np.any(prompt < 0) or _np.any(prompt >= self._model_cfg.vocab):
+            raise ValueError(
+                f"prompt token ids must be in [0, {self._model_cfg.vocab})")
+        # over-long prompts are rejected HERE (bucket_seq_len raises), the
+        # enqueue-time contract the fixed-shape serving layer shares
+        bucket = bucket_seq_len(prompt.size, self._seq_buckets)
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else cfg.max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = int(prompt.size) + max_new
+        if total > self._model_cfg.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) = "
+                f"{total} exceeds the model's max_len "
+                f"{self._model_cfg.max_len}")
+        need = blocks_for(total, cfg.block_size)
+        if need > cfg.num_blocks - 1:
+            raise ValueError(
+                f"request needs {need} cache blocks but the pool only has "
+                f"{cfg.num_blocks - 1} allocatable")
+        eos = cfg.eos_token if eos_token == "__config__" else (
+            None if eos_token is None else int(eos_token))
+        ms = deadline_ms if deadline_ms is not None \
+            else cfg.default_deadline_ms
+        deadline = None if ms is None else time.perf_counter() + ms / 1e3
+
+        with self._lock:
+            if self._closed:
+                raise ServingClosedError("generation service is shut down")
+            if len(self._waiting) >= cfg.queue_bound:
+                if cfg.backpressure == "reject":
+                    self._counts["rejected"] += 1
+                    raise QueueFullError(
+                        f"generation queue bound {cfg.queue_bound} reached")
+                if cfg.backpressure == "shed_oldest":
+                    shed = self._waiting.popleft()
+                    self._counts["shed"] += 1
+                    self._finish_locked(shed, error=RequestShedError(
+                        "request shed under overload (shed_oldest)"))
+                else:  # block
+                    t_end = (None if timeout is None
+                             else time.perf_counter() + timeout)
+                    while (len(self._waiting) >= cfg.queue_bound
+                           and not self._closed):
+                        remaining = (None if t_end is None
+                                     else t_end - time.perf_counter())
+                        if remaining is not None and remaining <= 0:
+                            raise QueueFullError(
+                                f"blocking submit timed out after {timeout}s")
+                        self._not_full.wait(remaining)
+                    if self._closed:
+                        raise ServingClosedError(
+                            "generation service is shut down")
+            req = _GenRequest(self._next_rid, prompt.astype(_np.int32),
+                              bucket, max_new, temperature, top_k, top_p,
+                              seed, eos, deadline, on_token)
+            self._next_rid += 1
+            self._waiting.append(req)
+            self._counts["submitted"] += 1
+            self._c_requests.inc()
+            self._g_waiting.set(len(self._waiting))
+            self._not_empty.notify_all()
+        if self._autostart:
+            self._ensure_worker()
+        return GenerationStream(req)
+
+    def generate(self, prompt, **kwargs) -> List[int]:
+        """Blocking convenience wrapper: ``submit(...).result()``."""
+        timeout = kwargs.pop("timeout", None)
+        return self.submit(prompt, **kwargs).result(timeout)
+
+    # -- lifecycle ----------------------------------------------------------------
+    def start(self) -> None:
+        """Launch the engine loop (idempotent)."""
+        self._autostart = True
+        self._ensure_worker()
+
+    def _ensure_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        with self._worker_lock:
+            if self._worker is None or not self._worker.is_alive():
+                t = threading.Thread(target=self._loop,
+                                     name="tpumx-generation-engine",
+                                     daemon=True)
+                self._worker = t
+                t.start()
+
+    def warmup(self) -> int:
+        """Pre-compile the entire steady-state program set: one prefill per
+        seq bucket, one decode per block-table-width bucket.  Calls
+        ``observability.mark_warm()`` — with ``TPUMX_FREEZE_COMPILES=1``
+        any later compile-cache miss raises instead of stalling the loop.
+        Returns the number of programs compiled by this call."""
+        cfg = self._config
+        before = self._programs.compiled_signatures()
+        S = cfg.max_slots
+        zeros_s = _np.zeros(S, _np.int32)
+        with _obs.span("serving.warmup", cat="serving"):
+            for tb in self._seq_buckets:
+                wp = blocks_for(tb, cfg.block_size)
+                self._programs.run(
+                    "gen_prefill", self._cache,
+                    _np.zeros((1, tb), _np.int32),
+                    _np.zeros((1, tb), _np.int32), _np.zeros(1, _np.int32),
+                    _np.zeros((1, wp), _np.int32),
+                    _np.zeros(1, _np.uint32), _np.zeros(1, _np.uint32),
+                    _np.zeros(1, _np.float32), _np.zeros(1, _np.int32),
+                    _np.ones(1, _np.float32))
+            for w in self._width_buckets:
+                self._programs.run(
+                    "gen_decode", self._cache,
+                    _np.zeros((S, 1), _np.int32),
+                    _np.zeros((S, 1), _np.int32), zeros_s,
+                    _np.zeros((S, w), _np.int32),
+                    zeros_s.astype(_np.uint32), zeros_s.astype(_np.uint32),
+                    zeros_s.astype(_np.float32), zeros_s,
+                    _np.ones(S, _np.float32))
+        _obs.mark_warm()
+        return self._programs.compiled_signatures() - before
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Shut down.  ``drain=True`` finishes running AND queued requests
+        first; ``drain=False`` fails them with ServingClosedError."""
+        started = self._worker is not None and self._worker.is_alive()
+        with self._lock:
+            self._closed = True
+            self._drain = drain
+            if not started:
+                # no loop to hand them to: fail queued requests inline
+                while self._waiting:
+                    self._finish_locked(self._waiting.popleft(),
+                                        error=ServingClosedError(
+                                            "generation service shut down"))
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+        if started:
+            self._worker.join(timeout)
+
+    drain_and_stop = stop
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)
+
+    # -- the engine loop ----------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            admitted: List[_GenRequest] = []
+            with self._lock:
+                self._purge_waiting_locked()
+                self._evict_locked()
+                if self._closed and not self._drain:
+                    err = ServingClosedError("generation service shut down")
+                    for r in list(self._waiting):
+                        self._finish_locked(r, error=err)
+                    self._waiting.clear()
+                    for i, r in enumerate(self._slots):
+                        if r is not None:
+                            self._release_slot_locked(i, error=err)
+                    self._update_gauges_locked()
+                    return
+                admitted = self._admit_locked()
+                active = [r for r in self._slots if r is not None]
+                if not active and not admitted:
+                    if self._closed and not self._waiting:
+                        return
+                    self._update_gauges_locked()
+                    self._not_empty.wait(0.05)
+                    continue
+            try:
+                for req in admitted:
+                    self._prefill(req)
+                running = [r for r in self._slots
+                           if r is not None and r.state == _RUNNING]
+                self._membership.append(
+                    (self._iteration,
+                     tuple(sorted(r.rid for r in running))))
+                if running:
+                    self._decode_step(running)
+            except Exception as exc:  # noqa: BLE001 — the loop must survive
+                # any per-iteration surprise; fail the affected requests
+                err = exc if isinstance(exc, ServingError) else ServingError(
+                    f"generation step failed: {exc!r}")
+                with self._lock:
+                    for i, r in enumerate(self._slots):
+                        if r is not None:
+                            self._release_slot_locked(i, error=err)
+            self._iteration += 1
+            with self._lock:
+                self._update_gauges_locked()
+
+    # -- scheduling (all _locked helpers hold self._lock) -------------------------
+    def _purge_waiting_locked(self) -> None:
+        now = time.perf_counter()
+        keep: "deque[_GenRequest]" = deque()
+        for r in self._waiting:
+            if r.cancel_requested:
+                self._counts["cancelled"] += 1
+                self._finish_locked(r, reason=_CANCELLED)
+            elif r.expired(now):
+                self._counts["expired"] += 1
+                self._finish_locked(r, error=DeadlineExceededError(
+                    f"deadline exceeded after "
+                    f"{(now - r.t_submit) * 1e3:.1f}ms in queue"))
+            else:
+                keep.append(r)
+        if len(keep) != len(self._waiting):
+            self._waiting = keep
+            self._not_full.notify_all()
+
+    def _evict_locked(self) -> None:
+        now = time.perf_counter()
+        for i, r in enumerate(self._slots):
+            if r is None:
+                continue
+            if r.cancel_requested and r.state == _RUNNING:
+                self._counts["cancelled"] += 1
+                self._release_slot_locked(i, reason=_CANCELLED)
+            elif r.state in (_FINISHED, _FAILED, _CANCELLED):
+                self._release_slot_locked(i)
+            elif r.expired(now):
+                self._counts["expired"] += 1
+                self._release_slot_locked(i, error=DeadlineExceededError(
+                    f"deadline exceeded after {r.n_generated} tokens"))
+
+    def _admit_locked(self) -> List[_GenRequest]:
+        """FIFO admission: fill free slots while the head request's block
+        reservation fits.  Head-of-line blocking on cache space is the
+        deliberate fairness policy (docs/generation.md)."""
+        admitted = []
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        while free and self._waiting:
+            head = self._waiting[0]
+            need = blocks_for(head.prompt_len + head.max_new,
+                              self._config.block_size)
+            blocks = self._cache.allocator.allocate(need)
+            if blocks is None:
+                break
+            self._waiting.popleft()
+            head.blocks = blocks
+            head.state = _RUNNING
+            self._slots[free.pop(0)] = head
+            admitted.append(head)
+            self._not_full.notify_all()
+        return admitted
+
+    def _release_slot_locked(self, i: int, reason: str = _FINISHED,
+                             error: Optional[BaseException] = None) -> None:
+        r = self._slots[i]
+        self._slots[i] = None
+        if r.blocks:
+            self._cache.allocator.free(r.blocks)
+            r.blocks = None
+        self._finish_locked(r, reason=reason, error=error)
+
+    def _finish_locked(self, r: _GenRequest, reason: str = _FINISHED,
+                       error: Optional[BaseException] = None) -> None:
+        if r.done_event.is_set():
+            return
+        if error is not None:
+            r.state = _FAILED
+            r.finish_reason = r.finish_reason or "error"
+            r.error = error
+            self._counts["failed"] += 1
+            r.out_queue.put(("error", error))
+        else:
+            r.state = reason
+            r.finish_reason = r.finish_reason or reason
+            r.out_queue.put(("done", r.finish_reason))
+        r.done_event.set()
+
+    # -- model steps (engine thread, no lock held) --------------------------------
+    def _prefill(self, r: _GenRequest) -> None:
+        cfg = self._config
+        tb = r.bucket
+        wp = blocks_for(tb, cfg.block_size)
+        table = _np.zeros((1, wp), _np.int32)
+        n = min(wp, len(r.blocks))
+        table[0, :n] = r.blocks[:n]
+        tokens = pad_tokens_right(
+            _np.asarray(r.seq_tokens[:r.prompt_len], _np.int32), tb)[None, :]
+        positions = _np.arange(tb, dtype=_np.int32)[None, :]
+        with _obs.span("serving.prefill", cat="serving",
+                       args={"rid": r.rid, "len": r.prompt_len,
+                             "bucket": tb}):
+            next_tok, _ = self._programs.run(
+                "gen_prefill", self._cache, tokens, positions,
+                _np.asarray([r.prompt_len], _np.int32), table,
+                _np.asarray([r.seed], _np.uint32),
+                _np.asarray([r.prompt_len], _np.uint32),
+                _np.asarray([r.temperature], _np.float32),
+                _np.asarray([r.top_k], _np.int32),
+                _np.asarray([r.top_p], _np.float32))
+        r.ctx_len = r.prompt_len
+        self._emit_token(r, int(next_tok[0]))
+
+    def _decode_step(self, running: List[_GenRequest]) -> None:
+        cfg = self._config
+        S = cfg.max_slots
+        tokens = _np.zeros((S, 1), _np.int32)
+        positions = _np.zeros((S, 1), _np.int32)
+        lengths = _np.zeros(S, _np.int32)
+        seeds = _np.zeros(S, _np.uint32)
+        counters = _np.zeros(S, _np.uint32)
+        temperature = _np.zeros(S, _np.float32)
+        top_k = _np.zeros(S, _np.int32)
+        top_p = _np.ones(S, _np.float32)
+        max_w = 1
+        for i, r in enumerate(self._slots):
+            if r is None or r.state != _RUNNING:
+                continue
+            tokens[i, 0] = r.seq_tokens[r.ctx_len]
+            positions[i, 0] = r.ctx_len
+            lengths[i] = 1
+            seeds[i] = r.seed
+            counters[i] = r.ctx_len + 1  # index of the token being produced
+            temperature[i] = r.temperature
+            top_k[i] = r.top_k
+            top_p[i] = r.top_p
+            max_w = max(max_w, blocks_for(r.ctx_len + 1, cfg.block_size))
+        w = bucket_batch(max_w, self._width_buckets)
+        tables = _np.zeros((S, w), _np.int32)
+        for i, r in enumerate(self._slots):
+            if r is None or r.state != _RUNNING:
+                continue
+            n = min(w, len(r.blocks))
+            tables[i, :n] = r.blocks[:n]
+        with _obs.span("serving.decode", cat="serving",
+                       args={"running": len(running), "width": int(w)}):
+            next_tok, _ = self._programs.run(
+                "gen_decode", self._cache, tokens, positions, lengths,
+                tables, seeds, counters, temperature, top_k, top_p)
+        for i, r in enumerate(self._slots):
+            if r is None or r.state != _RUNNING:
+                continue
+            r.ctx_len += 1
+            self._emit_token(r, int(next_tok[i]))
+
+    def _emit_token(self, r: _GenRequest, tok: int) -> None:
+        now = time.perf_counter()
+        r.seq_tokens.append(tok)
+        r.n_generated += 1
+        if r.t_first is None:
+            r.t_first = now
+            ttft = now - r.t_submit
+            self._ttft.append(ttft)
+            self._h_ttft.observe(ttft)
+        else:
+            itl = now - r.t_last
+            self._itl.append(itl)
+            self._h_itl.observe(itl)
+        r.t_last = now
+        self._token_times.append(now)
+        self._counts["tokens"] += 1
+        self._c_tokens.inc()
+        r.out_queue.put(("tok", tok))
+        if r.on_token is not None:
+            try:
+                r.on_token(r.rid, tok)
+            except Exception:  # callbacks must not kill the engine
+                pass
+        if r.eos_token is not None and tok == r.eos_token:
+            r.state = _FINISHED
+            r.finish_reason = "eos"
+            self._counts["finished"] += 1
+        elif r.n_generated >= r.max_new:
+            r.state = _FINISHED
+            r.finish_reason = "max_new_tokens"
+            self._counts["finished"] += 1
+
+    # -- introspection ------------------------------------------------------------
+    def _update_gauges_locked(self) -> None:
+        alloc = self._cache.allocator
+        running = sum(1 for r in self._slots if r is not None)
+        self._g_running.set(running)
+        self._g_waiting.set(len(self._waiting))
+        self._g_blocks_used.set(alloc.num_used)
+        self._g_blocks_free.set(alloc.num_free)
+        occ = alloc.occupancy()
+        self._peak_occupancy = max(self._peak_occupancy, occ)
+        self._g_occupancy.set(occ)
+        now = time.perf_counter()
+        while self._token_times and \
+                now - self._token_times[0] > self._TPS_WINDOW:
+            self._token_times.popleft()
+        self._g_tps.set(len(self._token_times) / self._TPS_WINDOW)
+
+    def membership_history(self) -> List[Tuple[int, Tuple[int, ...]]]:
+        """Per-iteration decode-batch membership ``(iteration, sorted
+        request ids)`` — the observable form of iteration-level
+        scheduling (tests assert a short request leaves and a queued one
+        joins while a long one keeps decoding)."""
+        return list(self._membership)
+
+    def compile_stats(self) -> Dict[tuple, Dict[str, int]]:
+        """Per-program-signature hit/miss counters (1 miss each after a
+        covering :meth:`warmup`)."""
+        return self._programs.compile_stats()
+
+    def stats(self) -> dict:
+        from .. import metrics as _smetrics
+
+        with self._lock:
+            counts = dict(self._counts)
+            waiting = len(self._waiting)
+            running = sum(1 for r in self._slots if r is not None)
+            ttft = list(self._ttft)
+            itl = list(self._itl)
+        alloc = self._cache.allocator
+        pct = _smetrics.percentile
+        return {
+            "running": running,
+            "waiting": waiting,
+            "iterations": self._iteration,
+            "counts": counts,
+            "kv_blocks": {
+                "total": self._cache.num_blocks - 1,
+                "used": alloc.num_used,
+                "free": alloc.num_free,
+                "occupancy": round(alloc.occupancy(), 4),
+                "peak_occupancy": round(self._peak_occupancy, 4),
+            },
+            "ttft_ms": {"p50": _ms(pct(ttft, 50)), "p99": _ms(pct(ttft, 99))},
+            "inter_token_ms": {"p50": _ms(pct(itl, 50)),
+                               "p99": _ms(pct(itl, 99))},
+            "compiled_signatures": self._programs.compiled_signatures(),
+            "seq_buckets": list(self._seq_buckets),
+            "width_buckets": list(self._width_buckets),
+            "closed": self._closed,
+        }
+
+
+def _ms(seconds: Optional[float]) -> Optional[float]:
+    return None if seconds is None else round(seconds * 1e3, 3)

@@ -1,0 +1,126 @@
+"""Paged KV-cache pool and host-side block allocator (vLLM's PagedAttention
+memory model, recast in tpu-mx's fixed-shape compile-cache idiom).
+
+The device side is two preallocated arrays of shape ``(n_layers,
+num_blocks, block_size, n_heads, d_head)`` — K and V — whose shapes never
+change for the life of the engine, so every compiled program that touches
+them keeps one signature regardless of how many requests come and go or
+how long their sequences grow.  A request owns a *list of physical blocks*
+(its block table); logical position ``p`` of a request lives at
+``(table[p // block_size], p % block_size)``.  Block 0 is reserved as the
+null/scratch block: padded prefill positions and inactive decode slots
+write there, so the traced model step needs no branches.
+
+The host side is :class:`BlockAllocator` — a plain free-list.  The engine
+uses *reserve-ahead* accounting (allocate ``ceil((prompt + max_new) /
+block_size)`` blocks at admission), so an admitted request can NEVER hit
+cache OOM mid-decode; the tradeoff (vs vLLM's incremental allocation +
+preemption) is documented in docs/generation.md.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+__all__ = ["BlockAllocator", "PagedKVCache", "blocks_for"]
+
+
+def blocks_for(n_positions: int, block_size: int) -> int:
+    """Number of cache blocks covering ``n_positions`` tokens."""
+    return max(1, -(-int(n_positions) // int(block_size)))
+
+
+class BlockAllocator:
+    """Free-list allocator over physical block ids ``1..num_blocks-1``
+    (block 0 is the reserved null block).  Thread-safe; all-or-nothing
+    allocation so a request is never half-admitted."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is reserved)")
+        self.num_blocks = int(num_blocks)
+        self._lock = threading.Lock()
+        # pop() takes from the tail: hand out low ids first
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+
+    @property
+    def num_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return (self.num_blocks - 1) - self.num_free
+
+    def can_allocate(self, n: int) -> bool:
+        return self.num_free >= int(n)
+
+    def allocate(self, n: int) -> Optional[List[int]]:
+        """``n`` blocks, or None (nothing taken) if fewer are free."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                b = int(b)
+                if b <= 0 or b >= self.num_blocks:
+                    raise ValueError(f"block id {b} out of range")
+                if b in self._free:
+                    raise ValueError(f"double free of block {b}")
+                self._free.append(b)
+
+    def occupancy(self) -> float:
+        """Fraction of allocatable blocks currently owned by requests."""
+        total = self.num_blocks - 1
+        return self.num_used / total if total else 0.0
+
+
+class PagedKVCache:
+    """The device-side pool: K/V arrays plus the allocator that parcels
+    their blocks out to requests.
+
+    The arrays are owned functionally: the engine threads them through its
+    donated compiled programs and stores the returned (aliased) arrays
+    back via :meth:`swap` — the pool is updated in place on device, and
+    this object always points at the live copy.
+    """
+
+    def __init__(self, n_layers: int, n_heads: int, d_head: int,
+                 num_blocks: int, block_size: int, dtype=None):
+        import jax.numpy as jnp
+
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.dtype = jnp.dtype(dtype) if dtype is not None \
+            else jnp.dtype(jnp.float32)
+        shape = (int(n_layers), self.num_blocks, self.block_size,
+                 int(n_heads), int(d_head))
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        self.allocator = BlockAllocator(self.num_blocks)
+
+    @property
+    def shape(self):
+        return tuple(self.k.shape)
+
+    def blocks_for(self, n_positions: int) -> int:
+        return blocks_for(n_positions, self.block_size)
+
+    def max_positions(self) -> int:
+        """Positions one request could address if it owned every block."""
+        return (self.num_blocks - 1) * self.block_size
+
+    def swap(self, k, v) -> None:
+        """Adopt the pool arrays returned by a donated program call."""
+        self.k = k
+        self.v = v
+
+    def nbytes(self) -> int:
+        return int(self.k.nbytes) + int(self.v.nbytes)

@@ -1,0 +1,119 @@
+"""The generation engine's compiled model programs.
+
+ONE traced step function serves both phases — prefill (B=1, T=seq-bucket)
+and decode (B=max_slots, T=1) — built from
+:func:`~mxnet_tpu.parallel.transformer.transformer_lm_decode` plus the
+per-row sampling kernel from :mod:`mxnet_tpu.ops.sampling`.  Each distinct
+``(kind, batch, chunk, table-width)`` signature compiles exactly once;
+every lookup is fed through ``executor._note_cache`` so these programs
+appear in :func:`mxnet_tpu.executor.compile_cache_stats` (sites
+``gen_prefill`` / ``gen_decode``), are explained by
+``TPUMX_EXPLAIN_RECOMPILES=1``, and are *refused* post-warmup under
+``TPUMX_FREEZE_COMPILES=1`` — the same zero-recompile discipline as the
+fused train step and the bucketed serving cache.
+
+KV pools are donated: the decode loop updates the cache in place on device
+instead of copying ``O(num_blocks)`` memory every token.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, Optional
+
+import numpy as _np
+
+__all__ = ["GenerationPrograms"]
+
+
+def _model_step(params, k_pool, v_pool, tokens, positions, lengths,
+                block_tables, seeds, counters, temperature, top_k, top_p,
+                *, cfg, compute_dtype):
+    import jax.numpy as jnp
+
+    from ...ops.sampling import sample_logits
+    from ...parallel.transformer import transformer_lm_decode
+
+    logits, k_pool, v_pool = transformer_lm_decode(
+        params, tokens, positions, lengths, k_pool, v_pool, block_tables,
+        cfg, compute_dtype=compute_dtype)
+    # logits at the LAST VALID position of each row feed the sampler
+    # (prefill: position len-1 predicts token len; decode: T=1 row 0)
+    last_idx = jnp.clip(jnp.asarray(lengths, jnp.int32) - 1, 0,
+                        tokens.shape[1] - 1)
+    last = jnp.take_along_axis(logits, last_idx[:, None, None],
+                               axis=1)[:, 0, :]
+    next_tokens = sample_logits(last, seeds, counters, temperature,
+                                top_k, top_p)
+    return next_tokens, last, k_pool, v_pool
+
+
+class GenerationPrograms:
+    """Owns the jitted step + per-signature compile accounting."""
+
+    def __init__(self, params, cfg, compute_dtype=None):
+        import jax
+        import jax.numpy as jnp
+
+        self._cfg = cfg
+        self._compute_dtype = compute_dtype
+        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+        self._jit = jax.jit(
+            functools.partial(_model_step, cfg=cfg,
+                              compute_dtype=compute_dtype),
+            donate_argnums=(1, 2))
+        self._lock = threading.Lock()
+        self._stats: Dict[tuple, Dict[str, int]] = {}
+
+    def refresh_params(self, params) -> None:
+        """Swap in updated model weights (programs are shape-keyed, so no
+        recompile — the next call simply runs with the new arrays)."""
+        import jax.numpy as jnp
+
+        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+
+    def _key(self, kind: str, cache, tokens, block_tables) -> tuple:
+        sig = (("tokens", tuple(tokens.shape), "int32"),
+               ("block_tables", tuple(block_tables.shape), "int32"),
+               ("kv_pool", cache.shape, str(cache.dtype)))
+        return (kind, sig)
+
+    def run(self, kind: str, cache, tokens, positions, lengths,
+            block_tables, seeds, counters, temperature, top_k, top_p):
+        """Execute one step; returns ``(next_tokens np(B,), last_logits)``.
+
+        ``cache`` is updated in place (donated pools swapped back).  The
+        compile-cache note happens BEFORE dispatch, so a frozen service
+        raises :class:`FreezeCompilesError` without burning an XLA compile.
+        """
+        from ... import executor as _executor
+
+        key = self._key(kind, cache, tokens, block_tables)
+        with self._lock:
+            per = self._stats.get(key)
+            hit = per is not None
+            if per is None:
+                per = self._stats[key] = {"hits": 0, "misses": 0}
+        _executor._note_cache(hit=hit, site=(kind, ("lm",)), key=key)
+        with self._lock:
+            per["hits" if hit else "misses"] += 1
+        next_tokens, last, k, v = self._jit(
+            self._params, cache.k, cache.v,
+            _np.asarray(tokens, _np.int32), _np.asarray(positions, _np.int32),
+            _np.asarray(lengths, _np.int32),
+            _np.asarray(block_tables, _np.int32),
+            _np.asarray(seeds, _np.uint32), _np.asarray(counters, _np.uint32),
+            _np.asarray(temperature, _np.float32),
+            _np.asarray(top_k, _np.int32), _np.asarray(top_p, _np.float32))
+        cache.swap(k, v)
+        return _np.asarray(next_tokens), last
+
+    def compile_stats(self) -> Dict[tuple, Dict[str, int]]:
+        """Per-signature ``{"hits", "misses"}`` — every signature compiled
+        by a warmed service must show exactly 1 miss."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._stats.items()}
+
+    def compiled_signatures(self) -> int:
+        with self._lock:
+            return len(self._stats)

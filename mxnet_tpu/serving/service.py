@@ -354,6 +354,22 @@ class InferenceService:
         return {names[0]: _as_sample(data)}
 
     def _bucket_key(self, sample: Dict[str, _np.ndarray]) -> tuple:
+        buckets = self._config.shape_buckets
+        if buckets:
+            # with an explicit bucket ladder, an over-sized sample must be
+            # rejected AT ENQUEUE: bucket_shape's open-world pow2 fallback
+            # would otherwise silently compile (and, post-warmup, freeze-
+            # fail on) an unplanned program for it
+            for n in self._adapter.input_names:
+                shape = tuple(int(d) for d in sample[n].shape)
+                same_rank = [b for b in buckets if len(b) == len(shape)]
+                if same_rank and not any(
+                        all(bd >= sd for bd, sd in zip(b, shape))
+                        for b in same_rank):
+                    raise ValueError(
+                        f"request input {n!r} shape {shape} exceeds every "
+                        f"configured shape bucket {same_rank}; add a larger "
+                        f"bucket (and warm it) to serve this shape")
         return tuple(
             (n, bucket_shape(sample[n].shape, self._config.shape_buckets),
              str(sample[n].dtype))

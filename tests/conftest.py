@@ -60,6 +60,12 @@ def pytest_configure(config):
         "with `pytest -m amp`)")
     config.addinivalue_line(
         "markers",
+        "generation: continuous-batching LM generation engine "
+        "(mxnet_tpu.serving.generation — paged KV cache, iteration-level "
+        "scheduling, streaming, docs/generation.md; select with "
+        "`pytest -m generation`)")
+    config.addinivalue_line(
+        "markers",
         "observability: unified runtime observability (mxnet_tpu."
         "observability — metrics registry, structured tracing, recompile "
         "explainer, device-side train telemetry, docs/observability.md; "

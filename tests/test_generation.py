@@ -1,0 +1,541 @@
+"""Continuous-batching LM generation engine (mxnet_tpu.serving.generation,
+docs/generation.md): paged-KV-cache correctness vs the full-sequence
+transformer oracle, iteration-level scheduling, zero steady-state
+recompiles under TPUMX_FREEZE_COMPILES, sampling ops, block allocator,
+backpressure/deadline/cancellation semantics.
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu import observability as obs
+from mxnet_tpu.ops import get_op
+from mxnet_tpu.ops import sampling as smp
+from mxnet_tpu.parallel import transformer as tr
+from mxnet_tpu.serving import (DeadlineExceededError, QueueFullError,
+                               ServingClosedError, bucket_seq_len,
+                               pad_tokens_right, seq_buckets)
+from mxnet_tpu.serving.generation import (BlockAllocator, GenerationConfig,
+                                          GenerationService, PagedKVCache,
+                                          blocks_for)
+
+pytestmark = pytest.mark.generation
+
+CFG = tr.TransformerConfig(vocab=40, d_model=32, n_heads=4, n_layers=2,
+                           d_ff=64, max_len=64)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observability():
+    """Generation warmup calls mark_warm(); keep the freeze/explainer state
+    from leaking across tests."""
+    yield
+    obs.recompile.reset()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tr.transformer_lm_init(CFG, jax.random.PRNGKey(0))
+
+
+def _gc(**kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("seq_buckets", [16, 32])
+    kw.setdefault("max_new_tokens", 8)
+    return GenerationConfig(**kw)
+
+
+def _greedy_oracle(params, prompt, n_new):
+    """Full-sequence greedy decoding via transformer_lm_apply — no cache."""
+    toks = [int(t) for t in prompt]
+    for _ in range(n_new):
+        logits = tr.transformer_lm_apply(
+            params, jnp.asarray([toks], dtype=jnp.int32),
+            jnp.arange(len(toks), dtype=jnp.int32), CFG)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+# -- satellite: seq-len ladder ------------------------------------------------------
+def test_seq_bucket_ladder():
+    assert seq_buckets(128) == [16, 32, 64, 128]
+    assert seq_buckets(100) == [16, 32, 64, 100]   # cap kept, like batch ladder
+    assert seq_buckets(8) == [8]
+    assert bucket_seq_len(1, [16, 32]) == 16
+    assert bucket_seq_len(16, [16, 32]) == 16
+    assert bucket_seq_len(17, [16, 32]) == 32
+
+
+def test_seq_bucket_overlong_raises():
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        bucket_seq_len(33, [16, 32])
+    with pytest.raises(ValueError):
+        bucket_seq_len(0, [16, 32])
+
+
+def test_pad_tokens_right():
+    out = pad_tokens_right(np.array([3, 4, 5]), 6)
+    np.testing.assert_array_equal(out, [3, 4, 5, 0, 0, 0])
+    with pytest.raises(ValueError):
+        pad_tokens_right(np.arange(7), 6)
+
+
+# -- satellite: sampling ops --------------------------------------------------------
+def test_top_k_mask_numpy_parity():
+    rs = np.random.RandomState(3)
+    logits = rs.randn(4, 12).astype(np.float32)
+    ks = np.array([1, 3, 0, 50], np.int32)  # 0 / >vocab disable
+    out = np.asarray(smp.top_k_mask(logits, ks))
+    for row, k in zip(range(4), ks):
+        kept = out[row] > smp.NEG_INF / 2
+        k_eff = 12 if (k <= 0 or k > 12) else k
+        expected = np.zeros(12, bool)
+        expected[np.argsort(-logits[row])[:k_eff]] = True
+        np.testing.assert_array_equal(kept, expected)
+        np.testing.assert_allclose(out[row][kept], logits[row][expected])
+
+
+def test_top_p_mask_numpy_parity():
+    rs = np.random.RandomState(4)
+    logits = rs.randn(3, 10).astype(np.float32)
+    ps = np.array([0.5, 0.9, 1.0], np.float32)
+    out = np.asarray(smp.top_p_mask(logits, ps))
+    for row, p in zip(range(3), ps):
+        order = np.argsort(-logits[row])
+        probs = np.exp(logits[row][order] - logits[row].max())
+        probs = probs / probs.sum()
+        exclusive = np.cumsum(probs) - probs
+        keep_sorted = (exclusive < p)
+        keep_sorted[0] = True
+        expected = np.zeros(10, bool)
+        expected[order[keep_sorted]] = True
+        kept = out[row] > smp.NEG_INF / 2
+        np.testing.assert_array_equal(kept, expected)
+
+
+def test_temperature_scale_and_greedy():
+    logits = np.array([[1.0, 5.0, 2.0]], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(smp.temperature_scale(logits, 2.0)), logits / 2.0)
+    # temperature <= 0 passes through (greedy branch uses raw logits)
+    np.testing.assert_allclose(
+        np.asarray(smp.temperature_scale(logits, 0.0)), logits)
+    assert int(get_op("sample_greedy").fn(logits)[0]) == 1
+
+
+def test_sample_logits_deterministic_and_in_support():
+    rs = np.random.RandomState(5)
+    logits = rs.randn(6, 20).astype(np.float32)
+    seeds = np.arange(6, dtype=np.uint32)
+    counters = np.full(6, 7, np.uint32)
+    t = np.full(6, 0.8, np.float32)
+    k = np.full(6, 4, np.int32)
+    p = np.full(6, 1.0, np.float32)
+    a = np.asarray(smp.sample_logits(logits, seeds, counters, t, k, p))
+    b = np.asarray(smp.sample_logits(logits, seeds, counters, t, k, p))
+    np.testing.assert_array_equal(a, b)      # same key -> same draw
+    c = np.asarray(smp.sample_logits(logits, seeds, counters + 1, t, k, p))
+    assert not np.array_equal(a, c)          # next position -> fresh draw
+    for row in range(6):                     # only top-4 tokens are eligible
+        assert a[row] in np.argsort(-logits[row])[:4]
+    # temperature 0 rows are exact greedy regardless of k/p
+    g = np.asarray(smp.sample_logits(logits, seeds, counters,
+                                     np.zeros(6, np.float32), k, p))
+    np.testing.assert_array_equal(g, np.argmax(logits, axis=-1))
+
+
+def test_sampling_registry_ops():
+    rs = np.random.RandomState(6)
+    logits = rs.randn(3, 16).astype(np.float32)
+    key = jax.random.PRNGKey(0)
+    for name in ("sample_temperature", "sample_top_k", "sample_top_p",
+                 "_sampling_top_k", "_sampling_top_p"):
+        op = get_op(name)
+        assert op.rng and not op.differentiable
+    tk = get_op("sample_top_k").fn(logits, rng_key=key, k=2, temperature=1.0)
+    for row in range(3):
+        assert int(tk[row]) in np.argsort(-logits[row])[:2]
+    a = get_op("sample_temperature").fn(logits, rng_key=key, temperature=0.7)
+    b = get_op("sample_temperature").fn(logits, rng_key=key, temperature=0.7)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- satellite/acceptance: paged-cache correctness ----------------------------------
+@pytest.mark.parametrize("compute_dtype", [None, "bfloat16"])
+def test_decode_with_cache_matches_full_apply(params, compute_dtype):
+    """Prefill + single-token decode steps across block boundaries
+    reproduce full-sequence transformer_lm_apply logits (rtol 1e-5), in
+    f32 and under the bf16 AMP dtype."""
+    dt = None if compute_dtype is None else jnp.dtype(compute_dtype)
+    oracle_params = params if dt is None else jax.tree_util.tree_map(
+        lambda p: p.astype(dt), params)
+    rs = np.random.RandomState(0)
+    plen, n_steps, bs = 13, 7, 8      # prompt spans blocks 0-1, decode
+    prompt = rs.randint(0, CFG.vocab, plen)   # crosses into block 2 (pos 16)
+    pool_dt = dt or jnp.float32
+    kp = jnp.zeros((CFG.n_layers, 16, bs, CFG.n_heads, CFG.d_head), pool_dt)
+    vp = jnp.zeros_like(kp)
+    table = np.array([[1, 2, 3]], np.int32)
+    tb = 16
+    logits, kp, vp = tr.transformer_lm_decode(
+        params, pad_tokens_right(prompt.astype(np.int32), tb)[None, :],
+        np.arange(tb, dtype=np.int32)[None, :],
+        np.asarray([plen], np.int32), kp, vp, table[:, :2], CFG,
+        compute_dtype=dt)
+    full = tr.transformer_lm_apply(
+        oracle_params, jnp.asarray([prompt], dtype=jnp.int32),
+        jnp.arange(plen, dtype=jnp.int32), CFG).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(logits[0, :plen]),
+                               np.asarray(full[0]), rtol=1e-5, atol=1e-5)
+    toks = list(prompt)
+    last = logits[0, plen - 1]
+    for step in range(n_steps):
+        nxt = int(jnp.argmax(last))
+        toks.append(nxt)
+        pos = len(toks) - 1
+        logits, kp, vp = tr.transformer_lm_decode(
+            params, np.asarray([[nxt]], np.int32),
+            np.asarray([[pos]], np.int32), np.asarray([1], np.int32),
+            kp, vp, table, CFG, compute_dtype=dt)
+        last = logits[0, 0]
+        full = tr.transformer_lm_apply(
+            oracle_params, jnp.asarray([toks], dtype=jnp.int32),
+            jnp.arange(len(toks), dtype=jnp.int32), CFG
+        ).astype(jnp.float32)
+        np.testing.assert_allclose(np.asarray(last), np.asarray(full[0, -1]),
+                                   rtol=1e-5, atol=1e-5)
+    assert len(toks) > 16, "test must cross a block boundary"
+
+
+def test_inactive_slots_do_not_corrupt_cache(params):
+    """A decode step with inactive (length 0) slots writes only to the
+    reserved null block 0."""
+    bs = 8
+    kp = jnp.zeros((CFG.n_layers, 8, bs, CFG.n_heads, CFG.d_head))
+    vp = jnp.zeros_like(kp)
+    # fill block 1 via an active row, with a garbage inactive row alongside
+    toks = np.array([[5], [7]], np.int32)
+    pos = np.array([[0], [3]], np.int32)
+    lengths = np.array([1, 0], np.int32)
+    tables = np.array([[1], [2]], np.int32)
+    _, kp, vp = tr.transformer_lm_decode(params, toks, pos, lengths,
+                                         kp, vp, tables, CFG)
+    assert float(jnp.abs(kp[:, 1, 0]).sum()) > 0   # active row wrote
+    assert float(jnp.abs(kp[:, 2]).sum()) == 0.0   # inactive row did NOT
+
+
+# -- block allocator ----------------------------------------------------------------
+def test_block_allocator_semantics():
+    a = BlockAllocator(8)                  # blocks 1..7 allocatable
+    assert a.num_free == 7
+    got = a.allocate(3)
+    assert len(got) == 3 and 0 not in got
+    assert a.allocate(5) is None           # all-or-nothing
+    assert a.num_free == 4
+    a.free(got)
+    assert a.num_free == 7
+    with pytest.raises(ValueError):
+        a.free(got)                        # double free
+    with pytest.raises(ValueError):
+        a.free([0])                        # null block is unallocatable
+    assert blocks_for(17, 8) == 3 and blocks_for(16, 8) == 2
+    assert blocks_for(1, 8) == 1
+
+
+def test_paged_cache_shapes():
+    c = PagedKVCache(n_layers=2, n_heads=4, d_head=8, num_blocks=16,
+                     block_size=4)
+    assert c.shape == (2, 16, 4, 4, 8)
+    assert c.max_positions() == 15 * 4
+    assert c.blocks_for(5) == 2
+
+
+# -- acceptance: continuous batching ------------------------------------------------
+def test_continuous_batching_membership_and_greedy_parity(params):
+    """>= 3 overlapping requests on 2 slots: the short request finishes
+    and the queued one is admitted while the long one is still decoding,
+    and every streamed token equals single-request greedy decoding."""
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    svc.warmup()
+    rs = np.random.RandomState(1)
+    prompts = [rs.randint(0, CFG.vocab, n) for n in (11, 20, 5)]
+    new = [8, 3, 6]
+    handles = [svc.submit(p, max_new_tokens=n)
+               for p, n in zip(prompts, new)]
+    svc.start()
+    results = [h.result(60) for h in handles]
+    svc.stop()
+
+    for got, p, n in zip(results, prompts, new):
+        assert got == _greedy_oracle(params, p, n)
+
+    member = [set(m) for _, m in svc.membership_history()]
+    # requests 0 and 1 share the batch; 2 joins only after 1 leaves
+    assert {0, 1} in member
+    assert {0, 2} in member
+    # iteration-level: the transition happens while 0 is STILL decoding
+    i01 = member.index({0, 1})
+    i02 = member.index({0, 2})
+    assert i02 > i01
+    assert all(0 in m for m in member[i01:i02 + 1])
+
+
+def test_streaming_iterator_and_callback(params):
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    svc.warmup()
+    seen = []
+    h = svc.submit(np.arange(5) % CFG.vocab, max_new_tokens=4,
+                   on_token=lambda rid, tok: seen.append((rid, tok)))
+    svc.start()
+    streamed = list(h)
+    svc.stop()
+    assert streamed == h.result()
+    assert [t for _, t in seen] == streamed
+    assert h.finish_reason == "max_new_tokens"
+    assert h.ttft_ms is not None and h.ttft_ms >= 0
+
+
+def test_eos_token_stops_early(params):
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    svc.warmup()
+    # discover what greedy emits first, then use it as the eos token
+    probe = svc.submit(np.arange(7) % CFG.vocab, max_new_tokens=1)
+    svc.start()
+    first = probe.result(60)[0]
+    h = svc.submit(np.arange(7) % CFG.vocab, max_new_tokens=8,
+                   eos_token=first)
+    out = h.result(60)
+    svc.stop()
+    assert out == [first]
+    assert h.finish_reason == "eos"
+
+
+def test_seeded_sampling_independent_of_batch_composition(params):
+    """A sampled request's tokens depend only on (seed, position) — never
+    on which requests share its decode slots."""
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(0, CFG.vocab, 9)
+    kw = dict(max_new_tokens=6, temperature=0.9, top_k=8, seed=123)
+
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    svc.warmup()
+    h = svc.submit(prompt, **kw)
+    svc.start()
+    alone = h.result(60)
+    svc.stop()
+
+    svc2 = GenerationService(params, CFG, _gc(), start=False)
+    svc2.warmup()
+    hs = [svc2.submit(rs.randint(0, CFG.vocab, n), max_new_tokens=5,
+                      temperature=0.5, seed=n)
+          for n in (6, 14)]
+    h2 = svc2.submit(prompt, **kw)
+    svc2.start()
+    crowded = h2.result(60)
+    [h.result(60) for h in hs]
+    svc2.stop()
+    assert alone == crowded
+
+
+# -- acceptance: zero steady-state recompiles ---------------------------------------
+def test_zero_recompiles_under_freeze(params, monkeypatch):
+    """After warmup, a mixed stream of staggered-length concurrent requests
+    runs under TPUMX_FREEZE_COMPILES=1 with every (prefill-bucket, decode)
+    program site showing 1 miss + N hits."""
+    svc = GenerationService(params, CFG, _gc(max_slots=3), start=False)
+    warmed = svc.warmup()
+    assert warmed == len(svc.compile_stats())
+    monkeypatch.setenv("TPUMX_FREEZE_COMPILES", "1")
+    rs = np.random.RandomState(2)
+    lens = [3, 16, 29, 9, 22, 5, 31, 12]
+    handles = []
+    svc.start()
+    for i, n in enumerate(lens):
+        handles.append(svc.submit(rs.randint(0, CFG.vocab, n),
+                                  max_new_tokens=3 + (i % 5),
+                                  temperature=0.5 * (i % 2), seed=i))
+        if i % 3 == 0:
+            time.sleep(0.01)     # stagger arrivals across iterations
+    for h in handles:
+        h.result(120)
+    stats = svc.compile_stats()
+    svc.stop()
+    assert stats, "no programs recorded"
+    for key, st in stats.items():
+        assert st["misses"] == 1, f"recompile at {key}: {st}"
+    # every prefill (one per request) and every decode iteration was a hit
+    prefill_hits = sum(st["hits"] for key, st in stats.items()
+                       if key[0] == "gen_prefill")
+    decode_hits = sum(st["hits"] for key, st in stats.items()
+                      if key[0] == "gen_decode")
+    assert prefill_hits >= len(lens)
+    assert decode_hits >= max(3 + (i % 5) for i in range(len(lens))) - 1
+
+
+def test_post_warmup_miss_raises_under_freeze(params, monkeypatch):
+    """A program signature outside the warmed set must raise (not compile)
+    when frozen — the watchdog guards the decode loop."""
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    svc.warmup()
+    monkeypatch.setenv("TPUMX_FREEZE_COMPILES", "1")
+    with pytest.raises(obs.FreezeCompilesError):
+        # a batch-3 prefill was never warmed (service always uses B=1)
+        svc._programs.run(
+            "gen_prefill", svc._cache, np.zeros((3, 16), np.int32),
+            np.zeros((3, 16), np.int32), np.zeros(3, np.int32),
+            np.zeros((3, 2), np.int32), np.zeros(3, np.uint32),
+            np.zeros(3, np.uint32), np.zeros(3, np.float32),
+            np.zeros(3, np.int32), np.ones(3, np.float32))
+    svc.stop()
+
+
+# -- scheduling: waiting on cache space, deadlines, backpressure --------------------
+def test_admission_waits_for_kv_blocks(params):
+    """With a pool too small for two concurrent requests, the second waits
+    until the first finishes and frees its blocks — not an error."""
+    # 9 allocatable blocks of 8 positions; each request reserves
+    # blocks_for(20 + 12) = 4 -> two fit, three do not
+    svc = GenerationService(params, CFG,
+                            _gc(max_slots=3, num_blocks=10), start=False)
+    svc.warmup()
+    rs = np.random.RandomState(3)
+    hs = [svc.submit(rs.randint(0, CFG.vocab, 20), max_new_tokens=12)
+          for _ in range(3)]
+    svc.start()
+    outs = [h.result(120) for h in hs]
+    svc.stop()
+    assert all(len(o) == 12 for o in outs)
+    member = [set(m) for _, m in svc.membership_history()]
+    assert not any({0, 1, 2} <= m for m in member), \
+        "all three requests should never decode together (blocks don't fit)"
+    assert any(2 in m for m in member)
+
+
+def test_overlong_prompt_rejected_at_submit(params):
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        svc.submit(np.zeros(33, np.int32))         # > top bucket 32
+    with pytest.raises(ValueError, match="max_len"):
+        svc.submit(np.zeros(30, np.int32), max_new_tokens=120)
+    with pytest.raises(ValueError):
+        svc.submit(np.zeros(0, np.int32))
+    svc.stop()
+
+
+def test_backpressure_reject_and_deadline(params):
+    svc = GenerationService(params, CFG,
+                            _gc(queue_bound=2, backpressure="reject"),
+                            start=False)
+    svc.warmup()
+    h1 = svc.submit(np.arange(4), max_new_tokens=2)
+    h2 = svc.submit(np.arange(4), max_new_tokens=2)
+    with pytest.raises(QueueFullError):
+        svc.submit(np.arange(4), max_new_tokens=2)
+    # an already-expired deadline fails in queue without touching the device
+    h3 = None
+    svc._waiting.popleft()    # make room for the deadline probe
+    svc._waiting.popleft()
+    h3 = svc.submit(np.arange(4), max_new_tokens=2, deadline_ms=0.0)
+    svc.start()
+    with pytest.raises(DeadlineExceededError):
+        h3.result(60)
+    svc.stop()
+    assert h1 is not None and h2 is not None
+
+
+def test_cancel_waiting_and_running(params):
+    svc = GenerationService(params, CFG, _gc(max_slots=1), start=False)
+    svc.warmup()
+    h1 = svc.submit(np.arange(8), max_new_tokens=40)
+    h2 = svc.submit(np.arange(8), max_new_tokens=4)   # queued behind h1
+    h2.cancel()
+    svc.start()
+    time.sleep(0.05)
+    h1.cancel()
+    assert h2.result(60) == []
+    assert h2.finish_reason == "cancelled"
+    out1 = h1.result(60)
+    svc.stop()
+    assert h1.finish_reason in ("cancelled", "max_new_tokens")
+    assert len(out1) <= 40
+
+
+def test_submit_after_stop_raises(params):
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    svc.stop()
+    with pytest.raises(ServingClosedError):
+        svc.submit(np.arange(4))
+
+
+def test_drain_completes_backlog(params):
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    svc.warmup()
+    hs = [svc.submit(np.arange(5), max_new_tokens=3) for _ in range(4)]
+    svc.start()
+    svc.stop(drain=True, timeout=120)
+    assert all(h.finished for h in hs)
+    assert all(len(h.result(1)) == 3 for h in hs)
+
+
+# -- amp + observability integration ------------------------------------------------
+def test_amp_bf16_service_matches_bf16_oracle(params):
+    """amp_dtype='bfloat16' serves the cast graph: engine tokens equal
+    greedy decoding over the bf16-cast full-sequence model."""
+    svc = GenerationService(params, CFG, _gc(amp_dtype="bfloat16"),
+                            start=False)
+    assert str(svc._cache.dtype) == "bfloat16"
+    svc.warmup()
+    rs = np.random.RandomState(4)
+    prompt = rs.randint(0, CFG.vocab, 10)
+    h = svc.submit(prompt, max_new_tokens=5)
+    svc.start()
+    got = h.result(60)
+    svc.stop()
+    cast = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16), params)
+    assert got == _greedy_oracle(cast, prompt, 5)
+
+
+def test_observability_wiring(params):
+    obs.reset()
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    svc.warmup()
+    h = svc.submit(np.arange(6), max_new_tokens=4)
+    svc.start()
+    h.result(60)
+    svc.stop()
+    snap = obs.snapshot()
+    names = {m["name"] for m in snap["metrics"]} \
+        if isinstance(snap.get("metrics"), list) else set(snap)
+    flat = repr(snap)
+    for metric in ("generation_tokens_total", "generation_ttft_seconds",
+                   "generation_kv_block_occupancy",
+                   "generation_running_requests"):
+        assert metric in flat, f"{metric} missing from registry snapshot"
+    st = svc.stats()
+    assert st["counts"]["tokens"] == 4
+    assert st["ttft_ms"]["p50"] is not None
+    assert st["kv_blocks"]["used"] == 0      # all freed after finish
+    del names
+
+
+def test_service_stats_and_compile_sites(params):
+    from mxnet_tpu import executor as _executor
+
+    _executor.reset_compile_cache_stats()
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    svc.warmup()
+    h = svc.submit(np.arange(9), max_new_tokens=3)
+    svc.start()
+    h.result(60)
+    svc.stop()
+    by_site = _executor.compile_cache_stats()["by_site"]
+    assert "gen_prefill" in by_site and "gen_decode" in by_site
+    assert by_site["gen_prefill"]["hits"] >= 1     # the real prefill
+    assert by_site["gen_decode"]["hits"] >= 1

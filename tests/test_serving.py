@@ -353,6 +353,35 @@ def test_bucketing_helpers():
         serving.pad_sample(np.ones((5, 3)), (4, 3))
 
 
+def test_seq_ladder_helpers():
+    assert serving.seq_buckets(64) == [16, 32, 64]
+    assert serving.seq_buckets(48) == [16, 32, 48]  # cap kept
+    assert serving.bucket_seq_len(20, [16, 32]) == 32
+    with pytest.raises(ValueError):
+        serving.bucket_seq_len(40, [16, 32])
+    np.testing.assert_array_equal(
+        serving.pad_tokens_right(np.array([1, 2]), 4), [1, 2, 0, 0])
+
+
+def test_overlong_request_rejected_at_enqueue():
+    """Regression: a sample exceeding every configured shape bucket used to
+    fall through bucket_shape's pow2 fallback and silently compile an
+    unplanned program — it must now raise ValueError at submit time."""
+    mod = _varlen_module()
+    svc = _service(mod)          # buckets (4, 8) / (8, 8)
+    try:
+        with pytest.raises(ValueError, match="exceeds every configured"):
+            svc.submit(np.random.rand(16, 8).astype(np.float32))
+        # in-bucket shapes keep working after the rejection
+        out = svc.predict(np.random.rand(6, 8).astype(np.float32),
+                          timeout=60)
+        assert out.shape == (5,)
+        # and the over-long request never reached the queue or the device
+        assert svc.stats()["queue_depth"] == 0
+    finally:
+        svc.stop()
+
+
 def test_serving_config_env_defaults(monkeypatch):
     monkeypatch.setenv("TPUMX_SERVING_MAX_BATCH_SIZE", "16")
     monkeypatch.setenv("TPUMX_SERVING_BATCH_TIMEOUT_MS", "7.5")

@@ -162,3 +162,59 @@ def test_bf16_compute_trains_close_to_f32():
     assert abs(lf32 - lbf16) / lf32 < 0.05, (lf32, lbf16)
     # the TRAINED params under bf16 compute are still f32 master copies
     assert all(v.dtype == jnp.float32 for v in p16.values())
+
+
+@pytest.mark.generation
+@pytest.mark.parametrize("compute_dtype", [None, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_prefill_vs_decode_logits_parity(compute_dtype):
+    """Satellite (docs/generation.md): a bucketed cache-writing prefill
+    followed by T=1 decode steps reproduces transformer_lm_apply's
+    full-sequence logits to rtol 1e-5, in f32 and bf16."""
+    params = _params(seed=2)
+    apply_params = params if compute_dtype is None else \
+        jax.tree_util.tree_map(lambda p: p.astype(compute_dtype), params)
+    plen, extra, bs = 11, 4, 8
+    tokens = RS.randint(0, CFG.vocab, plen + extra).astype(np.int32)
+    kp = jnp.zeros((CFG.n_layers, 8, bs, CFG.n_heads, CFG.d_head),
+                   compute_dtype or jnp.float32)
+    vp = jnp.zeros_like(kp)
+    table = np.array([[1, 2]], np.int32)
+    padded = np.zeros((1, 16), np.int32)
+    padded[0, :plen] = tokens[:plen]
+    logits, kp, vp = tr.transformer_lm_decode(
+        params, padded, np.arange(16, dtype=np.int32)[None, :],
+        np.asarray([plen], np.int32), kp, vp, table, CFG,
+        compute_dtype=compute_dtype)
+    got = [np.asarray(logits[0, :plen])]
+    for i in range(extra):
+        step_logits, kp, vp = tr.transformer_lm_decode(
+            params, tokens[None, plen + i:plen + i + 1],
+            np.asarray([[plen + i]], np.int32), np.asarray([1], np.int32),
+            kp, vp, table, CFG, compute_dtype=compute_dtype)
+        got.append(np.asarray(step_logits[0]))
+    full = np.asarray(tr.transformer_lm_apply(
+        apply_params, jnp.asarray(tokens[None, :], dtype=jnp.int32),
+        jnp.arange(plen + extra, dtype=jnp.int32), CFG)
+    ).astype(np.float32)
+    np.testing.assert_allclose(np.concatenate(got, axis=0), full[0],
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.generation
+def test_single_position_apply_uses_slice_path():
+    """T=1 transformer_lm_apply (the decode-shaped call) slices one
+    pos_emb row instead of gathering the table — same logits as the
+    corresponding column of a full-sequence call."""
+    params = _params(seed=3)
+    tokens, _, positions = _batch(B=2, T=8)
+    full = tr.transformer_lm_apply(params, tokens, positions, CFG)
+    one = tr.transformer_lm_apply(params, tokens[:, :1],
+                                  jnp.asarray([0], dtype=jnp.int32), CFG)
+    np.testing.assert_allclose(np.asarray(one[:, 0]),
+                               np.asarray(full[:, 0]), rtol=1e-6,
+                               atol=1e-6)
+    jaxpr = str(jax.make_jaxpr(
+        lambda p, t, pos: tr.transformer_lm_apply(p, t, pos, CFG))(
+        params, tokens[:, :1], jnp.asarray([0], dtype=jnp.int32)))
+    assert "dynamic_slice" in jaxpr
