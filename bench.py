@@ -403,6 +403,122 @@ def _multichip_block():
         f"{(proc.stderr or proc.stdout).strip()[-300:]}")
 
 
+def mp_sharded_train_throughput(dp: int = None, mp: int = None):
+    """Partition-rule sharded model parallelism (docs/sharding.md):
+    Module.fit over a ("dp","mp") mesh with the FSDP catch-all rules —
+    img-or-tok/s/chip plus LIVE param+optimizer bytes per chip vs the
+    replicated dp-only layout (the memory-reduction headline).  Runs in a
+    virtual-device subprocess on 1-chip hosts (PR 4's recipe); numbers
+    there are wiring checks, not bandwidth.  ``BENCH_MP=0`` skips."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    from mxnet_tpu.parallel.partition_rules import bytes_per_device
+
+    devs = jax.devices()
+    dp = dp or int(os.environ.get("BENCH_MP_DP", "2"))
+    mp = mp or int(os.environ.get("BENCH_MP_DEVICES", "2"))
+    if dp * mp > len(devs):
+        raise RuntimeError(
+            f"mp bench wants dp*mp={dp * mp} devices, have {len(devs)}")
+    batch = int(os.environ.get("BENCH_MP_BATCH", "256"))
+    steps = int(os.environ.get("BENCH_MP_STEPS", "16"))
+    dim, hidden, classes = 512, 1024, 64
+
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=hidden,
+                                          name="fc1"), act_type="relu")
+    h = sym.Activation(sym.FullyConnected(h, num_hidden=hidden, name="fc2"),
+                       act_type="relu")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(h, num_hidden=classes, name="fc3"), label,
+        name="softmax")
+
+    def run(env):
+        prev = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            rs = np.random.RandomState(0)
+            n = batch * steps
+            it = mx.io.NDArrayIter(rs.rand(n, dim).astype(np.float32),
+                                   rs.randint(0, classes, n).astype(
+                                       np.float32),
+                                   batch_size=batch)
+            mod = mx.mod.Module(net, context=mx.cpu()
+                                if devs[0].platform == "cpu" else None)
+            marks = []
+            mod.fit(it, num_epoch=2, optimizer="adam", kvstore="tpu_sync",
+                    optimizer_params={"learning_rate": 1e-3},
+                    batch_end_callback=lambda p: marks.append(
+                        (p.epoch * steps + p.nbatch, time.perf_counter())))
+            usable = [m for m in marks if m[0] >= steps]  # epoch 2 only
+            (n0, t0), (n1, t1) = usable[0], usable[-1]
+            arrs = [mod._exec.arg_dict[nm] for nm in mod._param_names]
+            arrs += [mod._updater.states[i] for i in mod._updater.states]
+            per_dev = bytes_per_device(arrs)
+            return ((n1 - n0) * batch / (t1 - t0),
+                    max(per_dev.values()) if per_dev else 0,
+                    getattr(mod, "_fused_step_count", 0) > 0)
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    img_s, repl_bytes, fused_r = run({"TPUMX_DP_DEVICES": str(dp * mp)})
+    img_mp, shard_bytes, fused_m = run({"TPUMX_DP_DEVICES": str(dp),
+                                        "TPUMX_MP_DEVICES": str(mp)})
+    return {
+        "mesh": {"dp": dp, "mp": mp},
+        "images_per_sec_per_chip": round(img_mp / (dp * mp), 2),
+        "replicated_images_per_sec_per_chip": round(img_s / (dp * mp), 2),
+        "batch": batch,
+        "fused_spmd": bool(fused_m and fused_r),
+        "param_opt_bytes_per_chip": int(shard_bytes),
+        "replicated_param_opt_bytes_per_chip": int(repl_bytes),
+        "memory_vs_replicated": round(shard_bytes / max(1, repl_bytes), 4),
+        "platform": devs[0].platform,
+    }
+
+
+def _mp_sharded_block():
+    """mp-sharded measurement for main(): inline when this process sees
+    enough devices, else in the virtual-CPU-mesh subprocess (same recipe
+    as _multichip_block)."""
+    import jax
+
+    dp = int(os.environ.get("BENCH_MP_DP", "2"))
+    mp = int(os.environ.get("BENCH_MP_DEVICES", "2"))
+    if len(jax.devices()) >= dp * mp:
+        return mp_sharded_train_throughput(dp, mp)
+    import re
+    import subprocess
+
+    env = dict(os.environ)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={dp * mp}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the live tunnel
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mp-sharded"],
+        capture_output=True, text=True, env=env, timeout=900)
+    for line in proc.stdout.splitlines():
+        try:
+            cand = json.loads(line)
+            if isinstance(cand, dict) and "memory_vs_replicated" in cand:
+                return cand
+        except ValueError:
+            continue
+    raise RuntimeError(
+        f"mp-sharded subprocess rc={proc.returncode}: "
+        f"{(proc.stderr or proc.stdout).strip()[-300:]}")
+
+
 def serving_latency(requests: int = None, clients: int = None):
     """p50/p99 request latency + QPS through mxnet_tpu.serving under a
     concurrent mixed-shape workload (docs/serving.md).  Runs inside the
@@ -795,6 +911,13 @@ def main():
         except Exception as e:  # optional block: failure is a field, not rc!=0
             sys.stderr.write(f"multichip bench failed: {type(e).__name__}: {e}\n")
             result["multichip_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_MP", "1") == "1":
+        try:
+            result["mp_sharded_train_throughput"] = _mp_sharded_block()
+        except Exception as e:  # optional block: failure is a field, not rc!=0
+            sys.stderr.write(f"mp-sharded bench failed: "
+                             f"{type(e).__name__}: {e}\n")
+            result["mp_sharded_error"] = f"{type(e).__name__}: {e}"
     if os.environ.get("BENCH_TELEMETRY", "1") == "1":
         try:
             result["telemetry_overhead"] = telemetry_overhead()
@@ -822,6 +945,8 @@ def main():
 if __name__ == "__main__":
     if "--multichip" in sys.argv:
         print(json.dumps(multichip_train_throughput()))
+    elif "--mp-sharded" in sys.argv:
+        print(json.dumps(mp_sharded_train_throughput()))
     elif "--measure" in sys.argv:
         main()
     else:

@@ -118,9 +118,13 @@ class Executor:
         # SPMD data-parallel annotation (set_spmd): when a mesh is attached,
         # fused_step compiles ONE shard_map program over it — batch args
         # sharded on the dp axis, params/optimizer state replicated+donated,
-        # gradients allreduced in-program (docs/multichip.md)
+        # gradients allreduced in-program (docs/multichip.md).  With
+        # partition specs attached too (docs/sharding.md), params/grads/
+        # optimizer state live SHARDED per-leaf on the model axes of an N-D
+        # ("dp","mp") mesh instead of replicated.
         self._spmd_mesh = None
         self._spmd_axis = "dp"
+        self._spmd_param_specs: Dict[str, tuple] = {}
         self._spmd_batch_args: frozenset = frozenset()
         self._spmd_out_is_batch: List[bool] = []
         self._spmd_active = False  # a fused SPMD step has run (buffers live
@@ -169,16 +173,25 @@ class Executor:
         return dict(zip(self._out_names, self._outputs))
 
     # -- SPMD annotation ----------------------------------------------------------
-    def set_spmd(self, mesh, batch_args, axis: str = "dp") -> None:
+    def set_spmd(self, mesh, batch_args, axis: str = "dp",
+                 param_specs=None) -> None:
         """Attach a data-parallel mesh to this executor (or detach with
         ``mesh=None``).  ``batch_args`` are the argument names carrying the
         batch dimension (data + labels): they shard on ``axis``; every other
-        input of the fused-step program stays replicated.  The mesh becomes
-        part of ``_signature`` so a program compiled for N devices is never
-        served to a rebind with a different device count."""
+        input of the fused-step program stays replicated — unless
+        ``param_specs`` (a name -> PartitionSpec mapping from
+        :mod:`mxnet_tpu.parallel.partition_rules`) says a parameter lives
+        sharded on the mesh's model axes, in which case that param, its
+        gradient, and its optimizer state (including AMP f32 master weights)
+        are stored and donated SHARDED (docs/sharding.md).  The mesh — and
+        each non-trivial spec — becomes part of ``_signature`` so a program
+        compiled for one device count / layout is never served to another;
+        with ``param_specs=None`` the signature stays byte-identical to the
+        dp-only layout."""
         if mesh is None:
             self._spmd_mesh = None
             self._spmd_batch_args = frozenset()
+            self._spmd_param_specs = {}
             self._spmd_out_is_batch = []
             return
         ndev = int(mesh.shape[axis])
@@ -208,14 +221,39 @@ class Executor:
         _, out_shapes, _ = self._symbol.infer_shape(**shape_kwargs)
         self._spmd_out_is_batch = [
             bool(s) and len(s) > 0 and s[0] == batch for s in out_shapes]
+        specs = {}
+        if param_specs:
+            from .parallel.partition_rules import spec_tuple
+
+            for n, s in param_specs.items():
+                if n not in self.arg_dict:
+                    raise MXNetError(
+                        f"set_spmd: partition spec for unknown argument "
+                        f"{n!r}")
+                if n in batch_args:
+                    raise MXNetError(
+                        f"set_spmd: {n!r} is a batch argument; batch args "
+                        f"shard on the {axis!r} axis, not via param_specs")
+                st = spec_tuple(s)
+                if any(e is not None for e in st):
+                    specs[n] = st
         self._spmd_mesh = mesh
         self._spmd_axis = axis
+        self._spmd_param_specs = specs
         self._spmd_batch_args = batch_args
 
     def _spmd_ndev(self) -> int:
         if self._spmd_mesh is None:
             return 1
         return int(self._spmd_mesh.shape[self._spmd_axis])
+
+    def _spmd_total(self) -> int:
+        """Total devices of the attached mesh (dp × model axes) — the SPMD
+        trigger: a ("dp":1, "mp":2) mesh is still a 2-device SPMD program
+        even though the dp width is 1."""
+        if self._spmd_mesh is None:
+            return 1
+        return int(self._spmd_mesh.devices.size)
 
     # -- compilation --------------------------------------------------------------
     def _site(self, kind: str) -> tuple:
@@ -242,6 +280,18 @@ class Executor:
             sig.append(("mesh", self._spmd_axis, self._spmd_ndev(),
                         int(self._spmd_mesh.devices.size),
                         tuple(sorted(self._spmd_batch_args))))
+            if self._spmd_param_specs:
+                # partition-rule layout (docs/sharding.md): the full mesh
+                # axis map plus each sharded param's resolved spec key their
+                # own programs — and feed the recompile explainer's
+                # "spec p('dp',None)→p('dp','mp') (name)" causes.  With no
+                # specs (rules=None) these entries are ABSENT and the
+                # signature stays byte-identical to the dp-only layout.
+                sig.append(("meshshape", tuple(
+                    (str(a), int(self._spmd_mesh.shape[a]))
+                    for a in self._spmd_mesh.axis_names)))
+                for n in sorted(self._spmd_param_specs):
+                    sig.append(("spec", n, self._spmd_param_specs[n]))
         return tuple(sig)
 
     def _get_fwd(self, is_train: bool):
@@ -340,6 +390,13 @@ class Executor:
             if n in self._spmd_batch_args and a.shape \
                     and a.shape[0] % ndev == 0:
                 a._data = jax.device_put(a._data, shard)
+            elif n in self._spmd_param_specs:
+                # rule-sharded params stay in their spec layout: the jitted
+                # eval program is a global-view computation, so GSPMD
+                # gathers transiently where needed without ever
+                # materializing a replicated persistent copy
+                a._data = jax.device_put(a._data, NamedSharding(
+                    mesh, PartitionSpec(*self._spmd_param_specs[n])))
             else:
                 a._data = jax.device_put(a._data, repl)
         for n in self._aux_names:
@@ -438,8 +495,9 @@ class Executor:
     def _get_fused_step(self, optimizer, mults_by_name, num_steps: int,
                         kvstore=None, scaler=None,
                         master_names: frozenset = frozenset(),
-                        telemetry: bool = False):
-        spmd = self._spmd_ndev() > 1
+                        telemetry: bool = False, state_specs=None):
+        spmd = self._spmd_total() > 1
+        pspecs = dict(self._spmd_param_specs) if spmd else {}
         reqs = tuple(sorted((n, self.grad_req.get(n, "write"))
                             for n in self._grad_arg_names))
         key = ("fused_step", self._signature(True), int(num_steps),
@@ -468,6 +526,64 @@ class Executor:
             gnames = list(self._grad_arg_names)
             req_of = dict(reqs)
             axis = self._spmd_axis if spmd else None
+            # partition-rule sharded layout (docs/sharding.md): params,
+            # grads, and optimizer state enter and leave the program as
+            # model-axis SHARDS.  The forward/backward runs on gathered
+            # (full) params — FSDP semantics, numerically identical to the
+            # replicated layout — then each gradient is sliced back to this
+            # device's shard and the (elementwise) optimizer update runs
+            # shard-wise, so the persistent donated buffers never hold more
+            # than 1/mp of any rule-matched leaf.
+            tele_axes = None
+            if pspecs:
+                mesh_sizes = {str(a): int(self._spmd_mesh.shape[a])
+                              for a in self._spmd_mesh.axis_names}
+                spec_of = {n: pspecs.get(n, ()) for n in gnames}
+
+                def _axes_of(entry):
+                    return entry if isinstance(entry, tuple) else (entry,)
+
+                tele_axes = tuple(sorted({ax for s in spec_of.values()
+                                          for entry in s if entry
+                                          for ax in _axes_of(entry)}))
+
+                def _gather_full(x, spec):
+                    # minor-most axis first: reassembles exactly the
+                    # NamedSharding block layout of the stored shard
+                    for dim, entry in enumerate(spec):
+                        if entry is None:
+                            continue
+                        for ax in reversed(_axes_of(entry)):
+                            x = jax.lax.all_gather(x, ax, axis=dim,
+                                                   tiled=True)
+                    return x
+
+                def _shard_of(x, spec):
+                    for dim, entry in enumerate(spec):
+                        if entry is None:
+                            continue
+                        idx, nshard = 0, 1
+                        for ax in _axes_of(entry):
+                            idx = idx * mesh_sizes[ax] \
+                                + jax.lax.axis_index(ax)
+                            nshard *= mesh_sizes[ax]
+                        size = x.shape[dim] // nshard
+                        x = jax.lax.dynamic_slice_in_dim(
+                            x, idx * size, size, axis=dim)
+                    return x
+
+                def gather_pvals(pv):
+                    return {n: _gather_full(v, spec_of[n])
+                            for n, v in pv.items()}
+
+                def slice_grad(n, g):
+                    return _shard_of(g, spec_of[n])
+            else:
+                def gather_pvals(pv):
+                    return pv
+
+                def slice_grad(n, g):
+                    return g
             if spmd and kvstore is not None \
                     and hasattr(kvstore, "reduce_in_program"):
                 # tpu_sync: the store IS the collective boundary — its
@@ -495,7 +611,10 @@ class Executor:
                                  collect_aux=aux_updates)
                     return outs, aux_updates
 
-                (outs, aux_updates), vjp = jax.vjp(f, pvals)
+                # forward/backward over the FULL params (all_gather of the
+                # stored shards under partition rules; identity otherwise)
+                p_full = gather_pvals(pvals)
+                (outs, aux_updates), vjp = jax.vjp(f, p_full)
                 if scaler is None:
                     out_cts = [_ones_cotangent(o) for o in outs]
                 else:
@@ -550,6 +669,12 @@ class Executor:
                     g = grads.get(n)
                     if g is None:  # no gradient path reached this argument
                         g = jnp.zeros_like(pvals[n])
+                    else:
+                        # under partition rules: keep only this device's
+                        # shard of the (full, already dp-allreduced)
+                        # gradient — the layout the stored grad buffer,
+                        # grad carry, and shard-wise update all share
+                        g = slice_grad(n, g)
                     if req_of[n] == "add":
                         g = gprev[n] + g
                     new_grads[n] = g
@@ -635,7 +760,7 @@ class Executor:
                     ret = ret + (_obs_tele.compute_in_program(
                         outs, grads, p,
                         scaler_state=sc if scaler is not None else None,
-                        pmean_axis=axis),)
+                        pmean_axis=axis, psum_axes=tele_axes),)
                 return ret
 
             if scaler is None:
@@ -675,13 +800,26 @@ class Executor:
                             for o, ob in zip(outs, out_is_batch)]
                     return (outs,) + tuple(rest)
 
+                if pspecs:
+                    # per-leaf specs (docs/sharding.md): params/grads keep
+                    # their rule-resolved layout through the program; each
+                    # optimizer-state leaf inherits its param's spec when
+                    # shapes match (momentum, Adam moments, AMP f32 masters)
+                    # and replicates otherwise (scalars) — `state_specs` is
+                    # that pytree, built by fused_step from the live states
+                    pspec_tree = {n: P(*spec_of[n]) for n in gnames}
+                    gspec_tree = pspec_tree
+                    sspec_tree = state_specs
+                else:
+                    pspec_tree = gspec_tree = sspec_tree = P()
+
                 def fused_spmd(pvals, gvals, svals, batch_vals, const_vals,
                                aux_vals, lr_vec, wd, t_vec, rng, *sc):
                     out_specs = ([P(axis) if ob else P()
                                   for ob in out_is_batch],
-                                 P(), P(), P(), P())
-                    in_specs = (P(), P(), P(), P(axis), P(), P(),
-                                P(), P(), P(), P())
+                                 P(), gspec_tree, pspec_tree, sspec_tree)
+                    in_specs = (pspec_tree, gspec_tree, sspec_tree, P(axis),
+                                P(), P(), P(), P(), P(), P())
                     if scaler is not None:
                         out_specs = out_specs + (P(),)
                         in_specs = in_specs + (P(),)
@@ -764,7 +902,7 @@ class Executor:
         lr_vec, wd, t_vec, mults_by_idx = fused_update_plan(
             optimizer, [idx for _, idx in updates], num_steps)
         mults_by_name = {n: mults_by_idx[idx] for n, idx in updates}
-        spmd = self._spmd_ndev() > 1
+        spmd = self._spmd_total() > 1
         # static per-param master-weight layout (create_state_multi_precision
         # returns (master_f32, inner) exactly when _needs_master holds)
         master_names = frozenset(
@@ -773,15 +911,34 @@ class Executor:
         from .observability import telemetry as _obs_tele
 
         tele_on = _obs_tele.enabled()
-        fn = self._get_fused_step(optimizer, mults_by_name, num_steps,
-                                  kvstore=kvstore if spmd else None,
-                                  scaler=loss_scaler,
-                                  master_names=master_names,
-                                  telemetry=tele_on)
         gnames = self._grad_arg_names
         pvals = {n: self.arg_dict[n]._data for n in gnames}
         gvals = {n: self.grad_dict[n]._data for n in gnames}
         svals = {n: _pack_state(states[n]) for n in gnames}
+        state_specs = None
+        if spmd and self._spmd_param_specs:
+            # per-leaf optimizer-state specs (docs/sharding.md): a state
+            # leaf with its param's shape (momentum, Adam moments, AMP f32
+            # master weights) shards exactly like the param; anything else
+            # (scalar counters) replicates.  The structure is static per
+            # compile key (optimizer statics + master layout), so the spec
+            # pytree never varies under a cached program.
+            from jax.sharding import PartitionSpec as _P
+
+            def _sspecs(n):
+                pshape = tuple(self.arg_dict[n].shape)
+                ps = _P(*self._spmd_param_specs.get(n, ()))
+                return jax.tree_util.tree_map(
+                    lambda leaf: ps if tuple(leaf.shape) == pshape else _P(),
+                    svals[n])
+
+            state_specs = {n: _sspecs(n) for n in gnames}
+        fn = self._get_fused_step(optimizer, mults_by_name, num_steps,
+                                  kvstore=kvstore if spmd else None,
+                                  scaler=loss_scaler,
+                                  master_names=master_names,
+                                  telemetry=tele_on,
+                                  state_specs=state_specs)
         other = {n: self.arg_dict[n]._data for n in self._arg_names
                  if n not in pvals}
         aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
@@ -808,12 +965,27 @@ class Executor:
             pvals, gvals, svals = uniquify_donated((pvals, gvals, svals))
             # one device_put per array, no per-device Python splits: the
             # batch lands sharded on the dp axis, everything else replicated
-            # (both are no-ops after the first step — program outputs carry
-            # these shardings already)
+            # — except rule-sharded params/grads/state, which land (and
+            # stay) in their PartitionSpec layout.  All of these are no-ops
+            # after the first step: program outputs carry these shardings.
             batch_vals = {n: jax.device_put(v, shard)
                           for n, v in batch_vals.items()}
-            pvals, gvals, svals, other, aux_vals, sc_args = jax.device_put(
-                (pvals, gvals, svals, other, aux_vals, sc_args), repl)
+            if state_specs is not None:
+                pvals = {n: jax.device_put(v, NamedSharding(
+                    mesh, PartitionSpec(*self._spmd_param_specs.get(n, ()))))
+                    for n, v in pvals.items()}
+                gvals = {n: jax.device_put(v, NamedSharding(
+                    mesh, PartitionSpec(*self._spmd_param_specs.get(n, ()))))
+                    for n, v in gvals.items()}
+                svals = jax.device_put(svals, jax.tree_util.tree_map(
+                    lambda sp: NamedSharding(mesh, sp), state_specs))
+                other, aux_vals, sc_args = jax.device_put(
+                    (other, aux_vals, sc_args), repl)
+            else:
+                pvals, gvals, svals, other, aux_vals, sc_args = \
+                    jax.device_put(
+                        (pvals, gvals, svals, other, aux_vals, sc_args),
+                        repl)
             self._spmd_active = True
             with _tracing.span("executor.fused_step", cat="executor"):
                 res = fn(pvals, gvals, svals, batch_vals, other, aux_vals,

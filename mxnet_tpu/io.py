@@ -26,27 +26,51 @@ __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "LibSVMIter", "shard_data_batch"]
 
 
-def shard_data_batch(batch: "DataBatch", mesh, axis: str = "dp") -> "DataBatch":
-    """Place a batch over a data-parallel mesh for the SPMD fused train step.
+def shard_data_batch(batch: "DataBatch", mesh, axis: str = "dp",
+                     strict: bool = False) -> "DataBatch":
+    """Place a batch over the batch axis of an SPMD mesh for the fused
+    train step.
 
-    One ``jax.device_put`` with a ``NamedSharding`` on the batch axis per
-    array — the input pipeline never materializes per-device Python splits
-    (the reference's ``_split_input_slice`` host slicing).  Arrays are
-    re-placed IN PLACE on the batch's NDArrays so every downstream consumer
-    (executor feed, device-side metrics comparing labels against sharded
-    outputs) sees consistently-sharded values.  Arrays whose leading dim
-    doesn't divide by the mesh size are left untouched (the caller falls
-    back to the legacy path for those batches).
+    One ``jax.device_put`` with a ``NamedSharding`` on ``axis`` per array —
+    the input pipeline never materializes per-device Python splits (the
+    reference's ``_split_input_slice`` host slicing).  ``axis`` is any
+    named axis of ``mesh`` (``"dp"`` for the training mesh; on a 2-D
+    ``("dp","mp")`` mesh the batch shards on dp and replicates across mp).
+    Arrays are re-placed IN PLACE on the batch's NDArrays so every
+    downstream consumer (executor feed, device-side metrics comparing
+    labels against sharded outputs) sees consistently-sharded values.
+
+    Arrays whose leading dim doesn't divide by the axis size are left
+    untouched by default (the Module caller pre-checks and falls back to
+    the legacy path for those batches); ``strict=True`` raises a
+    :class:`MXNetError` naming the batch size and the mesh axis size
+    instead — ask for it at pipeline boundaries where an indivisible batch
+    is a configuration bug, not a final partial batch (the old failure mode
+    was an opaque XLA reshape error much later).
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
+    axis_names = tuple(str(a) for a in mesh.axis_names)
+    if axis not in axis_names:
+        raise MXNetError(
+            f"shard_data_batch: axis {axis!r} is not an axis of the mesh "
+            f"(axes: {axis_names})")
     ndev = int(mesh.shape[axis])
     sharding = NamedSharding(mesh, PartitionSpec(axis))
     for arr in list(batch.data or []) + list(batch.label or []):
-        if isinstance(arr, NDArray) and arr._data is not None \
-                and arr.shape and arr.shape[0] % ndev == 0:
-            arr._data = jax.device_put(arr._data, sharding)
+        if not (isinstance(arr, NDArray) and arr._data is not None
+                and arr.shape):
+            continue
+        if arr.shape[0] % ndev:
+            if strict:
+                raise MXNetError(
+                    f"shard_data_batch: batch size {arr.shape[0]} is not "
+                    f"divisible by mesh axis {axis!r} of size {ndev}; pad "
+                    f"the final batch or pick a batch size that is a "
+                    f"multiple of {ndev}")
+            continue
+        arr._data = jax.device_put(arr._data, sharding)
     return batch
 
 

@@ -390,8 +390,13 @@ class KVStoreTPUSync(KVStoreLocal):
     # become jax.lax.psum / masked-psum over the dp mesh axis, lowered to
     # ICI allreduce by XLA.  No host round-trip, no per-key dispatch.
     def reduce_in_program(self, tree, axis: Optional[str] = None):
-        """Allreduce (sum) a gradient pytree over the dp axis — jit/shard_map
-        trace context only."""
+        """Allreduce (sum) a gradient pytree over the DATA-PARALLEL axis
+        only — jit/shard_map trace context only.  On a 2-D ``("dp","mp")``
+        mesh (docs/sharding.md) the mp axis carries partition-rule SHARDS,
+        not replicas: gradients must never be summed across it (the fused
+        step slices the dp-reduced gradient back to the local mp shard
+        instead), so this hook takes exactly one axis name and the executor
+        always passes ``"dp"``."""
         from .parallel import collectives
 
         axis = axis or self.spmd_axis

@@ -211,9 +211,16 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None,
-            sparse_row_id_fn=None):
-        """The canonical training loop (reference: base_module.py:409)."""
+            sparse_row_id_fn=None, shard_rules=None):
+        """The canonical training loop (reference: base_module.py:409).
+
+        ``shard_rules``: ordered ``(regex, PartitionSpec)`` partition rules
+        (docs/sharding.md) sharding params/grads/optimizer state over the
+        ``mp`` mesh axis when ``TPUMX_MP_DEVICES`` > 1; forwarded to
+        ``bind`` on modules that support it."""
         assert num_epoch is not None, "please specify number of epochs"
+        if shard_rules is not None:
+            self._shard_rules = shard_rules
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)

@@ -64,6 +64,11 @@ class Module(BaseModule):
         self._shared_bound = False
         self._amp_cfg = None      # resolved at bind (env TPUMX_AMP*)
         self._loss_scaler = None  # created at init_optimizer when needed
+        # partition rules (docs/sharding.md): ordered (regex, PartitionSpec)
+        # pairs accepted at bind()/fit() — or via TPUMX_SHARD_RULES — that
+        # shard params/grads/optimizer state on the mp axis of the
+        # ("dp","mp") mesh when TPUMX_MP_DEVICES widens model parallelism
+        self._shard_rules = None
         _check_input_names(symbol, self._data_names, "data", True)
         _check_input_names(symbol, self._label_names, "label", False)
         _check_input_names(symbol, self._state_names, "state", True)
@@ -137,10 +142,25 @@ class Module(BaseModule):
                 return n
         return len(self._context)
 
+    def _mp_size(self) -> int:
+        """Model-parallel width (``TPUMX_MP_DEVICES``): >1 adds an ``mp``
+        axis to the fused-step mesh and shards params/grads/optimizer state
+        over it per the bound partition rules (docs/sharding.md)."""
+        import os
+
+        env = os.environ.get("TPUMX_MP_DEVICES", "")
+        try:
+            n = int(env) if env else 0
+        except ValueError:
+            n = 0
+        return n if n > 1 else 1
+
     # -- binding ------------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
+             grad_req="write", shard_rules=None):
+        if shard_rules is not None:
+            self._shard_rules = shard_rules
         if self.binded and not force_rebind:
             self.logger.warning("Already bound, ignoring bind()")
             return
@@ -197,30 +217,52 @@ class Module(BaseModule):
                                         allow_extra_params=True)
 
     def _maybe_attach_spmd_mesh(self):
-        """Annotate the executor with a dp mesh when this Module is bound for
-        multi-device training (several contexts, or ``TPUMX_DP_DEVICES``):
-        the SPMD fused step then shards the batch across the mesh and
-        allreduces gradients in-program, replacing the reference's per-device
-        executor groups + host kvstore reduce.  Best-effort: anything the
-        SPMD program can't express (indivisible batch, RNN carry states,
+        """Annotate the executor with its SPMD mesh when this Module is
+        bound for multi-device training (several contexts,
+        ``TPUMX_DP_DEVICES``, or ``TPUMX_MP_DEVICES``): the SPMD fused step
+        then shards the batch across the ``dp`` axis and allreduces
+        gradients in-program, replacing the reference's per-device executor
+        groups + host kvstore reduce.  With model parallelism
+        (``TPUMX_MP_DEVICES`` > 1) the mesh gains an ``mp`` axis and the
+        bound partition rules (``shard_rules`` at bind/fit,
+        ``TPUMX_SHARD_RULES``, or the FSDP catch-all default) resolve to a
+        per-param spec pytree that shards params, gradients, and optimizer
+        state over it (docs/sharding.md).  Best-effort: anything the SPMD
+        program can't express (indivisible batch, RNN carry states,
         un-inferable output shapes) leaves the annotation off and fit takes
         the legacy path."""
         import os
 
         ndev = self._dp_size()
-        if (ndev <= 1 or not self.for_training or self._state_names
+        mp = self._mp_size()
+        if (ndev * mp <= 1 or not self.for_training or self._state_names
                 or os.environ.get("TPUMX_FUSED_STEP", "1") == "0"
                 or os.environ.get("TPUMX_FUSED_STEP_SPMD", "1") == "0"):
             return
         try:
-            from ..parallel.mesh import dp_mesh
+            from ..parallel.mesh import make_mesh
 
             devices = None
-            if len(self._context) > 1:
+            if len(self._context) > 1 and mp <= 1:
                 devices = [c.jax_device for c in self._context]
-            mesh = dp_mesh(ndev, devices=devices)
+            mesh = make_mesh({"dp": ndev, "mp": mp} if mp > 1
+                             else {"dp": ndev},
+                             devices=devices, install=False)
+            param_specs = None
+            if mp > 1:
+                from ..parallel import partition_rules as _pr
+
+                rules = (self._shard_rules or _pr.rules_from_env()
+                         or _pr.DEFAULT_FSDP_RULES)
+                shapes = {n: tuple(self._exec.arg_dict[n].shape)
+                          for n in self._param_names
+                          if n not in self._fixed_param_names
+                          and n in self._exec.arg_dict}
+                param_specs = _pr.make_param_specs(rules, shapes, mesh,
+                                                   mp_axis="mp")
             self._exec.set_spmd(
-                mesh, batch_args=self._data_names + self._label_names)
+                mesh, batch_args=self._data_names + self._label_names,
+                param_specs=param_specs)
         except Exception as e:
             self.logger.warning(
                 "SPMD fused step unavailable (%s); multi-device fit will use "
@@ -281,8 +323,16 @@ class Module(BaseModule):
         if self._fused_step_count:
             # NDArray.copy() shares the device buffer; under the fused path
             # the executor's buffers are donated every step, so a snapshot
-            # must own fresh device memory to survive the next step
-            deep = lambda v: NDArray(jnp.array(v._data, copy=True))
+            # must own fresh device memory to survive the next step.  Under
+            # partition rules the live params are mp-sharded: gather through
+            # the host so the snapshot (and any checkpoint written from it)
+            # holds the same full arrays as the replicated layout
+            # (docs/sharding.md — save under one mesh, restore under
+            # another).
+            if self._exec is not None and self._exec._spmd_param_specs:
+                deep = lambda v: NDArray(jnp.asarray(_np.asarray(v._data)))
+            else:
+                deep = lambda v: NDArray(jnp.array(v._data, copy=True))
             return ({k: deep(v) for k, v in self._arg_params.items()},
                     {k: deep(v) for k, v in self._aux_params.items()})
         return ({k: v.copy() for k, v in self._arg_params.items()},
@@ -411,11 +461,22 @@ class Module(BaseModule):
             return False
         if ndev > 1:
             # multi-device: the SPMD mesh must be attached and the global
-            # batch must shard evenly across it
+            # batch must shard evenly across it (the dp axis only; the mp
+            # axis never sees the batch dimension)
             if self._exec._spmd_ndev() != ndev:
                 return False
             batch = self._data_shapes[0][1][0] if self._data_shapes else 0
             if not batch or batch % ndev:
+                return False
+        if self._mp_size() > 1:
+            # model parallelism needs the 2-D mesh + resolved specs attached,
+            # and an optimizer whose update is elementwise in the weight
+            # (the shard-wise update contract, optimizer.py)
+            mesh = self._exec._spmd_mesh
+            if mesh is None or "mp" not in mesh.axis_names:
+                return False
+            if self._exec._spmd_param_specs and not getattr(
+                    self._optimizer, "update_step_elementwise", True):
                 return False
         return True
 
@@ -450,10 +511,13 @@ class Module(BaseModule):
                       zip([s[0] for s in self._data_shapes], data_batch.data)}
         if any(cur[n] != s for n, s in new_shapes.items()):
             self._reshape_exec(data_batch)
-        if self._dp_size() > 1 and self._exec._spmd_mesh is not None:
+        if (self._dp_size() > 1 or self._mp_size() > 1) \
+                and self._exec._spmd_mesh is not None:
             # one device_put per array with a NamedSharding on the batch
             # axis, mutating the batch's NDArrays in place: executor feed AND
             # device-side metrics (labels vs sharded outputs) stay consistent
+            # (dp=1 × mp>1 meshes still need the batch placed over the full
+            # mesh device set — P('dp') replicates it across mp)
             from ..io import shard_data_batch
 
             shard_data_batch(data_batch, self._exec._spmd_mesh,

@@ -105,6 +105,10 @@ def _components(key: tuple) -> Dict[tuple, object]:
             out[("aux", item[1])] = (item[2], item[3])
         elif isinstance(item, tuple) and item and item[0] == "mesh":
             out[("mesh",)] = item[1:]
+        elif isinstance(item, tuple) and item and item[0] == "meshshape":
+            out[("meshshape",)] = item[1]
+        elif isinstance(item, tuple) and item and item[0] == "spec":
+            out[("spec", item[1])] = item[2]
         else:
             out[("sig", repr(item))] = item
     for i, item in enumerate(key[2:]):
@@ -135,6 +139,20 @@ def _describe(slot: tuple, old, new) -> str:
         old_n = old[1] if old else 1
         new_n = new[1] if new else 1
         return f"mesh {old_n}→{new_n}"
+    if slot[0] == "spec":
+        # partition-rule layout drift (docs/sharding.md):
+        # "spec p('dp',None)→p('dp','mp') (dense0_weight)"
+        from ..parallel.partition_rules import spec_str
+
+        return (f"spec {spec_str(old or ())}→{spec_str(new or ())} "
+                f"({slot[1]})")
+    if slot[0] == "meshshape":
+        def _fmt(ms):
+            if not ms:
+                return "none"
+            return "×".join(f"{a}={n}" for a, n in ms)
+
+        return f"mesh shape {_fmt(old)}→{_fmt(new)}"
     if slot[0] == "is_train":
         return f"is_train {old}→{new}"
     if slot[0] == "static":

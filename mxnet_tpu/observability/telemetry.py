@@ -52,7 +52,8 @@ def every() -> int:
 
 def compute_in_program(outs, grads: Dict[str, object],
                        params: Dict[str, object], scaler_state=None,
-                       pmean_axis: Optional[str] = None) -> Dict[str, object]:
+                       pmean_axis: Optional[str] = None,
+                       psum_axes=None) -> Dict[str, object]:
     """Build the telemetry dict of f32 scalars — TRACE CONTEXT ONLY (called
     from inside ``Executor._get_fused_step``'s traced function).
 
@@ -60,24 +61,37 @@ def compute_in_program(outs, grads: Dict[str, object],
     params (replica-invariant under SPMD already); the step loss is the
     mean of the first inexact output — per-shard batch outputs are pmean'd
     over ``pmean_axis`` so the reported value is the global-batch mean.
+
+    ``psum_axes`` (partition-rule sharded layouts, docs/sharding.md): the
+    mesh axes params/grads are SHARDED over — per-shard square-sums and
+    nonfinite counts psum over them so the reported norms are the global
+    values, identical on every replica.  ``None`` (the dp-only layout)
+    leaves the traced program byte-identical to the pre-sharding build.
     """
     import jax
     import jax.numpy as jnp
 
     f32 = jnp.float32
 
+    def _global(x):
+        if psum_axes:
+            for ax in psum_axes:
+                x = jax.lax.psum(x, ax)
+        return x
+
     def _sqsum(tree):
         total = f32(0.0)
         for v in tree.values():
             if jnp.issubdtype(v.dtype, jnp.inexact):
                 total = total + jnp.sum(jnp.square(v.astype(f32)))
-        return total
+        return _global(total)
 
     nonfin = f32(0.0)
     for g in grads.values():
         if jnp.issubdtype(g.dtype, jnp.inexact):
             nonfin = nonfin + jnp.sum(
                 (~jnp.isfinite(g.astype(f32))).astype(f32))
+    nonfin = _global(nonfin)
     loss = f32(0.0)
     for o in outs:
         if jnp.issubdtype(o.dtype, jnp.inexact):

@@ -137,6 +137,18 @@ class Optimizer:
     # the reference's CreateCachedSegOpr bulking taken to the optimizer.
     fused_step_supported = False
 
+    #: Contract for the partition-rule sharded fused step (docs/sharding.md):
+    #: ``update_step`` must be ELEMENTWISE in (weight, grad, state) — no
+    #: cross-element reductions like a global weight/grad norm — so running
+    #: it on each device's mp SHARD equals running it on the full tensor,
+    #: and optimizer state (incl. AMP f32 masters, which inherit the
+    #: weight's sharding via ``zeros_like``/``astype`` at create_state time)
+    #: can live sharded.  Every fused optimizer here satisfies this; a
+    #: norm-based optimizer (LARS/LAMB-style) must set it False, which
+    #: routes mp-sharded training back to the legacy path rather than
+    #: silently computing per-shard norms.
+    update_step_elementwise = True
+
     def fused_static_key(self):
         """Hyperparameters baked into a fused trace as constants; part of the
         compile-cache key so changing them recompiles rather than reusing a

@@ -15,10 +15,15 @@ parallelism):
 - pipeline.py      pipeline parallelism via shard_map + ppermute microbatching
 - compression.py   2-bit gradient compression w/ error feedback
                    (src/kvstore/gradient_compression.*)
+- partition_rules.py regex→PartitionSpec sharding rules (tensor parallel +
+                   FSDP state sharding through the fused step — docs/sharding.md)
 """
 from .mesh import MeshConfig, get_mesh, make_mesh, local_mesh
 from . import collectives
 from . import compression
+from . import partition_rules
+from .partition_rules import (match_partition_rules, make_param_specs,
+                              make_shard_and_gather_fns)
 from .data_parallel import DataParallelTrainer
 from .ring_attention import ring_attention, ring_attention_sharded, \
     local_attention
@@ -30,5 +35,6 @@ from . import transformer
 __all__ = ["MeshConfig", "get_mesh", "make_mesh", "local_mesh", "collectives",
            "compression", "DataParallelTrainer", "ring_attention",
            "ring_attention_sharded", "local_attention", "ulysses_attention",
-           "transformer",
+           "transformer", "partition_rules", "match_partition_rules",
+           "make_param_specs", "make_shard_and_gather_fns",
            "ulysses_attention_sharded", "pipeline", "moe"]

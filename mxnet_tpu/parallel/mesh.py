@@ -36,10 +36,21 @@ class MeshConfig:
         return s
 
 
-def make_mesh(axes: Dict[str, int] = None, devices=None, **axis_kwargs) -> Mesh:
-    """Build a jax Mesh over the available devices.
+def make_mesh(axes: Dict[str, int] = None, devices=None, install: bool = True,
+              **axis_kwargs) -> Mesh:
+    """Build a jax Mesh over the available devices — THE N-D mesh source of
+    truth for the whole package: the SPMD fused train step (Executor /
+    Module, 2-D ``("dp","mp")`` under partition rules — docs/sharding.md),
+    the ``tpu_sync`` kvstore's in-program collectives, and the serving /
+    generation layers all construct their meshes here.
 
-    make_mesh({'dp': 4, 'tp': 2}) or make_mesh(dp=4, tp=2).
+    make_mesh({'dp': 4, 'mp': 2}) or make_mesh(dp=4, mp=2).
+
+    ``install=False`` skips registering the mesh as the ambient
+    :func:`get_mesh` default: subsystems that own their mesh explicitly
+    (``Module.bind``, the generation engine) pass it so they never clobber a
+    user's ambient mesh (say an ep-only MoE mesh) from inside library code —
+    spooky action at a distance.
     """
     axes = dict(axes or {})
     axes.update(axis_kwargs)
@@ -49,30 +60,24 @@ def make_mesh(axes: Dict[str, int] = None, devices=None, **axis_kwargs) -> Mesh:
     for v in axes.values():
         size *= v
     if size > len(devices):
-        raise ValueError(f"mesh wants {size} devices, only {len(devices)} present")
+        raise ValueError(
+            f"mesh {dict(axes)} wants {size} devices, only "
+            f"{len(devices)} present")
     names = tuple(axes.keys())
     shape = tuple(axes.values())
-    dev_array = _np.asarray(devices[:size]).reshape(shape)
+    dev_array = _np.asarray(list(devices)[:size]).reshape(shape)
     mesh = Mesh(dev_array, names)
-    set_mesh(mesh)
+    if install:
+        set_mesh(mesh)
     return mesh
 
 
 def dp_mesh(ndev: int, devices=None, axis_name: str = "dp") -> Mesh:
-    """One-axis data-parallel mesh over ``ndev`` devices — the single mesh
-    source of truth for the SPMD fused train step (Executor/Module) and the
-    ``tpu_sync`` kvstore's in-program collectives.
-
-    Does NOT install itself as the ambient mesh: the fused step owns its mesh
-    explicitly, and clobbering a user's `make_mesh` (say an ep-only MoE mesh)
-    from inside `Module.bind` would be spooky action at a distance.
-    """
-    if devices is None:
-        devices = jax.devices()
-    if ndev > len(devices):
-        raise ValueError(
-            f"dp mesh wants {ndev} devices, only {len(devices)} present")
-    return Mesh(_np.asarray(list(devices)[:ndev]), (axis_name,))
+    """One-axis data-parallel mesh over ``ndev`` devices — a thin wrapper
+    over :func:`make_mesh` kept for the dp-only callers (bench, tests,
+    ``DataParallelExecutorManager``).  New multi-axis call sites should use
+    ``make_mesh`` directly (the single N-D source of truth)."""
+    return make_mesh({axis_name: int(ndev)}, devices=devices, install=False)
 
 
 def local_mesh(axis_name: str = "dp") -> Mesh:

@@ -20,7 +20,23 @@ from jax.sharding import Mesh, PartitionSpec
 
 from .mesh import get_mesh
 
-__all__ = ["moe_dispatch_combine", "moe_apply_sharded", "top1_routing"]
+__all__ = ["moe_dispatch_combine", "moe_apply_sharded", "top1_routing",
+           "moe_partition_rules"]
+
+
+def moe_partition_rules(axis_name: str = "ep"):
+    """Expert placement as a rule set (docs/sharding.md): expert param
+    stacks (leading expert dim) shard dim 0 over the expert axis, the
+    router replicates — the same ordered regex→PartitionSpec form
+    `Module.fit(shard_rules=...)` and `partition_rules.make_param_specs`
+    consume, so this module's hand-rolled ``pspec`` tree in
+    :func:`moe_apply_sharded` is expressible (and testable) as data.
+    The expert axis is the model axis: on the fused-step ("dp","mp") mesh
+    pass ``axis_name="mp"``."""
+    return (
+        (r"router", ()),                       # replicated gate
+        (r"expert|w_in$|w_out$", (axis_name,)),  # one expert per shard
+    )
 
 
 def top1_routing(x, router_w, num_experts, capacity):

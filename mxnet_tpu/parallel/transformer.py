@@ -323,3 +323,109 @@ def shard_batch(mesh: Mesh, tokens, labels, positions):
     pos = NamedSharding(mesh, P("sp"))
     return (jax.device_put(tokens, data), jax.device_put(labels, data),
             jax.device_put(positions, pos))
+
+
+# -- partition rules (docs/sharding.md) ---------------------------------------------
+def transformer_partition_rules(mp_axis: str = "mp"):
+    """The transformer LM's hand-rolled sharding, as a RULE SET — the form
+    `Module.fit(shard_rules=...)` and `Executor.fused_step` consume
+    (parallel/partition_rules.py), retiring this module's bespoke layout
+    code as the thing other models must copy.
+
+    Megatron-style tensor-parallel placement over the model axis: the QKV
+    and MLP-in projections shard their OUTPUT features, the attention-out /
+    MLP-out projections shard their INPUT features, embeddings shard the
+    vocab/feature dim, LayerNorm gains/biases replicate (first match wins;
+    the trailing catch-all keeps everything else replicated)."""
+    return (
+        (r"wqkv$|w1$", (None, mp_axis)),     # column-parallel (out features)
+        (r"wo$|w2$", (mp_axis, None)),       # row-parallel (in features)
+        (r"tok_emb$|pos_emb$", (None, mp_axis)),
+        (r"ln\w*_[gb]$|_b1$|_b2$", ()),      # norms + biases replicate
+    )
+
+
+def make_partitioned_train_step(mesh: Mesh, cfg: TransformerConfig,
+                                rules=None, lr=0.1, momentum=0.9,
+                                compute_dtype=None):
+    """The rule-set successor of :func:`make_sharded_train_step`: ONE
+    compiled dp×mp training step whose params and momenta are STORED
+    sharded per partition rules (docs/sharding.md) instead of replicated —
+    the island's hand-rolled layout folded into the same
+    gather/compute/slice FSDP discipline ``Module.fit`` uses, so training a
+    transformer bigger than one chip's HBM needs a rules tuple, not a
+    bespoke shard_map.
+
+    Layout: tokens/labels (B, T) sharded ``P('dp')``; positions replicated;
+    params/momenta sharded per ``rules`` (default
+    :func:`transformer_partition_rules`).  Gradients psum over ``dp`` only
+    — the mp axis carries shards, never replicas.  Returns ``(step,
+    shard_fn, gather_fn)``: ``step(params, momenta, tokens, labels,
+    positions) -> (loss, params, momenta)`` jitted with donated sharded
+    carries; ``shard_fn``/``gather_fn`` place/unplace a param dict
+    (checkpoint boundary).
+    """
+    from .collectives import shard_map_compat
+    from .partition_rules import (make_param_specs,
+                                  make_shard_and_gather_fns)
+
+    if rules is None:
+        rules = transformer_partition_rules()
+    key0 = jax.random.PRNGKey(0)
+    shapes = {k: tuple(v.shape)
+              for k, v in transformer_lm_init(cfg, key0).items()}
+    specs = make_param_specs(rules, shapes, mesh, mp_axis="mp")
+    mesh_sizes = {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+    dp = mesh_sizes.get("dp", 1)
+
+    def _axes_of(entry):
+        return entry if isinstance(entry, tuple) else (entry,)
+
+    def _gather(x, spec):
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                continue
+            for ax in reversed(_axes_of(entry)):
+                x = jax.lax.all_gather(x, ax, axis=dim, tiled=True)
+        return x
+
+    def _slice(x, spec):
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                continue
+            idx, nshard = 0, 1
+            for ax in _axes_of(entry):
+                idx = idx * mesh_sizes[ax] + jax.lax.axis_index(ax)
+                nshard *= mesh_sizes[ax]
+            size = x.shape[dim] // nshard
+            x = jax.lax.dynamic_slice_in_dim(x, idx * size, size, axis=dim)
+        return x
+
+    spec_of = {k: specs.get(k, ()) for k in shapes}
+    pspec_tree = {k: P(*spec_of[k]) for k in shapes}
+
+    def shard_step(params, momenta, tokens, labels, positions):
+        full = {k: _gather(v, spec_of[k]) for k, v in params.items()}
+
+        def local_loss(p):
+            return lm_loss(p, tokens, labels, positions, cfg,
+                           compute_dtype=compute_dtype) / dp
+
+        loss, grads = jax.value_and_grad(local_loss)(full)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, "dp"), grads)
+        loss = jax.lax.psum(loss, "dp")
+        grads = {k: _slice(g, spec_of[k]) for k, g in grads.items()}
+        momenta = jax.tree_util.tree_map(lambda m, g: momentum * m + g,
+                                         momenta, grads)
+        params = jax.tree_util.tree_map(lambda p, m: p - lr * m,
+                                        params, momenta)
+        return loss, params, momenta
+
+    fn = shard_map_compat(
+        shard_step, mesh=mesh,
+        in_specs=(pspec_tree, pspec_tree, P("dp"), P("dp"), P()),
+        out_specs=(P(), pspec_tree, pspec_tree), check=False)
+    step = jax.jit(fn, donate_argnums=(0, 1))
+    shard_fn, gather_fn = make_shard_and_gather_fns(specs, mesh)
+    return step, shard_fn, gather_fn

@@ -66,7 +66,10 @@ class GenerationConfig:
                  backpressure: Optional[str] = None,
                  default_deadline_ms: Optional[float] = None,
                  amp_dtype: Optional[str] = None,
-                 eos_token: Optional[int] = None):
+                 eos_token: Optional[int] = None,
+                 chunked_prefill: Optional[bool] = None,
+                 mp_devices: Optional[int] = None,
+                 shard_rules=None):
         self.max_slots = int(max_slots if max_slots is not None
                              else getenv("TPUMX_GEN_SLOTS", 4))
         if self.max_slots < 1:
@@ -110,6 +113,20 @@ class GenerationConfig:
         self.seq_buckets = (sorted(int(b) for b in seq_buckets)
                             if seq_buckets else None)
         self.eos_token = None if eos_token is None else int(eos_token)
+        # chunked prefill (docs/generation.md): long prompts split into
+        # seq-bucket-sized chunks through the same cache-aware prefill
+        # program instead of padding to the full ladder rung
+        self.chunked_prefill = bool(
+            chunked_prefill if chunked_prefill is not None
+            else getenv("TPUMX_GEN_CHUNKED_PREFILL", 1))
+        # model-parallel serving (docs/sharding.md): params sharded per
+        # partition rules over an mp mesh axis so a model bigger than one
+        # chip's HBM serves through the same engine
+        self.mp_devices = int(mp_devices if mp_devices is not None
+                              else getenv("TPUMX_GEN_MP_DEVICES", 1))
+        if self.mp_devices < 1:
+            raise ValueError("mp_devices must be >= 1")
+        self.shard_rules = shard_rules
 
     def __repr__(self):
         return (f"GenerationConfig(max_slots={self.max_slots}, "
@@ -251,7 +268,9 @@ class GenerationService:
             cfg.num_blocks, cfg.block_size,
             dtype=compute_dtype or jnp.float32)
         self._programs = GenerationPrograms(params, model_cfg,
-                                            compute_dtype=compute_dtype)
+                                            compute_dtype=compute_dtype,
+                                            mp_devices=cfg.mp_devices,
+                                            shard_rules=cfg.shard_rules)
         # prefill ladder: bounded by the model's position table — a prompt
         # must also leave room for at least one generated token
         max_prompt = model_cfg.max_len - 1
@@ -424,8 +443,9 @@ class GenerationService:
         S = cfg.max_slots
         zeros_s = _np.zeros(S, _np.int32)
         with _obs.span("serving.warmup", cat="serving"):
-            for tb in self._seq_buckets:
-                wp = blocks_for(tb, cfg.block_size)
+            # every (T, W) pair the chunk planner can emit — the plain
+            # per-rung ladder when chunked prefill is off
+            for tb, wp in self._prefill_signatures():
                 self._programs.run(
                     "gen_prefill", self._cache,
                     _np.zeros((1, tb), _np.int32),
@@ -600,27 +620,81 @@ class GenerationService:
         r.done_event.set()
 
     # -- model steps (engine thread, no lock held) --------------------------------
+    def _chunk_plan(self, prompt_len: int):
+        """Prefill chunking (docs/generation.md): ``[(off, take, T, W)]``.
+
+        A single entry is the legacy path — whole prompt padded to its
+        ladder rung, table width ``blocks_for(rung)``.  With chunked
+        prefill on and a prompt past the smallest rung, the prompt is
+        split greedily into rung-sized chunks fed through the SAME
+        cache-aware prefill program (each chunk writes its positions and
+        attends to everything already cached), so a 130-token prompt
+        costs 64+64+64 padded positions instead of 256.  Chunk table
+        widths are pow2-bucketed on the decode width ladder, keeping the
+        whole (T, W) signature set finite and warmup-enumerable.
+        """
+        cfg = self._config
+        rungs = self._seq_buckets
+        if not cfg.chunked_prefill or prompt_len <= rungs[0]:
+            tb = bucket_seq_len(prompt_len, rungs)
+            return [(0, prompt_len, tb, blocks_for(tb, cfg.block_size))]
+        chunks = []
+        off = 0
+        while off < prompt_len:
+            rem = prompt_len - off
+            fitting = [b for b in rungs if b <= rem]
+            tb = fitting[-1] if fitting else rungs[0]
+            take = min(rem, tb)
+            w = bucket_batch(blocks_for(off + tb, cfg.block_size),
+                             self._width_buckets)
+            chunks.append((off, take, tb, w))
+            off += take
+        if len(chunks) == 1:  # exactly one rung: identical to legacy
+            tb = bucket_seq_len(prompt_len, rungs)
+            return [(0, prompt_len, tb, blocks_for(tb, cfg.block_size))]
+        return chunks
+
+    def _prefill_signatures(self):
+        """Every (T, W) prefill signature the chunk planner can emit —
+        the warmup enumeration set (finite: one pass over the possible
+        prompt lengths, pure host arithmetic)."""
+        cfg = self._config
+        out = {(tb, blocks_for(tb, cfg.block_size))
+               for tb in self._seq_buckets}
+        if cfg.chunked_prefill:
+            for L in range(1, self._seq_buckets[-1] + 1):
+                for (_, _, tb, w) in self._chunk_plan(L):
+                    out.add((tb, w))
+        return sorted(out)
+
     def _prefill(self, r: _GenRequest) -> None:
         cfg = self._config
-        tb = r.bucket
-        wp = blocks_for(tb, cfg.block_size)
-        table = _np.zeros((1, wp), _np.int32)
-        n = min(wp, len(r.blocks))
-        table[0, :n] = r.blocks[:n]
-        tokens = pad_tokens_right(
-            _np.asarray(r.seq_tokens[:r.prompt_len], _np.int32), tb)[None, :]
-        positions = _np.arange(tb, dtype=_np.int32)[None, :]
-        with _obs.span("serving.prefill", cat="serving",
-                       args={"rid": r.rid, "len": r.prompt_len,
-                             "bucket": tb}):
-            next_tok, _ = self._programs.run(
-                "gen_prefill", self._cache, tokens, positions,
-                _np.asarray([r.prompt_len], _np.int32), table,
-                _np.asarray([r.seed], _np.uint32),
-                _np.asarray([r.prompt_len], _np.uint32),
-                _np.asarray([r.temperature], _np.float32),
-                _np.asarray([r.top_k], _np.int32),
-                _np.asarray([r.top_p], _np.float32))
+        next_tok = None
+        plan = self._chunk_plan(r.prompt_len)
+        for (off, take, tb, wp) in plan:
+            table = _np.zeros((1, wp), _np.int32)
+            n = min(wp, len(r.blocks))
+            table[0, :n] = r.blocks[:n]
+            tokens = pad_tokens_right(
+                _np.asarray(r.seq_tokens[off:off + take], _np.int32),
+                tb)[None, :]
+            positions = _np.arange(off, off + tb, dtype=_np.int32)[None, :]
+            with _obs.span("serving.prefill", cat="serving",
+                           args={"rid": r.rid, "len": r.prompt_len,
+                                 "bucket": tb, "off": off,
+                                 "chunks": len(plan)}):
+                # the sampler reads the chunk's last VALID row; only the
+                # final chunk's sample (global position prompt_len-1, the
+                # same seed/counter as the unchunked program) is emitted —
+                # intermediate chunks exist to fill the cache
+                next_tok, _ = self._programs.run(
+                    "gen_prefill", self._cache, tokens, positions,
+                    _np.asarray([take], _np.int32), table,
+                    _np.asarray([r.seed], _np.uint32),
+                    _np.asarray([r.prompt_len], _np.uint32),
+                    _np.asarray([r.temperature], _np.float32),
+                    _np.asarray([r.top_k], _np.int32),
+                    _np.asarray([r.top_p], _np.float32))
         r.ctx_len = r.prompt_len
         self._emit_token(r, int(next_tok[0]))
 

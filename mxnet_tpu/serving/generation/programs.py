@@ -51,13 +51,31 @@ def _model_step(params, k_pool, v_pool, tokens, positions, lengths,
 class GenerationPrograms:
     """Owns the jitted step + per-signature compile accounting."""
 
-    def __init__(self, params, cfg, compute_dtype=None):
+    def __init__(self, params, cfg, compute_dtype=None, mp_devices: int = 1,
+                 shard_rules=None):
         import jax
         import jax.numpy as jnp
 
         self._cfg = cfg
         self._compute_dtype = compute_dtype
-        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+        # model-parallel serving (docs/sharding.md): with mp_devices > 1 the
+        # params live sharded per partition rules over a 1-axis ``mp`` mesh
+        # — the SAME rule sets training uses — and the jitted global-view
+        # programs let GSPMD insert the collectives, so a model bigger than
+        # one chip's HBM decodes through unchanged engine plumbing
+        self._mp_mesh = None
+        self._mp_specs = None
+        if mp_devices and int(mp_devices) > 1:
+            from ...parallel.mesh import make_mesh
+            from ...parallel.partition_rules import make_param_specs
+            from ...parallel.transformer import transformer_partition_rules
+
+            self._mp_mesh = make_mesh({"mp": int(mp_devices)}, install=False)
+            rules = shard_rules or transformer_partition_rules()
+            self._mp_specs = make_param_specs(
+                rules, {k: tuple(v.shape) for k, v in params.items()},
+                self._mp_mesh, mp_axis="mp")
+        self._params = self._place_params(params)
         self._jit = jax.jit(
             functools.partial(_model_step, cfg=cfg,
                               compute_dtype=compute_dtype),
@@ -65,12 +83,21 @@ class GenerationPrograms:
         self._lock = threading.Lock()
         self._stats: Dict[tuple, Dict[str, int]] = {}
 
-    def refresh_params(self, params) -> None:
-        """Swap in updated model weights (programs are shape-keyed, so no
-        recompile — the next call simply runs with the new arrays)."""
+    def _place_params(self, params):
         import jax.numpy as jnp
 
-        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+        out = {k: jnp.asarray(v) for k, v in params.items()}
+        if self._mp_mesh is not None:
+            from ...parallel.partition_rules import shard_params
+
+            out = shard_params(out, self._mp_specs, self._mp_mesh)
+        return out
+
+    def refresh_params(self, params) -> None:
+        """Swap in updated model weights (programs are shape-keyed, so no
+        recompile — the next call simply runs with the new arrays, resharded
+        onto the mp mesh when one is configured)."""
+        self._params = self._place_params(params)
 
     def _key(self, kind: str, cache, tokens, block_tables) -> tuple:
         sig = (("tokens", tuple(tokens.shape), "int32"),

@@ -66,6 +66,12 @@ def pytest_configure(config):
         "`pytest -m generation`)")
     config.addinivalue_line(
         "markers",
+        "sharding: partition-rule-driven sharded model parallelism (tensor "
+        "parallel + FSDP state sharding over the (dp,mp) mesh, "
+        "mxnet_tpu.parallel.partition_rules, docs/sharding.md; select with "
+        "`pytest -m sharding`)")
+    config.addinivalue_line(
+        "markers",
         "observability: unified runtime observability (mxnet_tpu."
         "observability — metrics registry, structured tracing, recompile "
         "explainer, device-side train telemetry, docs/observability.md; "

@@ -539,3 +539,105 @@ def test_service_stats_and_compile_sites(params):
     assert "gen_prefill" in by_site and "gen_decode" in by_site
     assert by_site["gen_prefill"]["hits"] >= 1     # the real prefill
     assert by_site["gen_decode"]["hits"] >= 1
+
+
+# -- satellite: chunked prefill (docs/generation.md, PR 8) --------------------------
+def test_chunk_plan_shapes(params):
+    """Long prompts split into rung-sized chunks; short prompts and
+    chunking-off stay on the legacy single-rung plan."""
+    svc = GenerationService(params, CFG, _gc(chunked_prefill=True),
+                            start=False)
+    assert svc._chunk_plan(9) == [(0, 9, 16, blocks_for(16, 8))]
+    plan = svc._chunk_plan(30)
+    assert [c[:2] for c in plan] == [(0, 16), (16, 14)]
+    assert all(take <= tb for (_, take, tb, _) in plan)
+    # chunk widths cover every written position
+    for (off, take, tb, w) in plan:
+        assert w * 8 >= off + take
+    off_svc = GenerationService(params, CFG, _gc(chunked_prefill=False),
+                                start=False)
+    assert off_svc._chunk_plan(30) == [(0, 30, 32, blocks_for(32, 8))]
+    svc.stop()
+    off_svc.stop()
+
+
+def test_chunked_prefill_matches_unchunked_and_oracle(params):
+    """Greedy generations are identical with chunking on and off, and both
+    match the no-cache full-sequence oracle."""
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(0, CFG.vocab, n) for n in (3, 17, 25, 30, 16)]
+
+    def run(chunked):
+        svc = GenerationService(params, CFG,
+                                _gc(chunked_prefill=chunked), start=False)
+        svc.warmup()
+        svc.start()
+        outs = [svc.generate(p, max_new_tokens=6, temperature=0.0)
+                for p in prompts]
+        svc.stop()
+        return outs
+
+    on, off = run(True), run(False)
+    assert on == off
+    for p, toks in zip(prompts, on):
+        assert toks == _greedy_oracle(params, p, 6)
+
+
+def test_chunked_prefill_sampled_tokens_identical(params):
+    """The final chunk samples with the same seed/counter as the unchunked
+    program — temperature>0 tokens are bit-identical too."""
+    rs = np.random.RandomState(5)
+    prompt = rs.randint(0, CFG.vocab, 29)
+
+    def run(chunked):
+        svc = GenerationService(params, CFG,
+                                _gc(chunked_prefill=chunked), start=False)
+        svc.start()
+        out = svc.generate(prompt, max_new_tokens=8, temperature=0.9,
+                           top_k=10, seed=123)
+        svc.stop()
+        return out
+
+    assert run(True) == run(False)
+
+
+def test_chunked_prefill_zero_postwarmup_compiles(params, monkeypatch):
+    """Warmup enumerates every (T, W) pair the chunk planner can emit:
+    long prompts then run under TPUMX_FREEZE_COMPILES=1 with 1 miss per
+    signature."""
+    svc = GenerationService(params, CFG, _gc(chunked_prefill=True),
+                            start=False)
+    warmed = svc.warmup()
+    assert warmed == len(svc.compile_stats())
+    monkeypatch.setenv("TPUMX_FREEZE_COMPILES", "1")
+    rs = np.random.RandomState(11)
+    svc.start()
+    handles = [svc.submit(rs.randint(0, CFG.vocab, n), max_new_tokens=4)
+               for n in (31, 17, 24, 30, 5)]
+    for h in handles:
+        assert len(h.result(60)) == 4
+    stats = svc.compile_stats()
+    svc.stop()
+    monkeypatch.delenv("TPUMX_FREEZE_COMPILES")
+    assert all(v["misses"] == 1 for v in stats.values())
+
+
+def test_generation_mp_axis_matches_single_device(params):
+    """GenerationConfig(mp_devices=2): params live sharded over the mp
+    mesh (docs/sharding.md) and greedy decoding matches mp=1."""
+    rs = np.random.RandomState(7)
+    prompts = [rs.randint(0, CFG.vocab, n) for n in (4, 19, 30)]
+
+    def run(mp):
+        svc = GenerationService(params, CFG, _gc(mp_devices=mp),
+                                start=False)
+        if mp > 1:
+            emb = svc._programs._params["tok_emb"]
+            assert len(emb.sharding.device_set) == mp
+        svc.start()
+        outs = [svc.generate(p, max_new_tokens=5, temperature=0.0)
+                for p in prompts]
+        svc.stop()
+        return outs
+
+    assert run(2) == run(1)

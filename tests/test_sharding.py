@@ -1,0 +1,515 @@
+"""Partition-rule-driven sharded model parallelism (docs/sharding.md):
+rule matching (precedence, replicate default, divisibility fallback, FSDP
+sentinel), the transformer golden spec tree, fused-step parity across
+("dp","mp") layouts for SGD/Adam/Adam+AMP, per-chip memory reduction,
+compile discipline + the byte-identical rules=None escape, checkpoint
+round-trips across mesh shapes, and the recompile explainer's spec causes.
+
+Runs on the conftest-forced 8-virtual-CPU-device backend, like
+tests/test_spmd_fused.py.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.executor import compile_cache_stats
+from mxnet_tpu.parallel import partition_rules as pr
+
+pytestmark = pytest.mark.sharding
+
+ENVS = ("TPUMX_DP_DEVICES", "TPUMX_MP_DEVICES", "TPUMX_SHARD_RULES",
+        "TPUMX_AMP", "TPUMX_AMP_DTYPE", "TPUMX_AMP_LOSS_SCALE")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in ENVS:
+        monkeypatch.delenv(k, raising=False)
+    yield
+
+
+def _mesh(dp=2, mp=2):
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    return make_mesh({"dp": dp, "mp": mp}, install=False)
+
+
+def _net(nh=32, classes=4, bn=False):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    h = sym.FullyConnected(data, num_hidden=nh, name="fc1")
+    if bn:
+        h = sym.BatchNorm(h, name="bn1")
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(h, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(out, label, name="softmax")
+
+
+def _iter(n=320, dim=8, classes=4, batch=32):
+    r = np.random.RandomState(0)
+    Y = r.randint(0, classes, n).astype(np.float32)
+    X = r.rand(n, dim).astype(np.float32) * 0.3
+    for c in range(classes):
+        X[Y == c, c] += 1.0
+    return mx.io.NDArrayIter(X, Y, batch_size=batch)
+
+
+def _fit(monkeypatch, env, kvstore="tpu_sync", optimizer="sgd",
+         opt_params=(("learning_rate", 0.5),), bn=False, shard_rules=None,
+         num_epoch=1):
+    for k in ENVS:
+        monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = mx.mod.Module(_net(bn=bn), context=mx.cpu())
+    mod.fit(_iter(), num_epoch=num_epoch, optimizer=optimizer,
+            kvstore=kvstore, optimizer_params=opt_params,
+            shard_rules=shard_rules)
+    arg, aux = mod.get_params()
+    return (mod, {k: v.asnumpy() for k, v in arg.items()},
+            {k: v.asnumpy() for k, v in aux.items()})
+
+
+def _close(pa, pb, **kw):
+    kw.setdefault("rtol", 1e-5)
+    kw.setdefault("atol", 1e-7)
+    for k in pb:
+        np.testing.assert_allclose(pa[k], pb[k], err_msg=k, **kw)
+
+
+# ---------------------------------------------------------------------------
+# rule matching
+# ---------------------------------------------------------------------------
+
+def test_first_match_wins_and_unmatched_replicates():
+    rules = ((r"fc1_weight", ("mp", None)),
+             (r"fc1_.*", ("mp",)),            # must NOT override the above
+             (r".*_bias", (None,)))
+    out = pr.match_partition_rules(rules, {
+        "fc1_weight": (32, 8), "fc1_bias": (32,), "fc2_weight": (4, 32)})
+    assert out["fc1_weight"] == ("mp", None)   # rule 1, not the fc1_.* rule
+    assert out["fc1_bias"] == ("mp",)          # rule 2 beats .*_bias
+    assert out["fc2_weight"] == ()             # unmatched -> replicated
+
+
+def test_scalars_never_partition():
+    out = pr.match_partition_rules(((r".*", ("mp",)),),
+                                   {"s": (), "one": (1,), "v": (8,)})
+    assert out["s"] == () and out["one"] == ()
+    assert out["v"] == ("mp",)
+
+
+def test_divisibility_fallback_drops_axis():
+    mesh = _mesh(dp=2, mp=2)
+    # 7 % 2 != 0 -> the mp axis is dropped, not an error
+    assert pr.resolve_spec(("mp",), (7,), mesh) == ()
+    assert pr.resolve_spec(("mp", None), (7, 8), mesh) == ()
+    # second dim divides -> spec survives there
+    assert pr.resolve_spec((None, "mp"), (7, 8), mesh) == (None, "mp")
+    # unknown axis names are dropped too
+    assert pr.resolve_spec(("nope",), (8,), mesh) == ()
+
+
+def test_fsdp_sentinel_shards_first_divisible_dim():
+    mesh = _mesh(dp=2, mp=2)
+    assert pr.resolve_spec(pr.FSDP, (4, 6), mesh) == ("mp", None)
+    assert pr.resolve_spec(pr.FSDP, (7, 6), mesh) == (None, "mp")
+    assert pr.resolve_spec(pr.FSDP, (7, 7), mesh) == ()
+
+
+def test_make_param_specs_omits_trivial():
+    mesh = _mesh()
+    specs = pr.make_param_specs(((r".*", pr.FSDP),),
+                                {"w": (8, 4), "odd": (7,)}, mesh)
+    assert specs == {"w": ("mp", None)}
+
+
+def test_rules_from_env_parsing(monkeypatch):
+    monkeypatch.setenv("TPUMX_SHARD_RULES",
+                       r".*_weight=mp,-;emb=dp+mp,-;.*=fsdp")
+    rules = pr.rules_from_env()
+    assert rules == [(r".*_weight", ("mp",)), ("emb", (("dp", "mp"),)),
+                     (r".*", pr.FSDP)]
+    assert pr.rules_from_env("") is None
+    with pytest.raises(ValueError, match="regex=spec"):
+        pr.rules_from_env("no-equals-sign-here;")
+
+
+def test_transformer_golden_spec_tree():
+    """The bundled transformer param tree resolves to the Megatron-style
+    golden layout (docs/sharding.md)."""
+    import jax
+
+    from mxnet_tpu.parallel import transformer as tr
+
+    cfg = tr.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                               d_ff=64, max_len=64)
+    params = tr.transformer_lm_init(cfg, jax.random.PRNGKey(0))
+    mesh = _mesh(dp=2, mp=2)
+    specs = pr.make_param_specs(tr.transformer_partition_rules(), params,
+                                mesh)
+    golden = {
+        "tok_emb": (None, "mp"), "pos_emb": (None, "mp"),
+    }
+    for i in range(cfg.n_layers):
+        golden[f"l{i}_wqkv"] = (None, "mp")   # column parallel
+        golden[f"l{i}_w1"] = (None, "mp")
+        golden[f"l{i}_wo"] = ("mp",)          # row parallel (trailing
+        golden[f"l{i}_w2"] = ("mp",)          # replicated dims trimmed)
+    assert specs == golden  # norms/biases replicate -> omitted
+
+
+def test_moe_rules_shard_expert_stacks():
+    mesh = _mesh(dp=2, mp=2)
+    from mxnet_tpu.parallel.moe import moe_partition_rules
+
+    specs = pr.make_param_specs(
+        moe_partition_rules(axis_name="mp"),
+        {"router_w": (16, 4), "expert_w_in": (2, 16, 32),
+         "expert_w_out": (2, 32, 16)}, mesh)
+    assert specs == {"expert_w_in": ("mp",), "expert_w_out": ("mp",)}
+
+
+# ---------------------------------------------------------------------------
+# fused-step parity across layouts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", (("learning_rate", 0.5),)),
+    ("sgd", (("learning_rate", 0.5), ("momentum", 0.9))),
+    ("adam", (("learning_rate", 0.05),)),
+], ids=["sgd", "sgd_momentum", "adam"])
+def test_mp_parity_10_steps(monkeypatch, optimizer, opt_params):
+    """10 steps on 2x2 and 1x2 ("dp","mp") meshes match the single-device
+    fused step at rtol 1e-5; params live sharded while training."""
+    _, p1, _ = _fit(monkeypatch, {}, kvstore="local", optimizer=optimizer,
+                    opt_params=opt_params)
+    m22, p22, _ = _fit(monkeypatch,
+                       {"TPUMX_DP_DEVICES": "2", "TPUMX_MP_DEVICES": "2"},
+                       optimizer=optimizer, opt_params=opt_params)
+    m12, p12, _ = _fit(monkeypatch, {"TPUMX_MP_DEVICES": "2"},
+                       optimizer=optimizer, opt_params=opt_params)
+    assert m22._fused_step_count == 10
+    assert m12._fused_step_count == 10
+    assert m22._exec._spmd_param_specs  # FSDP default rules engaged
+    _close(p22, p1)
+    _close(p12, p1)
+
+
+def test_mp_amp_master_weights_parity(monkeypatch):
+    """Adam + AMP fp16 dynamic loss scaling under mp sharding: the mp-only
+    layout matches single-device tightly, mp is invariant at fixed dp, and
+    the scaler takes the identical trajectory everywhere."""
+    amp = {"TPUMX_AMP": "1", "TPUMX_AMP_DTYPE": "float16",
+           "TPUMX_AMP_LOSS_SCALE": "dynamic"}
+    m1, p1, _ = _fit(monkeypatch, dict(amp), kvstore="local",
+                     optimizer="adam", opt_params=(("learning_rate", 0.05),))
+    mM, pM, _ = _fit(monkeypatch, dict(amp, TPUMX_MP_DEVICES="2"),
+                     optimizer="adam", opt_params=(("learning_rate", 0.05),))
+    mD, pD, _ = _fit(monkeypatch, dict(amp, TPUMX_DP_DEVICES="2"),
+                     optimizer="adam", opt_params=(("learning_rate", 0.05),))
+    mB, pB, _ = _fit(monkeypatch,
+                     dict(amp, TPUMX_DP_DEVICES="2", TPUMX_MP_DEVICES="2"),
+                     optimizer="adam", opt_params=(("learning_rate", 0.05),))
+    _close(pM, p1)          # mp-only == single device (tight)
+    _close(pB, pD)          # mp invariant at dp=2 (tight)
+    scales = [float(np.asarray(m._loss_scaler.state()[0]))
+              for m in (m1, mM, mD, mB)]
+    assert len(set(scales)) == 1, scales
+
+
+def test_mp_bn_aux_invariant(monkeypatch):
+    """BatchNorm running stats take the IDENTICAL trajectory with and
+    without the mp axis at fixed dp (per-dp-shard batch statistics are a
+    dp property, docs/multichip.md; mp must not perturb them)."""
+    _, pD, aD = _fit(monkeypatch, {"TPUMX_DP_DEVICES": "2"}, bn=True)
+    _, pB, aB = _fit(monkeypatch,
+                     {"TPUMX_DP_DEVICES": "2", "TPUMX_MP_DEVICES": "2"},
+                     bn=True)
+    _close(pB, pD)
+    _close(aB, aD)
+    # and at dp=1, BN matches the single device bitwise
+    _, p1, a1 = _fit(monkeypatch, {}, kvstore="local", bn=True)
+    _, pM, aM = _fit(monkeypatch, {"TPUMX_MP_DEVICES": "2"}, bn=True)
+    _close(pM, p1)
+    _close(aM, a1)
+
+
+def test_explicit_rules_and_env_rules(monkeypatch):
+    """A tensor-parallel rules tuple at fit() — and the same via
+    TPUMX_SHARD_RULES — trains to the same params as the default."""
+    rules = ((r"fc\d+_weight", ("mp", None)), (r".*", ()))
+    _, p1, _ = _fit(monkeypatch, {}, kvstore="local")
+    mR, pR, _ = _fit(monkeypatch, {"TPUMX_MP_DEVICES": "2"},
+                     shard_rules=rules)
+    assert mR._exec._spmd_param_specs == {
+        "fc1_weight": ("mp",), "fc2_weight": ("mp",)}
+    _close(pR, p1)
+    mE, pE, _ = _fit(monkeypatch, {"TPUMX_MP_DEVICES": "2",
+                                   "TPUMX_SHARD_RULES":
+                                       r"fc\d+_weight=mp,-"})
+    assert mE._exec._spmd_param_specs == mR._exec._spmd_param_specs
+    _close(pE, p1)
+
+
+# ---------------------------------------------------------------------------
+# memory
+# ---------------------------------------------------------------------------
+
+def test_mp2_memory_at_most_60_percent(monkeypatch):
+    """Live param + optimizer-state bytes per chip at mp=2 measure <= 60%
+    of the replicated dp-only layout (live-array accounting — the
+    memory-reduction headline)."""
+    def live_bytes(mod):
+        arrs = [mod._exec.arg_dict[n] for n in mod._param_names]
+        arrs += [mod._updater.states[i] for i in mod._updater.states]
+        per = pr.bytes_per_device(arrs)
+        return max(per.values())
+
+    mR, _, _ = _fit(monkeypatch, {"TPUMX_DP_DEVICES": "2"},
+                    optimizer="adam", opt_params=(("learning_rate", 0.05),))
+    mS, _, _ = _fit(monkeypatch,
+                    {"TPUMX_DP_DEVICES": "2", "TPUMX_MP_DEVICES": "2"},
+                    optimizer="adam", opt_params=(("learning_rate", 0.05),))
+    repl, shard = live_bytes(mR), live_bytes(mS)
+    assert shard <= 0.6 * repl, (shard, repl)
+
+
+def test_executor_fp16_master_weights_sharded():
+    """fp16 params + multi_precision: the (master_f32, inner) state pytree
+    shards on mp like its param — the AMP master-weight leg of the
+    acceptance criteria, exercised at the executor level."""
+    import jax
+
+    from mxnet_tpu.optimizer import create as create_opt
+
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.MakeLoss(sym.sum(out))
+    ex = net.simple_bind(ctx=mx.cpu(),
+                         grad_req={"data": "null", "fc1_weight": "write",
+                                   "fc1_bias": "write"},
+                         data=(8, 8))
+    for n, a in ex.arg_dict.items():
+        if n != "data":
+            a._data = a._data.astype("float16")
+    for n, g in ex.grad_dict.items():
+        g._data = g._data.astype("float16")
+    mesh = _mesh(dp=2, mp=2)
+    specs = pr.make_param_specs(pr.DEFAULT_FSDP_RULES,
+                                {n: tuple(ex.arg_dict[n].shape)
+                                 for n in ("fc1_weight", "fc1_bias")}, mesh)
+    ex.set_spmd(mesh, batch_args=("data",), param_specs=specs)
+    opt = create_opt("sgd", learning_rate=0.1, momentum=0.9,
+                     multi_precision=True, rescale_grad=1.0)
+    states = {n: opt.create_state_multi_precision(i, ex.arg_dict[n])
+              for i, n in enumerate(("fc1_weight", "fc1_bias"))}
+    updates = [("fc1_weight", 0), ("fc1_bias", 1)]
+    feed = {"data": nd.array(np.random.rand(8, 8).astype(np.float32))}
+    ex.fused_step(opt, states, updates, feed=feed, num_steps=1)
+    master = states["fc1_weight"][0]
+    assert str(master._data.dtype) == "float32"
+    # the f32 master occupies half its full bytes on each device (mp=2)
+    per = pr.bytes_per_device([master])
+    full = 16 * 8 * 4
+    assert set(per.values()) == {full // 2}
+
+
+# ---------------------------------------------------------------------------
+# compile discipline & escape hatches
+# ---------------------------------------------------------------------------
+
+def test_mp_compile_discipline(monkeypatch):
+    """20 fused steps at fixed shapes on the 2x2 mesh: exactly ONE compile."""
+    for k, v in {"TPUMX_DP_DEVICES": "2", "TPUMX_MP_DEVICES": "2"}.items():
+        monkeypatch.setenv(k, v)
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    before = compile_cache_stats()
+    mod.fit(_iter(), num_epoch=2, optimizer="sgd", kvstore="tpu_sync",
+            optimizer_params=(("learning_rate", 0.1),))
+    after = compile_cache_stats()
+    assert mod._fused_step_count == 20
+    assert after["misses"] - before["misses"] == 1
+    assert after["hits"] - before["hits"] == 19
+
+
+def test_rules_none_keeps_dp_signature_byte_identical():
+    """With no partition specs the executor signature (and hence every
+    compile key) carries no spec/meshshape entries — bit-identical to the
+    PR 4/5 dp-only layout."""
+    from mxnet_tpu.parallel.mesh import dp_mesh, make_mesh
+
+    ex = _net().simple_bind(ctx=mx.cpu(), data=(32, 8), softmax_label=(32,))
+    ex.set_spmd(dp_mesh(2), batch_args=("data", "softmax_label"))
+    sig_dp = ex._signature(True)
+    assert not any(isinstance(s, tuple) and s[0] in ("spec", "meshshape")
+                   for s in sig_dp)
+    ex2 = _net().simple_bind(ctx=mx.cpu(), data=(32, 8),
+                             softmax_label=(32,))
+    ex2.set_spmd(dp_mesh(2), batch_args=("data", "softmax_label"),
+                 param_specs=None)
+    assert ex2._signature(True) == sig_dp
+    # attaching specs keys fresh programs; detaching restores exactly
+    mesh = make_mesh({"dp": 2, "mp": 2}, install=False)
+    ex.set_spmd(mesh, batch_args=("data", "softmax_label"),
+                param_specs={"fc1_weight": ("mp", None)})
+    sig_mp = ex._signature(True)
+    assert any(isinstance(s, tuple) and s[0] == "spec" for s in sig_mp)
+    assert sig_mp != sig_dp
+
+
+def test_spmd_escape_hatch_disables_mp(monkeypatch):
+    monkeypatch.setenv("TPUMX_FUSED_STEP_SPMD", "0")
+    m, _, _ = _fit(monkeypatch, {"TPUMX_FUSED_STEP_SPMD": "0",
+                                 "TPUMX_MP_DEVICES": "2"})
+    assert m._fused_step_count == 0
+    assert m._exec._spmd_mesh is None
+
+
+def test_spec_change_renders_in_recompile_explainer():
+    from mxnet_tpu.observability.recompile import explain_key_diff
+
+    old = ("fused_step", (True, ("fc1_weight", (32, 8), "float32"),
+                          ("mesh", "dp", 2, 4, ("data",)),
+                          ("meshshape", (("dp", 2), ("mp", 2))),
+                          ("spec", "fc1_weight", ("dp", None))))
+    new = ("fused_step", (True, ("fc1_weight", (32, 8), "float32"),
+                          ("mesh", "dp", 2, 4, ("data",)),
+                          ("meshshape", (("dp", 4), ("mp", 1))),
+                          ("spec", "fc1_weight", ("dp", "mp"))))
+    causes = explain_key_diff(old, new)
+    assert "spec p('dp',None)→p('dp','mp') (fc1_weight)" in causes
+    assert any(c.startswith("mesh shape dp=2×mp=2→dp=4×mp=1")
+               for c in causes)
+
+
+# ---------------------------------------------------------------------------
+# checkpoints
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_across_mesh_shapes(monkeypatch, tmp_path):
+    """A sharded model saves the SAME host-side arrays as the replicated
+    layout, and a checkpoint saved under one mesh shape restores under
+    another (including back to a single device)."""
+    mR, _, _ = _fit(monkeypatch, {"TPUMX_DP_DEVICES": "2"}, bn=True)
+    mS, _, _ = _fit(monkeypatch,
+                    {"TPUMX_DP_DEVICES": "2", "TPUMX_MP_DEVICES": "2"},
+                    bn=True)
+    mR.save_checkpoint(str(tmp_path / "repl"), 1)
+    mS.save_checkpoint(str(tmp_path / "shard"), 1)
+    _, r_arg, r_aux = mx.model.load_checkpoint(str(tmp_path / "repl"), 1)
+    _, s_arg, s_aux = mx.model.load_checkpoint(str(tmp_path / "shard"), 1)
+    for k in r_arg:
+        np.testing.assert_array_equal(s_arg[k].asnumpy(), r_arg[k].asnumpy())
+    for k in r_aux:
+        np.testing.assert_array_equal(s_aux[k].asnumpy(), r_aux[k].asnumpy())
+    # restore under a DIFFERENT mesh (1x2) and under no mesh at all
+    for env in ({"TPUMX_MP_DEVICES": "2"}, {}):
+        for k in ENVS:
+            monkeypatch.delenv(k, raising=False)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        mx.random.seed(0)
+        np.random.seed(0)
+        mod = mx.mod.Module(_net(bn=True), context=mx.cpu())
+        mod.fit(_iter(), num_epoch=1, optimizer="sgd",
+                kvstore="tpu_sync" if env else "local",
+                arg_params=s_arg, aux_params=s_aux,
+                optimizer_params=(("learning_rate", 0.1),))
+        assert mod._fused_step_count == 10
+
+
+def test_shard_and_gather_fns_roundtrip():
+    import jax.numpy as jnp
+
+    mesh = _mesh(dp=2, mp=2)
+    params = {"w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+              "b": jnp.arange(4, dtype=jnp.float32)}
+    specs = pr.make_param_specs(pr.DEFAULT_FSDP_RULES, params, mesh)
+    shard_fn, gather_fn = pr.make_shard_and_gather_fns(specs, mesh)
+    sharded = shard_fn(params)
+    assert len(sharded["w"].sharding.device_set) == 4
+    back = gather_fn(sharded)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(back[k]),
+                                      np.asarray(params[k]))
+
+
+# ---------------------------------------------------------------------------
+# io.shard_data_batch generalization
+# ---------------------------------------------------------------------------
+
+def test_shard_data_batch_axis_and_errors():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.io import DataBatch, shard_data_batch
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"repl": 2, "batch": 4}, install=False)
+    b = DataBatch([nd.array(np.random.rand(32, 8).astype(np.float32))],
+                  [nd.array(np.random.rand(32).astype(np.float32))])
+    shard_data_batch(b, mesh, axis="batch")
+    assert len(b.data[0]._data.devices()) == 8  # placed over the full mesh
+    with pytest.raises(MXNetError, match="not an axis"):
+        shard_data_batch(b, mesh, axis="dp")
+    bad = DataBatch([nd.array(np.random.rand(30, 8).astype(np.float32))])
+    # default: indivisible arrays are skipped (legacy-path fallback)
+    shard_data_batch(bad, mesh, axis="batch")
+    assert len(bad.data[0]._data.devices()) == 1
+    # strict: a clear error naming batch size and axis size
+    with pytest.raises(MXNetError,
+                       match=r"batch size 30 .* 'batch' of size 4"):
+        shard_data_batch(bad, mesh, axis="batch", strict=True)
+
+
+# ---------------------------------------------------------------------------
+# the transformer island as a rule set
+# ---------------------------------------------------------------------------
+
+def test_partitioned_train_step_matches_oracle():
+    """make_partitioned_train_step (params/momenta STORED sharded per the
+    transformer rule set) matches the single-device train_step oracle."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import transformer as tr
+
+    cfg = tr.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                               d_ff=64, max_len=32)
+    params = tr.transformer_lm_init(cfg, jax.random.PRNGKey(0))
+    momenta = jax.tree_util.tree_map(jnp.zeros_like, params)
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(0, cfg.vocab, (8, 16)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, cfg.vocab, (8, 16)), jnp.int32)
+    positions = jnp.arange(16, dtype=jnp.int32)
+
+    p_ref = {k: v for k, v in params.items()}
+    m_ref = {k: v for k, v in momenta.items()}
+    losses_ref = []
+    for _ in range(3):
+        loss, p_ref, m_ref = tr.train_step(p_ref, m_ref, tokens, labels,
+                                           positions, cfg)
+        losses_ref.append(float(loss))
+
+    mesh = _mesh(dp=2, mp=2)
+    step, shard_fn, gather_fn = tr.make_partitioned_train_step(mesh, cfg)
+    p = shard_fn({k: jnp.array(v, copy=True) for k, v in params.items()})
+    m = shard_fn({k: jnp.array(v, copy=True) for k, v in momenta.items()})
+    assert len(p["l0_wqkv"].sharding.device_set) == 4
+    losses = []
+    for _ in range(3):
+        loss, p, m = step(p, m, tokens, labels, positions)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, losses_ref, rtol=1e-5)
+    p_full = gather_fn(p)
+    for k in p_ref:
+        np.testing.assert_allclose(np.asarray(p_full[k]),
+                                   np.asarray(p_ref[k]), rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
