@@ -639,6 +639,9 @@ def lm_decode_throughput(requests: int = None, clients: int = None):
     total_tokens = stats["counts"]["tokens"]
     n_chips = max(1, len(jax.local_devices()))
     return {
+        # "paged" (Pallas block-table kernel) vs "gather" (dense XLA path):
+        # the trajectory attributes decode wins to the active kernel
+        "kernel": stats.get("decode_kernel", "gather"),
         "tokens_per_sec": round(total_tokens / wall, 1),
         "tokens_per_sec_per_chip": round(total_tokens / wall / n_chips, 1),
         "ttft_p50_ms": stats["ttft_ms"]["p50"],
@@ -653,6 +656,111 @@ def lm_decode_throughput(requests: int = None, clients: int = None):
         "post_warmup_compiles": sum(
             st["misses"] for st in compile_stats.values()) - warmed,
     }
+
+
+def pallas_kernels_bench():
+    """Per-kernel microbenchmarks (docs/pallas.md): paged decode attention,
+    flash-attention forward+backward, and fused LayerNorm — each timed
+    against its XLA-composed counterpart at serving/training-shaped inputs,
+    reporting per-call µs and achieved GB/s so kernel regressions show up
+    in the BENCH trajectory next to the e2e numbers.  ``BENCH_PALLAS=0``
+    skips the block.  On CPU hosts the kernels run interpreted (numbers are
+    parity-smoke only; the TPU rounds are the real measurement)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import flash_attention as fa
+    from mxnet_tpu.ops import paged_attention as pa
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    iters = int(os.environ.get("BENCH_PALLAS_ITERS", "30"))
+    rs = np.random.RandomState(0)
+
+    def timeit(fn):
+        out = fn()                       # compile + warm
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    def entry(t_kernel, t_xla, nbytes):
+        return {
+            "kernel_us": round(t_kernel * 1e6, 2),
+            "xla_us": round(t_xla * 1e6, 2),
+            "speedup_vs_xla": round(t_xla / t_kernel, 3),
+            "kernel_gbps": round(nbytes / t_kernel / 1e9, 2),
+        }
+
+    out = {"iters": iters, "interpreted": pk._use_interpret()}
+
+    # -- paged decode attention: B slots of T=1 against a W-block table ----
+    B, H, D, bs, W = 8, 8, 64, 32, 16
+    nb = B * W + 1
+    q = jnp.asarray(rs.randn(B, 1, H, D).astype(np.float32))
+    kp = jnp.asarray(rs.randn(nb, bs, H, D).astype(np.float32))
+    vp = jnp.asarray(rs.randn(nb, bs, H, D).astype(np.float32))
+    tables = np.arange(1, B * W + 1, dtype=np.int32).reshape(B, W)
+    positions = np.full((B, 1), W * bs - 1, np.int32)
+    max_pos = np.full(B, W * bs - 1, np.int32)
+    ctx_pos = np.arange(W * bs, dtype=np.int32)
+    mask = jnp.asarray(ctx_pos[None, None, :] <= positions[:, :, None])
+    scale = pa.attention_scale(D)
+    jt = jnp.asarray(tables)
+
+    @jax.jit
+    def dense(q, kp, vp, jt, mask):
+        k_ctx = kp[jt].reshape(B, W * bs, H, D)
+        v_ctx = vp[jt].reshape(B, W * bs, H, D)
+        return pa.paged_attention_reference(q, k_ctx, v_ctx, mask,
+                                            jnp.float32(scale))
+
+    kv_bytes = 2 * B * W * bs * H * D * 4   # the K/V context each token reads
+    out["paged_attention"] = entry(
+        timeit(lambda: pa.paged_attention(q, kp, vp, tables, positions,
+                                          max_pos, scale)),
+        timeit(lambda: dense(q, kp, vp, jt, mask)), kv_bytes)
+
+    # -- flash attention fwd+bwd at a training shape -----------------------
+    Bf, Tf, Hf, Df = 2, 512, 4, 64
+    qf = jnp.asarray(rs.randn(Bf, Tf, Hf, Df).astype(np.float32))
+    prev_gate = os.environ.get("TPUMX_PALLAS")
+
+    def flash_grad():
+        return jax.grad(lambda x: jnp.sum(
+            pk.flash_attention(x, qf, qf, causal=True) ** 2))(qf)
+
+    try:
+        os.environ["TPUMX_PALLAS"] = "1"
+        t_kernel = timeit(flash_grad)
+        os.environ["TPUMX_PALLAS"] = "0"
+        t_scan = timeit(flash_grad)
+    finally:
+        if prev_gate is None:
+            os.environ.pop("TPUMX_PALLAS", None)
+        else:
+            os.environ["TPUMX_PALLAS"] = prev_gate
+    # fwd+bwd reads q/k/v/g/o and writes dq/dk/dv ≈ 8 passes over (B,T,H,D)
+    out["flash_attention_bwd"] = entry(t_kernel, t_scan,
+                                       8 * Bf * Tf * Hf * Df * 4)
+
+    # -- fused LayerNorm at the LM's channels-minor shape ------------------
+    M, C = 4096, 512
+    x = jnp.asarray(rs.randn(M, C).astype(np.float32))
+    g = jnp.asarray(rs.rand(C).astype(np.float32))
+    b = jnp.asarray(rs.randn(C).astype(np.float32))
+
+    @jax.jit
+    def ln_xla(x, g, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+    out["layer_norm_fused"] = entry(
+        timeit(lambda: pk.layer_norm_fused(x, g, b)),
+        timeit(lambda: ln_xla(x, g, b)), 2 * M * C * 4)
+    return out
 
 
 def telemetry_overhead(batch: int = None, steps: int = None):
@@ -930,6 +1038,12 @@ def main():
         except Exception as e:  # optional block: failure is a field, not rc!=0
             sys.stderr.write(f"decode bench failed: {type(e).__name__}: {e}\n")
             result["decode_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_PALLAS", "1") == "1":
+        try:
+            result["pallas_kernels"] = pallas_kernels_bench()
+        except Exception as e:  # optional block: failure is a field, not rc!=0
+            sys.stderr.write(f"pallas bench failed: {type(e).__name__}: {e}\n")
+            result["pallas_error"] = f"{type(e).__name__}: {e}"
     try:
         # every bench result carries the process registry (docs/
         # observability.md): compile-cache counters, serving p50/p99/QPS,

@@ -264,6 +264,17 @@ class Executor:
 
     def _signature(self, is_train: bool) -> tuple:
         sig = [is_train]
+        # the Pallas kernel layer changes the traced program (fused LN et
+        # al., docs/pallas.md): with the gate ON its programs key
+        # separately, so a cross-process A/B — or an ill-advised mid-run
+        # env flip — recompiles (and is explained) instead of silently
+        # serving the other implementation.  Gate OFF appends NOTHING:
+        # TPUMX_PALLAS=0 signatures are byte-identical to the pre-kernel
+        # layout, preserving warm caches and freeze sets.
+        from .ops.pallas_kernels import pallas_enabled
+
+        if pallas_enabled():
+            sig.append(("pallas", 1))
         for n in self._arg_names:
             a = self.arg_dict[n]
             sig.append((n, a.shape, str(a.dtype)))

@@ -10,12 +10,19 @@ makes long-context first-class, and this is the single-device leg the
 sequence-parallel ring composes with (`parallel/ring_attention.py` holds
 the cross-chip m/l merge).
 
-Backward is the memory-efficient recompute form as a lax.scan over k/v
-blocks (one (Bq, Bk) score tile live at a time) — XLA fuses it well and it
-keeps O(T) residency without a second hand kernel.
+Backward (docs/pallas.md): under the ``TPUMX_PALLAS`` gate the dq and
+dk/dv passes are true Pallas kernels — the forward additionally emits the
+per-row logsumexp, and both backward kernels replay the score tile from
+VMEM-resident q/k blocks (``p = exp(s - lse)``) with causal block
+skipping, so the whole recompute stays tiled in fast memory end-to-end
+(FlashAttention, Dao et al.).  ``TPUMX_PALLAS=0`` restores the previous
+memory-efficient lax.scan recompute (`_bwd_scan`) byte-for-byte.
 
-On CPU (tests, virtual meshes) the SAME kernel runs through the Pallas
-interpreter (`MXTPU_PALLAS_INTERPRET` / non-TPU backend, like the other
+Block sizes are selected from dtype and head dim to fit the ~16MB VMEM
+budget (``select_flash_blocks``; ``TPUMX_FLASH_BLOCK_Q``/``_K`` override).
+
+On CPU (tests, virtual meshes) the SAME kernels run through the Pallas
+interpreter (`TPUMX_PALLAS_INTERPRET` / non-TPU backend, like the other
 kernels in pallas_kernels.py).  Oracle: tests/test_flash_attention.py
 checks outputs AND gradients against `parallel.ring_attention.local_attention`.
 """
@@ -23,6 +30,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -39,8 +47,50 @@ def _use_interpret():
     return impl()
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                bq: int, bk: int, causal: bool, scale: float, t_real: int):
+def _use_pallas_bwd():
+    from .pallas_kernels import pallas_enabled
+
+    return pallas_enabled()
+
+
+def select_flash_blocks(d_head: int, dtype):
+    """(block_q, block_k) sized to VMEM from dtype and head dim.
+
+    Per grid step the kernel holds the q tile plus double-buffered k/v
+    tiles (lane dim padded to 128 by Mosaic for d_head < 128), the f32
+    accumulator scratch, and up to three (bq, bk) f32 score tiles in the
+    backward (p, dp, ds).  Blocks grow together in powers of two from 128
+    while that footprint fits a ~4.5MB slice of the 16MB VMEM — larger
+    tiles amortize the online-softmax rescale and the MXU ramp.
+    ``TPUMX_FLASH_BLOCK_Q``/``TPUMX_FLASH_BLOCK_K`` pin either explicitly.
+    """
+    env_q = os.environ.get("TPUMX_FLASH_BLOCK_Q")
+    env_k = os.environ.get("TPUMX_FLASH_BLOCK_K")
+    if env_q or env_k:
+        bq = int(env_q) if env_q else 128
+        return bq, int(env_k) if env_k else bq
+    item = jnp.dtype(dtype).itemsize
+    lane_d = max(int(d_head), 128)  # Mosaic pads the minor dim to a lane
+
+    def cost(bq, bk):
+        tiles = (bq + 2 * bk) * lane_d * item * 2      # double-buffered
+        scratch = bq * lane_d * 4 + 2 * bq * 4          # f32 acc + m/l
+        scores = 3 * bq * bk * 4                        # p/dp/ds (bwd)
+        return tiles + scratch + scores
+
+    bq = bk = 128
+    while bq < 512 and cost(bq * 2, bk * 2) <= 4.5 * 1024 * 1024:
+        bq *= 2
+        bk *= 2
+    return bq, bk
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, bq: int, bk: int, causal: bool,
+                scale: float, t_real: int, with_lse: bool):
+    if with_lse:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        (o_ref, acc_ref, m_ref, l_ref), lse_ref = refs, None
     # grid = (bh, q blocks, k blocks); kj is the INNERMOST (sequential)
     # dim, so the VMEM scratch (acc/m/l) carries the online-softmax state
     # across k blocks while only ONE (bk, d) k/v tile is resident — true
@@ -86,38 +136,187 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         o_ref[0] = (acc_ref[:] /
                     jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
                     ).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # per-row logsumexp of the masked scaled scores — the backward
+            # kernels' recompute anchor (p = exp(s - lse)).  Padded rows
+            # stay finite: their q is zero, so s == 0 on surviving columns.
+            lse_ref[0] = m_ref[:, 0] + jnp.log(
+                jnp.maximum(l_ref[:, 0], 1e-30))
+
+
+def _sds(shape, dtype, like):
+    # inside shard_map (Ulysses impl="flash") outputs must carry the
+    # inputs' varying-mesh-axes annotation or check_vma rejects them
+    # (jax.typeof/vma only exist on jax versions that HAVE check_vma;
+    # older releases use check_rep, where a plain ShapeDtypeStruct is
+    # exactly right)
+    if hasattr(jax, "typeof"):
+        return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("t_real", "causal", "bq", "bk",
-                                             "scale", "interpret"))
-def _fwd_call(q3, k3, v3, t_real, causal, bq, bk, scale, interpret):
+                                             "scale", "interpret",
+                                             "with_lse"))
+def _fwd_call(q3, k3, v3, t_real, causal, bq, bk, scale, interpret,
+              with_lse=False):
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t_pad, d = q3.shape
     grid = (bh, t_pad // bq, t_pad // bk)
     kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, causal=causal,
-                               scale=scale, t_real=t_real)
+                               scale=scale, t_real=t_real, with_lse=with_lse)
+    o_shape = _sds((bh, t_pad, d), q3.dtype, q3)
+    o_spec = pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0))
+    if with_lse:
+        out_shape = (o_shape, _sds((bh, t_pad), jnp.float32, q3))
+        out_specs = (o_spec, pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)))
+    else:
+        out_shape, out_specs = o_shape, o_spec
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
                   pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
                   pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0))],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-        # inside shard_map (Ulysses impl="flash") the output must carry the
-        # inputs' varying-mesh-axes annotation or check_vma rejects it
-        # (jax.typeof/vma only exist on jax versions that HAVE check_vma;
-        # older releases use check_rep, where a plain ShapeDtypeStruct is
-        # exactly right)
-        out_shape=(jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype,
-                                        vma=jax.typeof(q3).vma)
-                   if hasattr(jax, "typeof")
-                   else jax.ShapeDtypeStruct((bh, t_pad, d), q3.dtype)),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32)],
         interpret=interpret,
     )(q3, k3, v3)
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward: dq kernel (grid over q blocks, k innermost) and a fused
+# dk/dv kernel (grid over k blocks, q innermost).  Both replay the (bq, bk)
+# score tile in VMEM from the forward's lse — no T×T residency, causal
+# blocks above the diagonal skipped exactly like the forward.
+# ---------------------------------------------------------------------------
+
+def _bwd_mask(qi, kj, bq, bk, t_real, causal):
+    kpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = kpos < t_real
+    if causal:
+        qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        mask = mask & (kpos <= qpos)
+    return mask
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, bq: int, bk: int, causal: bool, scale: float,
+               t_real: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    live = (kj * bk <= (qi + 1) * bq - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale       # (bq, d), scaled
+        k = k_ref[0].astype(jnp.float32)               # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = jnp.where(_bwd_mask(qi, kj, bq, bk, t_real, causal), s, _NEG)
+        p = jnp.exp(s - lse_ref[0][:, None])           # masked cols → 0
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_acc, dv_acc, *, bq: int, bk: int, causal: bool,
+                scale: float, t_real: int):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: q blocks strictly above the k block's diagonal see none of it
+    live = ((qi + 1) * bq - 1 >= kj * bk) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale       # (bq, d), scaled
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = jnp.where(_bwd_mask(qi, kj, bq, bk, t_real, causal), s, _NEG)
+        p = jnp.exp(s - lse_ref[0][:, None])           # (bq, bk)
+        dv_acc[:] += jax.lax.dot_general(               # pᵀ @ g
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        dk_acc[:] += jax.lax.dot_general(               # dsᵀ @ q_scaled
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("t_real", "causal", "bq", "bk",
+                                             "scale", "interpret"))
+def _bwd_call(q3, k3, v3, g3, lse, delta, t_real, causal, bq, bk, scale,
+              interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t_pad, d = q3.shape
+    q_spec = pl.BlockSpec((1, bq, d), lambda i, a, b: (i, a, 0))
+    q_spec_inner = pl.BlockSpec((1, bq, d), lambda i, a, b: (i, b, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda i, a, b: (i, b, 0))
+    k_spec_outer = pl.BlockSpec((1, bk, d), lambda i, a, b: (i, a, 0))
+    row_spec = pl.BlockSpec((1, bq), lambda i, a, b: (i, a))
+    row_spec_inner = pl.BlockSpec((1, bq), lambda i, a, b: (i, b))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, bq=bq, bk=bk, causal=causal,
+                          scale=scale, t_real=t_real),
+        grid=(bh, t_pad // bq, t_pad // bk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=_sds((bh, t_pad, d), q3.dtype, q3),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, bq=bq, bk=bk, causal=causal,
+                          scale=scale, t_real=t_real),
+        grid=(bh, t_pad // bk, t_pad // bq),
+        in_specs=[q_spec_inner, k_spec_outer, k_spec_outer, q_spec_inner,
+                  row_spec_inner, row_spec_inner],
+        out_specs=(k_spec_outer, k_spec_outer),
+        out_shape=(_sds((bh, t_pad, d), k3.dtype, k3),
+                   _sds((bh, t_pad, d), v3.dtype, v3)),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, g3, lse, delta)
+    return dq, dk, dv
 
 
 def _bwd_scan(q3, k3, v3, o3, g3, t_real, causal, scale, bk):
@@ -189,11 +388,15 @@ def _pad_to(x, t_pad):
     return x
 
 
+def _pad_grid(t_real, bq, bk):
+    t_pad = ((t_real + bq - 1) // bq) * bq
+    return ((t_pad + bk - 1) // bk) * bk
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q3, k3, v3, t_real, causal, blocks, scale):
     bq, bk = blocks
-    t_pad = ((t_real + bq - 1) // bq) * bq
-    t_pad = ((t_pad + bk - 1) // bk) * bk
+    t_pad = _pad_grid(t_real, bq, bk)
     out = _fwd_call(_pad_to(q3, t_pad), _pad_to(k3, t_pad),
                     _pad_to(v3, t_pad), t_real, causal, bq, bk, scale,
                     _use_interpret())
@@ -201,17 +404,40 @@ def _flash(q3, k3, v3, t_real, causal, blocks, scale):
 
 
 def _flash_fwd(q3, k3, v3, t_real, causal, blocks, scale):
+    bq, bk = blocks
+    if _use_pallas_bwd():
+        # forward once more WITH the lse output — the anchor the Pallas
+        # backward kernels recompute p from (a with_lse=False program would
+        # throw the softmax stats away)
+        t_pad = _pad_grid(t_real, bq, bk)
+        out_p, lse = _fwd_call(_pad_to(q3, t_pad), _pad_to(k3, t_pad),
+                               _pad_to(v3, t_pad), t_real, causal, bq, bk,
+                               scale, _use_interpret(), with_lse=True)
+        return out_p[:, :t_real], (q3, k3, v3, out_p[:, :t_real], lse)
     out = _flash(q3, k3, v3, t_real, causal, blocks, scale)
-    return out, (q3, k3, v3, out)
+    return out, (q3, k3, v3, out, None)
 
 
 def _flash_bwd(t_real, causal, blocks, scale, res, g):
-    q3, k3, v3, out = res
+    q3, k3, v3, out, lse = res
     bq, bk = blocks
-    t_pad = ((t_real + bk - 1) // bk) * bk
-    dq, dk, dv = _bwd_scan(_pad_to(q3, t_pad), _pad_to(k3, t_pad),
-                           _pad_to(v3, t_pad), _pad_to(out, t_pad),
-                           _pad_to(g, t_pad), t_real, causal, scale, bk)
+    if lse is not None:
+        t_pad = _pad_grid(t_real, bq, bk)
+        g_pad = _pad_to(g, t_pad)
+        o_pad = _pad_to(out, t_pad)
+        # delta = rowsum(dO * O): one cheap elementwise pass; zero-padded g
+        # zeroes every padded row's contribution inside the kernels
+        delta = jnp.sum(g_pad.astype(jnp.float32)
+                        * o_pad.astype(jnp.float32), axis=-1)
+        dq, dk, dv = _bwd_call(_pad_to(q3, t_pad), _pad_to(k3, t_pad),
+                               _pad_to(v3, t_pad), g_pad, lse, delta,
+                               t_real, causal, bq, bk, scale,
+                               _use_interpret())
+    else:
+        t_pad = ((t_real + bk - 1) // bk) * bk
+        dq, dk, dv = _bwd_scan(_pad_to(q3, t_pad), _pad_to(k3, t_pad),
+                               _pad_to(v3, t_pad), _pad_to(out, t_pad),
+                               _pad_to(g, t_pad), t_real, causal, scale, bk)
     return dq[:, :t_real], dk[:, :t_real], dv[:, :t_real]
 
 
@@ -219,13 +445,18 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False, scale=None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = None, block_k: int = None):
     """(B, T, H, D) attention with O(T) memory.  Drop-in for
     `parallel.ring_attention.local_attention` (same signature/semantics,
     incl. the optional softmax scale), usable as the `attention=` callable
-    of the transformer LM and behind the `_contrib_flash_attention` op."""
+    of the transformer LM and behind the `_contrib_flash_attention` op.
+    Block sizes default to :func:`select_flash_blocks` (dtype/head-dim
+    VMEM fit); pass ``block_q``/``block_k`` to pin them."""
     B, T, H, D = q.shape
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    sel_q, sel_k = select_flash_blocks(D, q.dtype)
+    block_q = int(block_q) if block_q else sel_q
+    block_k = int(block_k) if block_k else sel_k
     if T >= block_q:
         bq = block_q
     else:

@@ -324,7 +324,17 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
 
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
-    ax = int(axis)
+    ax = int(axis) % data.ndim
+    if ax == data.ndim - 1 and not output_mean_var:
+        # channels-minor path: fused Pallas stats+normalize under the
+        # TPUMX_PALLAS gate (docs/pallas.md) — one activation read instead
+        # of the mean pass + var/normalize pass XLA composes here.  Trace-
+        # time gate, same A/B discipline as MXTPU_BN_PALLAS above.
+        from . import pallas_kernels as _pk
+
+        if _pk.pallas_enabled():
+            return _pk.layer_norm_fused(data, gamma, beta,
+                                        eps=float(eps)).astype(data.dtype)
     mean = jnp.mean(data, axis=ax, keepdims=True)
     var = jnp.var(data, axis=ax, keepdims=True)
     out = (data - mean) * lax.rsqrt(var + eps)

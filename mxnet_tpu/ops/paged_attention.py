@@ -1,0 +1,189 @@
+"""Paged flash-decode attention (vLLM's PagedAttention, as a Pallas TPU
+kernel).
+
+The generation engine's decode hot path (parallel/transformer.py
+``transformer_lm_decode``) historically GATHERED the whole paged KV context
+into contiguous ``(B, W*bs, H, D)`` arrays and ran dense attention over the
+full table-width bucket every token — per-token HBM traffic scaling with
+the bucket width, and a full materialized copy of the cache slice besides.
+This kernel walks the block table INSIDE the kernel instead: the table is a
+scalar-prefetch operand (``PrefetchScalarGridSpec``), so the index map
+streams exactly the K/V blocks the row owns from the donated pool straight
+through VMEM, accumulating with the online-softmax m/l recurrence (the same
+scheme as ops/flash_attention.py's forward).  Null table slots (the block-0
+sentinel) and blocks past the row's last written position are redirected to
+block 0 and skipped — consecutive identical block indices mean Mosaic never
+re-issues the DMA, so dead grid steps cost neither bandwidth nor compute.
+
+One kernel serves BOTH generation phases: decode (``T=1`` single queries
+per slot) and (chunked) prefill (``T=seq-bucket`` chunk attending to
+everything already cached, including its own freshly scattered K/V).
+Masking is by cache-position <= query-position, exactly the dense path's
+mask, so bucketed table widths never perturb real rows.
+
+Gating: ``mxnet_tpu.ops.pallas_kernels.pallas_enabled()`` — default on for
+TPU, ``TPUMX_PALLAS=0`` restores the gather+dense XLA path byte-for-byte
+(``paged_attention_reference`` below IS that path, verbatim).  On CPU the
+same kernel runs through the Pallas interpreter (tier-1's parity leg);
+tools/tpu_parity.py re-checks interpreter-vs-native on a real chip.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+__all__ = ["paged_attention", "paged_attention_reference", "attention_scale"]
+
+
+def attention_scale(d_head: int) -> float:
+    """1/sqrt(d) computed in f32 — bit-identical to the traced
+    ``1.0 / jnp.sqrt(d).astype(f32)`` the dense decode path uses (host f64
+    sqrt can differ in the last ulp)."""
+    import numpy as _np
+
+    return float(_np.float32(1.0) / _np.sqrt(_np.float32(d_head)))
+
+
+def paged_attention_reference(q, k_ctx, v_ctx, attn_mask, scale):
+    """The gather+dense attend, verbatim from transformer_lm_decode — the
+    ``TPUMX_PALLAS=0`` path and the kernel's parity oracle.
+
+    q: (B, T, H, D); k_ctx/v_ctx: (B, W*bs, H, D) gathered context;
+    attn_mask: (B, T, W*bs) bool; scale: f32 scalar.  Same numerics as
+    ring_attention.local_attention: f32 scores and accumulation, masked
+    slots at exactly 0 probability.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_ctx,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(attn_mask[:, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_ctx.dtype), v_ctx,
+                   preferred_element_type=jnp.float32).astype(q.dtype)
+    return o
+
+
+def _paged_kernel(tables_ref, maxpos_ref, q_ref, pos_ref, k_ref, v_ref,
+                  o_ref, acc_ref, m_ref, l_ref, *, bs: int, t: int,
+                  scale: float):
+    # grid = (B, H, W); W is the INNERMOST (sequential) dim, so the VMEM
+    # scratch (acc/m/l) carries the online-softmax state across the row's
+    # cache blocks while only ONE (bs, D) K/V tile is resident
+    b = pl.program_id(0)
+    w = pl.program_id(2)
+    nw = pl.num_programs(2)
+
+    @pl.when(w == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # dead blocks: null sentinel (table entry 0 — the allocator never hands
+    # out physical block 0) or wholly past the row's last valid query
+    # position.  The index map already redirected their DMA to block 0.
+    live = (tables_ref[b, w] != 0) & (w * bs <= maxpos_ref[b])
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale      # (T, D)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)              # (bs, D)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        ctx = w * bs + jax.lax.broadcasted_iota(jnp.int32, (t, bs), 1)
+        mask = ctx <= pos_ref[0][:, None]   # cache pos <= query pos
+        s = jnp.where(mask, s, _NEG)
+        m_old = m_ref[:, 0]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_old - m_new)
+        l_ref[:, 0] = alpha * l_ref[:, 0] + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, 0] = m_new
+
+    @pl.when(w == nw - 1)
+    def _emit():
+        # fully-skipped rows (inactive slots, all-null tables) emit 0 —
+        # the dense path's output there is garbage either way
+        o_ref[0, :, 0, :] = (
+            acc_ref[:] / jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _paged_call(tables, max_pos, q, positions, k_pool, v_pool, scale,
+                interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    _, bs, _, _ = k_pool.shape
+    W = tables.shape[1]
+
+    def kv_index(b, h, w, tables_ref, maxpos_ref):
+        # dead blocks redirect to the null block: consecutive identical
+        # indices skip the re-fetch, so dead grid steps cost no HBM traffic
+        blk = tables_ref[b, w]
+        return (jnp.where(w * bs > maxpos_ref[b], 0, blk), 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, W),
+        in_specs=[
+            pl.BlockSpec((1, T, 1, D), lambda b, h, w, *_: (b, 0, h, 0)),
+            pl.BlockSpec((1, T), lambda b, h, w, *_: (b, 0)),
+            pl.BlockSpec((1, bs, 1, D), kv_index),
+            pl.BlockSpec((1, bs, 1, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, T, 1, D),
+                               lambda b, h, w, *_: (b, 0, h, 0)),
+        scratch_shapes=[pltpu.VMEM((T, D), jnp.float32),
+                        pltpu.VMEM((T, 1), jnp.float32),
+                        pltpu.VMEM((T, 1), jnp.float32)],
+    )
+    kernel = functools.partial(_paged_kernel, bs=bs, t=T, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, T, H, D), q.dtype),
+        interpret=interpret,
+    )(tables, max_pos, q, positions, k_pool, v_pool)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, positions, max_pos,
+                    scale=None):
+    """Attention of ``q`` against a paged KV pool, walking the block table
+    in-kernel.
+
+    Parameters
+    ----------
+    q : (B, T, H, D) — this chunk's queries (T=1 decode, T=bucket prefill).
+    k_pool, v_pool : (num_blocks, block_size, H, D) — ONE layer's pool
+        (already holding this chunk's scattered K/V).
+    block_tables : (B, W) int32 — physical block of each logical block;
+        0 is the null sentinel.
+    positions : (B, T) int32 — global position of each query (in-range).
+    max_pos : (B,) int32 — last VALID query position per row (−1 for
+        inactive rows: every block is skipped and the output is 0).
+    scale : float, optional — softmax scale; default
+        :func:`attention_scale` of D.
+
+    Returns (B, T, H, D) in q's dtype, matching
+    :func:`paged_attention_reference` at rtol 1e-5 (f32) on valid rows.
+    """
+    from .pallas_kernels import _use_interpret
+
+    B, T, H, D = q.shape
+    if scale is None:
+        scale = attention_scale(D)
+    return _paged_call(
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(max_pos, jnp.int32), q,
+        jnp.asarray(positions, jnp.int32), k_pool, v_pool, float(scale),
+        _use_interpret())

@@ -13,12 +13,29 @@ one fused pass computes sign thresholding, error-feedback residual, and the
 from __future__ import annotations
 
 import functools
+import os
+import warnings
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _LANES = 16  # 2-bit codes per uint32 word (reference layout)
+
+
+def pallas_enabled() -> bool:
+    """The single ``TPUMX_PALLAS`` gate for the hot-path kernel layer
+    (docs/pallas.md): paged decode attention, the flash-attention backward
+    kernels, and fused LayerNorm.  Default ON for TPU backends;
+    ``TPUMX_PALLAS=0`` restores the XLA-composed paths (and their compile
+    keys) byte-identically, ``=1`` forces the kernels on CPU through the
+    Pallas interpreter (the tier-1 parity leg).  Read at TRACE time — like
+    ``MXTPU_BN_PALLAS``, A/B it across processes, not mid-run.
+    """
+    forced = os.environ.get("TPUMX_PALLAS")
+    if forced is not None:
+        return forced != "0"
+    return jax.default_backend() == "tpu"
 
 
 def _twobit_pack_kernel(g_ref, res_ref, thresh_ref, packed_ref, newres_ref):
@@ -85,13 +102,26 @@ def _unpack_call(packed2d, thresh, dtype, interpret):
     )(packed2d, thresh)
 
 
-def _use_interpret() -> bool:
-    # MXTPU_PALLAS_INTERPRET=1 forces the interpreter even on a TPU host —
-    # the two-backend oracle (tools/tpu_parity.py) needs a CPU-interpreted
-    # reference leg that is NOT the native Mosaic lowering being checked
-    import os
+_ALIAS_WARNED = False
 
-    forced = os.environ.get("MXTPU_PALLAS_INTERPRET")
+
+def _use_interpret() -> bool:
+    # TPUMX_PALLAS_INTERPRET=1 forces the interpreter even on a TPU host —
+    # the two-backend oracle (tools/tpu_parity.py) needs a CPU-interpreted
+    # reference leg that is NOT the native Mosaic lowering being checked.
+    # MXTPU_PALLAS_INTERPRET is the pre-rename spelling, honored with a
+    # one-time warning (every other knob in the tree is TPUMX_*).
+    global _ALIAS_WARNED
+
+    forced = os.environ.get("TPUMX_PALLAS_INTERPRET")
+    if forced is None:
+        forced = os.environ.get("MXTPU_PALLAS_INTERPRET")
+        if forced is not None and not _ALIAS_WARNED:
+            _ALIAS_WARNED = True
+            warnings.warn(
+                "MXTPU_PALLAS_INTERPRET is deprecated; use "
+                "TPUMX_PALLAS_INTERPRET (same semantics)",
+                DeprecationWarning, stacklevel=2)
     if forced is not None:
         return forced == "1"
     return jax.default_backend() != "tpu"
@@ -277,3 +307,105 @@ def _bn_fused_bwd(eps, channel_axis, res, g):
 
 
 bn_train_fused.defvjp(_bn_fused_fwd, _bn_fused_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused LayerNorm(+GELU) — the channels-minor normalization the transformer
+# LM runs twice per block per token (parallel/transformer.py _ln and the
+# registered LayerNorm op, ops/nn.py).  Same one-read-two-sums shape as
+# bn_train_fused, but the reduction is PER ROW (the 128-lane minor dim), so
+# stats and normalize fuse into ONE kernel: one HBM read, one write — the
+# XLA graph reads the activation twice (mean pass + var/normalize pass) and
+# materializes the centered intermediate.  The optional GELU epilogue folds
+# the activation of a following MLP in the same write.  Gated behind
+# TPUMX_PALLAS (pallas_enabled); backward is the jnp reference's vjp, like
+# bn_train_fused.
+# ---------------------------------------------------------------------------
+
+def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps: float, gelu: bool):
+    xf = x_ref[...].astype(jnp.float32)
+    c = xf.shape[-1]
+    # per-row pivot recenter (one lane) keeps the one-pass E[x^2]-mean^2
+    # form from cancelling at large mean/std — same trick as _bn_stats
+    pivot = xf[:, :1]
+    xc = xf - pivot
+    mean_c = jnp.sum(xc, axis=1, keepdims=True) / c
+    var = jnp.maximum(
+        jnp.sum(xc * xc, axis=1, keepdims=True) / c - mean_c * mean_c, 0.0)
+    out = (xc - mean_c) * jax.lax.rsqrt(var + eps) \
+        * g_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    if gelu:
+        out = jax.nn.gelu(out)
+    o_ref[...] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("eps", "gelu", "block_m", "interpret"))
+def _ln_call(x2d, gamma, beta, eps, gelu, block_m, interpret):
+    m, c = x2d.shape
+    return pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps, gelu=gelu),
+        grid=(m // block_m,),
+        in_specs=[pl.BlockSpec((block_m, c), lambda i: (i, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((block_m, c), lambda i: (i, 0)),
+        # same vma-annotation dance as the flash forward: inside shard_map
+        # the output must carry the inputs' varying mesh axes when the jax
+        # generation checks them (jax.typeof only exists on those versions)
+        out_shape=(jax.ShapeDtypeStruct((m, c), x2d.dtype,
+                                        vma=jax.typeof(x2d).vma)
+                   if hasattr(jax, "typeof")
+                   else jax.ShapeDtypeStruct((m, c), x2d.dtype)),
+        interpret=interpret,
+    )(x2d, gamma.reshape(1, c), beta.reshape(1, c))
+
+
+def _ln_reference(x, gamma, beta, eps, gelu):
+    """jnp reference of the fused forward — the vjp donor AND the
+    kernel-hostile-shape fallback.  f32 stats regardless of x dtype (the
+    kernel computes the same way)."""
+    xf = x.astype(jnp.float32)
+    pivot = jax.lax.stop_gradient(xf[..., :1])
+    xc = xf - pivot
+    mean_c = jnp.mean(xc, axis=-1, keepdims=True)
+    var = jnp.maximum(jnp.mean(xc * xc, axis=-1, keepdims=True)
+                      - mean_c * mean_c, 0.0)
+    out = (xc - mean_c) * jax.lax.rsqrt(var + eps) \
+        * gamma.astype(jnp.float32) + beta.astype(jnp.float32)
+    if gelu:
+        out = jax.nn.gelu(out)
+    return out.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def layer_norm_fused(x, gamma, beta, eps=1e-5, gelu=False):
+    """Fused LayerNorm over the LAST axis of ``x`` (any rank); ``gamma`` /
+    ``beta`` are ``(C,)``.  ``gelu=True`` applies the GELU epilogue to the
+    normalized output in the same kernel pass.  Kernel-hostile row counts
+    (odd M) fall back to the jnp reference, like bn_train_fused."""
+    out, _res = _ln_fused_fwd(x, gamma, beta, eps, gelu)
+    return out
+
+
+def _ln_fused_fwd(x, gamma, beta, eps, gelu):
+    shape = x.shape
+    c = shape[-1]
+    x2d = x.reshape(-1, c)
+    block_m = _bn_block_m(x2d.shape[0])
+    if block_m < 8:
+        return _ln_reference(x, gamma, beta, eps, gelu), (x, gamma, beta)
+    out2d = _ln_call(x2d, gamma, beta, float(eps), bool(gelu), block_m,
+                     _use_interpret())
+    return out2d.reshape(shape), (x, gamma, beta)
+
+
+def _ln_fused_bwd(eps, gelu, res, g):
+    x, gamma, beta = res
+    _, vjp = jax.vjp(
+        lambda x_, g_, b_: _ln_reference(x_, g_, b_, eps, gelu), x, gamma,
+        beta)
+    return vjp(g)
+
+
+layer_norm_fused.defvjp(_ln_fused_fwd, _ln_fused_bwd)

@@ -84,6 +84,11 @@ def transformer_lm_init(cfg: TransformerConfig, key) -> Params:
 
 
 def _ln(x, g, b, eps=1e-5):
+    from ..ops import pallas_kernels as _pk
+    if _pk.pallas_enabled():
+        # fused stats+normalize kernel (docs/pallas.md): one read one
+        # write; custom-vjp backward keeps training grads exact
+        return _pk.layer_norm_fused(x, g, b, eps=eps).astype(x.dtype)
     mu = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
     return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
@@ -125,7 +130,8 @@ def transformer_lm_apply(params: Params, tokens, positions,
 
 def transformer_lm_decode(params: Params, tokens, positions, lengths,
                           k_pool, v_pool, block_tables,
-                          cfg: TransformerConfig, compute_dtype=None):
+                          cfg: TransformerConfig, compute_dtype=None,
+                          attention_kernel: Optional[str] = None):
     """Cache-aware forward: read/write a paged per-layer KV cache.
 
     The generation engine's one model step, serving BOTH phases
@@ -179,6 +185,26 @@ def transformer_lm_decode(params: Params, tokens, positions, lengths,
     attn_mask = ctx_pos[None, None, :] <= positions[:, :, None]  # (B,T,W*bs)
     # bit-identical scale to local_attention's (f32 sqrt, not host f64)
     scale = 1.0 / jnp.sqrt(cfg.d_head).astype(jnp.float32)
+    # TPUMX_PALLAS (docs/pallas.md): walk the block table INSIDE a Pallas
+    # kernel — K/V blocks stream through VMEM, dead blocks are skipped —
+    # instead of gathering the whole (B, W*bs) bucket per token.  Read at
+    # trace time; =0 keeps the gather+dense path (and its programs) intact.
+    # ``attention_kernel`` ("paged"/"gather") pins the choice explicitly —
+    # GenerationPrograms freezes it per service (and forces "gather" under
+    # an mp mesh, where GSPMD can't partition an opaque kernel call).
+    from ..ops import pallas_kernels as _pk
+    from ..ops import paged_attention as _pa
+    from ..ops.paged_attention import paged_attention_reference as \
+        _pa_reference
+    if attention_kernel is None:
+        use_paged = _pk.pallas_enabled()
+    else:
+        use_paged = attention_kernel == "paged"
+    if use_paged:
+        # last valid query position per row; -1 (inactive slots) skips
+        # every block and the row's output is garbage, same as the oracle
+        max_pos = jnp.max(jnp.where(valid, positions, -1), axis=1)
+        kernel_scale = _pa.attention_scale(cfg.d_head)
 
     x = params["tok_emb"][tokens] + jnp.take(params["pos_emb"], positions,
                                              axis=0)
@@ -191,21 +217,20 @@ def transformer_lm_decode(params: Params, tokens, positions, lengths,
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
         k_pool = k_pool.at[i, phys, offs].set(k.astype(k_pool.dtype))
         v_pool = v_pool.at[i, phys, offs].set(v.astype(v_pool.dtype))
-        k_ctx = k_pool[i][block_tables].reshape(B, W * block_size,
-                                                cfg.n_heads, cfg.d_head)
-        v_ctx = v_pool[i][block_tables].reshape(B, W * block_size,
-                                                cfg.n_heads, cfg.d_head)
-        # same numerics as ring_attention.local_attention (f32 scores and
-        # accumulation), with the causal mask generalized to cache-position
-        # <= query-position — padded/unwritten slots land at exactly 0
-        # probability (exp(-1e30 - m) underflows), so bucketed table widths
-        # never perturb real rows
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_ctx,
-                       preferred_element_type=jnp.float32) * scale
-        s = jnp.where(attn_mask[:, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_ctx.dtype), v_ctx,
-                       preferred_element_type=jnp.float32).astype(q.dtype)
+        if use_paged:
+            o = _pa.paged_attention(q, k_pool[i], v_pool[i], block_tables,
+                                    positions, max_pos, scale=kernel_scale)
+        else:
+            k_ctx = k_pool[i][block_tables].reshape(B, W * block_size,
+                                                    cfg.n_heads, cfg.d_head)
+            v_ctx = v_pool[i][block_tables].reshape(B, W * block_size,
+                                                    cfg.n_heads, cfg.d_head)
+            # same numerics as ring_attention.local_attention (f32 scores
+            # and accumulation), with the causal mask generalized to
+            # cache-position <= query-position — padded/unwritten slots
+            # land at exactly 0 probability (exp(-1e30 - m) underflows),
+            # so bucketed table widths never perturb real rows
+            o = _pa_reference(q, k_ctx, v_ctx, attn_mask, scale)
         x = x + o.reshape(B, T, cfg.d_model) @ g("wo")
         h = _ln(x, g("ln2_g"), g("ln2_b"))
         x = x + jax.nn.gelu(h @ g("w1") + g("b1")) @ g("w2") + g("b2")

@@ -828,6 +828,7 @@ class GenerationService:
             "inter_token_ms": {"p50": _ms(pct(itl, 50)),
                                "p99": _ms(pct(itl, 99))},
             "compiled_signatures": self._programs.compiled_signatures(),
+            "decode_kernel": self._programs.kernel,
             "seq_buckets": list(self._seq_buckets),
             "width_buckets": list(self._width_buckets),
             "closed": self._closed,

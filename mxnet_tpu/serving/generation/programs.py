@@ -28,7 +28,7 @@ __all__ = ["GenerationPrograms"]
 
 def _model_step(params, k_pool, v_pool, tokens, positions, lengths,
                 block_tables, seeds, counters, temperature, top_k, top_p,
-                *, cfg, compute_dtype):
+                *, cfg, compute_dtype, attention_kernel="gather"):
     import jax.numpy as jnp
 
     from ...ops.sampling import sample_logits
@@ -36,7 +36,8 @@ def _model_step(params, k_pool, v_pool, tokens, positions, lengths,
 
     logits, k_pool, v_pool = transformer_lm_decode(
         params, tokens, positions, lengths, k_pool, v_pool, block_tables,
-        cfg, compute_dtype=compute_dtype)
+        cfg, compute_dtype=compute_dtype,
+        attention_kernel=attention_kernel)
     # logits at the LAST VALID position of each row feed the sampler
     # (prefill: position len-1 predicts token len; decode: T=1 row 0)
     last_idx = jnp.clip(jnp.asarray(lengths, jnp.int32) - 1, 0,
@@ -75,10 +76,19 @@ class GenerationPrograms:
             self._mp_specs = make_param_specs(
                 rules, {k: tuple(v.shape) for k, v in params.items()},
                 self._mp_mesh, mp_axis="mp")
+        # the attention kernel (docs/pallas.md) is frozen at service
+        # construction: TPUMX_PALLAS read ONCE here, and an mp mesh forces
+        # the gather path (GSPMD cannot partition an opaque Pallas call) —
+        # so a mid-run env flip can never desync keys from traced programs
+        from ...ops.pallas_kernels import pallas_enabled
+
+        self._kernel = ("paged" if self._mp_mesh is None and
+                        pallas_enabled() else "gather")
         self._params = self._place_params(params)
         self._jit = jax.jit(
             functools.partial(_model_step, cfg=cfg,
-                              compute_dtype=compute_dtype),
+                              compute_dtype=compute_dtype,
+                              attention_kernel=self._kernel),
             donate_argnums=(1, 2))
         self._lock = threading.Lock()
         self._stats: Dict[tuple, Dict[str, int]] = {}
@@ -99,10 +109,24 @@ class GenerationPrograms:
         onto the mp mesh when one is configured)."""
         self._params = self._place_params(params)
 
+    @property
+    def kernel(self) -> str:
+        """Active decode-attention implementation: ``"paged"`` (the Pallas
+        block-table-walking kernel, docs/pallas.md) or ``"gather"`` (the
+        gather+dense XLA path).  Frozen at construction from the
+        ``TPUMX_PALLAS`` gate (gather under an mp mesh) — the bench
+        trajectory attributes wins via this field."""
+        return self._kernel
+
     def _key(self, kind: str, cache, tokens, block_tables) -> tuple:
         sig = (("tokens", tuple(tokens.shape), "int32"),
                ("block_tables", tuple(block_tables.shape), "int32"),
                ("kv_pool", cache.shape, str(cache.dtype)))
+        # the paged kernel variant keys its programs separately, while
+        # gather (TPUMX_PALLAS=0) keys stay byte-identical to the
+        # pre-kernel layout — warm caches and freeze sets carry over
+        if self.kernel == "paged":
+            sig = sig + (("kernel", "paged"),)
         return (kind, sig)
 
     def run(self, kind: str, cache, tokens, positions, lengths,
@@ -115,13 +139,17 @@ class GenerationPrograms:
         """
         from ... import executor as _executor
 
+        kernel = self.kernel
         key = self._key(kind, cache, tokens, block_tables)
         with self._lock:
             per = self._stats.get(key)
             hit = per is not None
             if per is None:
                 per = self._stats[key] = {"hits": 0, "misses": 0}
-        _executor._note_cache(hit=hit, site=(kind, ("lm",)), key=key)
+        # program variants count per-site in compile_cache_stats()["by_site"]
+        # — "gen_decode_paged" next to the classic "gen_decode"
+        site_kind = kind if kernel == "gather" else f"{kind}_{kernel}"
+        _executor._note_cache(hit=hit, site=(site_kind, ("lm",)), key=key)
         with self._lock:
             per["hits" if hit else "misses"] += 1
         next_tokens, last, k, v = self._jit(

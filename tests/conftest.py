@@ -72,6 +72,11 @@ def pytest_configure(config):
         "`pytest -m sharding`)")
     config.addinivalue_line(
         "markers",
+        "pallas: Pallas hot-path kernel layer (TPUMX_PALLAS gate — paged "
+        "decode attention, flash-attention backward, fused LayerNorm; "
+        "docs/pallas.md; select with `pytest -m pallas`)")
+    config.addinivalue_line(
+        "markers",
         "observability: unified runtime observability (mxnet_tpu."
         "observability — metrics registry, structured tracing, recompile "
         "explainer, device-side train telemetry, docs/observability.md; "

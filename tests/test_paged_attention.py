@@ -1,0 +1,302 @@
+"""Paged flash-decode attention (ops/paged_attention.py, docs/pallas.md):
+the block-table-walking Pallas kernel vs the gathered-dense oracle — direct
+kernel parity, the full transformer_lm_decode pipeline across block
+boundaries / ragged lengths / inactive slots, chunked prefill, bf16 token
+parity, and the zero-recompile + compile-key discipline of the
+``TPUMX_PALLAS`` gate.  Runs on the Pallas interpreter (the CPU tier-1
+leg); tools/tpu_parity.py re-checks interpreter-vs-native on a real chip.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu import observability as obs
+from mxnet_tpu.ops import paged_attention as pa
+from mxnet_tpu.ops import pallas_kernels as pk
+from mxnet_tpu.parallel import transformer as tr
+from mxnet_tpu.serving import pad_tokens_right
+from mxnet_tpu.serving.generation import GenerationConfig, GenerationService
+
+pytestmark = pytest.mark.pallas
+
+CFG = tr.TransformerConfig(vocab=40, d_model=32, n_heads=4, n_layers=2,
+                           d_ff=64, max_len=64)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observability():
+    yield
+    obs.recompile.reset()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tr.transformer_lm_init(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture
+def paged(monkeypatch):
+    """Force the kernel layer on (CPU default is off; tier-1 exercises the
+    interpreter leg through this)."""
+    monkeypatch.setenv("TPUMX_PALLAS", "1")
+    assert pk.pallas_enabled()
+
+
+def _greedy_oracle(params, prompt, n_new):
+    toks = [int(t) for t in prompt]
+    for _ in range(n_new):
+        logits = tr.transformer_lm_apply(
+            params, jnp.asarray([toks], dtype=jnp.int32),
+            jnp.arange(len(toks), dtype=jnp.int32), CFG)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def _dense_reference(q, kp, vp, tables, positions, scale):
+    B, T, H, D = q.shape
+    W, bs = tables.shape[1], kp.shape[1]
+    k_ctx = kp[jnp.asarray(tables)].reshape(B, W * bs, H, D)
+    v_ctx = vp[jnp.asarray(tables)].reshape(B, W * bs, H, D)
+    ctx_pos = np.arange(W * bs, dtype=np.int32)
+    mask = jnp.asarray(ctx_pos[None, None, :] <= positions[:, :, None])
+    return pa.paged_attention_reference(q, k_ctx, v_ctx, mask,
+                                        jnp.float32(scale))
+
+
+# -- direct kernel parity -----------------------------------------------------------
+@pytest.mark.parametrize("dtype", [np.float32, "bfloat16"])
+def test_kernel_matches_gathered_dense(paged, dtype):
+    """Ragged per-row lengths, multi-block tables, a null-padded table
+    tail, and an inactive row: every VALID row matches the gathered-dense
+    attend — rtol 1e-5 in f32, bf16 at bf16 resolution."""
+    rs = np.random.RandomState(0)
+    B, T, H, D = 4, 4, 2, 16
+    nb, bs, W = 12, 4, 4
+    dt = jnp.dtype(dtype)
+    mk = lambda *s: jnp.asarray(rs.randn(*s).astype(np.float32)).astype(dt)
+    q, kp, vp = mk(B, T, H, D), mk(nb, bs, H, D), mk(nb, bs, H, D)
+    tables = np.zeros((B, W), np.int32)
+    tables[0, :4] = [2, 5, 7, 9]     # full table
+    tables[1, :2] = [1, 3]           # ragged: shorter context
+    tables[2, :1] = [4]              # single block
+    positions = np.zeros((B, T), np.int32)
+    positions[0] = [12, 13, 14, 15]  # prefill chunk crossing block 3
+    positions[1] = [5, 0, 0, 0]      # decode-style single query
+    positions[2] = [0, 1, 2, 3]      # from position zero
+    lengths = np.array([4, 1, 4, 0], np.int32)   # row 3 inactive
+    valid = np.arange(T)[None, :] < lengths[:, None]
+    max_pos = np.where(valid, positions, -1).max(axis=1).astype(np.int32)
+    scale = pa.attention_scale(D)
+
+    got = pa.paged_attention(q, kp, vp, tables, positions, max_pos, scale)
+    want = _dense_reference(q, kp, vp, tables, positions, scale)
+    assert got.dtype == dt
+    tol = dict(rtol=1e-5, atol=1e-5) if dt == jnp.float32 \
+        else dict(rtol=2e-2, atol=2e-2)
+    for b in range(B):
+        for t in range(T):
+            if valid[b, t]:
+                np.testing.assert_allclose(
+                    np.asarray(got[b, t], np.float32),
+                    np.asarray(want[b, t], np.float32),
+                    err_msg=f"row {b} query {t}", **tol)
+    # fully-skipped rows emit exactly zero (never NaN/inf)
+    assert float(jnp.abs(got[3].astype(jnp.float32)).max()) == 0.0
+
+
+# -- full decode pipeline -----------------------------------------------------------
+def test_decode_pipeline_matches_dense_across_blocks(params, paged,
+                                                     monkeypatch):
+    """Prefill + single-token decode steps crossing a block boundary under
+    the kernel reproduce the TPUMX_PALLAS=0 gather+dense pipeline at rtol
+    1e-5 (f32) — and both reproduce full transformer_lm_apply."""
+    rs = np.random.RandomState(0)
+    plen, n_steps, bs = 13, 7, 8
+    prompt = rs.randint(0, CFG.vocab, plen)
+    table = np.array([[1, 2, 3]], np.int32)
+    tb = 16
+
+    def run(gate):
+        monkeypatch.setenv("TPUMX_PALLAS", gate)
+        kp = jnp.zeros((CFG.n_layers, 16, bs, CFG.n_heads, CFG.d_head))
+        vp = jnp.zeros_like(kp)
+        outs = []
+        logits, kp, vp = tr.transformer_lm_decode(
+            params, pad_tokens_right(prompt.astype(np.int32), tb)[None, :],
+            np.arange(tb, dtype=np.int32)[None, :],
+            np.asarray([plen], np.int32), kp, vp, table[:, :2], CFG)
+        outs.append(np.asarray(logits[0, :plen]))
+        toks = list(prompt)
+        last = logits[0, plen - 1]
+        for _ in range(n_steps):
+            nxt = int(jnp.argmax(last))
+            toks.append(nxt)
+            pos = len(toks) - 1
+            logits, kp, vp = tr.transformer_lm_decode(
+                params, np.asarray([[nxt]], np.int32),
+                np.asarray([[pos]], np.int32), np.asarray([1], np.int32),
+                kp, vp, table, CFG)
+            last = logits[0, 0]
+            outs.append(np.asarray(last))
+        return toks, outs
+
+    toks_paged, outs_paged = run("1")
+    toks_dense, outs_dense = run("0")
+    assert len(toks_paged) > 16, "must cross a block boundary"
+    assert toks_paged == toks_dense
+    for a, b in zip(outs_paged, outs_dense):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    # and the kernel pipeline agrees with the cacheless full apply
+    full = tr.transformer_lm_apply(
+        params, jnp.asarray([toks_paged], jnp.int32),
+        jnp.arange(len(toks_paged), dtype=jnp.int32), CFG)
+    np.testing.assert_allclose(outs_paged[-1], np.asarray(full[0, -1]),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bf16_oracle_token_bitwise(params, paged, monkeypatch):
+    """bf16 decode through the kernel: greedy tokens are BITWISE identical
+    to the gather+dense bf16 pipeline, logits agree at bf16 resolution
+    (the one-pass online softmax keeps f32 probabilities where the dense
+    path rounds them to bf16 — sub-ulp-of-bf16 differences)."""
+    rs = np.random.RandomState(3)
+    plen, bs = 11, 8
+    prompt = rs.randint(0, CFG.vocab, plen)
+    table = np.array([[1, 2, 3]], np.int32)
+
+    def run(gate):
+        monkeypatch.setenv("TPUMX_PALLAS", gate)
+        kp = jnp.zeros((CFG.n_layers, 16, bs, CFG.n_heads, CFG.d_head),
+                       jnp.bfloat16)
+        vp = jnp.zeros_like(kp)
+        logits, kp, vp = tr.transformer_lm_decode(
+            params, pad_tokens_right(prompt.astype(np.int32), 16)[None, :],
+            np.arange(16, dtype=np.int32)[None, :],
+            np.asarray([plen], np.int32), kp, vp, table[:, :2], CFG,
+            compute_dtype=jnp.bfloat16)
+        toks = list(prompt)
+        last = logits[0, plen - 1]
+        all_logits = [np.asarray(last)]
+        for _ in range(6):
+            nxt = int(jnp.argmax(last))
+            toks.append(nxt)
+            logits, kp, vp = tr.transformer_lm_decode(
+                params, np.asarray([[nxt]], np.int32),
+                np.asarray([[len(toks) - 1]], np.int32),
+                np.asarray([1], np.int32), kp, vp, table, CFG,
+                compute_dtype=jnp.bfloat16)
+            last = logits[0, 0]
+            all_logits.append(np.asarray(last))
+        return toks, all_logits
+
+    toks_paged, lg_paged = run("1")
+    toks_dense, lg_dense = run("0")
+    assert toks_paged == toks_dense          # the serving-level contract
+    for a, b in zip(lg_paged, lg_dense):
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
+
+
+def test_inactive_slots_null_block_isolation(params, paged):
+    """Under the kernel gate, inactive (length-0) decode slots still write
+    only to the reserved null block 0 and never corrupt live cache."""
+    bs = 8
+    kp = jnp.zeros((CFG.n_layers, 8, bs, CFG.n_heads, CFG.d_head))
+    vp = jnp.zeros_like(kp)
+    toks = np.array([[5], [7]], np.int32)
+    pos = np.array([[0], [3]], np.int32)
+    lengths = np.array([1, 0], np.int32)
+    tables = np.array([[1], [2]], np.int32)
+    _, kp, vp = tr.transformer_lm_decode(params, toks, pos, lengths,
+                                         kp, vp, tables, CFG)
+    assert float(jnp.abs(kp[:, 1, 0]).sum()) > 0    # active row wrote
+    assert float(jnp.abs(kp[:, 2]).sum()) == 0.0    # inactive row did NOT
+
+
+# -- engine integration -------------------------------------------------------------
+def _gc(**kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("seq_buckets", [16, 32])
+    kw.setdefault("max_new_tokens", 8)
+    return GenerationConfig(**kw)
+
+
+def test_service_greedy_parity_chunked_prefill(params, paged, monkeypatch):
+    """End-to-end service under the kernel WITH chunked prefill: streamed
+    tokens equal full-sequence greedy decoding (f32)."""
+    monkeypatch.setenv("TPUMX_GEN_CHUNKED_PREFILL", "1")
+    svc = GenerationService(params, CFG, _gc(chunked_prefill=True),
+                            start=False)
+    svc.warmup()
+    rs = np.random.RandomState(1)
+    prompts = [rs.randint(0, CFG.vocab, n) for n in (30, 7, 19)]
+    handles = [svc.submit(p, max_new_tokens=6) for p in prompts]
+    svc.start()
+    results = [h.result(120) for h in handles]
+    assert svc.stats()["decode_kernel"] == "paged"
+    svc.stop()
+    for got, p in zip(results, prompts):
+        assert got == _greedy_oracle(params, p, 6)
+
+
+def test_zero_recompiles_under_freeze_paged(params, paged, monkeypatch):
+    """Warmup enumerates the same (kind, B, T, W) signature set with the
+    kernel on; a staggered mixed stream then runs frozen with exactly one
+    miss per signature, and the paged program variants count per-site."""
+    from mxnet_tpu.executor import compile_cache_stats
+
+    svc = GenerationService(params, CFG, _gc(max_slots=3), start=False)
+    warmed = svc.warmup()
+    assert warmed == len(svc.compile_stats())
+    monkeypatch.setenv("TPUMX_FREEZE_COMPILES", "1")
+    rs = np.random.RandomState(2)
+    handles = []
+    svc.start()
+    for i, n in enumerate([3, 16, 29, 9, 22, 12]):
+        handles.append(svc.submit(rs.randint(0, CFG.vocab, n),
+                                  max_new_tokens=3 + (i % 4), seed=i))
+        if i % 3 == 0:
+            time.sleep(0.01)
+    for h in handles:
+        h.result(120)
+    stats = svc.compile_stats()
+    svc.stop()
+    assert stats, "no programs recorded"
+    for key, st in stats.items():
+        assert st["misses"] == 1, f"recompile at {key}: {st}"
+        assert ("kernel", "paged") in key[1]
+    by_site = compile_cache_stats().get("by_site", {})
+    assert "gen_decode_paged" in by_site and \
+        "gen_prefill_paged" in by_site, \
+        f"no paged program sites in {list(by_site)[:8]}"
+
+
+def test_gate_off_keys_byte_identical(params, monkeypatch):
+    """TPUMX_PALLAS=0 must reproduce the pre-kernel compile keys exactly
+    (warm caches and freeze sets carry over across the gate)."""
+    from mxnet_tpu.serving.generation.programs import GenerationPrograms
+
+    cache = GenerationService(params, CFG, _gc(), start=False)._cache
+    tokens = np.zeros((1, 16), np.int32)
+    tables = np.zeros((1, 2), np.int32)
+    monkeypatch.setenv("TPUMX_PALLAS", "0")
+    progs = GenerationPrograms(params, CFG)
+    key = progs._key("gen_prefill", cache, tokens, tables)
+    assert key == ("gen_prefill",
+                   (("tokens", (1, 16), "int32"),
+                    ("block_tables", (1, 2), "int32"),
+                    ("kv_pool", cache.shape, str(cache.dtype))))
+    assert progs.kernel == "gather"
+    # the kernel choice is FROZEN at construction: a later env flip can
+    # never desync keys from already-traced programs
+    monkeypatch.setenv("TPUMX_PALLAS", "1")
+    assert progs.kernel == "gather"
+    assert progs._key("gen_prefill", cache, tokens, tables) == key
+    progs_paged = GenerationPrograms(params, CFG)
+    assert progs_paged.kernel == "paged"
+    key_paged = progs_paged._key("gen_prefill", cache, tokens, tables)
+    assert key_paged[1][-1] == ("kernel", "paged")

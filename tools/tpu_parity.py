@@ -208,16 +208,22 @@ def build_cases():
 
         ctx = mx.context.current_context()
         on_cpu = ctx.jax_device.platform == "cpu"
-        prev = os.environ.get("MXTPU_PALLAS_INTERPRET")
-        os.environ["MXTPU_PALLAS_INTERPRET"] = "1" if on_cpu else "0"
+        # TPUMX_PALLAS=1 keeps the gated call sites (flash backward, fused
+        # LN, paged decode) on their kernels for BOTH legs — the comparison
+        # is interpreter-vs-Mosaic of the same kernel, never kernel-vs-XLA
+        prev = {k: os.environ.get(k)
+                for k in ("TPUMX_PALLAS_INTERPRET", "TPUMX_PALLAS")}
+        os.environ["TPUMX_PALLAS_INTERPRET"] = "1" if on_cpu else "0"
+        os.environ["TPUMX_PALLAS"] = "1"
         try:
             put = lambda a: jax.device_put(a, ctx.jax_device)  # noqa: E731
             return fn(put)
         finally:
-            if prev is None:
-                os.environ.pop("MXTPU_PALLAS_INTERPRET", None)
-            else:
-                os.environ["MXTPU_PALLAS_INTERPRET"] = prev
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
 
     def pallas_flash():
         from mxnet_tpu.ops import pallas_kernels as pk
@@ -239,8 +245,64 @@ def build_cases():
 
         return _pallas_leg(body)
 
+    # the PR-9 kernel layer (docs/pallas.md): flash backward, fused LN and
+    # paged decode attention join the two-backend sweep.  Inputs hoisted
+    # like q_flash/x_bn above.
+    g_flash = rng.rand(1, 32, 2, 16).astype(np.float32)
+    x_ln = rng.randn(4, 8, 256).astype(np.float32)
+    g_ln = (rng.rand(256) + 0.5).astype(np.float32)
+    b_ln = rng.randn(256).astype(np.float32)
+    q_paged = rng.randn(3, 1, 2, 16).astype(np.float32)
+    kp_paged = rng.randn(8, 4, 2, 16).astype(np.float32)
+    vp_paged = rng.randn(8, 4, 2, 16).astype(np.float32)
+    tbl_paged = np.array([[1, 2, 0], [3, 0, 0], [0, 0, 0]], np.int32)
+    pos_paged = np.array([[6], [2], [0]], np.int32)
+    maxpos_paged = np.array([6, 2, -1], np.int32)
+
+    def pallas_flash_bwd():
+        import jax
+        import jax.numpy as jnp
+
+        from mxnet_tpu.ops import pallas_kernels as pk
+
+        def body(put):
+            q = put(q_flash)
+            g = put(g_flash)
+            grads = jax.grad(
+                lambda q_, k_, v_: jnp.sum(
+                    pk.flash_attention(q_, k_, v_, causal=True) * g),
+                argnums=(0, 1, 2))(q, q, q)
+            return [np.asarray(a) for a in grads]
+
+        return _pallas_leg(body)
+
+    def pallas_layer_norm():
+        from mxnet_tpu.ops import pallas_kernels as pk
+
+        def body(put):
+            out = pk.layer_norm_fused(put(x_ln), put(g_ln), put(b_ln))
+            out_g = pk.layer_norm_fused(put(x_ln), put(g_ln), put(b_ln),
+                                        gelu=True)
+            return [np.asarray(out), np.asarray(out_g)]
+
+        return _pallas_leg(body)
+
+    def pallas_paged():
+        from mxnet_tpu.ops import paged_attention as pa
+
+        def body(put):
+            out = pa.paged_attention(
+                put(q_paged), put(kp_paged), put(vp_paged), put(tbl_paged),
+                put(pos_paged), put(maxpos_paged))
+            return [np.asarray(out)]
+
+        return _pallas_leg(body)
+
     cases += [("pallas_flash_attention", pallas_flash),
-              ("pallas_bn_train_fused", pallas_bn)]
+              ("pallas_bn_train_fused", pallas_bn),
+              ("pallas_flash_attention_bwd", pallas_flash_bwd),
+              ("pallas_layer_norm_fused", pallas_layer_norm),
+              ("pallas_paged_attention", pallas_paged)]
     return cases
 
 
