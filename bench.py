@@ -830,6 +830,92 @@ def telemetry_overhead(batch: int = None, steps: int = None):
     }
 
 
+def checkpoint_overhead(batch: int = None, steps: int = None):
+    """Fused-step wall time while async checkpoint snapshots are in flight
+    vs without (docs/fault_tolerance.md): the SAME bound module stepped
+    through ``_try_fused_step``, one leg saving every
+    ``BENCH_CKPT_EVERY`` steps through the background writer, one leg
+    clean — reporting ``overhead_pct`` (acceptance: < 5%).
+    ``BENCH_CKPT=0`` skips the block."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    from mxnet_tpu.checkpoint import TrainCheckpointer
+
+    batch = batch or int(os.environ.get("BENCH_CKPT_BATCH", "512"))
+    steps = steps or int(os.environ.get("BENCH_CKPT_STEPS", "30"))
+    # every-8 is already far denser than production cadences (O(100) steps);
+    # on 1-core CI hosts the writer shares the "device" core, so denser
+    # cadences overstate what a TPU host would see
+    every = int(os.environ.get("BENCH_CKPT_EVERY", "8"))
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=1024, name="fc1"),
+                       act_type="relu")
+    h = sym.Activation(sym.FullyConnected(h, num_hidden=1024, name="fc2"),
+                       act_type="relu")
+    net = sym.SoftmaxOutput(sym.FullyConnected(h, num_hidden=64, name="fc3"),
+                            label, name="softmax")
+    r = np.random.RandomState(0)
+    X = r.rand(batch, 512).astype(np.float32)
+    Y = r.randint(0, 64, batch).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch)
+    mod = mx.mod.Module(net, context=mx.cpu()
+                        if jax.default_backend() == "cpu" else None)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    batch0 = next(iter(it))
+    if not mod._try_fused_step(batch0):  # compile + warm
+        raise RuntimeError("fused step unavailable for checkpoint bench")
+    mod._exec.outputs[0].wait_to_read()
+
+    def leg(ck):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            mod._try_fused_step(batch0)
+            if ck is not None and (i + 1) % every == 0:
+                ck.save(0, i + 1, i + 1, blocking=False)
+        mod._exec.outputs[0].wait_to_read()
+        return (time.perf_counter() - t0) / steps
+
+    ckdir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        ck = TrainCheckpointer(mod, ckdir, keep=2)
+        t_off = leg(None)
+        t_on = leg(ck)
+        # interleave a second pass to cancel clock/thermal drift
+        t_off = min(t_off, leg(None))
+        t_on = min(t_on, leg(ck))
+        ck.manager.wait(timeout=120)
+        from mxnet_tpu import observability as _obs
+
+        counters = _obs.snapshot()["counters"]
+        saved = sum(v for k, v in counters.items()
+                    if k.startswith("checkpoint_saves_total"))
+        saved_bytes = counters.get("checkpoint_save_bytes_total", 0)
+        ck.close()
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+    return {
+        "with_ms": round(t_on * 1e3, 4),
+        "without_ms": round(t_off * 1e3, 4),
+        "overhead_pct": round((t_on - t_off) / t_off * 100.0, 2),
+        "steps": steps,
+        "batch": batch,
+        "snapshot_every": every,
+        "checkpoints_committed": int(saved),
+        "checkpoint_bytes_total": int(saved_bytes),
+    }
+
+
 def main():
     # bs=512 saturates one v5e MXU (measured: 64→752, 256→1537, 512→1665
     # img/s; 1024 OOMs in 16 GB HBM); fall back on allocation failure
@@ -1044,6 +1130,13 @@ def main():
         except Exception as e:  # optional block: failure is a field, not rc!=0
             sys.stderr.write(f"pallas bench failed: {type(e).__name__}: {e}\n")
             result["pallas_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_CKPT", "1") == "1":
+        try:
+            result["checkpoint_overhead"] = checkpoint_overhead()
+        except Exception as e:  # optional block: failure is a field, not rc!=0
+            sys.stderr.write(f"checkpoint bench failed: "
+                             f"{type(e).__name__}: {e}\n")
+            result["ckpt_error"] = f"{type(e).__name__}: {e}"
     try:
         # every bench result carries the process registry (docs/
         # observability.md): compile-cache counters, serving p50/p99/QPS,

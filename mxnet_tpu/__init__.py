@@ -57,6 +57,8 @@ from .model import save_checkpoint, load_checkpoint
 from . import parallel
 from . import profiler
 from . import observability
+from . import fault
+from . import checkpoint
 from . import serving
 from . import contrib
 from . import executor_manager

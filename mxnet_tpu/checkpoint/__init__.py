@@ -1,0 +1,34 @@
+"""mxnet_tpu.checkpoint — async distributed checkpointing (ROADMAP item 3's
+production half; docs/fault_tolerance.md).
+
+- :class:`CheckpointManager` — atomic write-to-temp-then-rename checkpoint
+  directories with per-file sha256 checksums, a background writer thread
+  (the train step never stalls on host transfer or file IO), retention of
+  the last K checkpoints, and restore that skips corrupt/truncated
+  checkpoints in favor of the previous retained one.
+- :class:`TrainCheckpointer` — the ``Module.fit`` bridge: captures the
+  COMPLETE donated fused-step state (params, optimizer state incl. AMP f32
+  masters, loss scaler, RNG, iterator position, step counters) as
+  device-side copies and restores it under any mesh shape.
+- :mod:`.integrity` — checksum + manifest validation shared with the
+  classic ``save_checkpoint``/``load_checkpoint`` prefix-epoch format.
+
+``Module.fit(checkpoint_dir=..., checkpoint_every=N, resume=True)`` is the
+one-line spelling; SIGTERM/SIGINT mid-fit triggers a final synchronous
+checkpoint and a graceful exit (mxnet_tpu.fault.preemption).
+"""
+from __future__ import annotations
+
+from .integrity import (file_sha256, manifest_path_for, verify_params_file,
+                        write_params_manifest)
+from .manager import CheckpointInfo, CheckpointManager
+from .train_state import (ResumePoint, TrainCheckpointer,
+                          capture_train_state, restore_train_state)
+from . import integrity
+from . import manager
+from . import train_state
+
+__all__ = ["CheckpointManager", "CheckpointInfo", "TrainCheckpointer",
+           "ResumePoint", "capture_train_state", "restore_train_state",
+           "file_sha256", "write_params_manifest", "verify_params_file",
+           "manifest_path_for", "integrity", "manager", "train_state"]

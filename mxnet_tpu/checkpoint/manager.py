@@ -1,0 +1,377 @@
+"""Async distributed checkpointing: atomic, checksummed, retained.
+
+Design (docs/fault_tolerance.md):
+
+- a checkpoint is a DIRECTORY ``ckpt-<step:010d>/`` holding one ``.npz``
+  per array group (params, aux), a pickled optimizer-state tree, and a
+  ``manifest.json`` carrying the step, the train metadata (epoch/batch,
+  optimizer counters, loss-scaler state, RNG key) and a sha256 per file;
+- commit is atomic: everything is written into a ``.tmp-…`` sibling and
+  ``os.rename``'d into place — a crash mid-write leaves a stale tmp dir
+  (garbage-collected on the next save), never a half-valid checkpoint;
+- saves are ASYNC by default: the caller captures device-side copies of
+  the donated fused-step buffers (cheap device-to-device copies — the
+  train step never stalls on host transfer or file IO) and hands them to
+  one background writer thread, which does the device→host transfer,
+  serialization, hashing and the atomic rename.  At most one save is in
+  flight; a save landing while the writer is busy is SKIPPED (counted) —
+  a slow disk degrades checkpoint frequency, not step time;
+- retention: after each commit the newest ``keep`` checkpoints survive,
+  older ones (and stale tmp dirs) are deleted;
+- restore scans newest-first and VALIDATES each candidate (manifest
+  parses, every file present, every sha256 matches) before trusting it: a
+  corrupt or truncated newest checkpoint is skipped — with a warning and a
+  ``checkpoint_restore_fallbacks_total`` count — in favor of the previous
+  retained one.
+
+Registry metrics (docs/observability.md): ``checkpoint_save_seconds``,
+``checkpoint_save_bytes_total``, ``checkpoint_saves_total{mode}``,
+``checkpoint_save_skipped_total``, ``checkpoint_save_failures_total``,
+``checkpoint_last_step``, ``checkpoint_restores_total``,
+``checkpoint_restore_seconds``, ``checkpoint_restore_fallbacks_total``.
+Spans: ``checkpoint.save_async`` (writer thread), ``checkpoint.save_sync``,
+``checkpoint.restore``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import queue
+import re
+import shutil
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from .integrity import file_sha256
+
+__all__ = ["CheckpointManager", "CheckpointInfo"]
+
+_logger = logging.getLogger("mxnet_tpu.checkpoint")
+
+_CKPT_RE = re.compile(r"^ckpt-(\d{10})$")
+_OPT_FILE = "opt_state.pkl"
+_MANIFEST = "manifest.json"
+
+
+def _registry():
+    from ..observability import registry
+
+    return registry()
+
+
+def _json_safe(obj):
+    """Convert device/numpy scalars and arrays inside checkpoint meta to
+    plain Python — runs on the WRITER thread, so a device scalar in the
+    meta (e.g. the AMP loss-scaler state) costs the fit thread nothing."""
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, (str, bool, int, float)) or obj is None:
+        return obj
+    a = _np.asarray(obj)
+    if a.ndim == 0:
+        return a.item()
+    return a.tolist()
+
+
+def _span(name):
+    from ..observability import span
+
+    return span(name, cat="checkpoint")
+
+
+class CheckpointInfo:
+    """One committed checkpoint: path + parsed manifest."""
+
+    __slots__ = ("path", "step", "manifest")
+
+    def __init__(self, path: str, step: int, manifest: dict):
+        self.path = path
+        self.step = step
+        self.manifest = manifest
+
+    @property
+    def meta(self) -> dict:
+        return self.manifest.get("meta", {})
+
+    def __repr__(self):
+        return f"CheckpointInfo(step={self.step}, path={self.path!r})"
+
+
+class CheckpointManager:
+    """Atomic, checksummed, retained checkpoints under one directory.
+
+    ``save(arrays, opt_tree, meta, step)`` — arrays is ``{group_name:
+    {key: array}}`` (device or host arrays; converted to numpy on the
+    writer), ``opt_tree`` an arbitrary pickleable pytree of arrays (the
+    optimizer-state structure), ``meta`` a JSON-safe dict.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        if keep < 1:
+            raise MXNetError(f"CheckpointManager: keep must be >= 1, "
+                             f"got {keep}")
+        self.directory = os.path.abspath(directory)
+        self.keep = int(keep)
+        os.makedirs(self.directory, exist_ok=True)
+        self._writer: Optional[threading.Thread] = None
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._idle = threading.Event()
+        self._idle.set()
+        self._closed = False
+        self._lock = threading.Lock()
+        reg = _registry()
+        self._h_save = reg.histogram(
+            "checkpoint_save_seconds",
+            help="wall time of one checkpoint write (capture excluded)")
+        self._c_bytes = reg.counter(
+            "checkpoint_save_bytes_total",
+            help="bytes written across all committed checkpoints")
+        self._c_skipped = reg.counter(
+            "checkpoint_save_skipped_total",
+            help="async saves skipped because the writer was busy")
+        self._c_failures = reg.counter(
+            "checkpoint_save_failures_total",
+            help="checkpoint writes that raised (checkpoint not committed)")
+        self._g_last = reg.gauge(
+            "checkpoint_last_step",
+            help="step of the most recently committed checkpoint")
+        self._c_restores = reg.counter(
+            "checkpoint_restores_total", help="successful checkpoint restores")
+        self._h_restore = reg.histogram(
+            "checkpoint_restore_seconds",
+            help="wall time of checkpoint discovery + validation + load")
+        self._c_fallbacks = reg.counter(
+            "checkpoint_restore_fallbacks_total",
+            help="corrupt/invalid checkpoints skipped during restore "
+                 "in favor of an older retained one")
+
+    # -- save ---------------------------------------------------------------------
+    def save(self, arrays: Dict[str, Dict[str, object]],
+             opt_tree=None, meta: Optional[dict] = None, step: int = 0,
+             blocking: bool = True) -> Optional[str]:
+        """Write one checkpoint.  ``blocking=False`` enqueues to the writer
+        thread and returns immediately (None; or skips if one is already in
+        flight).  ``blocking=True`` writes inline and returns the committed
+        path."""
+        if self._closed:
+            raise MXNetError("CheckpointManager is closed")
+        job = (arrays, opt_tree, dict(meta or {}), int(step))
+        if blocking:
+            self.wait()  # an in-flight async save must not race the commit
+            with _span("checkpoint.save_sync"):
+                return self._write(*job, mode="sync")
+        self._ensure_writer()
+        with self._lock:
+            if not self._idle.is_set():
+                self._c_skipped.inc()
+                return None
+            self._idle.clear()
+        self._queue.put(job)
+        return None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until no async save is in flight."""
+        return self._idle.wait(timeout)
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain the writer and stop accepting saves."""
+        self.wait(timeout)
+        self._closed = True
+
+    def _ensure_writer(self) -> None:
+        if self._writer is not None and self._writer.is_alive():
+            return
+        t = threading.Thread(target=self._writer_loop,
+                             name="tpumx-ckpt-writer", daemon=True)
+        self._writer = t
+        t.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            job = self._queue.get()
+            try:
+                with _span("checkpoint.save_async"):
+                    self._write(*job, mode="async")
+            except Exception as e:  # noqa: BLE001 — a failed save must not
+                # kill the writer; the next save gets a fresh chance
+                self._c_failures.inc()
+                _logger.warning("async checkpoint save failed: %s", e)
+            finally:
+                self._idle.set()
+
+    def _write(self, arrays, opt_tree, meta, step, mode: str) -> str:
+        t0 = time.perf_counter()
+        final = os.path.join(self.directory, f"ckpt-{step:010d}")
+        tmp = tempfile.mkdtemp(prefix=f".tmp-ckpt-{step:010d}-",
+                               dir=self.directory)
+        try:
+            files: Dict[str, dict] = {}
+            key_lists: Dict[str, List[str]] = {}
+            total_bytes = 0
+            for group, kv in (arrays or {}).items():
+                fname = f"{group}.npz"
+                path = os.path.join(tmp, fname)
+                as_np = {k: _np.asarray(v) for k, v in kv.items()}
+                # np.savez mangles keys containing '/' on extraction paths;
+                # param names are flat identifiers in practice, but keep
+                # the authoritative list in the manifest regardless
+                with open(path, "wb") as f:
+                    _np.savez(f, **as_np)
+                files[fname] = {"sha256": file_sha256(path),
+                                "bytes": os.path.getsize(path)}
+                key_lists[group] = sorted(as_np)
+                total_bytes += files[fname]["bytes"]
+            if opt_tree is not None:
+                import jax
+
+                host_tree = jax.tree_util.tree_map(_np.asarray, opt_tree)
+                path = os.path.join(tmp, _OPT_FILE)
+                with open(path, "wb") as f:
+                    pickle.dump(host_tree, f, protocol=4)
+                files[_OPT_FILE] = {"sha256": file_sha256(path),
+                                    "bytes": os.path.getsize(path)}
+                total_bytes += files[_OPT_FILE]["bytes"]
+            manifest = {
+                "format": 1,
+                "step": step,
+                "saved_unix": time.time(),
+                "files": files,
+                "keys": key_lists,
+                "meta": _json_safe(meta),
+            }
+            mpath = os.path.join(tmp, _MANIFEST)
+            with open(mpath, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            if os.path.exists(final):  # re-save of the same step: replace
+                shutil.rmtree(final, ignore_errors=True)
+            os.rename(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        dt = time.perf_counter() - t0
+        self._h_save.observe(dt)
+        self._c_bytes.inc(total_bytes)
+        _registry().counter(
+            "checkpoint_saves_total", labels={"mode": mode},
+            help="committed checkpoints by save mode").inc()
+        self._g_last.set(step)
+        # fault injection (docs/fault_tolerance.md): corrupt the checkpoint
+        # we JUST committed — restore must fall back to the previous one
+        from ..fault import injector, corrupt_checkpoint
+
+        cmode = injector().ckpt_corrupt_mode()
+        if cmode:
+            corrupt_checkpoint(final, cmode)
+        self._gc()
+        return final
+
+    # -- discovery / validation ---------------------------------------------------
+    def list(self) -> List[Tuple[int, str]]:
+        """All committed checkpoint dirs as (step, path), newest first."""
+        out = []
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in entries:
+            m = _CKPT_RE.match(name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, name)))
+        return sorted(out, reverse=True)
+
+    def validate(self, path: str) -> Optional[dict]:
+        """The checkpoint's manifest when it is fully intact, else None."""
+        mpath = os.path.join(path, _MANIFEST)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        for fname, info in manifest.get("files", {}).items():
+            fpath = os.path.join(path, fname)
+            if not os.path.exists(fpath):
+                return None
+            if os.path.getsize(fpath) != info.get("bytes"):
+                return None
+            if file_sha256(fpath) != info.get("sha256"):
+                return None
+        return manifest
+
+    def latest(self) -> Optional[CheckpointInfo]:
+        """Newest VALID checkpoint; corrupt ones are skipped (warned +
+        counted) in favor of the previous retained one."""
+        for step, path in self.list():
+            manifest = self.validate(path)
+            if manifest is not None:
+                return CheckpointInfo(path, step, manifest)
+            self._c_fallbacks.inc()
+            _logger.warning(
+                "checkpoint %s failed validation (corrupt/truncated); "
+                "falling back to the previous retained checkpoint", path)
+        return None
+
+    # -- restore ------------------------------------------------------------------
+    def restore(self) -> Optional[Tuple[CheckpointInfo, Dict[str, Dict],
+                                        object]]:
+        """Load the newest valid checkpoint: returns ``(info, arrays,
+        opt_tree)`` with arrays as ``{group: {key: np.ndarray}}``, or None
+        when no valid checkpoint exists."""
+        t0 = time.perf_counter()
+        with _span("checkpoint.restore"):
+            info = self.latest()
+            if info is None:
+                return None
+            arrays: Dict[str, Dict[str, _np.ndarray]] = {}
+            for fname in info.manifest.get("files", {}):
+                if fname == _OPT_FILE or not fname.endswith(".npz"):
+                    continue
+                group = fname[:-len(".npz")]
+                with _np.load(os.path.join(info.path, fname),
+                              allow_pickle=False) as z:
+                    arrays[group] = {k: z[k] for k in z.files}
+                want = set(info.manifest.get("keys", {}).get(group, ()))
+                have = set(arrays[group])
+                missing = sorted(want - have)
+                if missing:
+                    raise MXNetError(
+                        f"checkpoint {info.path} group {group!r} is missing "
+                        f"key {missing[0]!r} despite a clean checksum")
+            opt_tree = None
+            opt_path = os.path.join(info.path, _OPT_FILE)
+            if os.path.exists(opt_path):
+                with open(opt_path, "rb") as f:
+                    opt_tree = pickle.load(f)
+        self._c_restores.inc()
+        self._h_restore.observe(time.perf_counter() - t0)
+        return info, arrays, opt_tree
+
+    # -- retention ----------------------------------------------------------------
+    def _gc(self) -> None:
+        for step, path in self.list()[self.keep:]:
+            shutil.rmtree(path, ignore_errors=True)
+        # stale tmp dirs from a crashed writer
+        now = time.time()
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in entries:
+            if name.startswith(".tmp-ckpt-"):
+                path = os.path.join(self.directory, name)
+                try:
+                    if now - os.path.getmtime(path) > 300:
+                        shutil.rmtree(path, ignore_errors=True)
+                except OSError:
+                    pass

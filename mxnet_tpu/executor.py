@@ -1059,6 +1059,40 @@ class Executor:
         out.update(self._telemetry_accum)
         return out
 
+    # -- checkpoint capture -------------------------------------------------------
+    def snapshot_arrays(self, include_aux: bool = True):
+        """Donation-safe snapshot of the bound argument (and aux) buffers:
+        ``({name: array}, {aux_name: array})``.
+
+        Single-device buffers are copied ON DEVICE (``jnp.array(copy=True)``
+        — an async D2D copy, no host sync, no executor-cache compile), so
+        the fit thread can hand the snapshot to the async checkpoint writer
+        and keep stepping: the next fused step donates the ORIGINAL buffers,
+        never these copies.  Multi-device buffers (replicated or
+        partition-rule sharded over the mp axis) gather through the host
+        instead — the snapshot then holds the full array, identical to the
+        replicated layout, so a checkpoint written from it restores under
+        any mesh shape (docs/sharding.md).
+        """
+        def snap(a):
+            x = a._data
+            if x is None:
+                return None
+            try:
+                multi = len(x.devices()) > 1
+            except Exception:
+                multi = False
+            return _np.asarray(x) if multi else jnp.array(x, copy=True)
+
+        args = {n: snap(self.arg_dict[n]) for n in self._arg_names
+                if n in self.arg_dict}
+        aux = {}
+        if include_aux:
+            aux = {n: snap(self.aux_dict[n]) for n in self._aux_names
+                   if n in self.aux_dict}
+        return ({k: v for k, v in args.items() if v is not None},
+                {k: v for k, v in aux.items() if v is not None})
+
     # -- params & misc ------------------------------------------------------------
     def copy_params_from(self, arg_params: Dict[str, NDArray],
                          aux_params: Optional[Dict[str, NDArray]] = None,

@@ -1,0 +1,30 @@
+"""mxnet_tpu.fault — failure as a first-class runtime concern.
+
+The reference framework treats failure handling as part of the runtime:
+ps-lite tracks peer liveness via heartbeats and surfaces ``num_dead_node``
+barriers (PAPER.md §5.8, ``kvstore_dist.h``).  tpu-mx's answer is this
+package (docs/fault_tolerance.md):
+
+- :mod:`.preemption` — one process-wide signal hub for SIGTERM/SIGINT:
+  ``Module.fit`` uses it to trigger a final synchronous checkpoint and a
+  graceful exit, ``InferenceService``/``GenerationService`` use it to drain
+  in-flight work while rejecting queued requests.
+- :mod:`.inject` — a deterministic fault-injection harness driven by the
+  ``TPUMX_FAULT_*`` env spec: drop/delay kvstore messages, kill a server
+  mid-round, corrupt/truncate a checkpoint, deliver a preemption signal at
+  step N.  The fault-tolerance test suite proves every recovery path
+  against it.
+"""
+from __future__ import annotations
+
+from .inject import (FaultInjectedError, FaultInjector, corrupt_checkpoint,
+                     injector)
+from .preemption import (PreemptionHandler, install_shutdown_hook,
+                         signals_supported)
+from . import inject
+from . import preemption
+
+__all__ = ["FaultInjector", "FaultInjectedError", "injector",
+           "corrupt_checkpoint", "PreemptionHandler",
+           "install_shutdown_hook", "signals_supported", "inject",
+           "preemption"]

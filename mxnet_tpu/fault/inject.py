@@ -1,0 +1,206 @@
+"""Deterministic fault injection (docs/fault_tolerance.md).
+
+Faults are declared up front through ``TPUMX_FAULT_*`` environment
+variables and consumed by *occurrence counters*, so a test (or a chaos
+drill) states exactly which message/step/file fails and the run is
+reproducible:
+
+- ``TPUMX_FAULT_KV_DROP="push:1,2;pull:3"`` — drop the Nth occurrence of
+  each named kvstore request (1-based, counted per op on the worker).  A
+  dropped request never reaches the wire; the worker sees it as a timeout
+  and the retry/backoff path (``TPUMX_KV_RETRIES``) must recover it.
+- ``TPUMX_FAULT_KV_DELAY_MS="push:200"`` or ``"push:200@1,2"`` — sleep
+  before sending every (or the Nth) matching request, exercising timeout
+  margins without a real slow network.
+- ``TPUMX_FAULT_KV_KILL_SERVER=N`` — the kvstore server stops accepting
+  and closes its socket after handling N messages, simulating a host dying
+  mid-round; workers must surface a peer-naming error in bounded time.
+- ``TPUMX_FAULT_PREEMPT_AT_STEP=N`` — ``Module.fit`` delivers a real
+  SIGTERM to the process after global step N, driving the SAME handler
+  path an evicted preemptible VM would (final synchronous checkpoint,
+  graceful exit).
+- ``TPUMX_FAULT_CKPT_CORRUPT="truncate"|"flip"[@N]`` — the checkpoint
+  manager corrupts the Nth committed checkpoint right after writing it
+  (every one without ``@N``), proving restore falls back to the previous
+  retained checkpoint via checksum validation.
+
+All counters live in one process-wide :class:`FaultInjector` (``injector()``);
+``reset()`` re-reads the environment — tests flip env vars per case.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["FaultInjector", "FaultInjectedError", "injector",
+           "corrupt_checkpoint"]
+
+
+class FaultInjectedError(MXNetError):
+    """An injected fault fired (only raised by injection sites themselves;
+    recovery paths are expected to translate or absorb it)."""
+
+
+def _parse_occurrences(spec: str) -> Dict[str, List[int]]:
+    """``"push:1,2;pull:3"`` -> {"push": [1, 2], "pull": [3]}."""
+    out: Dict[str, List[int]] = {}
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise MXNetError(
+                f"bad fault spec {part!r}: expected 'op:n[,n...]'")
+        op, ns = part.split(":", 1)
+        out[op.strip()] = sorted(int(n) for n in ns.split(",") if n.strip())
+    return out
+
+
+def _parse_delays(spec: str) -> Dict[str, Tuple[float, Optional[List[int]]]]:
+    """``"push:200"`` (every push) or ``"push:200@1,2"`` (1st and 2nd)."""
+    out: Dict[str, Tuple[float, Optional[List[int]]]] = {}
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise MXNetError(
+                f"bad delay spec {part!r}: expected 'op:ms[@n,...]'")
+        op, rest = part.split(":", 1)
+        if "@" in rest:
+            ms, ns = rest.split("@", 1)
+            occ: Optional[List[int]] = sorted(
+                int(n) for n in ns.split(",") if n.strip())
+        else:
+            ms, occ = rest, None
+        out[op.strip()] = (float(ms), occ)
+    return out
+
+
+class FaultInjector:
+    """Process-wide occurrence-counted fault state (see module docs)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        """Re-read the ``TPUMX_FAULT_*`` environment and zero every
+        occurrence counter (tests call this per case)."""
+        with self._lock:
+            self._drops = _parse_occurrences(
+                os.environ.get("TPUMX_FAULT_KV_DROP", ""))
+            self._delays = _parse_delays(
+                os.environ.get("TPUMX_FAULT_KV_DELAY_MS", ""))
+            kill = os.environ.get("TPUMX_FAULT_KV_KILL_SERVER", "")
+            self._kill_after = int(kill) if kill else None
+            pre = os.environ.get("TPUMX_FAULT_PREEMPT_AT_STEP", "")
+            self._preempt_step = int(pre) if pre else None
+            ck = os.environ.get("TPUMX_FAULT_CKPT_CORRUPT", "")
+            if ck and "@" in ck:
+                mode, n = ck.split("@", 1)
+                self._ckpt_mode, self._ckpt_at = mode.strip(), int(n)
+            else:
+                self._ckpt_mode = ck.strip() or None
+                self._ckpt_at = None
+            self._counts: Dict[str, int] = {}
+
+    def _bump(self, site: str) -> int:
+        n = self._counts.get(site, 0) + 1
+        self._counts[site] = n
+        return n
+
+    # -- kvstore worker side -------------------------------------------------------
+    def kv_fault(self, op: str) -> bool:
+        """Called once per outbound kvstore request.  Applies any configured
+        delay, then returns True when THIS occurrence must be dropped (the
+        caller simulates a timeout instead of sending)."""
+        with self._lock:
+            if not self._drops and not self._delays:
+                return False
+            n = self._bump(f"kv:{op}")
+            delay = self._delays.get(op)
+            drop = n in self._drops.get(op, ())
+        if delay is not None:
+            ms, occ = delay
+            if occ is None or n in occ:
+                time.sleep(ms / 1e3)
+        return drop
+
+    # -- kvstore server side -------------------------------------------------------
+    def server_kill_due(self) -> bool:
+        """Called once per handled server message: True exactly when the
+        configured message budget is exhausted — the server then dies."""
+        if self._kill_after is None:
+            return False
+        with self._lock:
+            return self._bump("kv:server_msg") >= self._kill_after
+
+    # -- training preemption -------------------------------------------------------
+    def preempt_due(self, global_step: int) -> bool:
+        """Whether the injected preemption fires at (or before) this step.
+        One-shot: consumed on first True."""
+        with self._lock:
+            if self._preempt_step is None:
+                return False
+            if global_step >= self._preempt_step:
+                self._preempt_step = None
+                return True
+            return False
+
+    # -- checkpoint corruption -----------------------------------------------------
+    def ckpt_corrupt_mode(self) -> Optional[str]:
+        """Corruption mode for the checkpoint that was JUST committed, or
+        None.  With ``@N`` only the Nth commit is corrupted."""
+        with self._lock:
+            if self._ckpt_mode is None:
+                return None
+            n = self._bump("ckpt:commit")
+            if self._ckpt_at is not None and n != self._ckpt_at:
+                return None
+            return self._ckpt_mode
+
+
+_injector = FaultInjector()
+
+
+def injector() -> FaultInjector:
+    """The process-wide :class:`FaultInjector`."""
+    return _injector
+
+
+def corrupt_checkpoint(path: str, mode: str = "truncate") -> str:
+    """Corrupt a committed checkpoint in place (test harness + the
+    ``TPUMX_FAULT_CKPT_CORRUPT`` hook).
+
+    ``path`` is a checkpoint directory (its largest data file is hit) or a
+    single file.  ``mode``: ``"truncate"`` cuts the file to half its length;
+    ``"flip"`` XOR-flips one byte in the middle (same length — only the
+    checksum can tell).  Returns the path of the file corrupted.
+    """
+    target = path
+    if os.path.isdir(path):
+        candidates = [os.path.join(path, f) for f in sorted(os.listdir(path))
+                      if not f.endswith(".json")]
+        if not candidates:
+            raise MXNetError(f"corrupt_checkpoint: no data files in {path}")
+        target = max(candidates, key=os.path.getsize)
+    size = os.path.getsize(target)
+    if mode == "truncate":
+        with open(target, "r+b") as f:
+            f.truncate(max(1, size // 2))
+    elif mode == "flip":
+        with open(target, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+    else:
+        raise MXNetError(
+            f"corrupt_checkpoint: unknown mode {mode!r} "
+            "(expected 'truncate' or 'flip')")
+    return target

@@ -23,7 +23,38 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter", "ImageRecordIterNative",
-           "LibSVMIter", "shard_data_batch"]
+           "LibSVMIter", "shard_data_batch", "fast_forward"]
+
+
+def fast_forward(data_iter, num_batches: int) -> int:
+    """Advance an iterator by ``num_batches`` without training on them —
+    the mid-epoch resume path of ``Module.fit(resume=True)``
+    (docs/fault_tolerance.md).
+
+    Iterators exposing ``seek(batch_index)`` (``NDArrayIter`` and
+    subclasses) jump without materializing the skipped batches; anything
+    else is consumed batch by batch.  Returns the number of batches
+    actually skipped (< ``num_batches`` when the epoch is shorter, e.g.
+    after a dataset change between runs).
+    """
+    n = int(num_batches or 0)
+    if n <= 0:
+        return 0
+    seek = getattr(data_iter, "seek", None)
+    if callable(seek):
+        try:
+            seek(n)
+            return n
+        except Exception:
+            pass  # fall through to plain consumption
+    consumed = 0
+    for _ in range(n):
+        try:
+            next(data_iter)
+        except StopIteration:
+            break
+        consumed += 1
+    return consumed
 
 
 def shard_data_batch(batch: "DataBatch", mesh, axis: str = "dp",
@@ -196,6 +227,21 @@ class NDArrayIter(DataIter):
     def iter_next(self):
         self.cursor += self.batch_size
         return self.cursor < self.num_data
+
+    def seek(self, batch_index: int) -> None:
+        """Position the cursor so the NEXT batch served is ``batch_index``
+        (0-based) of the current epoch order — checkpoint-resume
+        fast-forward without materializing the skipped batches.  The
+        shuffle order in effect is whatever the last ``reset()``
+        produced."""
+        if batch_index < 0:
+            raise ValueError(f"seek: negative batch index {batch_index}")
+        self.cursor = -self.batch_size + batch_index * self.batch_size
+
+    def tell(self) -> int:
+        """Batches already served this epoch (the value ``seek`` would
+        need to reproduce the current position)."""
+        return max(0, (self.cursor + self.batch_size) // self.batch_size)
 
     def _take(self, arrays):
         out = []

@@ -38,9 +38,11 @@ exceeds ``MXNET_KVSTORE_BIGARRAY_BOUND`` (default 1e6, reference
 """
 from __future__ import annotations
 
+import errno
 import hashlib
 import os
 import pickle
+import random as _random_mod
 import socket
 import struct
 import threading
@@ -53,8 +55,42 @@ from .base import MXNetError
 from .kvstore import KVStore, _as_list
 from .ndarray import array as nd_array
 from .ndarray.ndarray import NDArray
+from .observability import tracing as _tracing
 
 __all__ = ["KVStoreDist", "KVStoreDistServer"]
+
+
+# ------------------------------------------------------------------ fault knobs
+# Retry/timeout/backoff for every worker round-trip (docs/fault_tolerance.md):
+# a dead socket surfaces as a clear peer-naming MXNetError in bounded time
+# instead of an eternal recv().  The recv timeout default (630 s) must outlast
+# the longest LEGITIMATE server-side park (BSP merge / barrier deadline is
+# 600 s) — tighten it only alongside those.
+
+def _kv_timeout() -> float:
+    return float(os.environ.get("TPUMX_KV_TIMEOUT", "630"))
+
+
+def _kv_retries() -> int:
+    return max(0, int(os.environ.get("TPUMX_KV_RETRIES", "3")))
+
+
+def _kv_backoff_ms() -> float:
+    return float(os.environ.get("TPUMX_KV_BACKOFF_MS", "50"))
+
+
+def _kv_backoff_max_ms() -> float:
+    return float(os.environ.get("TPUMX_KV_BACKOFF_MAX_MS", "2000"))
+
+
+def _kv_connect_timeout() -> float:
+    return float(os.environ.get("TPUMX_KV_CONNECT_TIMEOUT", "60"))
+
+
+def _registry():
+    from .observability import registry
+
+    return registry()
 
 
 # ------------------------------------------------------------------ wire
@@ -225,7 +261,26 @@ class KVStoreDistServer:
         self._stop = False
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
+        # a restarted server (or a still-draining predecessor in TIME_WAIT
+        # beyond what SO_REUSEADDR covers) must not crash on EADDRINUSE:
+        # retry the bind with exponential backoff + jitter up to
+        # TPUMX_KV_BIND_TIMEOUT seconds, then raise a clear error naming
+        # the endpoint (docs/fault_tolerance.md)
+        deadline = time.time() + float(
+            os.environ.get("TPUMX_KV_BIND_TIMEOUT", "30"))
+        delay = 0.05
+        while True:
+            try:
+                self._sock.bind((host, port))
+                break
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or port == 0 \
+                        or time.time() >= deadline:
+                    raise MXNetError(
+                        f"kvstore server cannot bind {host}:{port}: "
+                        f"{e}") from e
+                time.sleep(delay * (0.5 + _random_mod.random() / 2))
+                delay = min(delay * 2, 1.0)
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
         self._threads: List[threading.Thread] = []
@@ -251,6 +306,19 @@ class KVStoreDistServer:
         try:
             while True:
                 msg = _recv_msg(conn)
+                # fault injection (docs/fault_tolerance.md): kill the
+                # server mid-round — the request is consumed, no reply is
+                # sent, the listener closes.  Workers must recover via the
+                # retry path or surface a peer-naming error
+                from .fault import injector as _fault_injector
+
+                if _fault_injector().server_kill_due():
+                    self._stop = True
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    return
                 # server-side spans: the server's work (merge/update) is
                 # raw jnp, not op dispatch, so the remote profiler records
                 # command-handling durations — the server_* rows the
@@ -479,11 +547,16 @@ class KVStoreDist(KVStore):
                     host="0.0.0.0", port=addrs[s][1], num_workers=self._num))
         self._socks: List[socket.socket] = []
         self._sock_locks: List[threading.Lock] = []
-        for h, p in addrs:
-            self._socks.append(self._connect(
-                h if self._rank or addrs_env else "127.0.0.1", p))
+        # effective connect endpoints, kept for peer-naming errors and
+        # reconnects (rank 0 talks to its in-process tier over loopback)
+        self._addrs: List[tuple] = [
+            (h if self._rank or addrs_env else "127.0.0.1", p)
+            for h, p in addrs]
+        for h, p in self._addrs:
+            self._socks.append(self._connect(h, p))
             self._sock_locks.append(threading.Lock())
         self._n_servers = n_servers
+        self._last_hb_ok: Optional[float] = None
         self._pull_version: Dict[str, int] = {}
         self._barrier_seq = 0
         for s in range(n_servers):
@@ -499,16 +572,18 @@ class KVStoreDist(KVStore):
     def _sock(self):  # primary (server 0) socket — barrier/heartbeat channel
         return self._socks[0]
 
-    def _connect(self, host, port, timeout=60):
-        deadline = time.time() + timeout
+    def _connect(self, host, port, timeout=None):
+        deadline = time.time() + (timeout if timeout is not None
+                                  else _kv_connect_timeout())
         while True:
             try:
                 sock = socket.create_connection((host, port), timeout=10)
                 # connect probes fast, but established-channel reads must
                 # outlast server-side BSP parks (server deadline 600 s) —
-                # a 10 s recv timeout would kill workers waiting at a barrier
-                # behind a slow peer
-                sock.settimeout(630)
+                # TPUMX_KV_TIMEOUT defaults to 630 s so a worker waiting at
+                # a barrier behind a slow peer is not killed; fault tests
+                # tighten it to bound dead-peer detection
+                sock.settimeout(_kv_timeout())
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 return sock
             except OSError:
@@ -517,13 +592,79 @@ class KVStoreDist(KVStore):
                         f"cannot reach kvstore server at {host}:{port}")
                 time.sleep(0.1)
 
-    def _request_on(self, server: int, *msg):
+    def _reconnect(self, server: int) -> None:
+        """Best-effort socket replacement between retries (the old channel
+        is presumed dead).  A failed reconnect leaves the dead socket in
+        place so the next attempt fails fast and consumes its retry."""
+        host, port = self._addrs[server]
         with self._sock_locks[server]:
-            _send_msg(self._socks[server], msg)
-            reply = _recv_msg(self._socks[server])
-        if reply[0] != "ok":
-            raise MXNetError(f"kvstore server error: {reply[1:]}")
-        return reply
+            try:
+                self._socks[server].close()
+            except OSError:
+                pass
+            try:
+                self._socks[server] = self._connect(
+                    host, port,
+                    timeout=min(_kv_connect_timeout(), _kv_timeout()))
+            except MXNetError:
+                pass
+
+    def _request_on(self, server: int, *msg, retries: Optional[int] = None):
+        """One request/reply round-trip with retry + exponential backoff +
+        jitter (``TPUMX_KV_TIMEOUT`` / ``TPUMX_KV_RETRIES`` /
+        ``TPUMX_KV_BACKOFF_MS``): a timed-out or dropped message is resent
+        over a fresh connection; a peer that stays silent raises a clear
+        :class:`MXNetError` NAMING it in bounded time instead of an
+        eternal ``recv()`` (docs/fault_tolerance.md)."""
+        cmd = str(msg[0])
+        retries = _kv_retries() if retries is None else retries
+        base_ms, max_ms = _kv_backoff_ms(), _kv_backoff_max_ms()
+        t0 = time.time()
+        last_err: Optional[BaseException] = None
+        from .fault import injector as _fault_injector
+
+        for attempt in range(retries + 1):
+            if attempt:
+                delay = min(base_ms * (2 ** (attempt - 1)), max_ms)
+                delay *= 0.5 + _random_mod.random() / 2  # jitter
+                with _tracing.span("kvstore.retry", cat="kvstore",
+                                   args={"op": cmd, "attempt": attempt}):
+                    time.sleep(delay / 1e3)
+                _registry().counter(
+                    "kvstore_retries_total", labels={"op": cmd},
+                    help="kvstore worker request retries after "
+                         "timeout/connection loss").inc()
+            try:
+                if _fault_injector().kv_fault(cmd):
+                    raise socket.timeout(
+                        f"fault-injected drop of {cmd!r} request")
+                with self._sock_locks[server]:
+                    _send_msg(self._socks[server], msg)
+                    reply = _recv_msg(self._socks[server])
+            except (socket.timeout, ConnectionError, OSError) as e:
+                last_err = e
+                if attempt < retries:
+                    self._reconnect(server)
+                continue
+            if reply[0] != "ok":
+                raise MXNetError(f"kvstore server error: {reply[1:]}")
+            return reply
+        host, port = self._addrs[server]
+        _registry().counter(
+            "kvstore_dead_peers_total",
+            help="kvstore peers declared dead after exhausting the "
+                 "retry budget").inc()
+        hb = ""
+        if server == 0 and self._last_hb_ok is not None:
+            hb = (f"; last successful heartbeat to this peer was "
+                  f"{time.time() - self._last_hb_ok:.1f}s ago")
+        raise MXNetError(
+            f"kvstore server {host}:{port} (server {server}, worker rank "
+            f"{self._rank}) did not answer a {cmd!r} request after "
+            f"{retries + 1} attempts over {time.time() - t0:.1f}s "
+            f"(TPUMX_KV_TIMEOUT={_kv_timeout():g}s, "
+            f"TPUMX_KV_RETRIES={retries}): {last_err!r}{hb}; "
+            f"the peer is presumed dead")
 
     def _request(self, *msg):
         return self._request_on(0, *msg)
@@ -583,9 +724,13 @@ class KVStoreDist(KVStore):
             while True:  # first beat immediately, then every second
                 _send_msg(sock, ("heartbeat", self._rank))
                 _recv_msg(sock)
+                self._last_hb_ok = time.time()
                 if self._hb_stop.wait(1.0):
                     break
         except (OSError, ConnectionError, MXNetError):
+            # a lost heartbeat channel marks the peer suspect; the request
+            # path's retry/backoff (and its peer-naming error) is the
+            # authoritative detector — don't fight it from this thread
             pass
         finally:
             if sock is not None:
@@ -773,7 +918,8 @@ class KVStoreDist(KVStore):
         self._hb_stop.set()
         for s in range(self._n_servers):
             try:
-                self._request_on(s, "shutdown")
+                # no retries at teardown: a dead server must not stall exit
+                self._request_on(s, "shutdown", retries=0)
             except (MXNetError, ConnectionError, OSError):
                 pass
         for sock in self._socks:

@@ -148,7 +148,11 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
 
     ``remove_amp_cast`` (default True, matching the reference) strips any
     AMP-policy cast nodes before serialization so the checkpoint stays an
-    original-precision graph portable to non-AMP consumers (docs/amp.md)."""
+    original-precision graph portable to non-AMP consumers (docs/amp.md).
+
+    Also writes a ``<params>.manifest.json`` sidecar (sha256 + key list) so
+    ``load_checkpoint`` can detect truncation/corruption and missing keys
+    BEFORE deserialization (docs/fault_tolerance.md)."""
     if symbol is not None:
         if remove_amp_cast:
             from .amp import remove_amp_cast as _strip
@@ -157,24 +161,53 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
         symbol.save(f"{prefix}-symbol.json")
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+    params_path = f"{prefix}-{epoch:04d}.params"
+    nd.save(params_path, save_dict)
+    from .checkpoint.integrity import write_params_manifest
+
+    write_params_manifest(params_path, list(save_dict))
 
 
 def load_checkpoint(prefix, epoch):
-    """Returns (symbol, arg_params, aux_params) — reference: model.py:414."""
+    """Returns (symbol, arg_params, aux_params) — reference: model.py:414.
+
+    File integrity is validated on load: a sidecar manifest (written by
+    ``save_checkpoint``) supplies a sha256 + the full key list, so a
+    truncated/bit-flipped file or a missing parameter raises a clear
+    :class:`MXNetError` naming the file/key instead of a cryptic
+    deserialization error.  Manifest-less (legacy/external) checkpoints
+    still load, with deserialization failures wrapped the same way."""
     import os
+    import struct as _struct
+
+    from .base import MXNetError
+    from .checkpoint.integrity import verify_params_file
 
     symbol = None
     if os.path.exists(f"{prefix}-symbol.json"):
         symbol = sym.load(f"{prefix}-symbol.json")
-    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    params_path = f"{prefix}-{epoch:04d}.params"
+    verify_params_file(params_path)  # existence + size + checksum
+    try:
+        save_dict = nd.load(params_path)
+    except MXNetError:
+        raise
+    except (_struct.error, ValueError, EOFError, OSError, KeyError) as e:
+        raise MXNetError(
+            f"checkpoint file {params_path!r} is corrupt/truncated and "
+            f"cannot be deserialized: {type(e).__name__}: {e}") from e
     arg_params, aux_params = {}, {}
     for k, v in save_dict.items():
+        if ":" not in k:
+            raise MXNetError(
+                f"checkpoint file {params_path!r} holds malformed key "
+                f"{k!r} (expected 'arg:<name>' or 'aux:<name>')")
         tp, name = k.split(":", 1)
         if tp == "arg":
             arg_params[name] = v
         elif tp == "aux":
             aux_params[name] = v
+    verify_params_file(params_path, loaded_keys=list(save_dict))
     return symbol, arg_params, aux_params
 
 

@@ -211,13 +211,27 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None,
-            sparse_row_id_fn=None, shard_rules=None):
+            sparse_row_id_fn=None, shard_rules=None, checkpoint_dir=None,
+            checkpoint_every=0, checkpoint_keep=3, resume=False):
         """The canonical training loop (reference: base_module.py:409).
 
         ``shard_rules``: ordered ``(regex, PartitionSpec)`` partition rules
         (docs/sharding.md) sharding params/grads/optimizer state over the
         ``mp`` mesh axis when ``TPUMX_MP_DEVICES`` > 1; forwarded to
-        ``bind`` on modules that support it."""
+        ``bind`` on modules that support it.
+
+        Fault tolerance (docs/fault_tolerance.md): with ``checkpoint_dir``
+        set, fit snapshots the COMPLETE train state (params, optimizer
+        state incl. AMP masters, loss-scaler, RNG, iterator position)
+        every ``checkpoint_every`` global steps into a background writer —
+        the train step never stalls — retaining the last
+        ``checkpoint_keep`` checkpoints, and installs a SIGTERM/SIGINT
+        handler that writes a final SYNCHRONOUS checkpoint and returns
+        from fit gracefully.  ``resume=True`` discovers the newest *valid*
+        checkpoint (corrupt/truncated ones are skipped by checksum in
+        favor of the previous retained one) and continues mid-epoch with
+        an identical loss trajectory.  Returns True when training ran to
+        completion, False when it exited early on a preemption signal."""
         assert num_epoch is not None, "please specify number of epochs"
         if shard_rules is not None:
             self._shard_rules = shard_rules
@@ -236,6 +250,33 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        # fault tolerance (docs/fault_tolerance.md): periodic async
+        # checkpoints + preemption-driven final synchronous checkpoint +
+        # mid-epoch resume.  All of it is inert without checkpoint_dir.
+        _ckpt = None
+        _preempt = None
+        _resume_skip = 0
+        _global_step = 0
+        if checkpoint_dir is not None:
+            from ..checkpoint import TrainCheckpointer
+            from ..fault.preemption import PreemptionHandler
+
+            _ckpt = TrainCheckpointer(self, checkpoint_dir,
+                                      every=checkpoint_every,
+                                      keep=checkpoint_keep)
+            _preempt = PreemptionHandler().install()
+            _ckpt.attach_preemption(_preempt)
+            if resume:
+                point = _ckpt.restore()
+                if point is not None:
+                    begin_epoch = point.epoch
+                    _resume_skip = point.nbatch
+                    _global_step = point.global_step
+                    self.logger.info(
+                        "resumed from checkpoint at step %d "
+                        "(epoch %d, batch %d)", point.global_step,
+                        point.epoch, point.nbatch)
+
         # step-time observability (docs/observability.md): host wall-clock
         # per batch into the registry histogram — dispatch time only, no
         # device sync added to the fit hot path
@@ -243,69 +284,101 @@ class BaseModule:
             "train_step_seconds",
             help="Module.fit per-batch host wall time (dispatch, no sync)")
         train_data.reset()  # defensive: support reused/exhausted iterators
-        for epoch in range(begin_epoch, num_epoch):
-          with _obs.span(f"fit.epoch[{epoch}]", cat="fit"):
-            tic = time.time()
-            eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                step_tic = time.perf_counter()
-                with _obs.span("fit.batch", cat="fit"):
-                    if not self._try_fused_step(data_batch):
-                        self.forward_backward(data_batch)
-                        self.update()
-                    if isinstance(data_batch, list):
-                        self.update_metric(eval_metric,
-                                           [db.label for db in data_batch],
-                                           pre_sliced=True)
-                    else:
-                        self.update_metric(eval_metric, data_batch.label)
-                step_hist.observe(time.perf_counter() - step_tic)
+        preempted = False
+        try:
+          for epoch in range(begin_epoch, num_epoch):
+            with _obs.span(f"fit.epoch[{epoch}]", cat="fit"):
+                tic = time.time()
+                eval_metric.reset()
+                nbatch = 0
+                data_iter = iter(train_data)
+                if _resume_skip and epoch == begin_epoch:
+                    from ..io import fast_forward
+
+                    nbatch = fast_forward(data_iter, _resume_skip)
+                    _resume_skip = 0
+                end_of_batch = False
+                eval_name_vals = []
                 try:
                     next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch, sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
+                except StopIteration:  # resumed exactly at the epoch end
                     end_of_batch = True
-                if monitor is not None:
-                    monitor.toc_print()
-                if end_of_batch:
                     eval_name_vals = eval_metric.get_name_value()
-                if batch_end_callback is not None:
-                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                           eval_metric=eval_metric, locals=locals())
-                    for cb in _as_list(batch_end_callback):
-                        cb(params)
-                nbatch += 1
+                while not end_of_batch:
+                    data_batch = next_data_batch
+                    if monitor is not None:
+                        monitor.tic()
+                    step_tic = time.perf_counter()
+                    with _obs.span("fit.batch", cat="fit"):
+                        if not self._try_fused_step(data_batch):
+                            self.forward_backward(data_batch)
+                            self.update()
+                        if isinstance(data_batch, list):
+                            self.update_metric(eval_metric,
+                                               [db.label for db in data_batch],
+                                               pre_sliced=True)
+                        else:
+                            self.update_metric(eval_metric, data_batch.label)
+                    step_hist.observe(time.perf_counter() - step_tic)
+                    _global_step += 1
+                    if _ckpt is not None and _ckpt.after_batch(
+                            epoch, nbatch + 1, _global_step):
+                        # final synchronous checkpoint already written by
+                        # the hook; leave the loop without touching the
+                        # iterator again so the process can exit cleanly
+                        preempted = True
+                        break
+                    try:
+                        next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch, sparse_row_id_fn=sparse_row_id_fn)
+                    except StopIteration:
+                        end_of_batch = True
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if end_of_batch:
+                        eval_name_vals = eval_metric.get_name_value()
+                    if batch_end_callback is not None:
+                        params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                               eval_metric=eval_metric, locals=locals())
+                        for cb in _as_list(batch_end_callback):
+                            cb(params)
+                    nbatch += 1
 
-            for name, val in eval_name_vals:
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
+                if preempted:
+                    self.logger.info(
+                        "Epoch[%d] preempted at batch %d (step %d); final "
+                        "checkpoint written, exiting fit", epoch, nbatch,
+                        _global_step)
+                    break
+                for name, val in eval_name_vals:
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                toc = time.time()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
 
-            arg_p, aux_p = self.get_params()
-            if not getattr(self, "_fused_step_count", 0):
-                # under the fused path params already live in the executor and
-                # get_params snapshots are deep copies; writing them back
-                # would re-alias executor buffers with the user's snapshot,
-                # which the next step's donation would invalidate
-                self.set_params(arg_p, aux_p)
-            if epoch_end_callback is not None:
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_p, aux_p)
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
-            train_data.reset()
+                arg_p, aux_p = self.get_params()
+                if not getattr(self, "_fused_step_count", 0):
+                    # under the fused path params already live in the executor and
+                    # get_params snapshots are deep copies; writing them back
+                    # would re-alias executor buffers with the user's snapshot,
+                    # which the next step's donation would invalidate
+                    self.set_params(arg_p, aux_p)
+                if epoch_end_callback is not None:
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_p, aux_p)
+                if eval_data is not None:
+                    res = self.score(eval_data, validation_metric,
+                                     score_end_callback=eval_end_callback,
+                                     batch_end_callback=eval_batch_end_callback,
+                                     epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+                train_data.reset()
+        finally:
+            if _preempt is not None:
+                _preempt.uninstall()
+            if _ckpt is not None:
+                _ckpt.close()
+        return not preempted
 
     # -- misc ---------------------------------------------------------------------
     def prepare(self, data_batch, sparse_row_id_fn=None):
@@ -328,11 +401,28 @@ class BaseModule:
         save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
         save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
         nd.save(fname, save_dict)
+        from ..checkpoint.integrity import write_params_manifest
+
+        write_params_manifest(fname, list(save_dict))
 
     def load_params(self, fname):
-        save_dict = nd.load(fname)
+        import struct as _struct
+
+        from ..checkpoint.integrity import verify_params_file
+
+        verify_params_file(fname)  # checksum/truncation, when manifest exists
+        try:
+            save_dict = nd.load(fname)
+        except MXNetError:
+            raise
+        except (_struct.error, ValueError, EOFError, OSError, KeyError) as e:
+            raise MXNetError(
+                f"param file {fname!r} is corrupt/truncated and cannot be "
+                f"deserialized: {type(e).__name__}: {e}") from e
         arg_params, aux_params = {}, {}
         for k, value in save_dict.items():
+            if ":" not in k:
+                raise ValueError(f"invalid param file {fname}")
             arg_type, name = k.split(":", 1)
             if arg_type == "arg":
                 arg_params[name] = value
@@ -340,6 +430,7 @@ class BaseModule:
                 aux_params[name] = value
             else:
                 raise ValueError(f"invalid param file {fname}")
+        verify_params_file(fname, loaded_keys=list(save_dict))
         self.set_params(arg_params, aux_params)
 
     @property

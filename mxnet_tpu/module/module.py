@@ -634,6 +634,25 @@ class Module(BaseModule):
                   inputs_need_grad=self.inputs_need_grad, force_rebind=True)
         self._exec.copy_params_from(arg_params, aux_params, allow_extra_params=True)
 
+    # -- fault tolerance ----------------------------------------------------------
+    def capture_train_state(self):
+        """Device-copied snapshot of the COMPLETE train state (params, aux,
+        optimizer state incl. AMP masters, optimizer counters, loss-scaler,
+        RNG) as ``(arrays, opt_tree, meta)`` — what one fault-tolerant
+        checkpoint persists (docs/fault_tolerance.md).  Safe against the
+        fused step's buffer donation: nothing here aliases a donated
+        buffer."""
+        from ..checkpoint.train_state import capture_train_state
+
+        return capture_train_state(self)
+
+    def restore_train_state(self, info, arrays, opt_tree):
+        """Install a checkpoint loaded by ``CheckpointManager.restore``
+        into this bound module; returns the ``ResumePoint``."""
+        from ..checkpoint.train_state import restore_train_state
+
+        return restore_train_state(self, info, arrays, opt_tree)
+
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
         self._optimizer = shared_module._optimizer

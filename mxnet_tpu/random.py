@@ -13,7 +13,7 @@ import threading
 
 import jax
 
-__all__ = ["seed", "next_key", "fold_in"]
+__all__ = ["seed", "next_key", "fold_in", "get_state", "set_state"]
 
 _state = threading.local()
 _DEFAULT_SEED = 0
@@ -93,6 +93,46 @@ def next_key():
 
 def fold_in(data: int):
     return jax.random.fold_in(_get_key(), data)
+
+
+def get_state():
+    """The calling thread's raw PRNG key data as a host uint32 array
+    (checkpointing: a resumed run's dropout/sampling streams continue
+    exactly where the interrupted run stopped).  Returns None if the key
+    cannot be read (e.g. a traced key is installed)."""
+    import numpy as _np
+
+    try:
+        key = _get_key()
+        try:  # new-style typed keys carry their raw words behind key_data
+            data = jax.random.key_data(key)
+        except (AttributeError, TypeError):
+            data = key
+        return _np.asarray(data)
+    except Exception:
+        return None
+
+
+def set_state(data) -> None:
+    """Install raw key data captured by :func:`get_state` as this thread's
+    stream key (bypasses the base/seq derivation — the restored stream IS
+    the checkpointed one)."""
+    import numpy as _np
+
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(_np.asarray(data, dtype=_np.uint32))
+    cur = _get_key()
+    typed = False
+    try:  # the live key decides the representation to restore into
+        jax.random.key_data(cur)
+        typed = cur.dtype != arr.dtype
+    except (AttributeError, TypeError):
+        typed = False
+    if typed:
+        arr = jax.random.wrap_key_data(arr)
+    _state.key = arr
+    _state.gen = _base_key()[1]
 
 
 def swap_key(new_key):

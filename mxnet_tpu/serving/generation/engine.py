@@ -466,25 +466,59 @@ class GenerationService:
         _obs.mark_warm()
         return self._programs.compiled_signatures() - before
 
-    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+    def stop(self, drain: bool = True, timeout: Optional[float] = None,
+             reject_queued: bool = False) -> None:
         """Shut down.  ``drain=True`` finishes running AND queued requests
-        first; ``drain=False`` fails them with ServingClosedError."""
+        first; ``drain=False`` fails them with ServingClosedError.
+        ``reject_queued=True`` (with ``drain=True``) is the graceful
+        PREEMPTION mode: requests already decoding in slots run to
+        completion, WAITING ones are rejected with a clear shutdown error
+        — bounded work without abandoning accepted streams."""
         started = self._worker is not None and self._worker.is_alive()
         with self._lock:
             self._closed = True
             self._drain = drain
-            if not started:
-                # no loop to hand them to: fail queued requests inline
+            if reject_queued or not started:
+                # rejected-at-queue (preemption) or no loop to hand them to
                 while self._waiting:
                     self._finish_locked(self._waiting.popleft(),
                                         error=ServingClosedError(
-                                            "generation service shut down"))
+                                            "generation service shutting "
+                                            "down; queued request rejected"))
             self._not_empty.notify_all()
             self._not_full.notify_all()
         if started:
             self._worker.join(timeout)
+        self.uninstall_signal_handlers()
 
     drain_and_stop = stop
+
+    def shutdown(self, timeout: Optional[float] = None) -> None:
+        """Graceful preemption shutdown (docs/fault_tolerance.md): slots
+        finish their generations, queued requests are rejected."""
+        _obs.registry().counter(
+            "serving_graceful_shutdowns_total",
+            help="graceful (signal-driven) service shutdowns").inc()
+        self.stop(drain=True, timeout=timeout, reject_queued=True)
+
+    def install_signal_handlers(self, signals=None) -> bool:
+        """Drain-on-SIGTERM/SIGINT, same hook as InferenceService
+        (mxnet_tpu.fault.preemption).  Returns False off the main thread."""
+        from ...fault.preemption import (DEFAULT_SIGNALS,
+                                         install_shutdown_hook)
+
+        if getattr(self, "_signal_unregister", None) is not None:
+            return True
+        self._signal_unregister = install_shutdown_hook(
+            lambda signum: self.shutdown(),
+            signals or DEFAULT_SIGNALS)
+        return self._signal_unregister is not None
+
+    def uninstall_signal_handlers(self) -> None:
+        unreg = getattr(self, "_signal_unregister", None)
+        if unreg is not None:
+            self._signal_unregister = None
+            unreg()
 
     def __enter__(self):
         return self

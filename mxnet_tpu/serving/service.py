@@ -540,8 +540,42 @@ class InferenceService:
         if self._metrics_server is not None:
             self._metrics_server.close()
             self._metrics_server = None
+        self.uninstall_signal_handlers()
 
     close = stop
+
+    def shutdown(self, timeout: Optional[float] = None) -> None:
+        """Graceful preemption shutdown (docs/fault_tolerance.md): new
+        submits raise :class:`ServingClosedError`, QUEUED requests are
+        rejected with the same clear shutdown error, and the batch
+        currently ON the device completes and delivers its results —
+        bounded work, no stranded futures."""
+        _obs.registry().counter(
+            "serving_graceful_shutdowns_total",
+            help="graceful (signal-driven) service shutdowns").inc()
+        # close(drain=False) fails every queued request; the in-flight
+        # batch was already popped by the worker and runs to completion
+        self.stop(drain=False, timeout=timeout)
+
+    def install_signal_handlers(self, signals=None) -> bool:
+        """Drain-on-SIGTERM/SIGINT (mxnet_tpu.fault.preemption): in-flight
+        requests complete, queued ones are rejected, the process can then
+        exit cleanly.  Returns False when handlers cannot be installed from
+        this thread (call from the main thread)."""
+        from ..fault.preemption import DEFAULT_SIGNALS, install_shutdown_hook
+
+        if getattr(self, "_signal_unregister", None) is not None:
+            return True
+        self._signal_unregister = install_shutdown_hook(
+            lambda signum: self.shutdown(),
+            signals or DEFAULT_SIGNALS)
+        return self._signal_unregister is not None
+
+    def uninstall_signal_handlers(self) -> None:
+        unreg = getattr(self, "_signal_unregister", None)
+        if unreg is not None:
+            self._signal_unregister = None
+            unreg()
 
     def __enter__(self):
         return self

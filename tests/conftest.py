@@ -81,6 +81,12 @@ def pytest_configure(config):
         "observability — metrics registry, structured tracing, recompile "
         "explainer, device-side train telemetry, docs/observability.md; "
         "select with `pytest -m observability`)")
+    config.addinivalue_line(
+        "markers",
+        "fault: fault-tolerant training (mxnet_tpu.checkpoint async "
+        "checkpointing + mxnet_tpu.fault preemption/injection, kvstore "
+        "retry/backoff, serving graceful shutdown; "
+        "docs/fault_tolerance.md; select with `pytest -m fault`)")
 
 
 def pytest_collection_modifyitems(config, items):
