@@ -582,6 +582,114 @@ def serving_latency(requests: int = None, clients: int = None):
     }
 
 
+def mp_compute_train_throughput():
+    """Tensor-parallel COMPUTE vs FSDP vs single-chip on the transformer
+    train step (docs/sharding.md "compute partitioning"): per-step seconds
+    for (a) mp=N with the GSPMD compute-partitioned matmuls, (b) mp=N with
+    the PR-8 gather-compute-slice, and (c) mp=1 — the ROADMAP item-2 claim
+    that more silicon now means faster steps, not just fewer bytes/chip.
+    ``BENCH_MP_COMPUTE=0`` skips; runs in a virtual-device subprocess on
+    1-chip hosts (wiring check there, bandwidth on real chips)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import transformer as tr
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    mp = int(os.environ.get("BENCH_MP_COMPUTE_DEVICES", "2"))
+    devs = jax.devices()
+    if mp > len(devs):
+        raise RuntimeError(
+            f"mp-compute bench wants {mp} devices, have {len(devs)}")
+    steps = int(os.environ.get("BENCH_MP_COMPUTE_STEPS", "8"))
+    batch = int(os.environ.get("BENCH_MP_COMPUTE_BATCH", "8"))
+    T = 256
+    cfg = tr.TransformerConfig(vocab=512, d_model=256, n_heads=8,
+                               n_layers=4, d_ff=1024, max_len=T)
+    params = tr.transformer_lm_init(cfg, jax.random.PRNGKey(0))
+    momenta = jax.tree_util.tree_map(jnp.zeros_like, params)
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(0, cfg.vocab, (batch, T)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, cfg.vocab, (batch, T)), jnp.int32)
+    positions = jnp.arange(T, dtype=jnp.int32)
+
+    def time_leg(step, p, m):
+        loss, p, m = step(p, m, tokens, labels, positions)  # compile+warm
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, p, m = step(p, m, tokens, labels, positions)
+        jax.block_until_ready(loss)
+        return (time.perf_counter() - t0) / steps
+
+    def fresh():
+        return ({k: jnp.array(v, copy=True) for k, v in params.items()},
+                {k: jnp.array(v, copy=True) for k, v in momenta.items()})
+
+    # mp=1 oracle: the single-device jitted train step
+    step1 = jax.jit(lambda p, m, t, l, pos: tr.train_step(p, m, t, l, pos,
+                                                          cfg),
+                    donate_argnums=(0, 1))
+    p, m = fresh()
+    t_mp1 = time_leg(step1, p, m)
+
+    mesh = make_mesh({"dp": 1, "mp": mp}, install=False)
+    legs = {}
+    for name, compute in (("mp_compute", True), ("mp_fsdp", False)):
+        step, shard_fn, _ = tr.make_partitioned_train_step(
+            mesh, cfg, mp_compute=compute)
+        p, m = fresh()
+        legs[name] = time_leg(step, shard_fn(p), shard_fn(m))
+
+    return {
+        "mp": mp,
+        "batch": batch,
+        "seq_len": T,
+        "step_seconds_mp1": round(t_mp1, 5),
+        "step_seconds_mp_compute": round(legs["mp_compute"], 5),
+        "step_seconds_mp_fsdp": round(legs["mp_fsdp"], 5),
+        "compute_vs_fsdp": round(legs["mp_compute"] / legs["mp_fsdp"], 4),
+        "compute_vs_mp1": round(legs["mp_compute"] / t_mp1, 4),
+        "compute_not_slower_than_fsdp":
+            legs["mp_compute"] <= legs["mp_fsdp"],
+        "platform": devs[0].platform,
+    }
+
+
+def _mp_compute_block():
+    """mp-compute measurement for main(): inline when this process sees
+    enough devices, else in the virtual-CPU-mesh subprocess (same recipe
+    as _mp_sharded_block)."""
+    import jax
+
+    mp = int(os.environ.get("BENCH_MP_COMPUTE_DEVICES", "2"))
+    if len(jax.devices()) >= mp:
+        return mp_compute_train_throughput()
+    import re
+    import subprocess
+
+    env = dict(os.environ)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={mp}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the live tunnel
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mp-compute"],
+        capture_output=True, text=True, env=env, timeout=900)
+    for line in proc.stdout.splitlines():
+        try:
+            cand = json.loads(line)
+            if isinstance(cand, dict) and "step_seconds_mp_compute" in cand:
+                return cand
+        except ValueError:
+            continue
+    raise RuntimeError(
+        f"mp-compute subprocess rc={proc.returncode}: "
+        f"{(proc.stderr or proc.stdout).strip()[-300:]}")
+
+
 def lm_decode_throughput(requests: int = None, clients: int = None):
     """Continuous-batching generation under concurrent load
     (docs/generation.md): tokens/sec/chip, p50/p99 time-to-first-token and
@@ -599,6 +707,10 @@ def lm_decode_throughput(requests: int = None, clients: int = None):
     requests = requests or int(os.environ.get("BENCH_DECODE_REQUESTS", "48"))
     clients = clients or int(os.environ.get("BENCH_DECODE_CLIENTS", "8"))
     new_tokens = int(os.environ.get("BENCH_DECODE_NEW_TOKENS", "32"))
+    # BENCH_DECODE_MP > 1 serves the mp-sharded model; since the per-head
+    # shard_map'd kernel landed this decodes through the PAGED fast path
+    # ("kernel": "paged" in the result) — heads permitting
+    mp = int(os.environ.get("BENCH_DECODE_MP", "1") or 1)
     cfg = tr.TransformerConfig(vocab=512, d_model=256, n_heads=8,
                                n_layers=4, d_ff=1024, max_len=512)
     params = tr.transformer_lm_init(cfg, jax.random.PRNGKey(0))
@@ -606,7 +718,8 @@ def lm_decode_throughput(requests: int = None, clients: int = None):
         params, cfg,
         GenerationConfig(max_slots=8, block_size=32, num_blocks=256,
                          seq_buckets=[64, 128, 256],
-                         max_new_tokens=new_tokens, queue_bound=1024))
+                         max_new_tokens=new_tokens, queue_bound=1024,
+                         mp_devices=mp))
     warmed = svc.warmup()
     per_client = requests // clients
     errors = []
@@ -642,6 +755,7 @@ def lm_decode_throughput(requests: int = None, clients: int = None):
         # "paged" (Pallas block-table kernel) vs "gather" (dense XLA path):
         # the trajectory attributes decode wins to the active kernel
         "kernel": stats.get("decode_kernel", "gather"),
+        "mp_devices": mp,
         "tokens_per_sec": round(total_tokens / wall, 1),
         "tokens_per_sec_per_chip": round(total_tokens / wall / n_chips, 1),
         "ttft_p50_ms": stats["ttft_ms"]["p50"],
@@ -1112,6 +1226,13 @@ def main():
             sys.stderr.write(f"mp-sharded bench failed: "
                              f"{type(e).__name__}: {e}\n")
             result["mp_sharded_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_MP_COMPUTE", "1") == "1":
+        try:
+            result["mp_compute_train_throughput"] = _mp_compute_block()
+        except Exception as e:  # optional block: failure is a field, not rc!=0
+            sys.stderr.write(f"mp-compute bench failed: "
+                             f"{type(e).__name__}: {e}\n")
+            result["mp_compute_error"] = f"{type(e).__name__}: {e}"
     if os.environ.get("BENCH_TELEMETRY", "1") == "1":
         try:
             result["telemetry_overhead"] = telemetry_overhead()
@@ -1154,6 +1275,8 @@ if __name__ == "__main__":
         print(json.dumps(multichip_train_throughput()))
     elif "--mp-sharded" in sys.argv:
         print(json.dumps(mp_sharded_train_throughput()))
+    elif "--mp-compute" in sys.argv:
+        print(json.dumps(mp_compute_train_throughput()))
     elif "--measure" in sys.argv:
         main()
     else:

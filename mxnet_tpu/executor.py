@@ -127,6 +127,15 @@ class Executor:
         self._spmd_param_specs: Dict[str, tuple] = {}
         self._spmd_batch_args: frozenset = frozenset()
         self._spmd_out_is_batch: List[bool] = []
+        # tensor-parallel COMPUTE (docs/sharding.md): with compute=True the
+        # fused step compiles as a GSPMD global-view jit whose matmuls XLA
+        # partitions along the rule specs — no per-leaf all_gather forward
+        self._spmd_compute = False
+        # pipeline parallelism (docs/sharding.md): (PipelinePlan, n_micro)
+        # when the mesh carries a "pp" axis and the bound symbol is
+        # stage-stackable — the fused program runs the body as a microbatch
+        # round-robin over the pp ranks (parallel/pipeline.py)
+        self._spmd_pipeline = None
         self._spmd_active = False  # a fused SPMD step has run (buffers live
         # replicated/sharded on the mesh; eager paths must reconcile)
         # device-side train telemetry (docs/observability.md): last-step
@@ -174,7 +183,8 @@ class Executor:
 
     # -- SPMD annotation ----------------------------------------------------------
     def set_spmd(self, mesh, batch_args, axis: str = "dp",
-                 param_specs=None) -> None:
+                 param_specs=None, compute: bool = False,
+                 pipeline=None) -> None:
         """Attach a data-parallel mesh to this executor (or detach with
         ``mesh=None``).  ``batch_args`` are the argument names carrying the
         batch dimension (data + labels): they shard on ``axis``; every other
@@ -187,12 +197,28 @@ class Executor:
         each non-trivial spec — becomes part of ``_signature`` so a program
         compiled for one device count / layout is never served to another;
         with ``param_specs=None`` the signature stays byte-identical to the
-        dp-only layout."""
+        dp-only layout.
+
+        ``compute=True`` (tensor-parallel compute, docs/sharding.md) makes
+        the fused step a GSPMD global-view program: the specs become
+        ``with_sharding_constraint`` pins and XLA partitions the matmuls
+        themselves — the forward never materializes a full copy of a
+        rule-sharded weight (vs. the default FSDP gather-compute-slice).
+        Only meaningful with ``param_specs``; keys its own programs via a
+        ``("mp_compute", 1)`` signature component.
+
+        ``pipeline=(plan, n_micro)`` (a :class:`~mxnet_tpu.symbol.staging
+        .PipelinePlan`) runs the plan's body as a GPipe microbatch
+        round-robin over the mesh's ``"pp"`` axis inside the same single
+        donated program; the signature gains ``("pp", n_stages, n_micro)``
+        plus the full mesh axis map."""
         if mesh is None:
             self._spmd_mesh = None
             self._spmd_batch_args = frozenset()
             self._spmd_param_specs = {}
             self._spmd_out_is_batch = []
+            self._spmd_compute = False
+            self._spmd_pipeline = None
             return
         ndev = int(mesh.shape[axis])
         batch_args = frozenset(batch_args)
@@ -237,10 +263,29 @@ class Executor:
                 st = spec_tuple(s)
                 if any(e is not None for e in st):
                     specs[n] = st
+        if pipeline is not None:
+            plan, n_micro = pipeline
+            if "pp" not in mesh.axis_names:
+                raise MXNetError("set_spmd: pipeline requires a 'pp' mesh "
+                                 "axis")
+            if int(plan.n_stages) != int(mesh.shape["pp"]):
+                raise MXNetError(
+                    f"set_spmd: plan has {plan.n_stages} stages but the pp "
+                    f"axis is {int(mesh.shape['pp'])} wide")
+            local_batch = batch // ndev
+            if int(n_micro) < 1 or local_batch % int(n_micro):
+                raise MXNetError(
+                    f"set_spmd: local batch {local_batch} not divisible by "
+                    f"{n_micro} microbatches (TPUMX_PP_MICROBATCHES)")
+            pipeline = (plan, int(n_micro))
         self._spmd_mesh = mesh
         self._spmd_axis = axis
         self._spmd_param_specs = specs
         self._spmd_batch_args = batch_args
+        # the pipelined program is a shard_map: GSPMD compute partitioning
+        # only applies on the pipeline-free mesh (docs/sharding.md)
+        self._spmd_compute = bool(compute and specs and pipeline is None)
+        self._spmd_pipeline = pipeline
 
     def _spmd_ndev(self) -> int:
         if self._spmd_mesh is None:
@@ -291,18 +336,28 @@ class Executor:
             sig.append(("mesh", self._spmd_axis, self._spmd_ndev(),
                         int(self._spmd_mesh.devices.size),
                         tuple(sorted(self._spmd_batch_args))))
-            if self._spmd_param_specs:
+            if self._spmd_param_specs or self._spmd_pipeline is not None:
                 # partition-rule layout (docs/sharding.md): the full mesh
                 # axis map plus each sharded param's resolved spec key their
                 # own programs — and feed the recompile explainer's
                 # "spec p('dp',None)→p('dp','mp') (name)" causes.  With no
-                # specs (rules=None) these entries are ABSENT and the
-                # signature stays byte-identical to the dp-only layout.
+                # specs (rules=None) and no pipeline these entries are
+                # ABSENT and the signature stays byte-identical to the
+                # dp-only layout.
                 sig.append(("meshshape", tuple(
                     (str(a), int(self._spmd_mesh.shape[a]))
                     for a in self._spmd_mesh.axis_names)))
+            if self._spmd_param_specs:
                 for n in sorted(self._spmd_param_specs):
                     sig.append(("spec", n, self._spmd_param_specs[n]))
+                if self._spmd_compute:
+                    # tensor-parallel COMPUTE keys its own programs; with
+                    # TPUMX_MP_COMPUTE=0 this component is absent and the
+                    # key is byte-identical to the FSDP gather layout
+                    sig.append(("mp_compute", 1))
+            if self._spmd_pipeline is not None:
+                plan, n_micro = self._spmd_pipeline
+                sig.append(("pp", int(plan.n_stages), int(n_micro)))
         return tuple(sig)
 
     def _get_fwd(self, is_train: bool):
@@ -509,6 +564,10 @@ class Executor:
                         telemetry: bool = False, state_specs=None):
         spmd = self._spmd_total() > 1
         pspecs = dict(self._spmd_param_specs) if spmd else {}
+        # tensor-parallel compute (docs/sharding.md): GSPMD global-view jit
+        # instead of the shard_map gather-compute-slice program
+        mp_compute = bool(spmd and pspecs and self._spmd_compute)
+        pp_cfg = self._spmd_pipeline if spmd else None
         reqs = tuple(sorted((n, self.grad_req.get(n, "write"))
                             for n in self._grad_arg_names))
         key = ("fused_step", self._signature(True), int(num_steps),
@@ -545,8 +604,20 @@ class Executor:
             # device's shard and the (elementwise) optimizer update runs
             # shard-wise, so the persistent donated buffers never hold more
             # than 1/mp of any rule-matched leaf.
+            if pp_cfg is not None:
+                # pipelined body (docs/sharding.md): the plan's prologue/
+                # round-robin/epilogue replaces the flat whole-graph trace;
+                # same env contract, same outputs
+                plan, n_micro = pp_cfg
+
+                def trace_model(env, rng, aux_dict):
+                    return plan.apply(env, True, rng, aux_dict, n_micro)
+            else:
+                def trace_model(env, rng, aux_dict):
+                    return trace(entries, env, True, rng,
+                                 collect_aux=aux_dict)
             tele_axes = None
-            if pspecs:
+            if pspecs and not mp_compute:
                 mesh_sizes = {str(a): int(self._spmd_mesh.shape[a])
                               for a in self._spmd_mesh.axis_names}
                 spec_of = {n: pspecs.get(n, ()) for n in gnames}
@@ -595,19 +666,26 @@ class Executor:
 
                 def slice_grad(n, g):
                     return g
-            if spmd and kvstore is not None \
+            if spmd and not mp_compute and kvstore is not None \
                     and hasattr(kvstore, "reduce_in_program"):
                 # tpu_sync: the store IS the collective boundary — its
                 # in-trace hook emits the psum (kvstore.py)
                 def allreduce(g):
                     return kvstore.reduce_in_program(g, axis)
-            elif spmd:
+            elif spmd and not mp_compute:
                 from .parallel.collectives import allreduce as _psum
 
                 def allreduce(g):
                     return {n: _psum(v, axis) for n, v in g.items()}
             else:
+                # mp-compute (GSPMD global view): the gradient of the global
+                # batch is computed directly — XLA inserts whatever
+                # collectives the partitioning needs; there is no per-shard
+                # sum to combine
                 allreduce = None
+            # GSPMD has no named axes in-trace: telemetry norms/loss are
+            # already global values there
+            tele_pmean = None if mp_compute else axis
 
             from .optimizer import fused_apply_update
 
@@ -618,8 +696,7 @@ class Executor:
                     env.update(gvals)
                     env.update(aux_vals)
                     aux_updates: Dict[str, object] = {}
-                    outs = trace(entries, env, True, rng,
-                                 collect_aux=aux_updates)
+                    outs = trace_model(env, rng, aux_updates)
                     return outs, aux_updates
 
                 # forward/backward over the FULL params (all_gather of the
@@ -642,6 +719,15 @@ class Executor:
                         else jnp.zeros_like(v)
                         for k, v in aux_updates.items()})
                 (grads,) = vjp(cts)
+                if pp_cfg is not None:
+                    # combine over the pp axis (parallel/pipeline.py):
+                    # prologue + stage param cotangents are rank-gated
+                    # (nonzero on one pp rank) → psum; epilogue params are
+                    # exact and replica-invariant already → identity
+                    grads = {
+                        n: (jax.lax.psum(g, "pp")
+                            if plan.pp_combine(n) == "psum" else g)
+                        for n, g in grads.items() if g is not None}
                 if allreduce is not None:
                     # in-program allreduce over the dp axis: per-shard grad
                     # sums combine into the full-batch gradient, exactly what
@@ -771,7 +857,7 @@ class Executor:
                     ret = ret + (_obs_tele.compute_in_program(
                         outs, grads, p,
                         scaler_state=sc if scaler is not None else None,
-                        pmean_axis=axis, psum_axes=tele_axes),)
+                        pmean_axis=tele_pmean, psum_axes=tele_axes),)
                 return ret
 
             if scaler is None:
@@ -786,7 +872,53 @@ class Executor:
                                       aux_vals, lr_vec, wd, t_vec, rng,
                                       sc_state)
 
-            if spmd:
+            if mp_compute:
+                # GSPMD global view (docs/sharding.md "compute
+                # partitioning"): ONE jit traced at GLOBAL shapes — the same
+                # math as the single-device fused step — with the rule specs
+                # pinned via with_sharding_constraint so XLA partitions the
+                # matmuls themselves (column-parallel QKV/FFN-in,
+                # row-parallel proj/FFN-out, one reduce per block).  No
+                # all_gather of any rule-sharded weight appears in the
+                # traced program; numerics match mp=1 to reduction-order
+                # (tests assert rtol 1e-5).
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                mesh = self._spmd_mesh
+                spec_of_c = {n: pspecs.get(n, ()) for n in gnames}
+                wsc = jax.lax.with_sharding_constraint
+
+                def _pin(v, spec):
+                    return wsc(v, NamedSharding(mesh, P(*spec)))
+
+                def fused_gspmd(pvals, gvals, svals, batch_vals, const_vals,
+                                aux_vals, lr_vec, wd, t_vec, rng, *sc):
+                    pvals = {n: _pin(v, spec_of_c[n])
+                             for n, v in pvals.items()}
+                    batch_vals = {n: _pin(v, (axis,))
+                                  for n, v in batch_vals.items()}
+                    other_vals = dict(const_vals)
+                    other_vals.update(batch_vals)
+                    res = fused(pvals, gvals, svals, other_vals, aux_vals,
+                                lr_vec, wd, t_vec, rng, *sc)
+                    outs, auxu, grads, p, s = res[:5]
+                    # pin the persistent (donated) carries back to their
+                    # stored layout so the program's outputs alias its
+                    # inputs and the steady state never re-lays-out
+                    grads = {n: _pin(v, spec_of_c[n])
+                             for n, v in grads.items()}
+                    p = {n: _pin(v, spec_of_c[n]) for n, v in p.items()}
+                    if state_specs is not None:
+                        s = {n: jax.tree_util.tree_map(
+                            lambda leaf, sp: wsc(leaf,
+                                                 NamedSharding(mesh, sp)),
+                            s[n], state_specs[n]) for n in s}
+                    return (outs, auxu, grads, p, s) + tuple(res[5:])
+
+                self._jit_cache[key] = jax.jit(fused_gspmd,
+                                               donate_argnums=(0, 1, 2))
+            elif spmd:
                 from jax.sharding import PartitionSpec as P
 
                 from .parallel.collectives import shard_map_compat

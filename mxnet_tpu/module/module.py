@@ -155,6 +155,21 @@ class Module(BaseModule):
             n = 0
         return n if n > 1 else 1
 
+    def _pp_size(self) -> int:
+        """Pipeline-parallel width (``TPUMX_PP_DEVICES``): >1 adds a ``pp``
+        axis to the fused-step mesh and, when the bound symbol is
+        stage-stackable (symbol/staging.py), runs the repeated body as a
+        GPipe microbatch round-robin inside the ONE donated program
+        (docs/sharding.md)."""
+        import os
+
+        env = os.environ.get("TPUMX_PP_DEVICES", "")
+        try:
+            n = int(env) if env else 0
+        except ValueError:
+            n = 0
+        return n if n > 1 else 1
+
     # -- binding ------------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -235,38 +250,123 @@ class Module(BaseModule):
 
         ndev = self._dp_size()
         mp = self._mp_size()
-        if (ndev * mp <= 1 or not self.for_training or self._state_names
+        pp = self._pp_size()
+        if (ndev * mp * pp <= 1 or not self.for_training or self._state_names
                 or os.environ.get("TPUMX_FUSED_STEP", "1") == "0"
                 or os.environ.get("TPUMX_FUSED_STEP_SPMD", "1") == "0"):
             return
+        rules = None
+        if mp > 1:
+            from ..parallel import partition_rules as _pr
+
+            env_rules = _pr.rules_from_env()
+            rules = (self._shard_rules or env_rules
+                     or _pr.DEFAULT_FSDP_RULES)
+            # unknown mesh-axis names in a rule must raise a clear error
+            # NOW, not surface as an opaque shard_map failure (or a silent
+            # legacy-path fallback) three layers down
+            axes = ("dp",) + (("pp",) if pp > 1 else ()) \
+                + (("mp",) if mp > 1 else ())
+            _pr.validate_rule_axes(
+                rules, axes,
+                source=("TPUMX_SHARD_RULES" if self._shard_rules is None
+                        and env_rules is not None else "shard_rules"))
         try:
-            from ..parallel.mesh import make_mesh
-
-            devices = None
-            if len(self._context) > 1 and mp <= 1:
-                devices = [c.jax_device for c in self._context]
-            mesh = make_mesh({"dp": ndev, "mp": mp} if mp > 1
-                             else {"dp": ndev},
-                             devices=devices, install=False)
-            param_specs = None
-            if mp > 1:
-                from ..parallel import partition_rules as _pr
-
-                rules = (self._shard_rules or _pr.rules_from_env()
-                         or _pr.DEFAULT_FSDP_RULES)
-                shapes = {n: tuple(self._exec.arg_dict[n].shape)
-                          for n in self._param_names
-                          if n not in self._fixed_param_names
-                          and n in self._exec.arg_dict}
-                param_specs = _pr.make_param_specs(rules, shapes, mesh,
-                                                   mp_axis="mp")
-            self._exec.set_spmd(
-                mesh, batch_args=self._data_names + self._label_names,
-                param_specs=param_specs)
+            self._attach_spmd_mesh(ndev, mp, pp, rules)
         except Exception as e:
             self.logger.warning(
                 "SPMD fused step unavailable (%s); multi-device fit will use "
                 "the legacy executor-group path", e)
+
+    def _attach_spmd_mesh(self, ndev, mp, pp, rules):
+        from ..parallel.mesh import make_mesh
+
+        devices = None
+        if len(self._context) > 1 and mp <= 1 and pp <= 1:
+            devices = [c.jax_device for c in self._context]
+        pipeline = None
+        if pp > 1:
+            # stage-stackable symbols pipeline the repeated body over a pp
+            # axis (symbol/staging.py); anything else drops pp with a
+            # logged reason and trains on the dp×mp mesh
+            pipeline = self._plan_pipeline(ndev, pp)
+            if pipeline is None:
+                pp = 1
+        axes = {"dp": ndev}
+        if pp > 1:
+            axes["pp"] = pp
+        if mp > 1:
+            axes["mp"] = mp
+        if ndev * mp * pp <= 1:
+            return
+        mesh = make_mesh(axes, devices=devices, install=False)
+        param_specs = None
+        compute = False
+        if mp > 1:
+            from ..parallel import partition_rules as _pr
+
+            shapes = {n: tuple(self._exec.arg_dict[n].shape)
+                      for n in self._param_names
+                      if n not in self._fixed_param_names
+                      and n in self._exec.arg_dict}
+            param_specs = _pr.make_param_specs(rules, shapes, mesh,
+                                               mp_axis="mp")
+            # tensor-parallel COMPUTE (docs/sharding.md): explicit
+            # column/row rule sets partition the matmuls via GSPMD; the
+            # FSDP catch-all keeps gather-compute-slice.  TPUMX_MP_COMPUTE=0
+            # pins the gather path byte-for-byte (keys included).  The
+            # pipelined program is a shard_map — mp stays a storage axis
+            # under pp.
+            compute = (pp <= 1 and _pr.mp_compute_enabled()
+                       and _pr.rules_compute_partitionable(rules))
+        self._exec.set_spmd(
+            mesh, batch_args=self._data_names + self._label_names,
+            param_specs=param_specs, compute=compute, pipeline=pipeline)
+
+    def _plan_pipeline(self, ndev, pp):
+        """(plan, n_micro) when the bound symbol splits into ``pp`` stages
+        and the microbatch count divides the per-dp-shard batch; None (with
+        a logged reason) otherwise."""
+        import os
+
+        import jax
+
+        from ..symbol.staging import PlanError, plan_pipeline
+
+        batch = self._data_shapes[0][1][0] if self._data_shapes else 0
+        if not batch or batch % ndev:
+            return None
+        local_batch = batch // ndev
+        env = os.environ.get("TPUMX_PP_MICROBATCHES", "")
+        try:
+            n_micro = int(env) if env else 0
+        except ValueError:
+            n_micro = 0
+        if not n_micro:
+            n_micro = next((m for m in (4 * pp, 2 * pp, pp)
+                            if m <= local_batch and local_batch % m == 0), 0)
+        if n_micro < 1 or local_batch % n_micro:
+            self.logger.warning(
+                "pipeline: local batch %d has no usable microbatch count "
+                "(TPUMX_PP_MICROBATCHES=%s); dropping the pp axis",
+                local_batch, env or "auto")
+            return None
+        structs = {n: jax.ShapeDtypeStruct(tuple(a.shape), a._data.dtype)
+                   for n, a in list(self._exec.arg_dict.items())
+                   + list(self._exec.aux_dict.items())}
+        try:
+            plan = plan_pipeline(
+                self._exec._symbol._entries, pp, structs,
+                input_names=(self._data_names + self._label_names
+                             + self._state_names))
+        except PlanError as e:
+            self.logger.warning(
+                "pipeline: symbol is not stage-stackable (%s); dropping "
+                "the pp axis", e)
+            return None
+        self.logger.info("pipeline: %s, %d microbatches", plan.describe(),
+                         n_micro)
+        return (plan, n_micro)
 
     # -- params -------------------------------------------------------------------
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
@@ -511,7 +611,8 @@ class Module(BaseModule):
                       zip([s[0] for s in self._data_shapes], data_batch.data)}
         if any(cur[n] != s for n, s in new_shapes.items()):
             self._reshape_exec(data_batch)
-        if (self._dp_size() > 1 or self._mp_size() > 1) \
+        if (self._dp_size() > 1 or self._mp_size() > 1
+                or self._pp_size() > 1) \
                 and self._exec._spmd_mesh is not None:
             # one device_put per array with a NamedSharding on the batch
             # axis, mutating the batch's NDArrays in place: executor feed AND

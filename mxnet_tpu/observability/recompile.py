@@ -109,6 +109,10 @@ def _components(key: tuple) -> Dict[tuple, object]:
             out[("meshshape",)] = item[1]
         elif isinstance(item, tuple) and item and item[0] == "spec":
             out[("spec", item[1])] = item[2]
+        elif isinstance(item, tuple) and item and item[0] == "pp":
+            out[("pp",)] = item[1:]
+        elif isinstance(item, tuple) and item and item[0] == "mp_compute":
+            out[("mp_compute",)] = item[1]
         else:
             out[("sig", repr(item))] = item
     for i, item in enumerate(key[2:]):
@@ -153,6 +157,18 @@ def _describe(slot: tuple, old, new) -> str:
             return "×".join(f"{a}={n}" for a, n in ms)
 
         return f"mesh shape {_fmt(old)}→{_fmt(new)}"
+    if slot[0] == "pp":
+        # 3-axis pipeline drift (docs/sharding.md):
+        # "pipeline off→pp=2×mb=8", "pipeline pp=2×mb=8→pp=4×mb=16"
+        def _fmt(v):
+            return "off" if not v else f"pp={v[0]}×mb={v[1]}"
+
+        return f"pipeline {_fmt(old)}→{_fmt(new)}"
+    if slot[0] == "mp_compute":
+        def _fmt(v):
+            return "on" if v else "off"
+
+        return f"tensor-parallel compute {_fmt(old)}→{_fmt(new)}"
     if slot[0] == "is_train":
         return f"is_train {old}→{new}"
     if slot[0] == "static":

@@ -37,7 +37,8 @@ from jax.experimental import pallas as pl
 
 _NEG = -1e30
 
-__all__ = ["paged_attention", "paged_attention_reference", "attention_scale"]
+__all__ = ["paged_attention", "paged_attention_reference", "attention_scale",
+           "paged_attention_sharded"]
 
 
 def attention_scale(d_head: int) -> float:
@@ -187,3 +188,47 @@ def paged_attention(q, k_pool, v_pool, block_tables, positions, max_pos,
         jnp.asarray(max_pos, jnp.int32), q,
         jnp.asarray(positions, jnp.int32), k_pool, v_pool, float(scale),
         _use_interpret())
+
+
+def paged_attention_sharded(q, k_pool, v_pool, block_tables, positions,
+                            max_pos, mesh, axis: str = "mp", scale=None):
+    """:func:`paged_attention` partitioned PER HEAD over a model-parallel
+    mesh axis (docs/sharding.md, docs/generation.md).
+
+    An opaque ``pallas_call`` cannot be partitioned by GSPMD, which is why
+    mp-sharded generation historically fell back to the gather+dense path.
+    But the kernel's grid is ``(B, H, W)`` with every head independent — so
+    a ``shard_map`` over the head dimension runs the SAME kernel on each
+    mp rank's head slice (Q, K/V pool, and output all head-sharded; block
+    tables / positions replicated — they are head-invariant).  Per-head
+    numerics are bit-identical to the unsharded kernel.
+
+    Requires ``H % mesh.shape[axis] == 0`` (the caller gates kernel choice
+    on this at service construction).  Works inside an outer GSPMD ``jit``:
+    the surrounding column-parallel QKV projection already produces
+    head-sharded activations, so no resharding is inserted at the boundary.
+    """
+    from ..base import MXNetError
+    from ..parallel.collectives import shard_map_compat
+
+    H = q.shape[2]
+    n = int(mesh.shape[axis])
+    if H % n:
+        raise MXNetError(
+            f"paged_attention_sharded: {H} heads not divisible by mesh "
+            f"axis {axis!r} of size {n}")
+    if scale is None:
+        scale = attention_scale(q.shape[3])
+    from jax.sharding import PartitionSpec as P
+
+    hspec = P(None, None, axis, None)   # heads at dim 2 for q AND the pools
+    fn = shard_map_compat(
+        lambda q, k, v, t, p, m: paged_attention(q, k, v, t, p, m,
+                                                 scale=scale),
+        mesh=mesh,
+        in_specs=(hspec, hspec, hspec, P(), P(), P()),
+        out_specs=hspec, check=False)
+    return fn(q, k_pool, v_pool,
+              jnp.asarray(block_tables, jnp.int32),
+              jnp.asarray(positions, jnp.int32),
+              jnp.asarray(max_pos, jnp.int32))

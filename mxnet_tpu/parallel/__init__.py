@@ -13,6 +13,8 @@ parallelism):
 - ring_attention.py blockwise ring attention over the sequence axis
 - sequence_parallel.py all-to-all (DeepSpeed-Ulysses style) sequence sharding
 - pipeline.py      pipeline parallelism via shard_map + ppermute microbatching
+                   (differentiable scan schedule — the pp axis behind
+                   Module.fit, symbol/staging.py + docs/sharding.md)
 - compression.py   2-bit gradient compression w/ error feedback
                    (src/kvstore/gradient_compression.*)
 - partition_rules.py regex→PartitionSpec sharding rules (tensor parallel +

@@ -40,7 +40,9 @@ import numpy as _np
 __all__ = ["FSDP", "DEFAULT_FSDP_RULES", "match_partition_rules",
            "resolve_spec", "make_param_specs", "spec_tuple", "spec_str",
            "shard_params", "gather_params", "make_shard_and_gather_fns",
-           "rules_from_env", "bytes_per_device", "max_bytes_per_device"]
+           "rules_from_env", "bytes_per_device", "max_bytes_per_device",
+           "rules_compute_partitionable", "validate_rule_axes",
+           "mp_compute_enabled"]
 
 #: sentinel spec: shard the first divisible dim on the model axis
 #: (ZeRO/FSDP-style fully-sharded storage)
@@ -227,6 +229,56 @@ def make_shard_and_gather_fns(specs: Dict[str, object], mesh):
         return gather_params(params, mesh)
 
     return shard_fn, gather_fn
+
+
+def mp_compute_enabled() -> bool:
+    """``TPUMX_MP_COMPUTE`` gate (default ON): whether compute-partitionable
+    rule sets run the GSPMD tensor-parallel-compute fused step.  ``=0``
+    restores the FSDP gather-compute-slice program byte-for-byte, compile
+    keys included (docs/sharding.md)."""
+    return os.environ.get("TPUMX_MP_COMPUTE", "1") != "0"
+
+
+def rules_compute_partitionable(rules) -> bool:
+    """Whether a rule set describes a COMPUTE partitioning: every spec is an
+    explicit per-dim placement (Megatron column/row style) that XLA's SPMD
+    partitioner can push through the matmuls.  A rule carrying the ``FSDP``
+    sentinel makes the whole set storage-only — FSDP means
+    gather-compute-slice by construction, so those keep the PR-8 path."""
+    for _pat, spec in rules or ():
+        if spec == FSDP or spec == (FSDP,):
+            return False
+    return True
+
+
+def validate_rule_axes(rules, axis_names, source: str = "shard_rules"):
+    """Raise :class:`~mxnet_tpu.base.MXNetError` when any rule names a mesh
+    axis that does not exist, identifying the rule, the bad axis, and the
+    mesh axes — instead of the opaque shard_map/NamedSharding error the
+    stale name would otherwise surface as three layers down.
+
+    ``axis_names``: the bound mesh's axis names (a Mesh is accepted too).
+    """
+    from ..base import MXNetError
+
+    if not rules:
+        return
+    if hasattr(axis_names, "axis_names"):
+        axis_names = axis_names.axis_names
+    known = {str(a) for a in axis_names}
+    for pat, spec in rules:
+        if spec == FSDP or spec == (FSDP,):
+            continue
+        for entry in spec_tuple(spec):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            for a in axes:
+                if str(a) not in known:
+                    raise MXNetError(
+                        f"{source}: rule {pat!r} names mesh axis {a!r}, "
+                        f"which is not in the bound mesh "
+                        f"(axes: {sorted(known)})")
 
 
 def rules_from_env(env: Optional[str] = None):

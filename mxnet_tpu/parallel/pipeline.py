@@ -4,6 +4,23 @@ The reference has only inter-layer model parallelism with cross-device copies
 (`group2ctx` + _CrossDeviceCopy nodes, SURVEY.md §2.3); this provides true
 GPipe-style pipelining: stages live on the `pp` mesh axis, microbatches flow
 stage-to-stage over ICI with a steady-state bubble of (S-1)/(M+S-1).
+
+The tick loop is a ``lax.scan`` (not ``fori_loop``) so the WHOLE schedule is
+reverse-differentiable: ``jax.grad`` through :func:`pipeline_apply` replays
+the ring backwards (ppermute transposes to the inverse permutation), which is
+what lets ``Executor.fused_step`` trace forward+backward+update over a
+pipelined model as ONE donated program (docs/sharding.md).  Gradient
+bookkeeping contract under ``shard_map(check=False)``:
+
+- the microbatch input is consumed through a ``rank == 0`` select, so its
+  cotangent — and every parameter upstream of it — is nonzero ONLY on stage
+  0: combine those with ``psum`` over the pp axis;
+- each stage's parameters are used only on their own rank: also ``psum``;
+- :func:`psum_bcast` replicates the last stage's committed outputs with a
+  custom VJP whose backward is the identity (the raw ``psum`` transposes to
+  another psum under ``check=False``, which would multiply every cotangent
+  flowing through the pipeline output by the stage count) — downstream
+  (replicated) consumers then see exact gradients with NO pp combination.
 """
 from __future__ import annotations
 
@@ -17,7 +34,33 @@ from jax.sharding import Mesh, PartitionSpec
 
 from .mesh import get_mesh
 
-__all__ = ["pipeline_apply", "pipeline_apply_sharded"]
+__all__ = ["pipeline_apply", "pipeline_apply_sharded", "psum_bcast"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_bcast(x, axis_name: str):
+    """``lax.psum`` whose transpose is the IDENTITY, for replicating a value
+    that is nonzero on exactly one member of ``axis_name`` (the pipeline's
+    last-stage outputs) to all members.
+
+    Inside ``shard_map(check=False)`` the stock ``psum`` transposes to a
+    psum of the cotangents, so a replicated consumer downstream would inject
+    ``axis_size`` copies of the gradient back into the pipeline.  Since every
+    rank's downstream cotangent is replica-invariant here, the identity
+    backward is exact.
+    """
+    return lax.psum(x, axis_name)
+
+
+def _psum_bcast_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _psum_bcast_bwd(axis_name, _res, ct):
+    return (ct,)
+
+
+psum_bcast.defvjp(_psum_bcast_fwd, _psum_bcast_bwd)
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
@@ -26,10 +69,14 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
 
     stage_fn(params, x) -> y             one pipeline stage (same shape in/out)
     stage_params                         this device's stage params (leading
-                                         stage dim already split by shard_map)
+                                         stage dim already split by shard_map,
+                                         or sliced via ``lax.axis_index``)
     x_microbatches: (M, ...) microbatches; only stage 0's input is used.
 
-    Returns (M, ...) outputs valid on the LAST stage (others zeros).
+    Returns (M, ...) outputs valid on the LAST stage (others zeros); combine
+    with :func:`psum_bcast` to replicate them across the axis with correct
+    gradients.  Differentiable end to end (the round-robin is a ``lax.scan``
+    over M + S - 1 ticks).
     """
     from .collectives import axis_size
 
@@ -40,14 +87,14 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
     state = jnp.zeros_like(x_microbatches[0])
     outputs = jnp.zeros_like(x_microbatches)
     # mark carries as device-varying over the pp axis up front: the loop body
-    # makes them varying (rank-dependent writes), and lax.fori_loop requires
-    # carry types to be invariant across iterations.  Older jax has neither
-    # lax.pcast nor vma tracking — there the zeros carries are already fine.
+    # makes them varying (rank-dependent writes), and the scan carry type
+    # must be invariant across iterations.  Older jax has neither lax.pcast
+    # nor vma tracking — there the zeros carries are already fine.
     if hasattr(lax, "pcast"):
         state = lax.pcast(state, (axis_name,), to="varying")
         outputs = lax.pcast(outputs, (axis_name,), to="varying")
 
-    def tick(t, carry):
+    def tick(carry, t):
         state, outputs = carry
         # stage 0 ingests microbatch t (if still available)
         mb_idx = jnp.clip(t, 0, M - 1)
@@ -64,9 +111,10 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
         # shift activations one stage down the ring
         perm = [(j, (j + 1) % n) for j in range(n)]
         state = lax.ppermute(y, axis_name, perm)
-        return (state, outputs)
+        return (state, outputs), None
 
-    state, outputs = lax.fori_loop(0, T, tick, (state, outputs))
+    (state, outputs), _ = lax.scan(tick, (state, outputs),
+                                   jnp.arange(T, dtype=jnp.int32))
     return outputs
 
 
@@ -81,8 +129,9 @@ def pipeline_apply_sharded(stage_fn: Callable, stacked_params, x_microbatches,
         # shard_map splits the stage dim; drop it inside
         params = jax.tree_util.tree_map(lambda p: p[0], params)
         out = pipeline_apply(stage_fn, params, x, axis_name)
-        # outputs are zeros except on the last stage → psum replicates them
-        return lax.psum(out, axis_name)
+        # outputs are zeros except on the last stage → replicate them with
+        # the transpose-correct broadcast so grads flow through unscaled
+        return psum_bcast(out, axis_name)
 
     from .collectives import shard_map_compat
 

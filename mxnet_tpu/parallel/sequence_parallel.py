@@ -53,7 +53,25 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
 def ulysses_attention_sharded(q, k, v, mesh: Optional[Mesh] = None,
                               axis_name: str = "sp", causal: bool = False,
                               impl: str = "dense"):
+    """Host entry.  Validates the mesh/shape contract up front — a missing
+    axis or an indivisible head count otherwise surfaces as an opaque
+    shard_map/all_to_all error three layers down (the same discipline as
+    ``partition_rules.validate_rule_axes``)."""
+    from ..base import MXNetError
+
     mesh = mesh or get_mesh()
+    if mesh is None or axis_name not in mesh.axis_names:
+        axes = sorted(str(a) for a in mesh.axis_names) if mesh is not None \
+            else []
+        raise MXNetError(
+            f"ulysses_attention_sharded: axis {axis_name!r} is not in the "
+            f"bound mesh (axes: {axes})")
+    n = int(mesh.shape[axis_name])
+    heads = q.shape[2]
+    if heads % n:
+        raise MXNetError(
+            f"ulysses_attention_sharded: {heads} heads not divisible by "
+            f"mesh axis {axis_name!r} of size {n}")
     spec = PartitionSpec(None, axis_name, None, None)
     from .collectives import shard_map_compat
 

@@ -131,7 +131,8 @@ def transformer_lm_apply(params: Params, tokens, positions,
 def transformer_lm_decode(params: Params, tokens, positions, lengths,
                           k_pool, v_pool, block_tables,
                           cfg: TransformerConfig, compute_dtype=None,
-                          attention_kernel: Optional[str] = None):
+                          attention_kernel: Optional[str] = None,
+                          mp_mesh=None):
     """Cache-aware forward: read/write a paged per-layer KV cache.
 
     The generation engine's one model step, serving BOTH phases
@@ -190,8 +191,11 @@ def transformer_lm_decode(params: Params, tokens, positions, lengths,
     # instead of gathering the whole (B, W*bs) bucket per token.  Read at
     # trace time; =0 keeps the gather+dense path (and its programs) intact.
     # ``attention_kernel`` ("paged"/"gather") pins the choice explicitly —
-    # GenerationPrograms freezes it per service (and forces "gather" under
-    # an mp mesh, where GSPMD can't partition an opaque kernel call).
+    # GenerationPrograms freezes it per service.  Under an mp mesh GSPMD
+    # cannot partition the opaque kernel call itself, but ``mp_mesh`` routes
+    # it through a per-head shard_map (ops/paged_attention
+    # .paged_attention_sharded) whenever heads divide the axis — mp-sharded
+    # models decode through the fast path (docs/generation.md).
     from ..ops import pallas_kernels as _pk
     from ..ops import paged_attention as _pa
     from ..ops.paged_attention import paged_attention_reference as \
@@ -217,7 +221,11 @@ def transformer_lm_decode(params: Params, tokens, positions, lengths,
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
         k_pool = k_pool.at[i, phys, offs].set(k.astype(k_pool.dtype))
         v_pool = v_pool.at[i, phys, offs].set(v.astype(v_pool.dtype))
-        if use_paged:
+        if use_paged and mp_mesh is not None:
+            o = _pa.paged_attention_sharded(
+                q, k_pool[i], v_pool[i], block_tables, positions, max_pos,
+                mesh=mp_mesh, axis="mp", scale=kernel_scale)
+        elif use_paged:
             o = _pa.paged_attention(q, k_pool[i], v_pool[i], block_tables,
                                     positions, max_pos, scale=kernel_scale)
         else:
@@ -372,7 +380,8 @@ def transformer_partition_rules(mp_axis: str = "mp"):
 
 def make_partitioned_train_step(mesh: Mesh, cfg: TransformerConfig,
                                 rules=None, lr=0.1, momentum=0.9,
-                                compute_dtype=None):
+                                compute_dtype=None,
+                                mp_compute: Optional[bool] = None):
     """The rule-set successor of :func:`make_sharded_train_step`: ONE
     compiled dp×mp training step whose params and momenta are STORED
     sharded per partition rules (docs/sharding.md) instead of replicated —
@@ -389,17 +398,35 @@ def make_partitioned_train_step(mesh: Mesh, cfg: TransformerConfig,
     positions) -> (loss, params, momenta)`` jitted with donated sharded
     carries; ``shard_fn``/``gather_fn`` place/unplace a param dict
     (checkpoint boundary).
+
+    ``mp_compute`` (default: the ``TPUMX_MP_COMPUTE`` gate, on whenever the
+    rule set is compute-partitionable) turns ``mp`` from a storage axis into
+    a COMPUTE axis: instead of the shard_map gather-compute-slice, the step
+    is a GSPMD global-view ``jit`` whose matmuls XLA partitions along the
+    Megatron column/row specs — column-parallel QKV/FFN-in, row-parallel
+    attention-out/FFN-out, one reduce per block, and NO all_gather of any
+    rule-sharded weight in the traced program (tests assert the jaxpr).
+    Step time now improves with mp, which is the ROADMAP item-2 claim.
     """
     from .collectives import shard_map_compat
     from .partition_rules import (make_param_specs,
-                                  make_shard_and_gather_fns)
+                                  make_shard_and_gather_fns,
+                                  mp_compute_enabled,
+                                  rules_compute_partitionable)
 
     if rules is None:
         rules = transformer_partition_rules()
+    if mp_compute is None:
+        mp_compute = (mp_compute_enabled()
+                      and rules_compute_partitionable(rules))
     key0 = jax.random.PRNGKey(0)
     shapes = {k: tuple(v.shape)
               for k, v in transformer_lm_init(cfg, key0).items()}
     specs = make_param_specs(rules, shapes, mesh, mp_axis="mp")
+    if mp_compute:
+        return _make_compute_partitioned_train_step(
+            mesh, cfg, specs, shapes, lr=lr, momentum=momentum,
+            compute_dtype=compute_dtype)
     mesh_sizes = {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
     dp = mesh_sizes.get("dp", 1)
 
@@ -452,5 +479,52 @@ def make_partitioned_train_step(mesh: Mesh, cfg: TransformerConfig,
         in_specs=(pspec_tree, pspec_tree, P("dp"), P("dp"), P()),
         out_specs=(P(), pspec_tree, pspec_tree), check=False)
     step = jax.jit(fn, donate_argnums=(0, 1))
+    shard_fn, gather_fn = make_shard_and_gather_fns(specs, mesh)
+    return step, shard_fn, gather_fn
+
+
+def _make_compute_partitioned_train_step(mesh: Mesh, cfg: TransformerConfig,
+                                         specs, shapes, lr=0.1, momentum=0.9,
+                                         compute_dtype=None):
+    """The tensor-parallel-COMPUTE variant of
+    :func:`make_partitioned_train_step`: a GSPMD global-view ``jit`` traced
+    at global batch shapes — the exact math of the single-device
+    :func:`train_step` — with every rule-sharded param pinned to its spec by
+    ``with_sharding_constraint``.  XLA's SPMD partitioner then splits the
+    einsums themselves: the column-parallel QKV/FFN-in matmuls compute only
+    their local output features, the row-parallel projections contract their
+    local input slice and combine with one reduce per block, and no
+    all_gather of a rule-sharded weight exists anywhere in the program
+    (tests/test_mp_compute.py asserts the jaxpr and optimized HLO)."""
+    from jax.sharding import NamedSharding
+
+    from .partition_rules import make_shard_and_gather_fns
+
+    spec_of = {k: specs.get(k, ()) for k in shapes}
+    has_dp = "dp" in mesh.axis_names
+
+    def _pin(x, spec):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+
+    def step(params, momenta, tokens, labels, positions):
+        params = {k: _pin(v, spec_of[k]) for k, v in params.items()}
+        momenta = {k: _pin(v, spec_of[k]) for k, v in momenta.items()}
+        if has_dp:
+            tokens = _pin(tokens, ("dp",))
+            labels = _pin(labels, ("dp",))
+
+        def loss_fn(p):
+            return lm_loss(p, tokens, labels, positions, cfg,
+                           compute_dtype=compute_dtype)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        momenta = {k: _pin(momentum * momenta[k] + grads[k], spec_of[k])
+                   for k in momenta}
+        params = {k: _pin(params[k] - lr * momenta[k], spec_of[k])
+                  for k in params}
+        return loss, params, momenta
+
+    step = jax.jit(step, donate_argnums=(0, 1))
     shard_fn, gather_fn = make_shard_and_gather_fns(specs, mesh)
     return step, shard_fn, gather_fn

@@ -271,6 +271,9 @@ class GenerationService:
                                             compute_dtype=compute_dtype,
                                             mp_devices=cfg.mp_devices,
                                             shard_rules=cfg.shard_rules)
+        # mp + paged kernel: the pool lives head-sharded on the mp mesh
+        # (1/mp of the cache per chip, docs/generation.md)
+        self._programs.place_cache(self._cache)
         # prefill ladder: bounded by the model's position table — a prompt
         # must also leave room for at least one generated token
         max_prompt = model_cfg.max_len - 1

@@ -28,7 +28,8 @@ __all__ = ["GenerationPrograms"]
 
 def _model_step(params, k_pool, v_pool, tokens, positions, lengths,
                 block_tables, seeds, counters, temperature, top_k, top_p,
-                *, cfg, compute_dtype, attention_kernel="gather"):
+                *, cfg, compute_dtype, attention_kernel="gather",
+                mp_mesh=None):
     import jax.numpy as jnp
 
     from ...ops.sampling import sample_logits
@@ -37,7 +38,7 @@ def _model_step(params, k_pool, v_pool, tokens, positions, lengths,
     logits, k_pool, v_pool = transformer_lm_decode(
         params, tokens, positions, lengths, k_pool, v_pool, block_tables,
         cfg, compute_dtype=compute_dtype,
-        attention_kernel=attention_kernel)
+        attention_kernel=attention_kernel, mp_mesh=mp_mesh)
     # logits at the LAST VALID position of each row feed the sampler
     # (prefill: position len-1 predicts token len; decode: T=1 row 0)
     last_idx = jnp.clip(jnp.asarray(lengths, jnp.int32) - 1, 0,
@@ -77,18 +78,24 @@ class GenerationPrograms:
                 rules, {k: tuple(v.shape) for k, v in params.items()},
                 self._mp_mesh, mp_axis="mp")
         # the attention kernel (docs/pallas.md) is frozen at service
-        # construction: TPUMX_PALLAS read ONCE here, and an mp mesh forces
-        # the gather path (GSPMD cannot partition an opaque Pallas call) —
-        # so a mid-run env flip can never desync keys from traced programs
+        # construction: TPUMX_PALLAS read ONCE here.  GSPMD cannot
+        # partition an opaque Pallas call, but under an mp mesh the kernel
+        # runs as a per-head shard_map (paged_attention_sharded) whenever
+        # the heads divide the axis — mp-sharded models decode through the
+        # fast path; an indivisible head count is the only gather fallback.
+        # A mid-run env flip can never desync keys from traced programs.
         from ...ops.pallas_kernels import pallas_enabled
 
-        self._kernel = ("paged" if self._mp_mesh is None and
-                        pallas_enabled() else "gather")
+        mp_ok = (self._mp_mesh is None
+                 or cfg.n_heads % int(self._mp_mesh.shape["mp"]) == 0)
+        self._kernel = "paged" if pallas_enabled() and mp_ok else "gather"
         self._params = self._place_params(params)
         self._jit = jax.jit(
-            functools.partial(_model_step, cfg=cfg,
-                              compute_dtype=compute_dtype,
-                              attention_kernel=self._kernel),
+            functools.partial(
+                _model_step, cfg=cfg, compute_dtype=compute_dtype,
+                attention_kernel=self._kernel,
+                mp_mesh=(self._mp_mesh if self._kernel == "paged"
+                         else None)),
             donate_argnums=(1, 2))
         self._lock = threading.Lock()
         self._stats: Dict[tuple, Dict[str, int]] = {}
@@ -102,6 +109,21 @@ class GenerationPrograms:
 
             out = shard_params(out, self._mp_specs, self._mp_mesh)
         return out
+
+    def place_cache(self, cache) -> None:
+        """Lay the paged KV pool out for this service's mesh: under mp with
+        the per-head paged kernel the pool lives HEAD-SHARDED on the mp
+        axis — each chip stores 1/mp of the cache (the same memory win the
+        params already get), and the donated decode programs keep that
+        layout steady-state.  No-op without an mp mesh."""
+        if self._mp_mesh is None or self._kernel != "paged":
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # (n_layers, num_blocks, block_size, n_heads, d_head): heads dim 3
+        sh = NamedSharding(self._mp_mesh, P(None, None, None, "mp", None))
+        cache.swap(jax.device_put(cache.k, sh), jax.device_put(cache.v, sh))
 
     def refresh_params(self, params) -> None:
         """Swap in updated model weights (programs are shape-keyed, so no

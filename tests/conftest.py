@@ -77,6 +77,12 @@ def pytest_configure(config):
         "docs/pallas.md; select with `pytest -m pallas`)")
     config.addinivalue_line(
         "markers",
+        "pp: pipeline-parallel training (TPUMX_PP_DEVICES — stage-stacked "
+        "symbol staging + GPipe microbatch round-robin inside the fused "
+        "step over the (dp,pp,mp) mesh, parallel/pipeline.py + "
+        "symbol/staging.py, docs/sharding.md; select with `pytest -m pp`)")
+    config.addinivalue_line(
+        "markers",
         "observability: unified runtime observability (mxnet_tpu."
         "observability — metrics registry, structured tracing, recompile "
         "explainer, device-side train telemetry, docs/observability.md; "

@@ -1,0 +1,388 @@
+"""Tensor-parallel COMPUTE (docs/sharding.md "compute partitioning"): the
+GSPMD fused train step that replaces the FSDP per-leaf all_gather forward
+whenever the rule set is compute-partitionable — Module.fit parity at mp=2
+vs the mp=1 fused step (SGD, Adam, AMP bf16/fp16), the no-all-gather
+property asserted on the traced program, the ``TPUMX_MP_COMPUTE=0`` escape
+hatch (byte-identical PR-8 gather path + keys), the transformer island's
+compute-partitioned ``make_partitioned_train_step``, and the
+``validate_rule_axes`` satellite (unknown mesh axes raise MXNetError naming
+the rule, the axis, and the mesh axes instead of an opaque shard_map error).
+
+Runs on the conftest-forced 8-virtual-CPU-device backend.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.executor import compile_cache_stats
+from mxnet_tpu.parallel import partition_rules as pr
+from mxnet_tpu.parallel.mesh import make_mesh
+
+pytestmark = pytest.mark.sharding
+
+ENVS = ("TPUMX_DP_DEVICES", "TPUMX_MP_DEVICES", "TPUMX_PP_DEVICES",
+        "TPUMX_SHARD_RULES", "TPUMX_MP_COMPUTE", "TPUMX_AMP",
+        "TPUMX_AMP_DTYPE", "TPUMX_AMP_LOSS_SCALE")
+
+#: Megatron-style column/row placement for the test MLP: fc1 shards its
+#: output features (dim 0 of the (nh, in) weight), fc2 its input features
+RULES = ((r"fc1_weight", ("mp", None)), (r"fc2_weight", (None, "mp")))
+RULES_ENV = "fc1_weight=mp,-;fc2_weight=-,mp"
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for k in ENVS:
+        monkeypatch.delenv(k, raising=False)
+    yield
+
+
+def _net(nh=32, classes=4):
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    h = sym.FullyConnected(data, num_hidden=nh, name="fc1")
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(h, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(out, label, name="softmax")
+
+
+def _iter(n=320, dim=8, classes=4, batch=32):
+    r = np.random.RandomState(0)
+    Y = r.randint(0, classes, n).astype(np.float32)
+    X = r.rand(n, dim).astype(np.float32) * 0.3
+    for c in range(classes):
+        X[Y == c, c] += 1.0
+    return mx.io.NDArrayIter(X, Y, batch_size=batch)
+
+
+class _FusedSpy:
+    """Capture the raw fused-step callable + its (abstract) call signature
+    the first time the executor jits it, so tests can render the traced
+    program's jaxpr without touching donated buffers."""
+
+    def __init__(self, monkeypatch, names=("fused_gspmd", "fused_spmd")):
+        self.cap = {}
+        real = jax.jit
+
+        def spy(f, *a, **k):
+            w = real(f, *a, **k)
+            if getattr(f, "__name__", "") not in names:
+                return w
+            cap = self.cap
+
+            def wrapper(*ca, **ck):
+                if "structs" not in cap:
+                    cap["f"] = f
+                    cap["structs"] = jax.tree_util.tree_map(
+                        lambda x: (jax.ShapeDtypeStruct(x.shape, x.dtype)
+                                   if hasattr(x, "shape")
+                                   and hasattr(x, "dtype") else x), ca)
+                return w(*ca, **ck)
+
+            return wrapper
+
+        monkeypatch.setattr(jax, "jit", spy)
+
+    def jaxpr(self) -> str:
+        assert "f" in self.cap, "no fused program was compiled"
+        return str(jax.make_jaxpr(self.cap["f"])(*self.cap["structs"]))
+
+    @property
+    def kind(self) -> str:
+        return self.cap["f"].__name__
+
+
+def _fit(monkeypatch, env, optimizer="sgd",
+         opt_params=(("learning_rate", 0.5),), spy=False, num_epoch=1,
+         shard_rules=None):
+    for k in ENVS:
+        monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    mx.random.seed(0)
+    np.random.seed(0)
+    spy_obj = _FusedSpy(monkeypatch) if spy else None
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mod.fit(_iter(), num_epoch=num_epoch, optimizer=optimizer,
+            kvstore="tpu_sync", optimizer_params=dict(opt_params),
+            shard_rules=shard_rules)
+    arg, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in arg.items()}, spy_obj
+
+
+def _close(pa, pb, **kw):
+    kw.setdefault("rtol", 1e-5)
+    kw.setdefault("atol", 1e-7)
+    for k in pb:
+        np.testing.assert_allclose(pa[k], pb[k], err_msg=k, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Module.fit parity + the no-all-gather property
+# ---------------------------------------------------------------------------
+
+def test_mp2_compute_matches_mp1_sgd_and_no_all_gather(monkeypatch):
+    _, p0, _ = _fit(monkeypatch, {})
+    mod, pc, spy = _fit(monkeypatch, {"TPUMX_MP_DEVICES": "2",
+                                      "TPUMX_SHARD_RULES": RULES_ENV},
+                        spy=True)
+    assert mod._exec._spmd_compute
+    assert spy.kind == "fused_gspmd"
+    # the defining property: the forward never materializes a full copy of
+    # a column/row-ruled weight — no all_gather anywhere in the program
+    # (GSPMD inserts only what the einsum partitioning needs, post-trace)
+    assert "all_gather" not in spy.jaxpr()
+    assert mod._fused_step_count == 10
+    _close(p0, pc)
+    # live storage is still sharded: ~0.5x param bytes per chip
+    arrs = [mod._exec.arg_dict["fc1_weight"], mod._exec.arg_dict["fc2_weight"]]
+    per_dev = pr.bytes_per_device(arrs)
+    total = sum(a.size * 4 for a in arrs)
+    assert max(per_dev.values()) <= total // 2
+
+
+def test_mp2_compute_matches_mp1_adam(monkeypatch):
+    _, p0, _ = _fit(monkeypatch, {}, optimizer="adam",
+                    opt_params=(("learning_rate", 1e-2),))
+    mod, pc, _ = _fit(monkeypatch, {"TPUMX_MP_DEVICES": "2",
+                                    "TPUMX_SHARD_RULES": RULES_ENV},
+                      optimizer="adam",
+                      opt_params=(("learning_rate", 1e-2),))
+    assert mod._exec._spmd_compute
+    _close(p0, pc)
+
+
+@pytest.mark.parametrize("amp_env", [
+    {"TPUMX_AMP": "1", "TPUMX_AMP_DTYPE": "bfloat16"},
+    {"TPUMX_AMP": "1", "TPUMX_AMP_DTYPE": "float16",
+     "TPUMX_AMP_LOSS_SCALE": "dynamic"},
+])
+def test_mp2_compute_amp_matches_mp1(monkeypatch, amp_env):
+    """AMP rides the same single program: mp=2-compute equals the mp=1
+    fused AMP step (bf16, and fp16 with the traced dynamic loss scaler)."""
+    _, p0, _ = _fit(monkeypatch, dict(amp_env), optimizer="adam",
+                    opt_params=(("learning_rate", 1e-2),))
+    env = dict(amp_env)
+    env.update({"TPUMX_MP_DEVICES": "2", "TPUMX_SHARD_RULES": RULES_ENV})
+    mod, pc, _ = _fit(monkeypatch, env, optimizer="adam",
+                      opt_params=(("learning_rate", 1e-2),))
+    assert mod._exec._spmd_compute
+    _close(p0, pc, rtol=1e-5, atol=1e-6)
+
+
+def test_dp2_mp2_compute_matches(monkeypatch):
+    _, p0, _ = _fit(monkeypatch, {})
+    mod, pc, _ = _fit(monkeypatch, {"TPUMX_DP_DEVICES": "2",
+                                    "TPUMX_MP_DEVICES": "2",
+                                    "TPUMX_SHARD_RULES": RULES_ENV})
+    assert mod._exec._spmd_compute
+    _close(p0, pc)
+
+
+def test_compile_discipline_one_miss(monkeypatch):
+    base = compile_cache_stats()["by_site"].get("fused_step",
+                                                {"hits": 0, "misses": 0})
+    mod, _, _ = _fit(monkeypatch, {"TPUMX_MP_DEVICES": "2",
+                                   "TPUMX_SHARD_RULES": RULES_ENV},
+                     num_epoch=2)
+    assert mod._fused_step_count == 20
+    after = compile_cache_stats()["by_site"]["fused_step"]
+    assert after["misses"] - base["misses"] == 1
+    assert after["hits"] - base["hits"] == 19
+
+
+# ---------------------------------------------------------------------------
+# escape hatch + gating
+# ---------------------------------------------------------------------------
+
+def test_escape_hatch_keeps_gather_path(monkeypatch):
+    """TPUMX_MP_COMPUTE=0 restores the PR-8 shard_map program: the compute
+    flag is off, the signature carries no mp_compute component, and the
+    traced program DOES all_gather the rule-sharded params (the FSDP
+    gather-compute-slice forward) — while training identically."""
+    _, p0, _ = _fit(monkeypatch, {})
+    mod, pf, spy = _fit(monkeypatch, {"TPUMX_MP_DEVICES": "2",
+                                      "TPUMX_SHARD_RULES": RULES_ENV,
+                                      "TPUMX_MP_COMPUTE": "0"}, spy=True)
+    assert not mod._exec._spmd_compute
+    assert spy.kind == "fused_spmd"
+    assert "all_gather" in spy.jaxpr()
+    assert not any(c[0] == "mp_compute" for c in mod._exec._signature(True)
+                   if isinstance(c, tuple))
+    _close(p0, pf)
+
+
+def test_fsdp_rules_keep_gather_path(monkeypatch):
+    """The FSDP catch-all is storage-only by construction: no compute flag
+    even with TPUMX_MP_COMPUTE unset (default on)."""
+    mod, pf, spy = _fit(monkeypatch, {"TPUMX_MP_DEVICES": "2"}, spy=True)
+    assert not mod._exec._spmd_compute
+    assert spy.kind == "fused_spmd"
+    _, p0, _ = _fit(monkeypatch, {})
+    _close(p0, pf)
+
+
+def test_rules_compute_partitionable():
+    assert pr.rules_compute_partitionable(RULES)
+    assert not pr.rules_compute_partitionable(((r".*", pr.FSDP),))
+    assert not pr.rules_compute_partitionable(
+        RULES + ((r".*", pr.FSDP),))
+    assert pr.rules_compute_partitionable(None)
+
+
+# ---------------------------------------------------------------------------
+# validate_rule_axes (satellite): clear MXNetError, not an opaque failure
+# ---------------------------------------------------------------------------
+
+def test_validate_rule_axes_names_rule_axis_and_mesh():
+    with pytest.raises(MXNetError) as ei:
+        pr.validate_rule_axes(((r"fc1_weight", ("tp", None)),),
+                              ("dp", "mp"), source="TPUMX_SHARD_RULES")
+    msg = str(ei.value)
+    assert "TPUMX_SHARD_RULES" in msg and "fc1_weight" in msg
+    assert "'tp'" in msg and "dp" in msg and "mp" in msg
+    # a Mesh is accepted directly, FSDP sentinels are exempt
+    mesh = make_mesh({"dp": 2, "mp": 2}, install=False)
+    pr.validate_rule_axes(((r".*", pr.FSDP),), mesh)
+    pr.validate_rule_axes(RULES, mesh)
+
+
+def test_unknown_axis_in_env_rules_raises_at_bind(monkeypatch):
+    monkeypatch.setenv("TPUMX_MP_DEVICES", "2")
+    monkeypatch.setenv("TPUMX_SHARD_RULES", "fc1_weight=tp,-")
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    with pytest.raises(MXNetError) as ei:
+        mod.bind(data_shapes=[("data", (32, 8))],
+                 label_shapes=[("softmax_label", (32,))])
+    msg = str(ei.value)
+    assert "TPUMX_SHARD_RULES" in msg and "'tp'" in msg and "mp" in msg
+
+
+# ---------------------------------------------------------------------------
+# transformer island: compute-partitioned make_partitioned_train_step
+# ---------------------------------------------------------------------------
+
+def _tr_setup():
+    from mxnet_tpu.parallel import transformer as tr
+
+    cfg = tr.TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                               d_ff=64, max_len=32)
+    params = tr.transformer_lm_init(cfg, jax.random.PRNGKey(0))
+    momenta = jax.tree_util.tree_map(jnp.zeros_like, params)
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(0, cfg.vocab, (8, 16)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, cfg.vocab, (8, 16)), jnp.int32)
+    positions = jnp.arange(16, dtype=jnp.int32)
+    return tr, cfg, params, momenta, tokens, labels, positions
+
+
+@pytest.mark.parametrize("compute_dtype", [None, jnp.bfloat16])
+def test_transformer_compute_partitioned_step_matches_oracle(compute_dtype):
+    """The acceptance asset: the transformer train step at mp=2 with
+    compute partitioning matches the mp=1 oracle at rtol 1e-5 (f32 and AMP
+    bf16) while the traced program contains NO all_gather of the
+    column/row-ruled params, and the compiled HLO no all-gather at all."""
+    tr, cfg, params, momenta, tokens, labels, positions = _tr_setup()
+    p_ref = dict(params)
+    m_ref = dict(momenta)
+    losses_ref = []
+    for _ in range(3):
+        loss, p_ref, m_ref = tr.train_step(p_ref, m_ref, tokens, labels,
+                                           positions, cfg,
+                                           compute_dtype=compute_dtype)
+        losses_ref.append(float(loss))
+
+    mesh = make_mesh({"dp": 2, "mp": 2}, install=False)
+    step, shard_fn, gather_fn = tr.make_partitioned_train_step(
+        mesh, cfg, mp_compute=True, compute_dtype=compute_dtype)
+    jaxpr = str(jax.make_jaxpr(lambda p, m: step(p, m, tokens, labels,
+                                                 positions))(
+        params, momenta))
+    assert "all_gather" not in jaxpr
+    p = shard_fn({k: jnp.array(v, copy=True) for k, v in params.items()})
+    m = shard_fn({k: jnp.array(v, copy=True) for k, v in momenta.items()})
+    assert len(p["l0_wqkv"].sharding.device_set) == 4
+    # the compiled HLO may gather small ACTIVATIONS where the partitioner
+    # prefers it, but never a full copy of a column/row-ruled WEIGHT — the
+    # memory that made FSDP gather-compute-slice a non-win for step time
+    from mxnet_tpu.parallel.partition_rules import make_param_specs
+    from mxnet_tpu.parallel.transformer import transformer_partition_rules
+
+    if compute_dtype is None:  # one AOT compile is enough for the property
+        shapes = {k: tuple(v.shape) for k, v in params.items()}
+        ruled = {shapes[k] for k in make_param_specs(
+            transformer_partition_rules(), shapes, mesh)}
+        hlo = step.lower(p, m, tokens, labels,
+                         positions).compile().as_text()
+        import re as _re
+
+        gathered = {
+            tuple(int(d) for d in m_.group(1).split(","))
+            for m_ in _re.finditer(
+                r"all-gather\.?\d*\s*=\s*\w+\[([\d,]+)\]", hlo)}
+        gathered |= {
+            tuple(int(d) for d in m_.group(1).split(","))
+            for m_ in _re.finditer(
+                r"=\s*\w+\[([\d,]+)\][^=]*\ball-gather\(", hlo)}
+        assert not (gathered & ruled), (
+            f"full weight materialized: {gathered & ruled}")
+    losses = []
+    for _ in range(3):
+        loss, p, m = step(p, m, tokens, labels, positions)
+        losses.append(float(loss))
+    # f32 holds the acceptance rtol 1e-5; the all-bf16-compute leg sees
+    # reduction-order deltas at bf16 resolution (the f32-master AMP parity
+    # at 1e-5 lives in test_mp2_compute_amp_matches_mp1)
+    tol = dict(rtol=1e-5, atol=1e-6) if compute_dtype is None \
+        else dict(rtol=2e-2, atol=1e-3)
+    np.testing.assert_allclose(losses, losses_ref, **tol)
+    p_full = gather_fn(p)
+    for k in p_ref:
+        np.testing.assert_allclose(np.asarray(p_full[k], np.float32),
+                                   np.asarray(p_ref[k], np.float32),
+                                   err_msg=k, **tol)
+
+
+def test_transformer_fsdp_variant_still_available():
+    """mp_compute=False pins the PR-8 shard_map gather step (the FSDP
+    path stays selectable per-call regardless of the env gate)."""
+    tr, cfg, params, momenta, tokens, labels, positions = _tr_setup()
+    p_ref, m_ref = dict(params), dict(momenta)
+    for _ in range(2):
+        _, p_ref, m_ref = tr.train_step(p_ref, m_ref, tokens, labels,
+                                        positions, cfg)
+    mesh = make_mesh({"dp": 2, "mp": 2}, install=False)
+    step, shard_fn, gather_fn = tr.make_partitioned_train_step(
+        mesh, cfg, mp_compute=False)
+    p = shard_fn({k: jnp.array(v, copy=True) for k, v in params.items()})
+    m = shard_fn({k: jnp.array(v, copy=True) for k, v in momenta.items()})
+    for _ in range(2):
+        _, p, m = step(p, m, tokens, labels, positions)
+    p_full = gather_fn(p)
+    for k in p_ref:
+        np.testing.assert_allclose(np.asarray(p_full[k]),
+                                   np.asarray(p_ref[k]), rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# explainer: compute-flag drift renders per-site
+# ---------------------------------------------------------------------------
+
+def test_explainer_renders_mp_compute_drift(monkeypatch):
+    from mxnet_tpu.observability import recompile as rc
+
+    rc.reset()
+    monkeypatch.setenv("TPUMX_EXPLAIN_RECOMPILES", "1")
+    _fit(monkeypatch, {"TPUMX_MP_DEVICES": "2",
+                       "TPUMX_SHARD_RULES": RULES_ENV})
+    _fit(monkeypatch, {"TPUMX_MP_DEVICES": "2",
+                       "TPUMX_SHARD_RULES": RULES_ENV,
+                       "TPUMX_MP_COMPUTE": "0"})
+    causes = [c for e in rc.last_explanations() for c in e["causes"]]
+    assert any("tensor-parallel compute on→off" in c for c in causes), causes
