@@ -23,9 +23,25 @@ reproducible:
   manager corrupts the Nth committed checkpoint right after writing it
   (every one without ``@N``), proving restore falls back to the previous
   retained checkpoint via checksum validation.
+- ``TPUMX_FAULT_GEN_STEP_FAIL=N[@rid]`` — the generation engine's Nth
+  decode-step invocation raises before the program is dispatched.  Bare
+  ``N`` is one-shot (the retry path must absorb it with zero blast
+  radius); ``N@rid`` poisons request ``rid`` persistently from the Nth
+  invocation on — every decode batch containing it fails, so the
+  bisect-quarantine path must isolate exactly that request
+  (docs/generation.md "failure isolation").
+- ``TPUMX_FAULT_GEN_KILL_REPLICA=N[@K]`` — the generation router kills
+  replica index ``N`` right after dispatching its ``K``-th request to it
+  (default 1): the engine loop exits abruptly, streams hang, and the
+  router's health probe / circuit breaker / resubmission path must
+  recover (docs/fault_tolerance.md recovery matrix, serving rows).
 
-All counters live in one process-wide :class:`FaultInjector` (``injector()``);
-``reset()`` re-reads the environment — tests flip env vars per case.
+Specs are parsed STRICTLY at :meth:`FaultInjector.reset`: a malformed
+token raises :class:`~mxnet_tpu.base.MXNetError` naming the environment
+variable and the offending token — a typo'd chaos drill must fail loudly,
+never silently inject nothing.  All counters live in one process-wide
+:class:`FaultInjector` (``injector()``); ``reset()`` re-reads the
+environment — tests flip env vars per case.
 """
 from __future__ import annotations
 
@@ -45,7 +61,29 @@ class FaultInjectedError(MXNetError):
     recovery paths are expected to translate or absorb it)."""
 
 
-def _parse_occurrences(spec: str) -> Dict[str, List[int]]:
+def _int_token(var: str, tok: str, minimum: int = 1) -> int:
+    """Strictly parse one integer token of a ``TPUMX_FAULT_*`` spec."""
+    tok = tok.strip()
+    try:
+        n = int(tok)
+    except ValueError:
+        raise MXNetError(
+            f"{var}: bad token {tok!r} (expected an integer)") from None
+    if n < minimum:
+        raise MXNetError(f"{var}: bad token {tok!r} (must be >= {minimum})")
+    return n
+
+
+def _float_token(var: str, tok: str) -> float:
+    tok = tok.strip()
+    try:
+        return float(tok)
+    except ValueError:
+        raise MXNetError(
+            f"{var}: bad token {tok!r} (expected a number)") from None
+
+
+def _parse_occurrences(var: str, spec: str) -> Dict[str, List[int]]:
     """``"push:1,2;pull:3"`` -> {"push": [1, 2], "pull": [3]}."""
     out: Dict[str, List[int]] = {}
     for part in (spec or "").split(";"):
@@ -54,13 +92,21 @@ def _parse_occurrences(spec: str) -> Dict[str, List[int]]:
             continue
         if ":" not in part:
             raise MXNetError(
-                f"bad fault spec {part!r}: expected 'op:n[,n...]'")
+                f"{var}: bad token {part!r} (expected 'op:n[,n...]')")
         op, ns = part.split(":", 1)
-        out[op.strip()] = sorted(int(n) for n in ns.split(",") if n.strip())
+        if not op.strip():
+            raise MXNetError(
+                f"{var}: bad token {part!r} (empty op name)")
+        occ = [_int_token(var, n) for n in ns.split(",") if n.strip()]
+        if not occ:
+            raise MXNetError(
+                f"{var}: bad token {part!r} (no occurrence numbers)")
+        out[op.strip()] = sorted(occ)
     return out
 
 
-def _parse_delays(spec: str) -> Dict[str, Tuple[float, Optional[List[int]]]]:
+def _parse_delays(var: str,
+                  spec: str) -> Dict[str, Tuple[float, Optional[List[int]]]]:
     """``"push:200"`` (every push) or ``"push:200@1,2"`` (1st and 2nd)."""
     out: Dict[str, Tuple[float, Optional[List[int]]]] = {}
     for part in (spec or "").split(";"):
@@ -69,16 +115,35 @@ def _parse_delays(spec: str) -> Dict[str, Tuple[float, Optional[List[int]]]]:
             continue
         if ":" not in part:
             raise MXNetError(
-                f"bad delay spec {part!r}: expected 'op:ms[@n,...]'")
+                f"{var}: bad token {part!r} (expected 'op:ms[@n,...]')")
         op, rest = part.split(":", 1)
+        if not op.strip():
+            raise MXNetError(f"{var}: bad token {part!r} (empty op name)")
         if "@" in rest:
             ms, ns = rest.split("@", 1)
             occ: Optional[List[int]] = sorted(
-                int(n) for n in ns.split(",") if n.strip())
+                _int_token(var, n) for n in ns.split(",") if n.strip())
+            if not occ:
+                raise MXNetError(
+                    f"{var}: bad token {part!r} (no occurrence numbers "
+                    "after '@')")
         else:
             ms, occ = rest, None
-        out[op.strip()] = (float(ms), occ)
+        out[op.strip()] = (_float_token(var, ms), occ)
     return out
+
+
+def _parse_at_pair(var: str, spec: str, default_second: Optional[int] = None
+                   ) -> Optional[Tuple[int, Optional[int]]]:
+    """``"N"`` or ``"N@M"`` -> (N, M or ``default_second``)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    if "@" in spec:
+        first, second = spec.split("@", 1)
+        return (_int_token(var, first, minimum=0),
+                _int_token(var, second, minimum=0))
+    return (_int_token(var, spec, minimum=0), default_second)
 
 
 class FaultInjector:
@@ -90,23 +155,47 @@ class FaultInjector:
 
     def reset(self) -> None:
         """Re-read the ``TPUMX_FAULT_*`` environment and zero every
-        occurrence counter (tests call this per case)."""
+        occurrence counter (tests call this per case).  Parsing is strict
+        — a malformed spec raises :class:`MXNetError` naming the variable
+        and the bad token, leaving the previous configuration in place."""
+        drops = _parse_occurrences(
+            "TPUMX_FAULT_KV_DROP", os.environ.get("TPUMX_FAULT_KV_DROP", ""))
+        delays = _parse_delays(
+            "TPUMX_FAULT_KV_DELAY_MS",
+            os.environ.get("TPUMX_FAULT_KV_DELAY_MS", ""))
+        kill = os.environ.get("TPUMX_FAULT_KV_KILL_SERVER", "").strip()
+        kill_after = (_int_token("TPUMX_FAULT_KV_KILL_SERVER", kill)
+                      if kill else None)
+        pre = os.environ.get("TPUMX_FAULT_PREEMPT_AT_STEP", "").strip()
+        preempt_step = (_int_token("TPUMX_FAULT_PREEMPT_AT_STEP", pre,
+                                   minimum=0) if pre else None)
+        ck = os.environ.get("TPUMX_FAULT_CKPT_CORRUPT", "").strip()
+        if ck and "@" in ck:
+            mode, n = ck.split("@", 1)
+            ckpt_mode, ckpt_at = mode.strip(), _int_token(
+                "TPUMX_FAULT_CKPT_CORRUPT", n)
+        else:
+            ckpt_mode, ckpt_at = (ck or None), None
+        if ckpt_mode is not None and ckpt_mode not in ("truncate", "flip"):
+            raise MXNetError(
+                f"TPUMX_FAULT_CKPT_CORRUPT: bad token {ckpt_mode!r} "
+                "(expected 'truncate' or 'flip')")
+        # generation serving faults (docs/generation.md, docs/fault_tolerance.md)
+        gen_step = _parse_at_pair(
+            "TPUMX_FAULT_GEN_STEP_FAIL",
+            os.environ.get("TPUMX_FAULT_GEN_STEP_FAIL", ""))
+        kill_replica = _parse_at_pair(
+            "TPUMX_FAULT_GEN_KILL_REPLICA",
+            os.environ.get("TPUMX_FAULT_GEN_KILL_REPLICA", ""),
+            default_second=1)
         with self._lock:
-            self._drops = _parse_occurrences(
-                os.environ.get("TPUMX_FAULT_KV_DROP", ""))
-            self._delays = _parse_delays(
-                os.environ.get("TPUMX_FAULT_KV_DELAY_MS", ""))
-            kill = os.environ.get("TPUMX_FAULT_KV_KILL_SERVER", "")
-            self._kill_after = int(kill) if kill else None
-            pre = os.environ.get("TPUMX_FAULT_PREEMPT_AT_STEP", "")
-            self._preempt_step = int(pre) if pre else None
-            ck = os.environ.get("TPUMX_FAULT_CKPT_CORRUPT", "")
-            if ck and "@" in ck:
-                mode, n = ck.split("@", 1)
-                self._ckpt_mode, self._ckpt_at = mode.strip(), int(n)
-            else:
-                self._ckpt_mode = ck.strip() or None
-                self._ckpt_at = None
+            self._drops = drops
+            self._delays = delays
+            self._kill_after = kill_after
+            self._preempt_step = preempt_step
+            self._ckpt_mode, self._ckpt_at = ckpt_mode, ckpt_at
+            self._gen_step_fail = gen_step          # (N, rid or None)
+            self._kill_replica = kill_replica       # (replica idx, after K)
             self._counts: Dict[str, int] = {}
 
     def _bump(self, site: str) -> int:
@@ -149,6 +238,37 @@ class FaultInjector:
                 return False
             if global_step >= self._preempt_step:
                 self._preempt_step = None
+                return True
+            return False
+
+    # -- generation serving --------------------------------------------------------
+    def gen_step_fail(self, rids) -> bool:
+        """Called once per decode-step program invocation with the request
+        ids in the batch.  Bare ``N`` specs fire exactly on the Nth
+        invocation (one-shot — the engine's retry must recover); ``N@rid``
+        specs poison request ``rid`` from the Nth invocation on, so every
+        batch containing it fails until bisection quarantines it."""
+        with self._lock:
+            if self._gen_step_fail is None:
+                return False
+            n_at, rid = self._gen_step_fail
+            n = self._bump("gen:step")
+            if rid is None:
+                return n == n_at
+            return n >= n_at and rid in rids
+
+    def gen_kill_replica(self, replica_idx: int) -> bool:
+        """Called by the router after each dispatch to ``replica_idx``:
+        True exactly when the injected replica death must fire (replica
+        ``N`` after its ``K``-th dispatch; one-shot)."""
+        with self._lock:
+            if self._kill_replica is None:
+                return False
+            idx, after = self._kill_replica
+            if int(replica_idx) != idx:
+                return False
+            if self._bump(f"gen:replica{idx}:dispatch") >= (after or 1):
+                self._kill_replica = None
                 return True
             return False
 

@@ -15,7 +15,9 @@ from .bucketing import (assemble_batch, batch_buckets, bucket_batch,
 from .metrics import ServingMetrics
 from .service import InferenceService
 from .generation import (GenerationConfig, GenerationService,
-                         GenerationStream)
+                         GenerationStepError, GenerationStream)
+from .router import (GenerationRouter, NoHealthyReplicaError,
+                     ReplicaDeadError, RouterConfig, RouterStream)
 from . import generation
 
 __all__ = ["InferenceService", "ServingConfig", "ServingMetrics",
@@ -25,4 +27,6 @@ __all__ = ["InferenceService", "ServingConfig", "ServingMetrics",
            "pad_sample", "pad_batch_rows", "assemble_batch",
            "seq_buckets", "bucket_seq_len", "pad_tokens_right",
            "GenerationService", "GenerationConfig", "GenerationStream",
+           "GenerationStepError", "GenerationRouter", "RouterConfig",
+           "RouterStream", "ReplicaDeadError", "NoHealthyReplicaError",
            "generation"]

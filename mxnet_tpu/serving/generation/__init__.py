@@ -6,10 +6,11 @@ cache (vLLM's PagedAttention memory model), built in tpu-mx's
 zero-recompile bucketed-program idiom on top of the transformer LM in
 :mod:`mxnet_tpu.parallel.transformer`.
 """
-from .engine import GenerationConfig, GenerationService, GenerationStream
+from .engine import (GenerationConfig, GenerationService, GenerationStepError,
+                     GenerationStream)
 from .kv_cache import BlockAllocator, PagedKVCache, blocks_for
 from .programs import GenerationPrograms
 
 __all__ = ["GenerationService", "GenerationConfig", "GenerationStream",
-           "PagedKVCache", "BlockAllocator", "GenerationPrograms",
-           "blocks_for"]
+           "GenerationStepError", "PagedKVCache", "BlockAllocator",
+           "GenerationPrograms", "blocks_for"]

@@ -6,13 +6,23 @@ vLLM's paged KV cache, recast in tpu-mx's zero-recompile idiom
 
 - the engine owns ``max_slots`` *decode slots*; every loop iteration it
   (1) evicts finished/cancelled/expired requests (freeing their cache
-  blocks), (2) admits waiting requests into free slots — FIFO, each
-  reserving its worst-case block budget up front — running one bucketed
-  *prefill* program per admission, then (3) runs ONE *decode* program over
-  all occupied slots, advancing every running request by one token.  A
-  short request finishing never waits for a long neighbour, and a queued
+  blocks), (2) under the default incremental-allocation policy preempts
+  victims when the pool crosses its high watermark and grows each running
+  request's block table one block at allocation-boundary crossings,
+  (3) admits waiting requests into free slots — priority classes first,
+  FIFO within a class; blocks for the current context only (or the
+  worst case up front under ``TPUMX_GEN_PREEMPTION=0`` reserve-ahead) —
+  running one bucketed *prefill* program per admission (a re-admitted
+  preempted request re-prefills its context through the chunked-prefill
+  rungs, emitting nothing), then (4) runs ONE *decode* program over all
+  occupied slots, advancing every running request by one token.  A short
+  request finishing never waits for a long neighbour, and a queued
   request starts the moment a slot and blocks free up — admission and
   eviction happen every token, not every batch;
+- a failed decode step is retried once, then bisected so only the suspect
+  request is quarantined with a typed :class:`GenerationStepError` while
+  healthy slots keep decoding; requests a failing iteration never touched
+  are requeued, not failed (docs/fault_tolerance.md);
 - prefill is bucketed on the :func:`~mxnet_tpu.serving.bucketing.seq_buckets`
   ladder (B=1, T=bucket); decode runs at fixed batch ``max_slots`` with the
   block-table width bucketed on its own pow2 ladder — so the entire
@@ -39,6 +49,7 @@ import numpy as _np
 
 from ... import observability as _obs
 from ...base import getenv
+from ...fault.inject import injector as _fault_injector
 from ..batcher import (BACKPRESSURE_POLICIES, DeadlineExceededError,
                        QueueFullError, RequestShedError, ServingClosedError,
                        ServingError)
@@ -47,7 +58,15 @@ from ..bucketing import (batch_buckets, bucket_batch, bucket_seq_len,
 from .kv_cache import PagedKVCache, blocks_for
 from .programs import GenerationPrograms
 
-__all__ = ["GenerationConfig", "GenerationService", "GenerationStream"]
+__all__ = ["GenerationConfig", "GenerationService", "GenerationStream",
+           "GenerationStepError"]
+
+
+class GenerationStepError(ServingError):
+    """A decode step failed for this specific request even after the
+    retry, and bisection isolated it (the quarantine outcome) — or the
+    request exhausted its error-requeue budget.  Other requests in the
+    same batch keep decoding (docs/generation.md "failure isolation")."""
 
 _WAITING, _RUNNING, _FINISHED, _CANCELLED, _FAILED = (
     "waiting", "running", "finished", "cancelled", "failed")
@@ -69,7 +88,11 @@ class GenerationConfig:
                  eos_token: Optional[int] = None,
                  chunked_prefill: Optional[bool] = None,
                  mp_devices: Optional[int] = None,
-                 shard_rules=None):
+                 shard_rules=None,
+                 preemption: Optional[bool] = None,
+                 watermark_high: Optional[float] = None,
+                 watermark_low: Optional[float] = None,
+                 admission_budget: Optional[float] = None):
         self.max_slots = int(max_slots if max_slots is not None
                              else getenv("TPUMX_GEN_SLOTS", 4))
         if self.max_slots < 1:
@@ -127,6 +150,32 @@ class GenerationConfig:
         if self.mp_devices < 1:
             raise ValueError("mp_devices must be >= 1")
         self.shard_rules = shard_rules
+        # incremental KV allocation + victim preemption (docs/generation.md):
+        # admission takes only the blocks the context needs, decode grows
+        # the table one block at boundary crossings, and pool pressure
+        # preempts the newest-admitted lowest-priority request back to the
+        # queue.  =0 restores reserve-ahead admission byte-for-byte,
+        # warmup enumeration and program keys included.
+        self.preemption = bool(preemption if preemption is not None
+                               else getenv("TPUMX_GEN_PREEMPTION", True))
+        self.watermark_high = float(
+            watermark_high if watermark_high is not None
+            else getenv("TPUMX_GEN_WATERMARK_HIGH", 0.95))
+        self.watermark_low = float(
+            watermark_low if watermark_low is not None
+            else getenv("TPUMX_GEN_WATERMARK_LOW", 0.80))
+        if not (0.0 < self.watermark_low <= self.watermark_high <= 1.0):
+            raise ValueError(
+                f"watermarks must satisfy 0 < low <= high <= 1, got "
+                f"low={self.watermark_low}, high={self.watermark_high}")
+        # overload control: submissions whose projected worst-case blocks
+        # (queued + running) would exceed this multiple of the pool hit the
+        # backpressure policy BEFORE the pool thrashes
+        self.admission_budget = float(
+            admission_budget if admission_budget is not None
+            else getenv("TPUMX_GEN_ADMISSION_BUDGET", 4.0))
+        if self.admission_budget <= 0:
+            raise ValueError("admission_budget must be > 0")
 
     def __repr__(self):
         return (f"GenerationConfig(max_slots={self.max_slots}, "
@@ -135,7 +184,8 @@ class GenerationConfig:
                 f"seq_buckets={self.seq_buckets}, "
                 f"max_new_tokens={self.max_new_tokens}, "
                 f"backpressure={self.backpressure!r}, "
-                f"amp_dtype={self.amp_dtype!r})")
+                f"amp_dtype={self.amp_dtype!r}, "
+                f"preemption={self.preemption})")
 
 
 class _GenRequest:
@@ -146,10 +196,11 @@ class _GenRequest:
                  "deadline", "on_token", "state", "blocks", "ctx_len",
                  "n_generated", "out_queue", "done_event", "error",
                  "finish_reason", "t_submit", "t_first", "t_last",
-                 "cancel_requested")
+                 "cancel_requested", "priority", "admit_seq",
+                 "n_preempted", "n_requeues")
 
     def __init__(self, rid, prompt, bucket, max_new, temperature, top_k,
-                 top_p, seed, eos_token, deadline, on_token):
+                 top_p, seed, eos_token, deadline, on_token, priority=0):
         self.rid = rid
         self.prompt_len = len(prompt)
         self.seq_tokens: List[int] = [int(t) for t in prompt]
@@ -174,6 +225,10 @@ class _GenRequest:
         self.t_first: Optional[float] = None
         self.t_last: Optional[float] = None
         self.cancel_requested = False
+        self.priority = int(priority)
+        self.admit_seq = -1        # admission recency, keys victim order
+        self.n_preempted = 0       # watermark/growth preemptions survived
+        self.n_requeues = 0        # error-path requeues consumed
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -235,6 +290,13 @@ class GenerationStream:
             return None
         return (self._req.t_first - self._req.t_submit) * 1e3
 
+    @property
+    def started(self) -> bool:
+        """Whether the engine has emitted at least one token for this
+        request (the router's resubmit-safety criterion: an unstarted
+        request can move replicas without duplicate delivery)."""
+        return self._req.t_first is not None
+
 
 class GenerationService:
     """Continuous-batching LM generation over a paged KV cache.
@@ -267,6 +329,8 @@ class GenerationService:
             model_cfg.n_layers, model_cfg.n_heads, model_cfg.d_head,
             cfg.num_blocks, cfg.block_size,
             dtype=compute_dtype or jnp.float32)
+        self._cache.allocator.set_watermarks(cfg.watermark_high,
+                                             cfg.watermark_low)
         self._programs = GenerationPrograms(params, model_cfg,
                                             compute_dtype=compute_dtype,
                                             mp_devices=cfg.mp_devices,
@@ -295,7 +359,11 @@ class GenerationService:
         self._slots: List[Optional[_GenRequest]] = [None] * cfg.max_slots
         self._closed = False
         self._drain = True
+        self._killed = False          # chaos hook: crashed-replica simulation
         self._next_rid = 0
+        self._admit_seq = 0           # admission recency for victim order
+        self._consec_step_failures = 0
+        self._max_error_requeues = 3  # error-path requeue budget per request
         self._iteration = 0
         self._membership: "deque[Tuple[int, Tuple[int, ...]]]" = \
             deque(maxlen=4096)
@@ -305,7 +373,8 @@ class GenerationService:
 
         self._counts = {"submitted": 0, "finished": 0, "cancelled": 0,
                         "failed": 0, "rejected": 0, "expired": 0,
-                        "shed": 0, "tokens": 0}
+                        "shed": 0, "tokens": 0, "preempted": 0,
+                        "requeued": 0, "quarantined": 0, "step_failures": 0}
         self._peak_occupancy = 0.0
         self._ttft: "deque[float]" = deque(maxlen=4096)
         self._itl: "deque[float]" = deque(maxlen=4096)
@@ -317,9 +386,29 @@ class GenerationService:
         self._g_blocks_used = reg.gauge("generation_kv_blocks_used")
         self._g_blocks_free = reg.gauge("generation_kv_blocks_free")
         self._g_occupancy = reg.gauge("generation_kv_block_occupancy")
+        self._g_live_occupancy = reg.gauge(
+            "generation_kv_block_live_occupancy",
+            help="fraction of the pool holding WRITTEN context — the "
+                 "number reserve-ahead reservation wastes and incremental "
+                 "allocation recovers (docs/generation.md)")
         self._g_tps = reg.gauge("generation_tokens_per_sec")
         self._c_tokens = reg.counter("generation_tokens_total")
         self._c_requests = reg.counter("generation_requests_total")
+        self._c_preempt = reg.counter(
+            "generation_preemptions_total",
+            help="running requests preempted back to the waiting queue "
+                 "by KV-pool pressure (watermark or failed growth)")
+        self._c_requeue = reg.counter(
+            "generation_requeues_total",
+            help="requests requeued (not failed) after an iteration error "
+                 "that never touched them")
+        self._c_quarantine = reg.counter(
+            "generation_quarantines_total",
+            help="requests isolated by decode-step bisection and failed "
+                 "with GenerationStepError")
+        self._c_step_fail = reg.counter(
+            "generation_step_failures_total",
+            help="decode-step program invocations that raised")
         self._h_ttft = reg.histogram("generation_ttft_seconds")
         self._h_itl = reg.histogram("generation_inter_token_seconds")
 
@@ -329,7 +418,8 @@ class GenerationService:
                seed: int = 0, eos_token: Optional[int] = "__config__",
                deadline_ms: Optional[float] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
-               timeout: Optional[float] = None) -> GenerationStream:
+               timeout: Optional[float] = None,
+               priority: int = 0) -> GenerationStream:
         """Enqueue one generation request; returns a stream handle.
 
         ``prompt``: 1-D int token ids.  ``temperature <= 0`` is greedy;
@@ -339,6 +429,8 @@ class GenerationService:
         ``deadline_ms`` bounds total queue+generate time.  ``on_token(rid,
         token)`` is called from the engine thread per token.  ``timeout``
         bounds a *blocking* submit under the ``block`` policy.
+        ``priority`` is the request's class: higher classes are admitted
+        first and preempted last (ties FIFO / newest-admitted-first).
         """
         cfg = self._config
         if self._closed:
@@ -373,24 +465,41 @@ class GenerationService:
             else cfg.default_deadline_ms
         deadline = None if ms is None else time.perf_counter() + ms / 1e3
 
+        budget = cfg.admission_budget * (cfg.num_blocks - 1)
         with self._lock:
             if self._closed:
                 raise ServingClosedError("generation service is shut down")
-            if len(self._waiting) >= cfg.queue_bound:
+
+            def _overloaded():
+                # the token-budget estimator (docs/generation.md "overload
+                # control"): worst-case projected blocks of everything
+                # queued+running, plus this request — fires the policy
+                # BEFORE the pool thrashes, not when the queue fills
+                if len(self._waiting) >= cfg.queue_bound:
+                    return f"generation queue bound {cfg.queue_bound} reached"
+                projected = self._projected_blocks_locked() + need
+                if projected > budget:
+                    return (f"projected KV demand {projected} blocks exceeds "
+                            f"admission budget {budget:.0f} "
+                            f"({cfg.admission_budget}x pool)")
+                return None
+
+            reason = _overloaded()
+            if reason is not None:
                 if cfg.backpressure == "reject":
                     self._counts["rejected"] += 1
-                    raise QueueFullError(
-                        f"generation queue bound {cfg.queue_bound} reached")
+                    raise QueueFullError(reason)
                 if cfg.backpressure == "shed_oldest":
-                    shed = self._waiting.popleft()
-                    self._counts["shed"] += 1
-                    self._finish_locked(shed, error=RequestShedError(
-                        "request shed under overload (shed_oldest)"))
+                    while self._waiting and _overloaded() is not None:
+                        shed = self._waiting.popleft()
+                        self._counts["shed"] += 1
+                        self._finish_locked(shed, error=RequestShedError(
+                            "request shed under overload (shed_oldest): "
+                            + reason))
                 else:  # block
                     t_end = (None if timeout is None
                              else time.perf_counter() + timeout)
-                    while (len(self._waiting) >= cfg.queue_bound
-                           and not self._closed):
+                    while _overloaded() is not None and not self._closed:
                         remaining = (None if t_end is None
                                      else t_end - time.perf_counter())
                         if remaining is not None and remaining <= 0:
@@ -402,7 +511,8 @@ class GenerationService:
                             "generation service is shut down")
             req = _GenRequest(self._next_rid, prompt.astype(_np.int32),
                               bucket, max_new, temperature, top_k, top_p,
-                              seed, eos, deadline, on_token)
+                              seed, eos, deadline, on_token,
+                              priority=priority)
             self._next_rid += 1
             self._waiting.append(req)
             self._counts["submitted"] += 1
@@ -425,6 +535,8 @@ class GenerationService:
         self._ensure_worker()
 
     def _ensure_worker(self) -> None:
+        if self._killed:
+            return  # a crashed replica never restarts itself
         if self._worker is not None and self._worker.is_alive():
             return
         with self._worker_lock:
@@ -496,6 +608,42 @@ class GenerationService:
 
     drain_and_stop = stop
 
+    def kill(self) -> None:
+        """Chaos/test hook (docs/fault_tolerance.md): simulate a crashed
+        replica.  The engine loop exits at its next iteration WITHOUT
+        draining, failing, or notifying outstanding requests — their
+        streams hang exactly as they would if the process died.  The
+        router's health probe is the layer that must notice and recover
+        (``TPUMX_FAULT_GEN_KILL_REPLICA`` drives this deterministically)."""
+        self._killed = True
+        with self._lock:
+            self._not_empty.notify_all()
+
+    def health(self) -> dict:
+        """Liveness/health snapshot for the router's probe loop."""
+        worker_ok = self._worker is None or self._worker.is_alive()
+        with self._lock:
+            waiting = len(self._waiting)
+            running = sum(1 for r in self._slots if r is not None)
+        return {
+            "alive": (not self._killed) and (not self._closed) and worker_ok,
+            "killed": self._killed,
+            "closed": self._closed,
+            "consecutive_step_failures": self._consec_step_failures,
+            "waiting": waiting,
+            "running": running,
+            "occupancy": self._cache.allocator.occupancy(),
+        }
+
+    def load(self) -> float:
+        """Dispatch-ranking load score: queue depth + running slots +
+        KV occupancy — the same signals the observability gauges export
+        (the router's least-loaded policy sorts on this)."""
+        with self._lock:
+            waiting = len(self._waiting)
+            running = sum(1 for r in self._slots if r is not None)
+        return waiting + running + self._cache.allocator.occupancy()
+
     def shutdown(self, timeout: Optional[float] = None) -> None:
         """Graceful preemption shutdown (docs/fault_tolerance.md): slots
         finish their generations, queued requests are rejected."""
@@ -534,6 +682,8 @@ class GenerationService:
         while True:
             admitted: List[_GenRequest] = []
             with self._lock:
+                if self._killed:
+                    return  # crashed-replica simulation: vanish, no cleanup
                 self._purge_waiting_locked()
                 self._evict_locked()
                 if self._closed and not self._drain:
@@ -546,6 +696,9 @@ class GenerationService:
                             self._release_slot_locked(i, error=err)
                     self._update_gauges_locked()
                     return
+                if self._config.preemption:
+                    self._watermark_preempt_locked()
+                    self._grow_blocks_locked()
                 admitted = self._admit_locked()
                 active = [r for r in self._slots if r is not None]
                 if not active and not admitted:
@@ -554,24 +707,28 @@ class GenerationService:
                     self._update_gauges_locked()
                     self._not_empty.wait(0.05)
                     continue
+                # per-iteration progress snapshot: the blast-radius guard
+                # distinguishes requests the failing step advanced from
+                # untouched ones (the latter are requeued, never failed)
+                progress = {r.rid: r.n_generated
+                            for r in self._slots if r is not None}
             try:
                 for req in admitted:
-                    self._prefill(req)
+                    try:
+                        self._prefill(req)
+                    except Exception as exc:  # noqa: BLE001 — isolate
+                        self._requeue_or_fail(req, exc)
                 running = [r for r in self._slots
                            if r is not None and r.state == _RUNNING]
                 self._membership.append(
                     (self._iteration,
                      tuple(sorted(r.rid for r in running))))
                 if running:
-                    self._decode_step(running)
+                    self._decode_isolated(running)
             except Exception as exc:  # noqa: BLE001 — the loop must survive
-                # any per-iteration surprise; fail the affected requests
-                err = exc if isinstance(exc, ServingError) else ServingError(
-                    f"generation step failed: {exc!r}")
-                with self._lock:
-                    for i, r in enumerate(self._slots):
-                        if r is not None:
-                            self._release_slot_locked(i, error=err)
+                # any per-iteration surprise with minimum blast radius:
+                # requeue what the failing iteration never touched
+                self._absorb_iteration_error(exc, progress)
             self._iteration += 1
             with self._lock:
                 self._update_gauges_locked()
@@ -610,26 +767,144 @@ class GenerationService:
                 self._release_slot_locked(i, error=DeadlineExceededError(
                     f"deadline exceeded after {r.n_generated} tokens"))
 
+    def _admit_need(self, r: _GenRequest) -> int:
+        """Blocks an admission must secure for ``r``: under incremental
+        allocation just the current context plus the next written
+        position; under reserve-ahead the full worst case."""
+        cfg = self._config
+        if cfg.preemption:
+            ctx = r.ctx_len if r.ctx_len > 0 else r.prompt_len
+            return blocks_for(ctx + 1, cfg.block_size)
+        return blocks_for(r.prompt_len + r.max_new, cfg.block_size)
+
     def _admit_locked(self) -> List[_GenRequest]:
-        """FIFO admission: fill free slots while the head request's block
-        reservation fits.  Head-of-line blocking on cache space is the
-        deliberate fairness policy (docs/generation.md)."""
+        """Priority-class-then-FIFO admission: fill free slots while the
+        best waiting request's block need fits (head-of-line blocking
+        within the chosen class is the deliberate fairness policy,
+        docs/generation.md).  Under incremental allocation, admission
+        additionally leaves the high-watermark headroom intact unless
+        nothing is running at all (the progress guarantee)."""
+        cfg = self._config
+        alloc = self._cache.allocator
+        total = cfg.num_blocks - 1
         admitted = []
         free = [i for i, s in enumerate(self._slots) if s is None]
         while free and self._waiting:
-            head = self._waiting[0]
-            need = blocks_for(head.prompt_len + head.max_new,
-                              self._config.block_size)
+            best_i, head = 0, self._waiting[0]
+            for j, r in enumerate(self._waiting):
+                if r.priority > head.priority:
+                    best_i, head = j, r
+            need = self._admit_need(head)
+            if cfg.preemption and any(s is not None for s in self._slots) \
+                    and alloc.num_used + need > cfg.watermark_high * total:
+                break  # keep the growth headroom; readmit later
             blocks = self._cache.allocator.allocate(need)
             if blocks is None:
                 break
-            self._waiting.popleft()
+            del self._waiting[best_i]
             head.blocks = blocks
             head.state = _RUNNING
+            head.admit_seq = self._admit_seq
+            self._admit_seq += 1
             self._slots[free.pop(0)] = head
             admitted.append(head)
             self._not_full.notify_all()
         return admitted
+
+    def _pick_victim_locked(self) -> Optional[int]:
+        """Victim slot for preemption: lowest priority class first, then
+        newest admitted (vLLM's evict-the-latecomer policy — the oldest
+        request monotonically progresses, guaranteeing liveness)."""
+        best_i = None
+        best_key = None
+        for i, r in enumerate(self._slots):
+            if r is None or r.state != _RUNNING:
+                continue
+            key = (r.priority, -r.admit_seq)
+            if best_key is None or key < best_key:
+                best_i, best_key = i, key
+        return best_i
+
+    def _preempt_slot_locked(self, i: int, counter: str = "preempted") -> None:
+        """Move a running request back to the waiting queue: blocks
+        returned to the pool, context retained — re-admission re-prefills
+        it through the chunked-prefill rungs (tokens stay bit-identical:
+        sampling is keyed on (seed, position) only)."""
+        r = self._slots[i]
+        with _obs.span("serving.preempt", cat="serving",
+                       args={"rid": r.rid, "ctx": r.ctx_len,
+                             "blocks": len(r.blocks or ()),
+                             "kind": counter}):
+            self._slots[i] = None
+            if r.blocks:
+                self._cache.allocator.free(r.blocks)
+                r.blocks = None
+            r.state = _WAITING
+            self._waiting.appendleft(r)
+            if counter == "preempted":
+                r.n_preempted += 1
+                self._c_preempt.inc()
+            else:
+                r.n_requeues += 1
+                self._c_requeue.inc()
+            self._counts[counter] += 1
+
+    def _watermark_preempt_locked(self) -> None:
+        """Crossing the high watermark preempts victims down to the low
+        watermark, so near-term block growth never hits a hard exhaust
+        mid-step.  The last running request is never preempted (it alone
+        cannot thrash the pool — its worst case was validated at submit)."""
+        alloc = self._cache.allocator
+        if not alloc.above_high():
+            return
+        while alloc.above_low():
+            if sum(1 for r in self._slots
+                   if r is not None and r.state == _RUNNING) <= 1:
+                break
+            v = self._pick_victim_locked()
+            if v is None:
+                break
+            self._preempt_slot_locked(v)
+
+    def _grow_blocks_locked(self) -> None:
+        """Incremental allocation: before the decode step, every running
+        request whose next written position crosses a block boundary gets
+        one more block — oldest admitted first.  Exhaustion preempts the
+        victim policy's pick; when the grower IS the pick, it preempts
+        itself (it is the newest/lowest — latecomers yield)."""
+        cfg = self._config
+        alloc = self._cache.allocator
+        order = sorted(
+            (i for i, r in enumerate(self._slots)
+             if r is not None and r.state == _RUNNING),
+            key=lambda i: self._slots[i].admit_seq)
+        for i in order:
+            r = self._slots[i]
+            if r is None or r.state != _RUNNING:
+                continue  # preempted by an earlier grower this pass
+            need = blocks_for(r.ctx_len + 1, cfg.block_size)
+            while len(r.blocks) < need:
+                got = alloc.allocate(need - len(r.blocks))
+                if got is not None:
+                    r.blocks.extend(got)
+                    break
+                v = self._pick_victim_locked()
+                if v is None or self._slots[v] is r:
+                    self._preempt_slot_locked(i)
+                    break
+                self._preempt_slot_locked(v)
+
+    def _projected_blocks_locked(self) -> int:
+        """Worst-case KV demand of everything queued + running — the
+        overload estimator's input (docs/generation.md)."""
+        bs = self._config.block_size
+        total = 0
+        for r in self._waiting:
+            total += blocks_for(r.prompt_len + r.max_new, bs)
+        for r in self._slots:
+            if r is not None:
+                total += blocks_for(r.prompt_len + r.max_new, bs)
+        return total
 
     def _release_slot_locked(self, i: int, reason: str = _FINISHED,
                              error: Optional[BaseException] = None) -> None:
@@ -639,6 +914,7 @@ class GenerationService:
             self._cache.allocator.free(r.blocks)
             r.blocks = None
         self._finish_locked(r, reason=reason, error=error)
+        self._not_full.notify_all()  # blocks freed: budget waiters re-check
 
     def _finish_locked(self, r: _GenRequest, reason: str = _FINISHED,
                        error: Optional[BaseException] = None) -> None:
@@ -657,7 +933,7 @@ class GenerationService:
         r.done_event.set()
 
     # -- model steps (engine thread, no lock held) --------------------------------
-    def _chunk_plan(self, prompt_len: int):
+    def _chunk_plan(self, prompt_len: int, force_chunked: bool = False):
         """Prefill chunking (docs/generation.md): ``[(off, take, T, W)]``.
 
         A single entry is the legacy path — whole prompt padded to its
@@ -669,10 +945,16 @@ class GenerationService:
         costs 64+64+64 padded positions instead of 256.  Chunk table
         widths are pow2-bucketed on the decode width ladder, keeping the
         whole (T, W) signature set finite and warmup-enumerable.
+
+        ``force_chunked`` is the re-prefill spelling (a preempted
+        request's context can exceed the prompt ladder, and must chunk
+        even when ``chunked_prefill`` is off): the rung walk is used for
+        any length past the smallest rung.
         """
         cfg = self._config
         rungs = self._seq_buckets
-        if not cfg.chunked_prefill or prompt_len <= rungs[0]:
+        chunked = cfg.chunked_prefill or force_chunked
+        if not chunked or prompt_len <= rungs[0]:
             tb = bucket_seq_len(prompt_len, rungs)
             return [(0, prompt_len, tb, blocks_for(tb, cfg.block_size))]
         chunks = []
@@ -694,7 +976,11 @@ class GenerationService:
     def _prefill_signatures(self):
         """Every (T, W) prefill signature the chunk planner can emit —
         the warmup enumeration set (finite: one pass over the possible
-        prompt lengths, pure host arithmetic)."""
+        prompt lengths, pure host arithmetic).  With preemption enabled
+        the set also covers every RE-prefill plan — a preempted request's
+        context can be any length up to ``max_len - 1`` and must replay
+        through already-warmed rungs (the zero-recompile guarantee holds
+        under ``TPUMX_FREEZE_COMPILES=1`` with preemption active)."""
         cfg = self._config
         out = {(tb, blocks_for(tb, cfg.block_size))
                for tb in self._seq_buckets}
@@ -702,12 +988,24 @@ class GenerationService:
             for L in range(1, self._seq_buckets[-1] + 1):
                 for (_, _, tb, w) in self._chunk_plan(L):
                     out.add((tb, w))
+        if cfg.preemption:
+            for L in range(1, self._model_cfg.max_len):
+                for (_, _, tb, w) in self._chunk_plan(L, force_chunked=True):
+                    out.add((tb, w))
         return sorted(out)
 
     def _prefill(self, r: _GenRequest) -> None:
         cfg = self._config
         next_tok = None
-        plan = self._chunk_plan(r.prompt_len)
+        # re-admission after preemption: replay the WHOLE cached context
+        # (prompt + already-generated tokens) through the chunked-prefill
+        # rungs, emit nothing — the pending token at index ctx_len is
+        # already in seq_tokens and the next decode picks it up.  The
+        # final chunk's sample (seed, counter=ctx) is bit-identical to the
+        # token already emitted, so it is simply discarded.
+        resumed = r.ctx_len > 0
+        ctx = r.ctx_len if resumed else r.prompt_len
+        plan = self._chunk_plan(ctx, force_chunked=resumed)
         for (off, take, tb, wp) in plan:
             table = _np.zeros((1, wp), _np.int32)
             n = min(wp, len(r.blocks))
@@ -717,9 +1015,10 @@ class GenerationService:
                 tb)[None, :]
             positions = _np.arange(off, off + tb, dtype=_np.int32)[None, :]
             with _obs.span("serving.prefill", cat="serving",
-                           args={"rid": r.rid, "len": r.prompt_len,
+                           args={"rid": r.rid, "len": ctx,
                                  "bucket": tb, "off": off,
-                                 "chunks": len(plan)}):
+                                 "chunks": len(plan),
+                                 "resumed": resumed}):
                 # the sampler reads the chunk's last VALID row; only the
                 # final chunk's sample (global position prompt_len-1, the
                 # same seed/counter as the unchunked program) is emitted —
@@ -728,16 +1027,24 @@ class GenerationService:
                     "gen_prefill", self._cache, tokens, positions,
                     _np.asarray([take], _np.int32), table,
                     _np.asarray([r.seed], _np.uint32),
-                    _np.asarray([r.prompt_len], _np.uint32),
+                    _np.asarray([ctx], _np.uint32),
                     _np.asarray([r.temperature], _np.float32),
                     _np.asarray([r.top_k], _np.int32),
                     _np.asarray([r.top_p], _np.float32))
+        if resumed:
+            return
         r.ctx_len = r.prompt_len
         self._emit_token(r, int(next_tok[0]))
 
-    def _decode_step(self, running: List[_GenRequest]) -> None:
+    def _decode_step(self, batch: List[_GenRequest]) -> None:
+        """One decode program over exactly the requests in ``batch``
+        (slots outside it stay inactive: length 0, null-block table) —
+        the full running set normally, a bisection subset when isolating
+        a poisoned request.  Tokens are batch-composition-independent
+        (seeded per request), so subsets emit identical values."""
         cfg = self._config
         S = cfg.max_slots
+        rids = {r.rid for r in batch}
         tokens = _np.zeros((S, 1), _np.int32)
         positions = _np.zeros((S, 1), _np.int32)
         lengths = _np.zeros(S, _np.int32)
@@ -748,7 +1055,7 @@ class GenerationService:
         top_p = _np.ones(S, _np.float32)
         max_w = 1
         for i, r in enumerate(self._slots):
-            if r is None or r.state != _RUNNING:
+            if r is None or r.state != _RUNNING or r.rid not in rids:
                 continue
             tokens[i, 0] = r.seq_tokens[r.ctx_len]
             positions[i, 0] = r.ctx_len
@@ -762,22 +1069,112 @@ class GenerationService:
         w = bucket_batch(max_w, self._width_buckets)
         tables = _np.zeros((S, w), _np.int32)
         for i, r in enumerate(self._slots):
-            if r is None or r.state != _RUNNING:
+            if r is None or r.state != _RUNNING or r.rid not in rids:
                 continue
             n = min(w, len(r.blocks))
             tables[i, :n] = r.blocks[:n]
+        # deterministic failure injection (TPUMX_FAULT_GEN_STEP_FAIL):
+        # fires BEFORE dispatch, so the paged pool is never half-written
+        if _fault_injector().gen_step_fail(rids):
+            from ...fault.inject import FaultInjectedError
+            raise FaultInjectedError(
+                f"injected decode-step failure "
+                f"(TPUMX_FAULT_GEN_STEP_FAIL) at iteration "
+                f"{self._iteration}, batch rids {sorted(rids)}")
         with _obs.span("serving.decode", cat="serving",
-                       args={"running": len(running), "width": int(w)}):
+                       args={"running": len(batch), "width": int(w)}):
             next_tok, _ = self._programs.run(
                 "gen_decode", self._cache, tokens, positions, lengths,
                 tables, seeds, counters, temperature, top_k, top_p)
         for i, r in enumerate(self._slots):
-            if r is None or r.state != _RUNNING:
+            if r is None or r.state != _RUNNING or r.rid not in rids:
                 continue
             r.ctx_len += 1
             self._emit_token(r, int(next_tok[i]))
 
+    # -- failure isolation (docs/fault_tolerance.md serving rows) -----------------
+    def _note_step_failure(self, exc: BaseException) -> None:
+        self._counts["step_failures"] += 1
+        self._consec_step_failures += 1
+        self._c_step_fail.inc()
+
+    def _decode_isolated(self, running: List[_GenRequest]) -> None:
+        """Decode with bounded blast radius: run the full batch; on
+        failure retry once (transient faults recover with zero client
+        impact), then bisect so only the poisoned request is quarantined
+        while every healthy slot still advances this iteration."""
+        for attempt in (0, 1):
+            try:
+                self._decode_step(running)
+                self._consec_step_failures = 0
+                return
+            except Exception as exc:  # noqa: BLE001 — isolate below
+                self._note_step_failure(exc)
+        self._bisect_decode(running)
+
+    def _bisect_decode(self, group: List[_GenRequest],
+                       cause: Optional[BaseException] = None) -> None:
+        group = [r for r in group if r.state == _RUNNING]
+        if not group:
+            return
+        if len(group) == 1:
+            r = group[0]
+            with self._lock:
+                for i, s in enumerate(self._slots):
+                    if s is r and r.state == _RUNNING:
+                        self._counts["quarantined"] += 1
+                        self._c_quarantine.inc()
+                        self._release_slot_locked(
+                            i, error=GenerationStepError(
+                                f"request {r.rid} quarantined: decode step "
+                                f"fails whenever it is scheduled "
+                                f"(last error: {cause!r})"))
+                        break
+            return
+        mid = len(group) // 2
+        for half in (group[:mid], group[mid:]):
+            try:
+                self._decode_step(half)
+                self._consec_step_failures = 0
+            except Exception as exc:  # noqa: BLE001 — keep narrowing
+                self._note_step_failure(exc)
+                self._bisect_decode(half, exc)
+
+    def _requeue_or_fail(self, r: _GenRequest, exc: BaseException) -> None:
+        """Blast-radius containment for one request (prefill error or an
+        iteration error that never touched it): requeue it — bounded by
+        the error-requeue budget — instead of failing it."""
+        err = exc if isinstance(exc, ServingError) else ServingError(
+            f"generation step failed: {exc!r}")
+        with self._lock:
+            for i, s in enumerate(self._slots):
+                if s is r:
+                    if r.n_requeues < self._max_error_requeues:
+                        self._preempt_slot_locked(i, counter="requeued")
+                    else:
+                        self._release_slot_locked(
+                            i, error=GenerationStepError(
+                                f"request {r.rid} failed after "
+                                f"{r.n_requeues} error requeues: {err}"))
+                    return
+
+    def _absorb_iteration_error(self, exc: BaseException,
+                                progress: Dict[int, int]) -> None:
+        """An iteration blew up outside the isolated decode path: requests
+        the failing iteration advanced keep their slots and keep decoding;
+        untouched ones are requeued (bounded), never failed — the step-
+        exception blast radius stays at zero healthy casualties."""
+        for r in list(self._slots):
+            if r is None or r.state != _RUNNING:
+                continue
+            touched = r.n_generated != progress.get(r.rid, r.n_generated)
+            if not touched:
+                self._requeue_or_fail(r, exc)
+
     def _emit_token(self, r: _GenRequest, tok: int) -> None:
+        if self._killed:
+            return  # a dead replica leaks nothing: the router may already
+            #         have resubmitted this request elsewhere
         now = time.perf_counter()
         r.seq_tokens.append(tok)
         r.n_generated += 1
@@ -810,13 +1207,34 @@ class GenerationService:
             self._counts["finished"] += 1
 
     # -- introspection ------------------------------------------------------------
+    def _live_blocks_locked(self) -> int:
+        """Blocks holding WRITTEN context across the running slots (owned
+        blocks minus reservation/growth headroom)."""
+        bs = self._config.block_size
+        return sum(blocks_for(r.ctx_len, bs)
+                   for r in self._slots
+                   if r is not None and r.ctx_len > 0)
+
+    def live_occupancy(self) -> float:
+        """Fraction of the allocatable pool holding written KV context —
+        unlike ``allocator.occupancy()`` (owned blocks), reservation and
+        growth headroom do not count.  The incremental-vs-reserve-ahead
+        comparison in bench.py's ``overload_serving`` reads this."""
+        total = self._config.num_blocks - 1
+        with self._lock:
+            live = self._live_blocks_locked()
+        return live / total if total else 0.0
+
     def _update_gauges_locked(self) -> None:
         alloc = self._cache.allocator
+        total = self._config.num_blocks - 1
         running = sum(1 for r in self._slots if r is not None)
         self._g_running.set(running)
         self._g_waiting.set(len(self._waiting))
         self._g_blocks_used.set(alloc.num_used)
         self._g_blocks_free.set(alloc.num_free)
+        self._g_live_occupancy.set(
+            self._live_blocks_locked() / total if total else 0.0)
         occ = alloc.occupancy()
         self._peak_occupancy = max(self._peak_occupancy, occ)
         self._g_occupancy.set(occ)
@@ -859,6 +1277,7 @@ class GenerationService:
                 "used": alloc.num_used,
                 "free": alloc.num_free,
                 "occupancy": round(alloc.occupancy(), 4),
+                "live_occupancy": round(self.live_occupancy(), 4),
                 "peak_occupancy": round(self._peak_occupancy, 4),
             },
             "ttft_ms": {"p50": _ms(pct(ttft, 50)), "p99": _ms(pct(ttft, 99))},
@@ -869,6 +1288,11 @@ class GenerationService:
             "seq_buckets": list(self._seq_buckets),
             "width_buckets": list(self._width_buckets),
             "closed": self._closed,
+            "killed": self._killed,
+            "preemption": self._config.preemption,
+            "watermarks": {"high": self._config.watermark_high,
+                           "low": self._config.watermark_low},
+            "consecutive_step_failures": self._consec_step_failures,
         }
 
 

@@ -11,11 +11,21 @@ how long their sequences grow.  A request owns a *list of physical blocks*
 null/scratch block: padded prefill positions and inactive decode slots
 write there, so the traced model step needs no branches.
 
-The host side is :class:`BlockAllocator` — a plain free-list.  The engine
-uses *reserve-ahead* accounting (allocate ``ceil((prompt + max_new) /
-block_size)`` blocks at admission), so an admitted request can NEVER hit
-cache OOM mid-decode; the tradeoff (vs vLLM's incremental allocation +
-preemption) is documented in docs/generation.md.
+The host side is :class:`BlockAllocator` — a plain free-list with a
+high/low occupancy watermark pair.  The engine's default accounting is
+*incremental* (vLLM's allocate-as-you-decode): admission takes only the
+blocks the request's current context needs, every decode that crosses a
+block boundary takes one more, and when the pool crosses the high
+watermark — or a growth allocation fails outright — the engine preempts
+victim requests (lowest priority, newest admitted first) back to the
+waiting queue until occupancy falls to the low watermark, re-prefilling
+their context through the chunked-prefill rungs on re-admission.  Steady-
+state occupancy therefore tracks *actual* use, not the worst case.
+``TPUMX_GEN_PREEMPTION=0`` restores the original reserve-ahead accounting
+byte-for-byte (allocate ``ceil((prompt + max_new) / block_size)`` blocks
+at admission, never preempt — an admitted request can never hit cache OOM
+mid-decode, at the cost of pool headroom); both policies are documented
+in docs/generation.md.
 """
 from __future__ import annotations
 
@@ -33,15 +43,43 @@ def blocks_for(n_positions: int, block_size: int) -> int:
 class BlockAllocator:
     """Free-list allocator over physical block ids ``1..num_blocks-1``
     (block 0 is the reserved null block).  Thread-safe; all-or-nothing
-    allocation so a request is never half-admitted."""
+    allocation so a request is never half-admitted.
 
-    def __init__(self, num_blocks: int):
+    ``watermark_high`` / ``watermark_low`` are occupancy fractions the
+    preempting engine steers by: crossing above high triggers victim
+    preemption down to low (docs/generation.md "incremental allocation +
+    preemption").  The allocator only reports them (:meth:`above_high`,
+    :meth:`above_low`); the policy lives in the engine."""
+
+    def __init__(self, num_blocks: int, watermark_high: float = 1.0,
+                 watermark_low: float = 1.0):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is reserved)")
+        if not (0.0 < watermark_low <= watermark_high <= 1.0):
+            raise ValueError(
+                f"watermarks must satisfy 0 < low <= high <= 1, got "
+                f"low={watermark_low}, high={watermark_high}")
         self.num_blocks = int(num_blocks)
+        self.watermark_high = float(watermark_high)
+        self.watermark_low = float(watermark_low)
         self._lock = threading.Lock()
         # pop() takes from the tail: hand out low ids first
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+
+    def set_watermarks(self, high: float, low: float) -> None:
+        if not (0.0 < low <= high <= 1.0):
+            raise ValueError(
+                f"watermarks must satisfy 0 < low <= high <= 1, got "
+                f"low={low}, high={high}")
+        self.watermark_high, self.watermark_low = float(high), float(low)
+
+    def above_high(self) -> bool:
+        """Occupancy strictly above the high watermark (preemption due)."""
+        return self.occupancy() > self.watermark_high
+
+    def above_low(self) -> bool:
+        """Occupancy strictly above the low watermark (keep preempting)."""
+        return self.occupancy() > self.watermark_low
 
     @property
     def num_free(self) -> int:

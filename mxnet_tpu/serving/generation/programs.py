@@ -14,6 +14,15 @@ fused train step and the bucketed serving cache.
 
 KV pools are donated: the decode loop updates the cache in place on device
 instead of copying ``O(num_blocks)`` memory every token.
+
+Preemption (docs/generation.md "incremental allocation + victim
+preemption") adds NO program shapes to this family: a preempted request's
+context re-prefills through the same ``gen_prefill`` (T, W) rung
+signatures the chunk planner already emits — the engine's warmup simply
+enumerates the re-prefill plans too, so the post-warmup zero-recompile
+guarantee (``TPUMX_FREEZE_COMPILES=1``) holds with preemption active, and
+``TPUMX_GEN_PREEMPTION=0`` restores the reserve-ahead program-key set
+byte-for-byte.
 """
 from __future__ import annotations
 

@@ -89,6 +89,12 @@ def pytest_configure(config):
         "select with `pytest -m observability`)")
     config.addinivalue_line(
         "markers",
+        "router: multi-replica generation routing (mxnet_tpu.serving."
+        "router — least-loaded dispatch, health probes + circuit breaker, "
+        "dead-replica resubmission, drain-aware shutdown; "
+        "docs/generation.md; select with `pytest -m router`)")
+    config.addinivalue_line(
+        "markers",
         "fault: fault-tolerant training (mxnet_tpu.checkpoint async "
         "checkpointing + mxnet_tpu.fault preemption/injection, kvstore "
         "retry/backoff, serving graceful shutdown; "
