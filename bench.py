@@ -75,6 +75,41 @@ def _probe_backend(timeout: float):
         f"probe rc={proc.returncode}: {proc.stderr.strip()[-400:]}")
 
 
+def _supervisor_flight_record(reason, attempts):
+    """Self-contained flight-recorder dump for probe/tunnel failures: the
+    supervisor process never imports mxnet_tpu/jax (by design), so it
+    writes the dump format itself — the r04/r05 ``measured: false`` runs
+    left nothing to debug from; now every failed artifact names a black
+    box with the attempt history and the BENCH_*/TPUMX_* environment."""
+    import tempfile
+
+    if os.environ.get("TPUMX_FLIGHT_RECORDER", "").strip().lower() in (
+            "0", "false", "off", "no"):
+        return None
+    d = os.environ.get("TPUMX_FLIGHT_RECORDER_DIR") or tempfile.gettempdir()
+    path = os.path.join(
+        d, f"tpumx_flight_{time.strftime('%Y%m%d-%H%M%S', time.gmtime())}"
+           f"_{reason}_{os.getpid()}.json")
+    payload = {
+        "reason": reason,
+        "time_unix": time.time(),
+        "time_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "pid": os.getpid(),
+        "extra": {
+            "attempts": attempts,
+            "env": {k: v for k, v in sorted(os.environ.items())
+                    if k.startswith(("BENCH_", "TPUMX_", "JAX_"))},
+        },
+        "notes": [], "wide_events": [], "spans": [], "metrics": {},
+    }
+    try:
+        with open(path, "w") as f:
+            json.dump(payload, f)
+    except OSError:
+        return None
+    return path
+
+
 def supervise():
     """Probe → measure → retry loop; structured JSON no matter what.
 
@@ -176,6 +211,10 @@ def supervise():
                     "last_good": last_good,
                     "error_tail":
                         (proc.stderr or proc.stdout).strip()[-400:],
+                    "flight_record": _supervisor_flight_record(
+                        "bench_measure_failed",
+                        attempts + [(proc.stderr or proc.stdout)
+                                    .strip()[-400:]]),
                 }))
                 return
             raise RuntimeError(
@@ -202,6 +241,8 @@ def supervise():
         "last_good": last_good,
         "attempts": len(attempts),
         "error_tail": attempts[-1] if attempts else "",
+        "flight_record": _supervisor_flight_record("bench_tunnel_down",
+                                                   attempts),
     }))
 
 
@@ -1058,6 +1099,69 @@ def telemetry_overhead(batch: int = None, steps: int = None):
     }
 
 
+def tracing_overhead():
+    """Generation decode throughput with the trace-context layer ON vs
+    ``TPUMX_TRACING=0`` (docs/observability.md): the same request burst
+    through two fresh engines, reporting ``overhead_pct`` (acceptance:
+    < 2% — the per-request wide events, per-rung spans, and per-iteration
+    decode participation fan-out must stay invisible next to the device
+    work).  ``BENCH_TRACING=0`` skips the block."""
+    import jax
+
+    from mxnet_tpu.parallel import transformer as tr
+    from mxnet_tpu.serving.generation import (GenerationConfig,
+                                              GenerationService)
+
+    reqs = int(os.environ.get("BENCH_TRACING_REQUESTS", "32"))
+    new_tokens = int(os.environ.get("BENCH_TRACING_NEW_TOKENS", "24"))
+    cfg = tr.TransformerConfig(vocab=512, d_model=128, n_heads=8,
+                               n_layers=2, d_ff=512, max_len=256)
+    params = tr.transformer_lm_init(cfg, jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, cfg.vocab, int(rs.choice([16, 40, 80])))
+               for _ in range(reqs)]
+    prev = os.environ.get("TPUMX_TRACING")
+
+    def leg(env_val):
+        os.environ["TPUMX_TRACING"] = env_val
+        svc = GenerationService(params, cfg, GenerationConfig(
+            max_slots=8, block_size=16, num_blocks=128,
+            seq_buckets=[64, 128], max_new_tokens=new_tokens,
+            queue_bound=1024), start=False)
+        svc.warmup()
+        hs = [svc.submit(p, max_new_tokens=new_tokens, seed=i)
+              for i, p in enumerate(prompts)]
+        t0 = time.perf_counter()
+        svc.start()
+        for h in hs:
+            h.result(600)
+        wall = time.perf_counter() - t0
+        tokens = svc.stats()["counts"]["tokens"]
+        svc.stop()
+        return tokens / wall
+
+    try:
+        tps_on = leg("1")
+        tps_off = leg("0")
+        # interleave a second pass to cancel clock/thermal drift
+        tps_on = max(tps_on, leg("1"))
+        tps_off = max(tps_off, leg("0"))
+    finally:
+        if prev is None:
+            os.environ.pop("TPUMX_TRACING", None)
+        else:
+            os.environ["TPUMX_TRACING"] = prev
+    overhead_pct = (tps_off / tps_on - 1.0) * 100.0
+    return {
+        "tokens_per_sec_traced": round(tps_on, 1),
+        "tokens_per_sec_untraced": round(tps_off, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "within_budget": overhead_pct < 2.0,
+        "requests": reqs,
+        "new_tokens_per_request": new_tokens,
+    }
+
+
 def checkpoint_overhead(batch: int = None, steps: int = None):
     """Fused-step wall time while async checkpoint snapshots are in flight
     vs without (docs/fault_tolerance.md): the SAME bound module stepped
@@ -1379,6 +1483,25 @@ def main():
             sys.stderr.write(f"checkpoint bench failed: "
                              f"{type(e).__name__}: {e}\n")
             result["ckpt_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_TRACING", "1") == "1":
+        try:
+            result["tracing_overhead"] = tracing_overhead()
+        except Exception as e:  # optional block: failure is a field, not rc!=0
+            sys.stderr.write(f"tracing bench failed: "
+                             f"{type(e).__name__}: {e}\n")
+            result["tracing_error"] = f"{type(e).__name__}: {e}"
+    failed_blocks = [k for k in result if k.endswith("_error")]
+    if failed_blocks:
+        # a failed probe leaves a black box next to the artifact: dump the
+        # flight recorder (spans/wide events/metrics of this very run) and
+        # name the path in the result JSON
+        try:
+            from mxnet_tpu.observability import flight_recorder as _flight
+
+            result["flight_record"] = _flight.dump(
+                "bench_block_failed", extra={"blocks": failed_blocks})
+        except Exception as e:
+            result["flight_record_error"] = f"{type(e).__name__}: {e}"
     try:
         # every bench result carries the process registry (docs/
         # observability.md): compile-cache counters, serving p50/p99/QPS,

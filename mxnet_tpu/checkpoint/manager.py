@@ -175,7 +175,11 @@ class CheckpointManager:
                 self._c_skipped.inc()
                 return None
             self._idle.clear()
-        self._queue.put(job)
+        # explicit trace handoff across the writer-thread boundary: the
+        # async save span joins the submitting fit's trace
+        from ..observability import tracing as _tracing
+
+        self._queue.put((job, _tracing.current_trace()))
         return None
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -196,11 +200,14 @@ class CheckpointManager:
         t.start()
 
     def _writer_loop(self) -> None:
+        from ..observability import tracing as _tracing
+
         while True:
-            job = self._queue.get()
+            job, trace_ctx = self._queue.get()
             try:
-                with _span("checkpoint.save_async"):
-                    self._write(*job, mode="async")
+                with _tracing.use_context(trace_ctx):
+                    with _span("checkpoint.save_async"):
+                        self._write(*job, mode="async")
             except Exception as e:  # noqa: BLE001 — a failed save must not
                 # kill the writer; the next save gets a fresh chance
                 self._c_failures.inc()

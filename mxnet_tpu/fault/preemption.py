@@ -83,6 +83,7 @@ class _SignalHub:
         self._fired.clear()
 
     def _on_signal(self, signum, frame):
+        from ..observability import flight_recorder as _flight
         from ..observability import registry as _registry
 
         try:
@@ -91,6 +92,11 @@ class _SignalHub:
                 labels={"signal": signal.Signals(signum).name},
                 help="SIGTERM/SIGINT deliveries observed by the fault "
                      "preemption hub").inc()
+            # the black box sees every delivery, whether or not the
+            # flight recorder's own dump hook is registered
+            _flight.note("signal_delivery",
+                         {"signal": signal.Signals(signum).name,
+                          "count": self._fired.get(signum, 0) + 1})
         except Exception:
             pass
         with self._lock:

@@ -285,6 +285,11 @@ class BaseModule:
             help="Module.fit per-batch host wall time (dispatch, no sync)")
         train_data.reset()  # defensive: support reused/exhausted iterators
         preempted = False
+        # one trace context for the whole fit call (docs/observability.md):
+        # fit.epoch/fit.batch/executor.fused_step/kvstore.push spans share
+        # a trace id, and the async checkpoint writer inherits it across
+        # its thread boundary.  attach(None) is a no-op (TPUMX_TRACING=0).
+        _fit_trace_token = _obs.tracing.attach(_obs.tracing.new_trace())
         try:
           for epoch in range(begin_epoch, num_epoch):
             with _obs.span(f"fit.epoch[{epoch}]", cat="fit"):
@@ -374,6 +379,7 @@ class BaseModule:
                         self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
                 train_data.reset()
         finally:
+            _obs.tracing.detach(_fit_trace_token)
             if _preempt is not None:
                 _preempt.uninstall()
             if _ckpt is not None:

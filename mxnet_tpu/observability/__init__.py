@@ -12,7 +12,13 @@ kvstore, io, amp and serving:
   (:func:`dump_prometheus`, :mod:`.exposition` HTTP endpoint);
 - :mod:`.tracing` — nested :class:`span`s that emit into the profiler's
   chrome-trace stream AND ``jax.profiler.TraceAnnotation``, lining host
-  spans up with device traces on one perfetto timeline;
+  spans up with device traces on one perfetto timeline; plus the
+  trace-context layer (``TraceContext`` ids propagated thread-locally and
+  handed off explicitly across queue/thread/replica boundaries) and the
+  per-request **wide-event** records behind :func:`recent_requests`;
+- :mod:`.flight_recorder` — the crash black box: bounded rings of recent
+  spans/wide events/notes that dump to a timestamped JSON file on crash,
+  SIGTERM, decode-step quarantine, and circuit-breaker open;
 - :mod:`.recompile` — the compile-cache explainer/watchdog
   (``TPUMX_EXPLAIN_RECOMPILES=1`` logs human-readable miss causes;
   ``TPUMX_FREEZE_COMPILES=1`` + :func:`mark_warm` makes any post-warmup
@@ -31,10 +37,13 @@ from __future__ import annotations
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       DEFAULT_BUCKETS)
-from .tracing import span, current_span, span_stack
+from .tracing import (span, current_span, span_stack, TraceContext,
+                      new_trace, current_trace, use_context,
+                      recent_requests, recent_spans)
 from .recompile import (FreezeCompilesError, explain_key_diff,
                         last_explanations, mark_warm)
 from . import exposition
+from . import flight_recorder
 from . import metrics
 from . import recompile
 from . import telemetry
@@ -42,10 +51,12 @@ from . import tracing
 
 __all__ = ["registry", "snapshot", "to_prometheus", "dump_prometheus",
            "reset", "span", "current_span", "span_stack", "mark_warm",
+           "TraceContext", "new_trace", "current_trace", "use_context",
+           "recent_requests", "recent_spans",
            "last_explanations", "explain_key_diff", "FreezeCompilesError",
            "Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_BUCKETS", "metrics", "tracing", "recompile",
-           "telemetry", "exposition"]
+           "telemetry", "exposition", "flight_recorder"]
 
 #: the process-wide default registry every subsystem records into
 _default_registry = MetricsRegistry()
@@ -73,6 +84,8 @@ def dump_prometheus(path: str) -> None:
 
 def reset() -> None:
     """Clear the default registry AND the recompile explainer state
-    (tests/bench isolation)."""
+    (tests/bench isolation).  Trace/wide-event rings and the flight
+    recorder's note ring have their own ``clear()``s — a metrics reset
+    must not erase the black box a postmortem is about to dump."""
     _default_registry.reset()
     recompile.reset()

@@ -257,7 +257,18 @@ class MetricsRegistry:
             try:
                 fn()
             except Exception:  # noqa: BLE001 — a broken collector must not
-                pass           # take down every scrape
+                # take down every scrape: the rest of the snapshot still
+                # serves, and the failure is itself a metric
+                try:
+                    self.counter(
+                        "observability_collector_errors_total",
+                        labels={"collector": getattr(
+                            fn, "__qualname__", None) or repr(fn)},
+                        help="pull collectors that raised during a "
+                             "snapshot/scrape (isolated per collector)"
+                    ).inc()
+                except Exception:
+                    pass
         if dead:
             with self._lock:
                 self._collectors = [r for r in self._collectors

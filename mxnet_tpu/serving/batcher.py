@@ -139,7 +139,8 @@ class ServingConfig:
 class Request:
     """One in-flight inference request."""
 
-    __slots__ = ("data", "future", "deadline", "t_submit", "bucket_key", "seq")
+    __slots__ = ("data", "future", "deadline", "t_submit", "bucket_key",
+                 "seq", "trace")
 
     def __init__(self, data, bucket_key, deadline: Optional[float], seq: int):
         self.data = data                  # dict name -> per-sample np array
@@ -148,6 +149,7 @@ class Request:
         self.t_submit = time.perf_counter()
         self.bucket_key = bucket_key
         self.seq = seq
+        self.trace = None   # TraceContext parked across the queue boundary
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -183,7 +185,7 @@ class MicroBatcher:
 
     # -- producer side ------------------------------------------------------------
     def put(self, data, bucket_key, deadline: Optional[float],
-            timeout: Optional[float] = None) -> Request:
+            timeout: Optional[float] = None, trace=None) -> Request:
         cfg = self._cfg
         with self._lock:
             if self._closed:
@@ -212,6 +214,7 @@ class MicroBatcher:
                     if self._closed:
                         raise ServingClosedError("service is shut down")
             req = Request(data, bucket_key, deadline, self._seq)
+            req.trace = trace   # set before the worker can pop the request
             self._seq += 1
             self._queues.setdefault(bucket_key, deque()).append(req)
             self._size += 1

@@ -50,6 +50,8 @@ import numpy as _np
 from ... import observability as _obs
 from ...base import getenv
 from ...fault.inject import injector as _fault_injector
+from ...observability import flight_recorder as _flight
+from ...observability import tracing as _trace
 from ..batcher import (BACKPRESSURE_POLICIES, DeadlineExceededError,
                        QueueFullError, RequestShedError, ServingClosedError,
                        ServingError)
@@ -197,7 +199,9 @@ class _GenRequest:
                  "n_generated", "out_queue", "done_event", "error",
                  "finish_reason", "t_submit", "t_first", "t_last",
                  "cancel_requested", "priority", "admit_seq",
-                 "n_preempted", "n_requeues")
+                 "n_preempted", "n_requeues", "trace", "seg_state",
+                 "seg_t0", "breakdown", "breakdown_first", "rung_s",
+                 "decode_steps", "n_retries", "token_log", "wide_event")
 
     def __init__(self, rid, prompt, bucket, max_new, temperature, top_k,
                  top_p, seed, eos_token, deadline, on_token, priority=0):
@@ -229,6 +233,28 @@ class _GenRequest:
         self.admit_seq = -1        # admission recency, keys victim order
         self.n_preempted = 0       # watermark/growth preemptions survived
         self.n_requeues = 0        # error-path requeues consumed
+        # latency attribution (docs/observability.md): the request's
+        # lifetime is partitioned into contiguous segments — queue,
+        # admission, prefill, decode, preempted — whose transition points
+        # are the scheduling events below, so the components sum exactly
+        # to measured wall time (and, snapshotted at first token, to TTFT)
+        self.trace = None               # TraceContext handed across threads
+        self.seg_state = "queue"
+        self.seg_t0 = self.t_submit
+        self.breakdown: Dict[str, float] = {}
+        self.breakdown_first: Optional[Dict[str, float]] = None
+        self.rung_s: Dict[int, float] = {}
+        self.decode_steps = 0
+        self.n_retries = 0
+        self.token_log: List[float] = []
+        self.wide_event: Optional[dict] = None
+
+    def seg(self, state: str, now: float) -> None:
+        """Close the open lifetime segment at ``now`` and open ``state``."""
+        self.breakdown[self.seg_state] = \
+            self.breakdown.get(self.seg_state, 0.0) + (now - self.seg_t0)
+        self.seg_state = state
+        self.seg_t0 = now
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -297,6 +323,53 @@ class GenerationStream:
         request can move replicas without duplicate delivery)."""
         return self._req.t_first is not None
 
+    @property
+    def trace_id(self) -> Optional[str]:
+        """The request's trace id (stable across threads and replica
+        hops; None with ``TPUMX_TRACING=0``)."""
+        return None if self._req.trace is None else self._req.trace.trace_id
+
+    def stats(self) -> dict:
+        """Per-request observability: the wide-event record once the
+        request finished, or a live snapshot of the same shape while it
+        runs — TTFT, per-token timestamps, the latency breakdown, and
+        preemption/requeue/retry counts (docs/observability.md)."""
+        r = self._req
+        ev = r.wide_event
+        if ev is not None:
+            return dict(ev)
+        now = time.perf_counter()
+        bd = dict(r.breakdown)
+        bd[r.seg_state] = bd.get(r.seg_state, 0.0) + (now - r.seg_t0)
+        first = r.breakdown_first
+        return {
+            "type": "generation_request",
+            "request_id": r.rid,
+            "trace_id": self.trace_id,
+            "replica": None,
+            "priority": r.priority,
+            "prompt_tokens": r.prompt_len,
+            "output_tokens": r.n_generated,
+            "outcome": r.state,
+            "finish_reason": r.finish_reason,
+            "error": None if r.error is None else repr(r.error),
+            "total_ms": round((now - r.t_submit) * 1e3, 3),
+            "ttft_ms": (None if r.t_first is None
+                        else round((r.t_first - r.t_submit) * 1e3, 3)),
+            "ttft_breakdown_ms": (
+                None if first is None
+                else {k: round(v * 1e3, 3) for k, v in first.items()}),
+            "breakdown_ms": {k: round(v * 1e3, 3) for k, v in bd.items()},
+            "prefill_rungs_ms": {str(k): round(v * 1e3, 3)
+                                 for k, v in r.rung_s.items()},
+            "decode_steps": r.decode_steps,
+            "preemptions": r.n_preempted,
+            "requeues": r.n_requeues,
+            "retries": r.n_retries,
+            "token_offsets_ms": [round((t - r.t_submit) * 1e3, 3)
+                                 for t in r.token_log],
+        }
+
 
 class GenerationService:
     """Continuous-batching LM generation over a paged KV cache.
@@ -321,6 +394,7 @@ class GenerationService:
 
         self._model_cfg = model_cfg
         self._config = config or GenerationConfig()
+        self._replica_id = 0  # the router overwrites with the fleet index
         cfg = self._config
         compute_dtype = None
         if cfg.amp_dtype:
@@ -419,7 +493,9 @@ class GenerationService:
                deadline_ms: Optional[float] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
                timeout: Optional[float] = None,
-               priority: int = 0) -> GenerationStream:
+               priority: int = 0,
+               trace_ctx: Optional[_trace.TraceContext] = None
+               ) -> GenerationStream:
         """Enqueue one generation request; returns a stream handle.
 
         ``prompt``: 1-D int token ids.  ``temperature <= 0`` is greedy;
@@ -431,6 +507,10 @@ class GenerationService:
         bounds a *blocking* submit under the ``block`` policy.
         ``priority`` is the request's class: higher classes are admitted
         first and preempted last (ties FIFO / newest-admitted-first).
+        ``trace_ctx`` is the explicit trace handoff (docs/observability.md):
+        the router passes its dispatch context so the request keeps ONE
+        trace id across the replica hop; without it the submitting
+        thread's context (or a fresh trace) is used.
         """
         cfg = self._config
         if self._closed:
@@ -513,6 +593,9 @@ class GenerationService:
                               bucket, max_new, temperature, top_k, top_p,
                               seed, eos, deadline, on_token,
                               priority=priority)
+            if _trace.enabled():
+                req.trace = (trace_ctx or _trace.current_trace()
+                             or _trace.new_trace())
             self._next_rid += 1
             self._waiting.append(req)
             self._counts["submitted"] += 1
@@ -627,6 +710,7 @@ class GenerationService:
             running = sum(1 for r in self._slots if r is not None)
         return {
             "alive": (not self._killed) and (not self._closed) and worker_ok,
+            "replica": self._replica_id,
             "killed": self._killed,
             "closed": self._closed,
             "consecutive_step_failures": self._consec_step_failures,
@@ -660,6 +744,7 @@ class GenerationService:
 
         if getattr(self, "_signal_unregister", None) is not None:
             return True
+        _flight.install()  # a preempted replica leaves its black box
         self._signal_unregister = install_shutdown_hook(
             lambda signum: self.shutdown(),
             signals or DEFAULT_SIGNALS)
@@ -670,6 +755,10 @@ class GenerationService:
         if unreg is not None:
             self._signal_unregister = None
             unreg()
+            # symmetric lifecycle: the hub restores default dispositions
+            # once its last callback unregisters (a mid-delivery dump
+            # still fires — the hub iterates a snapshot)
+            _flight.uninstall()
 
     def __enter__(self):
         return self
@@ -808,6 +897,18 @@ class GenerationService:
             self._admit_seq += 1
             self._slots[free.pop(0)] = head
             admitted.append(head)
+            # latency attribution: close the wait segment (queue on first
+            # admission, preempted on re-admission) and record it as a
+            # span of the request's trace — the engine thread picks up
+            # the context the submitter parked on the request
+            now = time.perf_counter()
+            waited, t_wait0 = head.seg_state, head.seg_t0
+            head.seg("admission", now)
+            if head.trace is not None:
+                _trace.record_event(
+                    "gen.queue", "serving", t_wait0, now, ctx=head.trace,
+                    args={"rid": head.rid, "kind": waited,
+                          "replica": self._replica_id})
             self._not_full.notify_all()
         return admitted
 
@@ -831,10 +932,11 @@ class GenerationService:
         it through the chunked-prefill rungs (tokens stay bit-identical:
         sampling is keyed on (seed, position) only)."""
         r = self._slots[i]
+        r.seg("preempted", time.perf_counter())
         with _obs.span("serving.preempt", cat="serving",
                        args={"rid": r.rid, "ctx": r.ctx_len,
                              "blocks": len(r.blocks or ()),
-                             "kind": counter}):
+                             "kind": counter}, ctx=r.trace):
             self._slots[i] = None
             if r.blocks:
                 self._cache.allocator.free(r.blocks)
@@ -920,6 +1022,8 @@ class GenerationService:
                        error: Optional[BaseException] = None) -> None:
         if r.done_event.is_set():
             return
+        now = time.perf_counter()
+        r.seg("end", now)  # close the final lifetime segment
         if error is not None:
             r.state = _FAILED
             r.finish_reason = r.finish_reason or "error"
@@ -930,7 +1034,49 @@ class GenerationService:
             r.state = reason
             r.finish_reason = r.finish_reason or reason
             r.out_queue.put(("done", r.finish_reason))
+        # every request terminates in ONE wide-event record
+        # (docs/observability.md): ring + TPUMX_TRACE_LOG sink + stream
+        # stats, and the trace gains its terminal reply span
+        r.wide_event = self._build_wide_event(r, now)
+        _trace.record_wide_event(r.wide_event)
+        if r.trace is not None:
+            _trace.record_event("gen.reply", "serving", now,
+                                time.perf_counter(), ctx=r.trace,
+                                args={"rid": r.rid, "outcome": r.state,
+                                      "replica": self._replica_id})
         r.done_event.set()
+
+    def _build_wide_event(self, r: _GenRequest, now: float) -> dict:
+        bd = dict(r.breakdown)
+        bd.pop("end", None)
+        first = r.breakdown_first
+        return {
+            "type": "generation_request",
+            "request_id": r.rid,
+            "trace_id": None if r.trace is None else r.trace.trace_id,
+            "replica": self._replica_id,
+            "priority": r.priority,
+            "prompt_tokens": r.prompt_len,
+            "output_tokens": r.n_generated,
+            "outcome": r.state,
+            "finish_reason": r.finish_reason,
+            "error": None if r.error is None else repr(r.error),
+            "total_ms": round((now - r.t_submit) * 1e3, 3),
+            "ttft_ms": (None if r.t_first is None
+                        else round((r.t_first - r.t_submit) * 1e3, 3)),
+            "ttft_breakdown_ms": (
+                None if first is None
+                else {k: round(v * 1e3, 3) for k, v in first.items()}),
+            "breakdown_ms": {k: round(v * 1e3, 3) for k, v in bd.items()},
+            "prefill_rungs_ms": {str(k): round(v * 1e3, 3)
+                                 for k, v in r.rung_s.items()},
+            "decode_steps": r.decode_steps,
+            "preemptions": r.n_preempted,
+            "requeues": r.n_requeues,
+            "retries": r.n_retries,
+            "token_offsets_ms": [round((t - r.t_submit) * 1e3, 3)
+                                 for t in r.token_log],
+        }
 
     # -- model steps (engine thread, no lock held) --------------------------------
     def _chunk_plan(self, prompt_len: int, force_chunked: bool = False):
@@ -1006,6 +1152,16 @@ class GenerationService:
         resumed = r.ctx_len > 0
         ctx = r.ctx_len if resumed else r.prompt_len
         plan = self._chunk_plan(ctx, force_chunked=resumed)
+        # attribution: the admission segment ran from block allocation to
+        # here; record it on the trace, then open the prefill segment
+        now = time.perf_counter()
+        if r.trace is not None:
+            _trace.record_event("gen.admit", "serving", r.seg_t0, now,
+                                ctx=r.trace,
+                                args={"rid": r.rid, "resumed": resumed,
+                                      "blocks": len(r.blocks or ()),
+                                      "replica": self._replica_id})
+        r.seg("prefill", now)
         for (off, take, tb, wp) in plan:
             table = _np.zeros((1, wp), _np.int32)
             n = min(wp, len(r.blocks))
@@ -1014,11 +1170,12 @@ class GenerationService:
                 _np.asarray(r.seq_tokens[off:off + take], _np.int32),
                 tb)[None, :]
             positions = _np.arange(off, off + tb, dtype=_np.int32)[None, :]
+            t_rung0 = time.perf_counter()
             with _obs.span("serving.prefill", cat="serving",
                            args={"rid": r.rid, "len": ctx,
                                  "bucket": tb, "off": off,
                                  "chunks": len(plan),
-                                 "resumed": resumed}):
+                                 "resumed": resumed}, ctx=r.trace):
                 # the sampler reads the chunk's last VALID row; only the
                 # final chunk's sample (global position prompt_len-1, the
                 # same seed/counter as the unchunked program) is emitted —
@@ -1031,6 +1188,9 @@ class GenerationService:
                     _np.asarray([r.temperature], _np.float32),
                     _np.asarray([r.top_k], _np.int32),
                     _np.asarray([r.top_p], _np.float32))
+            r.rung_s[tb] = r.rung_s.get(tb, 0.0) \
+                + (time.perf_counter() - t_rung0)
+        r.seg("decode", time.perf_counter())
         if resumed:
             return
         r.ctx_len = r.prompt_len
@@ -1081,14 +1241,29 @@ class GenerationService:
                 f"injected decode-step failure "
                 f"(TPUMX_FAULT_GEN_STEP_FAIL) at iteration "
                 f"{self._iteration}, batch rids {sorted(rids)}")
+        t_step0 = time.perf_counter()
         with _obs.span("serving.decode", cat="serving",
-                       args={"running": len(batch), "width": int(w)}):
+                       args={"running": len(batch), "width": int(w),
+                             "iteration": self._iteration}):
             next_tok, _ = self._programs.run(
                 "gen_decode", self._cache, tokens, positions, lengths,
                 tables, seeds, counters, temperature, top_k, top_p)
+        t_step1 = time.perf_counter()
+        traced = _trace.enabled()
         for i, r in enumerate(self._slots):
             if r is None or r.state != _RUNNING or r.rid not in rids:
                 continue
+            # Orca attribution: the ONE shared decode step fans out a
+            # child participation span per active request, so each trace
+            # still shows every step that advanced it
+            r.decode_steps += 1
+            if traced and r.trace is not None:
+                _trace.record_event(
+                    "serving.decode.participate", "serving", t_step0,
+                    t_step1, ctx=r.trace,
+                    args={"rid": r.rid, "iteration": self._iteration,
+                          "running": len(batch),
+                          "replica": self._replica_id})
             r.ctx_len += 1
             self._emit_token(r, int(next_tok[i]))
 
@@ -1110,6 +1285,8 @@ class GenerationService:
                 return
             except Exception as exc:  # noqa: BLE001 — isolate below
                 self._note_step_failure(exc)
+                for r in running:  # attributed per request (wide event)
+                    r.n_retries += 1
         self._bisect_decode(running)
 
     def _bisect_decode(self, group: List[_GenRequest],
@@ -1119,6 +1296,7 @@ class GenerationService:
             return
         if len(group) == 1:
             r = group[0]
+            quarantined = False
             with self._lock:
                 for i, s in enumerate(self._slots):
                     if s is r and r.state == _RUNNING:
@@ -1129,7 +1307,14 @@ class GenerationService:
                                 f"request {r.rid} quarantined: decode step "
                                 f"fails whenever it is scheduled "
                                 f"(last error: {cause!r})"))
+                        quarantined = True
                         break
+            if quarantined:
+                # postmortems start from data: the black box carries the
+                # quarantined request's wide event (docs/observability.md)
+                _flight.dump("gen_quarantine", extra={
+                    "rid": r.rid, "replica": self._replica_id,
+                    "cause": repr(cause), "request": r.wide_event})
             return
         mid = len(group) // 2
         for half in (group[:mid], group[mid:]):
@@ -1146,6 +1331,7 @@ class GenerationService:
         the error-requeue budget — instead of failing it."""
         err = exc if isinstance(exc, ServingError) else ServingError(
             f"generation step failed: {exc!r}")
+        failed = False
         with self._lock:
             for i, s in enumerate(self._slots):
                 if s is r:
@@ -1156,7 +1342,12 @@ class GenerationService:
                             i, error=GenerationStepError(
                                 f"request {r.rid} failed after "
                                 f"{r.n_requeues} error requeues: {err}"))
-                    return
+                        failed = True
+                    break
+        if failed:
+            _flight.dump("gen_requeue_budget", extra={
+                "rid": r.rid, "replica": self._replica_id,
+                "cause": repr(exc), "request": r.wide_event})
 
     def _absorb_iteration_error(self, exc: BaseException,
                                 progress: Dict[int, int]) -> None:
@@ -1178,8 +1369,15 @@ class GenerationService:
         now = time.perf_counter()
         r.seq_tokens.append(tok)
         r.n_generated += 1
+        if len(r.token_log) < 4096:
+            r.token_log.append(now)
         if r.t_first is None:
             r.t_first = now
+            # snapshot the lifetime partition AT the first token: these
+            # components sum exactly to measured TTFT (the wide event's
+            # ttft_breakdown_ms, docs/observability.md)
+            r.seg(r.seg_state, now)
+            r.breakdown_first = dict(r.breakdown)
             ttft = now - r.t_submit
             self._ttft.append(ttft)
             self._h_ttft.observe(ttft)
@@ -1238,6 +1436,17 @@ class GenerationService:
         occ = alloc.occupancy()
         self._peak_occupancy = max(self._peak_occupancy, occ)
         self._g_occupancy.set(occ)
+        if self._iteration % 64 == 0:
+            # periodic metric deltas into the flight recorder's note ring:
+            # a dead replica's dump shows how its load evolved, not just
+            # its final snapshot
+            _flight.note("gen_metrics", {
+                "replica": self._replica_id, "iteration": self._iteration,
+                "running": running, "waiting": len(self._waiting),
+                "occupancy": round(occ, 4),
+                "tokens": self._counts["tokens"],
+                "preempted": self._counts["preempted"],
+                "step_failures": self._counts["step_failures"]})
         now = time.perf_counter()
         while self._token_times and \
                 now - self._token_times[0] > self._TPS_WINDOW:

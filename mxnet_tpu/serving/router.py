@@ -38,6 +38,8 @@ from typing import Callable, List, Optional
 from .. import observability as _obs
 from ..base import getenv
 from ..fault.inject import injector as _fault_injector
+from ..observability import flight_recorder as _flight
+from ..observability import tracing as _trace
 from .batcher import ServingClosedError, ServingError
 from .generation import GenerationConfig, GenerationService
 
@@ -108,9 +110,9 @@ class _Record:
     """One outstanding client request: enough to resubmit it verbatim."""
 
     __slots__ = ("prompt", "kwargs", "stream", "replica_idx", "error",
-                 "resubmits", "cancelled")
+                 "resubmits", "cancelled", "trace")
 
-    def __init__(self, prompt, kwargs, stream, replica_idx):
+    def __init__(self, prompt, kwargs, stream, replica_idx, trace=None):
         self.prompt = prompt
         self.kwargs = kwargs
         self.stream = stream            # swapped atomically on resubmit
@@ -118,6 +120,7 @@ class _Record:
         self.error: Optional[BaseException] = None
         self.resubmits = 0
         self.cancelled = False
+        self.trace = trace              # one trace id across replica hops
 
     @property
     def done(self) -> bool:
@@ -207,6 +210,20 @@ class RouterStream:
     def resubmits(self) -> int:
         return self._rec.resubmits
 
+    @property
+    def trace_id(self) -> Optional[str]:
+        """The request's trace id — stable across replica failover (the
+        resubmitted engine request continues the same trace)."""
+        return None if self._rec.trace is None else self._rec.trace.trace_id
+
+    def stats(self) -> dict:
+        """The CURRENT engine request's wide-event record (or live
+        snapshot), plus router-level failover counts."""
+        out = self._rec.stream.stats()
+        out["router_replica"] = self._rec.replica_idx
+        out["resubmits"] = self._rec.resubmits
+        return out
+
 
 class GenerationRouter:
     """N generation replicas behind one health-gated front-end.
@@ -238,7 +255,10 @@ class GenerationRouter:
                                   gen_config or GenerationConfig(),
                                   start=False)
                 for _ in range(self._config.num_replicas)]
-        self._replicas = [_Replica(i, svc) for i, svc in enumerate(replicas)]
+        self._replicas = []
+        for i, svc in enumerate(replicas):
+            svc._replica_id = i  # wide events/spans name the fleet index
+            self._replicas.append(_Replica(i, svc))
         self._lock = threading.Lock()
         self._records: List[_Record] = []
         self._closed = False
@@ -316,6 +336,7 @@ class GenerationRouter:
 
         if self._signal_unregister is not None:
             return True
+        _flight.install()  # a preempted fleet leaves its black box
         self._signal_unregister = install_shutdown_hook(
             lambda signum: self.shutdown(), signals or DEFAULT_SIGNALS)
         return self._signal_unregister is not None
@@ -325,6 +346,7 @@ class GenerationRouter:
         if unreg is not None:
             self._signal_unregister = None
             unreg()
+            _flight.uninstall()  # symmetric with install_signal_handlers
 
     def __enter__(self):
         return self
@@ -355,20 +377,28 @@ class GenerationRouter:
                 f"all {len(self._replicas)} replicas are circuit-broken "
                 "or dead")
         rep = min(candidates, key=lambda c: c.service.load())
-        with _obs.span("router.dispatch", cat="serving",
-                       args={"replica": rep.idx,
-                             "candidates": len(candidates)}):
-            stream = rep.service.submit(prompt, **kwargs)
-            rec = _Record(prompt, dict(kwargs), stream, rep.idx)
-            with self._lock:
-                self._records.append(rec)
-            rep.dispatches += 1
-            self._c_dispatch.inc()
-            # deterministic chaos: TPUMX_FAULT_GEN_KILL_REPLICA=N[@K]
-            # kills replica N right AFTER its K-th accepted dispatch, so
-            # the request is on a replica that dies before serving it
-            if _fault_injector().gen_kill_replica(rep.idx):
-                rep.service.kill()
+        # one trace for the whole request lifecycle: reuse the caller's
+        # context when one is active (a traced client), else mint a root;
+        # the dispatch span narrows it and the engine inherits it through
+        # the explicit trace_ctx handoff (docs/observability.md)
+        ctx = _trace.current_trace() or _trace.new_trace()
+        with _trace.use_context(ctx):
+            with _obs.span("router.dispatch", cat="serving",
+                           args={"replica": rep.idx,
+                                 "candidates": len(candidates)}):
+                stream = rep.service.submit(
+                    prompt, trace_ctx=_trace.current_trace(), **kwargs)
+                rec = _Record(prompt, dict(kwargs), stream, rep.idx,
+                              trace=ctx)
+                with self._lock:
+                    self._records.append(rec)
+                rep.dispatches += 1
+                self._c_dispatch.inc()
+                # deterministic chaos: TPUMX_FAULT_GEN_KILL_REPLICA=N[@K]
+                # kills replica N right AFTER its K-th accepted dispatch,
+                # so the request is on a replica that dies before serving
+                if _fault_injector().gen_kill_replica(rep.idx):
+                    rep.service.kill()
         return RouterStream(rec)
 
     def generate(self, prompt, **kwargs) -> List[int]:
@@ -427,10 +457,17 @@ class GenerationRouter:
     def _transition(self, rep: _Replica, state: str, now: float) -> None:
         if rep.breaker == state:
             return
+        prev = rep.breaker
         rep.breaker = state
         if state == _OPEN:
             rep.opened_at = now
         self._c_breaker.inc()
+        _flight.note("breaker", {"replica": rep.idx, "from": prev,
+                                 "to": state})
+        if state == _OPEN:
+            # a breaker opening means a replica just went dark under
+            # traffic — dump the black box while the evidence is fresh
+            _flight.dump("breaker_open", extra={"replica": rep.idx})
 
     def _handle_dead_replica(self, rep: _Replica) -> None:
         """Failure isolation: resubmit every request the dead replica
@@ -463,13 +500,21 @@ class GenerationRouter:
             raise NoHealthyReplicaError(
                 "dead replica's queued work has no healthy target")
         rep = min(candidates, key=lambda c: c.service.load())
-        stream = rep.service.submit(rec.prompt, **rec.kwargs)
+        t0 = time.perf_counter()
+        from_idx = rec.replica_idx
+        # the SAME trace context crosses the replica hop — the new
+        # replica's spans continue the dead one's trace
+        stream = rep.service.submit(rec.prompt, trace_ctx=rec.trace,
+                                    **rec.kwargs)
         rec.replica_idx = rep.idx
         rec.stream = stream  # swap is the failover commit point
         rec.resubmits += 1
         rep.dispatches += 1
         self._c_dispatch.inc()
         self._c_resubmit.inc()
+        _trace.record_event("router.resubmit", "serving", t0,
+                            time.perf_counter(), ctx=rec.trace,
+                            args={"from": from_idx, "to": rep.idx})
 
     # -- introspection ------------------------------------------------------------
     def stats(self) -> dict:

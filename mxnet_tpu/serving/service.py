@@ -313,10 +313,16 @@ class InferenceService:
             else self._config.default_deadline_ms
         deadline = None if ms is None else time.perf_counter() + ms / 1e3
         self._metrics.incr("requests_submitted")
+        # per-request trace (docs/observability.md): the caller's context
+        # when one is active, else a fresh root — parked on the request
+        # across the batcher queue so the dispatch worker can attribute
+        # the shared batch execute to every rider
+        tr_ctx = _obs.tracing.current_trace() or _obs.tracing.new_trace()
         if _engine.is_naive():
             # synchronous debug mode: same pad/bucket/execute path, no
             # threads — every submit() runs to completion inline
             req = Request(sample, key, deadline, seq=0)
+            req.trace = tr_ctx
             if req.expired():
                 from .batcher import DeadlineExceededError
 
@@ -328,9 +334,9 @@ class InferenceService:
         from .batcher import QueueFullError
 
         try:
-            with _obs.span("serving.enqueue", cat="serving"):
+            with _obs.span("serving.enqueue", cat="serving", ctx=tr_ctx):
                 req = self._batcher.put(sample, key, deadline,
-                                        timeout=timeout)
+                                        timeout=timeout, trace=tr_ctx)
         except QueueFullError:
             self._metrics.incr("requests_rejected")
             raise
@@ -470,8 +476,18 @@ class InferenceService:
                         feed[name] = assemble_batch(
                             [r.data[name] for r in live], sample_bucket,
                             padded)
+                t_exec0 = time.perf_counter()
                 with _obs.span("serving.execute", cat="serving"):
                     outs = self._adapter.run(feed)
+                t_exec1 = time.perf_counter()
+                # Orca-style attribution for the micro-batch: one shared
+                # execute, one participation span per rider's trace
+                for r in live:
+                    if r.trace is not None:
+                        _obs.tracing.record_event(
+                            "serving.execute.participate", "serving",
+                            t_exec0, t_exec1, ctx=r.trace,
+                            args={"batch": n, "padded": padded})
         except Exception as exc:  # noqa: BLE001 — isolate, then surface
             if n == 1 or _isolated:
                 self._metrics.incr("requests_failed", n)

@@ -95,6 +95,12 @@ def pytest_configure(config):
         "docs/generation.md; select with `pytest -m router`)")
     config.addinivalue_line(
         "markers",
+        "tracing: end-to-end request tracing + flight recorder "
+        "(mxnet_tpu.observability.tracing trace contexts, wide-event "
+        "records, mxnet_tpu.observability.flight_recorder; "
+        "docs/observability.md; select with `pytest -m tracing`)")
+    config.addinivalue_line(
+        "markers",
         "fault: fault-tolerant training (mxnet_tpu.checkpoint async "
         "checkpointing + mxnet_tpu.fault preemption/injection, kvstore "
         "retry/backoff, serving graceful shutdown; "

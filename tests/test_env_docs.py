@@ -1,0 +1,73 @@
+"""Static docs-drift check: every ``TPUMX_*``/``BENCH_*`` environment
+variable READ anywhere in mxnet_tpu/ or bench.py must be documented in
+docs/env_vars.md (PRs 9 and 11 each had to fix this drift by hand; this
+makes it a tier-1 failure instead of a reviewer catch).
+"""
+import os
+import re
+
+import pytest
+
+pytestmark = pytest.mark.tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# an env READ site: getenv("VAR", ...) / os.environ.get("VAR") /
+# os.environ["VAR"] / os.environ.setdefault("VAR", ...) — NOT a mere
+# mention in a docstring or comment
+_READ = re.compile(
+    r'(?:getenv|environ(?:\.get|\.setdefault|\.pop)?)'
+    r'\s*[\(\[]\s*f?["\']((?:TPUMX|BENCH)_[A-Z0-9_]+)["\']')
+
+
+def _source_files():
+    yield os.path.join(REPO, "bench.py")
+    for root, _dirs, files in os.walk(os.path.join(REPO, "mxnet_tpu")):
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def test_every_env_var_read_in_source_is_documented():
+    reads = {}
+    for path in _source_files():
+        with open(path) as f:
+            src = f.read()
+        rel = os.path.relpath(path, REPO)
+        for m in _READ.finditer(src):
+            reads.setdefault(m.group(1), set()).add(rel)
+    assert len(reads) > 80, \
+        f"scanner regressed: only {len(reads)} env reads found"
+    with open(os.path.join(REPO, "docs", "env_vars.md")) as f:
+        docs = f.read()
+    missing = {v: sorted(files) for v, files in sorted(reads.items())
+               if v not in docs}
+    assert not missing, (
+        "environment variables read in source but missing from "
+        f"docs/env_vars.md: {missing} — document them (name, default, "
+        "effect) in the appropriate section")
+
+
+def test_documented_tpumx_vars_exist_in_source():
+    """The reverse direction: a TPUMX_ var documented as a knob should
+    still be read somewhere (stale docs rows are drift too).  BENCH_ rows
+    are exempt: some are consumed by CI wrappers outside this repo."""
+    reads = set()
+    for path in _source_files():
+        with open(path) as f:
+            src = f.read()
+        for m in _READ.finditer(src):
+            reads.add(m.group(1))
+        # vars can also be SET for subprocesses (bench legs); mentions in
+        # code strings count as alive
+        for m in re.finditer(r'["\'](TPUMX_[A-Z0-9_]+)["\']', src):
+            reads.add(m.group(1))
+    with open(os.path.join(REPO, "docs", "env_vars.md")) as f:
+        docs = f.read()
+    documented = set(re.findall(r"`(TPUMX_[A-Z0-9_]+)`", docs))
+    # wildcard-family rows (e.g. the TPUMX_FAULT_* umbrella) and names
+    # documented for the launcher rather than the library are fine
+    stale = {v for v in documented - reads if not v.endswith("_")}
+    assert not stale, (
+        f"docs/env_vars.md documents {sorted(stale)} but nothing in "
+        "mxnet_tpu/ or bench.py reads them — remove or fix the rows")

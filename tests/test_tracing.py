@@ -1,0 +1,544 @@
+"""End-to-end request tracing, latency attribution, and the crash flight
+recorder (docs/observability.md): trace-context propagation across the
+router -> replica -> engine thread hops, wide-event TTFT breakdowns that
+sum to measured wall time, GenerationStream.stats(), the TPUMX_TRACING=0
+byte-identity gate, flight-recorder dumps on quarantine/SIGTERM/breaker
+open, and collector-failure isolation in the metrics registry.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from mxnet_tpu import observability as obs
+from mxnet_tpu import profiler
+from mxnet_tpu.fault.inject import injector
+from mxnet_tpu.observability import flight_recorder as flight
+from mxnet_tpu.observability import tracing
+from mxnet_tpu.parallel import transformer as tr
+from mxnet_tpu.serving import (GenerationConfig, GenerationRouter,
+                               GenerationService, GenerationStepError,
+                               RouterConfig)
+
+pytestmark = pytest.mark.tracing
+
+CFG = tr.TransformerConfig(vocab=40, d_model=32, n_heads=4, n_layers=2,
+                           d_ff=64, max_len=64)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    tracing.clear()
+    flight.clear()
+    yield
+    obs.recompile.reset()
+    injector().reset()
+    tracing.clear()
+    flight.clear()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tr.transformer_lm_init(CFG, jax.random.PRNGKey(0))
+
+
+def _gc(**kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("seq_buckets", [16, 32])
+    kw.setdefault("max_new_tokens", 8)
+    return GenerationConfig(**kw)
+
+
+def _names(spans):
+    return [s["name"] for s in spans]
+
+
+# -- the acceptance trace: one trace id across every hop ----------------------------
+def test_one_trace_id_across_dispatch_queue_rungs_decode_preempt_reply(
+        params, tmp_path, monkeypatch):
+    """Acceptance: a single request's spans carry ONE trace id across
+    router dispatch, replica queue, every prefill rung, >= 2 decode-step
+    participations, a forced preemption + re-prefill, and the reply —
+    asserted via the trace buffer, and mirrored into the chrome-trace
+    stream when the profiler runs."""
+    monkeypatch.setenv("TPUMX_FLIGHT_RECORDER", "0")  # no breaker dumps here
+    # a pool too small for both worst cases forces preemption + re-prefill
+    svc = GenerationService(params, CFG,
+                            _gc(num_blocks=8, preemption=True), start=False)
+    router = GenerationRouter(
+        replicas=[svc], config=RouterConfig(num_replicas=1,
+                                            probe_interval_ms=10.0),
+        start=False)
+    profiler.set_config(filename=str(tmp_path / "trace.json"))
+    profiler.start()
+    try:
+        rs = np.random.RandomState(1)
+        hs = [router.submit(rs.randint(0, CFG.vocab, 20), max_new_tokens=12)
+              for _ in range(2)]
+        router.start()
+        outs = [h.result(120) for h in hs]
+    finally:
+        profiler.stop()
+    stats = svc.stats()
+    assert all(len(o) == 12 for o in outs)
+    assert stats["counts"]["preempted"] >= 1
+
+    # the preempted-and-resumed request is the interesting trace
+    preempted = [h for h in hs if h.stats()["preemptions"] >= 1]
+    assert preempted, "tight pool must have preempted one request"
+    h = preempted[0]
+    tid = h.trace_id
+    assert tid is not None and all(x.trace_id for x in hs)
+    assert len({x.trace_id for x in hs}) == 2  # one trace PER request
+
+    spans = obs.recent_spans(trace_id=tid)
+    names = _names(spans)
+    assert "router.dispatch" in names             # client thread
+    assert "gen.queue" in names                   # engine thread: the hop
+    assert "gen.admit" in names
+    prefills = [s for s in spans if s["name"] == "serving.prefill"]
+    assert prefills, "prefill rungs must land in the trace"
+    # forced preemption + re-prefill: a preempt span and a resumed rung
+    assert "serving.preempt" in names
+    assert any(s["args"].get("resumed") for s in prefills), \
+        "the re-prefill (resumed) rung must ride the same trace"
+    participations = [s for s in spans
+                      if s["name"] == "serving.decode.participate"]
+    assert len(participations) >= 2
+    assert names[-1] == "gen.reply" or "gen.reply" in names
+    # every span of the trace shares the one id and names this replica
+    assert {s["trace_id"] for s in spans} == {tid}
+    # spans crossed threads: dispatch ran on the client thread, the rest
+    # on the engine thread
+    assert len({s["thread"] for s in spans}) >= 2
+
+    # chrome-trace export: the same ids ride the profiler event stream,
+    # so one perfetto timeline shows the request end to end
+    events = json.loads(profiler.dumps(format="json"))["traceEvents"]
+    traced = [e for e in events
+              if e.get("args", {}).get("trace_id") == tid]
+    assert {"router.dispatch", "serving.prefill",
+            "serving.decode.participate"} <= {e["name"] for e in traced}
+    router.stop()
+
+
+def test_trace_id_survives_replica_failover(params, monkeypatch):
+    """The resubmitted request continues the dead replica's trace: one
+    trace id across BOTH replicas' spans, with a router.resubmit span
+    marking the hop."""
+    monkeypatch.setenv("TPUMX_FLIGHT_RECORDER", "0")
+    monkeypatch.setenv("TPUMX_FAULT_GEN_KILL_REPLICA", "0@1")
+    injector().reset()
+    replicas = [GenerationService(params, CFG, _gc(max_slots=1),
+                                  start=False) for _ in range(2)]
+    router = GenerationRouter(replicas=replicas,
+                              config=RouterConfig(probe_interval_ms=10.0,
+                                                  breaker_cooldown_ms=100.0))
+    rs = np.random.RandomState(2)
+    # replica 0 is killed right after accepting this dispatch; the router
+    # must resubmit it to replica 1 under the SAME trace
+    h = router.submit(rs.randint(0, CFG.vocab, 8), max_new_tokens=4)
+    out = h.result(120)
+    assert len(out) == 4
+    assert h.resubmits >= 1
+    tid = h.trace_id
+    spans = obs.recent_spans(trace_id=tid)
+    names = _names(spans)
+    assert "router.dispatch" in names
+    assert "router.resubmit" in names
+    replicas_seen = {s["args"].get("replica") for s in spans
+                     if s["name"] == "serving.decode.participate"}
+    assert replicas_seen == {1}, "the reply decoded on the survivor"
+    ev = h.stats()
+    assert ev["trace_id"] == tid and ev["replica"] == 1
+    router.stop()
+
+
+# -- wide events + latency attribution ----------------------------------------------
+def test_ttft_breakdown_sums_to_ttft_and_total(params):
+    """Acceptance: queue + admission + prefill + decode + preempted
+    components sum to measured TTFT (snapshotted at first token) and the
+    full breakdown to total wall time — exact partitions, tolerance is
+    float rounding only."""
+    svc = GenerationService(params, CFG, _gc(num_blocks=8, preemption=True),
+                            start=False)
+    rs = np.random.RandomState(1)
+    hs = [svc.submit(rs.randint(0, CFG.vocab, 20), max_new_tokens=12)
+          for _ in range(2)]
+    svc.start()
+    for h in hs:
+        h.result(120)
+    evs = [h.stats() for h in hs]
+    assert svc.stats()["counts"]["preempted"] >= 1
+    svc.stop()
+    for ev in evs:
+        assert ev["outcome"] == "finished"
+        comp = set(ev["ttft_breakdown_ms"]) | set(ev["breakdown_ms"])
+        assert comp <= {"queue", "admission", "prefill", "decode",
+                        "preempted"}
+        assert sum(ev["ttft_breakdown_ms"].values()) == \
+            pytest.approx(ev["ttft_ms"], abs=0.05)
+        assert sum(ev["breakdown_ms"].values()) == \
+            pytest.approx(ev["total_ms"], abs=0.05)
+        assert ev["prefill_rungs_ms"], "per-rung prefill attribution"
+        assert ev["decode_steps"] >= 2
+        assert len(ev["token_offsets_ms"]) == ev["output_tokens"] == 12
+    preempted = [ev for ev in evs if ev["preemptions"] >= 1]
+    assert preempted and preempted[0]["breakdown_ms"].get("preempted", 0) > 0
+
+
+def test_retried_then_quarantined_wide_event(params, tmp_path, monkeypatch):
+    """A persistently poisoned request is retried, bisected, quarantined —
+    its wide event records the retries and a breakdown that still sums to
+    its total wall time, and the flight recorder dumps a valid JSON file
+    containing that wide event."""
+    monkeypatch.setenv("TPUMX_FLIGHT_RECORDER_DIR", str(tmp_path))
+    monkeypatch.setenv("TPUMX_FAULT_GEN_STEP_FAIL", "3@1")
+    injector().reset()
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    rs = np.random.RandomState(3)
+    h0 = svc.submit(rs.randint(0, CFG.vocab, 6), max_new_tokens=8, seed=1)
+    h1 = svc.submit(rs.randint(0, CFG.vocab, 6), max_new_tokens=8, seed=2)
+    svc.start()
+    assert len(h0.result(120)) == 8          # the healthy neighbour finishes
+    with pytest.raises(GenerationStepError):
+        h1.result(120)
+    ev = h1.stats()
+    svc.stop(drain=False)
+    assert ev["outcome"] == "failed"
+    assert ev["retries"] >= 1
+    assert "quarantined" in (ev["error"] or "")
+    assert sum(ev["breakdown_ms"].values()) == \
+        pytest.approx(ev["total_ms"], abs=0.05)
+    # the quarantine dump: valid JSON, tagged with the reason, carrying
+    # the failing request's wide event.  The dump is written by the
+    # engine thread AFTER the client's result() unblocks — poll for it.
+    deadline = time.perf_counter() + 10
+    while flight.last_dump() is None and time.perf_counter() < deadline:
+        time.sleep(0.02)
+    path = flight.last_dump()
+    assert path is not None and os.path.exists(path)
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "gen_quarantine"
+    assert dump["extra"]["rid"] == ev["request_id"]
+    assert dump["extra"]["request"]["outcome"] == "failed"
+    assert any(e.get("request_id") == ev["request_id"]
+               for e in dump["wide_events"])
+    assert dump["metrics"]["counters"].get(
+        "generation_quarantines_total", 0) >= 1
+
+
+def test_wide_event_ring_and_jsonl_sink(params, tmp_path, monkeypatch):
+    """Every request terminates in one wide event: the in-memory ring
+    (observability.recent_requests) and the TPUMX_TRACE_LOG JSONL sink
+    agree."""
+    log = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("TPUMX_TRACE_LOG", str(log))
+    tracing.clear()
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    rs = np.random.RandomState(4)
+    hs = [svc.submit(rs.randint(0, CFG.vocab, 6), max_new_tokens=3)
+          for _ in range(3)]
+    svc.start()
+    for h in hs:
+        h.result(120)
+    svc.stop()
+    ring = [e for e in obs.recent_requests()
+            if e["type"] == "generation_request"]
+    assert len(ring) == 3
+    lines = [json.loads(ln) for ln in log.read_text().splitlines()]
+    assert [e["request_id"] for e in lines] == \
+        [e["request_id"] for e in ring]
+    for ev in ring:
+        assert ev["outcome"] == "finished" and ev["output_tokens"] == 3
+
+
+def test_fit_batches_and_checkpoint_saves_share_one_trace(tmp_path):
+    """Module.fit runs under one trace: fit.epoch/fit.batch/
+    executor.fused_step/kvstore.push spans — and the async checkpoint
+    writer on ITS thread — all carry the fit's trace id."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=8, name="fc"), label,
+        name="softmax")
+    rs = np.random.RandomState(0)
+    it = mx.io.NDArrayIter(rs.rand(16, 4).astype(np.float32),
+                           rs.randint(0, 8, 16).astype(np.float32),
+                           batch_size=8)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    tracing.clear()
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=1)
+    spans = obs.recent_spans()
+    fit_spans = [s for s in spans if s["name"].startswith("fit.")]
+    assert fit_spans, "fit spans must land in the trace ring"
+    tid = fit_spans[0]["trace_id"]
+    assert tid is not None
+    by_name = {}
+    for s in spans:
+        if s["trace_id"] == tid:
+            by_name.setdefault(s["name"], []).append(s)
+    assert "fit.batch" in by_name
+    assert "executor.fused_step" in by_name or "kvstore.push" in by_name
+    saves = [n for n in by_name
+             if n in ("checkpoint.save_async", "checkpoint.save_sync")]
+    assert saves, "checkpoint saves must join the fit trace across the " \
+                  "writer-thread boundary"
+
+
+# -- the TPUMX_TRACING=0 gate --------------------------------------------------------
+def test_tracing_off_is_byte_identical_and_dark(params, monkeypatch):
+    """TPUMX_TRACING=0: no contexts, no rings, no sink — and the engine's
+    tokens and compiled program signatures are bitwise identical to the
+    traced run."""
+    rs = np.random.RandomState(5)
+    prompts = [rs.randint(0, CFG.vocab, n) for n in (5, 11, 20)]
+
+    def run():
+        svc = GenerationService(params, CFG, _gc(num_blocks=8), start=False)
+        hs = [svc.submit(p, max_new_tokens=10) for p in prompts]
+        svc.start()
+        outs = [h.result(120) for h in hs]
+        keys = set(svc.compile_stats().keys())
+        stats = [h.stats() for h in hs]
+        svc.stop()
+        return outs, keys, stats
+
+    tracing.clear()
+    outs_on, keys_on, _ = run()
+    assert tracing.recent_spans() and tracing.recent_requests()
+
+    tracing.clear()
+    monkeypatch.setenv("TPUMX_TRACING", "0")
+    assert not tracing.enabled()
+    outs_off, keys_off, stats_off = run()
+    assert outs_off == outs_on                      # bitwise tokens
+    assert keys_off == keys_on                      # same program keys
+    assert tracing.recent_spans() == []             # dark
+    assert tracing.recent_requests() == []
+    assert tracing.new_trace() is None
+    # stream stats still work off the request's own bookkeeping
+    for s in stats_off:
+        assert s["trace_id"] is None
+        assert s["outcome"] == "finished"
+        assert sum(s["breakdown_ms"].values()) == \
+            pytest.approx(s["total_ms"], abs=0.05)
+
+
+# -- flight recorder ----------------------------------------------------------------
+def test_flight_recorder_dump_on_real_sigterm_subprocess(tmp_path):
+    """Acceptance: a real SIGTERM (through the PR 10 signal hub) dumps the
+    black box before the process exits — subprocess test."""
+    code = r"""
+import json, os, signal, sys
+import numpy as np, jax
+from mxnet_tpu.parallel import transformer as tr
+from mxnet_tpu.serving import GenerationConfig, GenerationService
+
+cfg = tr.TransformerConfig(vocab=40, d_model=16, n_heads=2, n_layers=1,
+                           d_ff=32, max_len=32)
+params = tr.transformer_lm_init(cfg, jax.random.PRNGKey(0))
+svc = GenerationService(params, cfg,
+                        GenerationConfig(max_slots=1, block_size=8,
+                                         num_blocks=16, seq_buckets=[16],
+                                         max_new_tokens=2), start=False)
+assert svc.install_signal_handlers()
+h = svc.submit(np.arange(4), max_new_tokens=2)
+svc.start()
+h.result(120)                      # one finished request -> one wide event
+os.kill(os.getpid(), signal.SIGTERM)
+print("SURVIVED_DRAIN")            # graceful drain: process lives to report
+"""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "TPUMX_FLIGHT_RECORDER_DIR": str(tmp_path)})
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert "SURVIVED_DRAIN" in proc.stdout, proc.stderr[-2000:]
+    dumps = [p for p in os.listdir(tmp_path) if p.endswith(".json")]
+    assert dumps, "SIGTERM must have written a flight dump"
+    with open(os.path.join(str(tmp_path), sorted(dumps)[0])) as f:
+        dump = json.load(f)
+    assert dump["reason"].startswith("signal_")
+    assert any(e.get("type") == "generation_request"
+               for e in dump["wide_events"])
+    assert any(n["kind"] == "signal" for n in dump["notes"])
+
+
+def test_flight_recorder_dump_on_breaker_open(params, tmp_path, monkeypatch):
+    """A replica going dark under traffic opens its breaker AND dumps the
+    black box."""
+    monkeypatch.setenv("TPUMX_FLIGHT_RECORDER_DIR", str(tmp_path))
+    replicas = [GenerationService(params, CFG, _gc(), start=False)
+                for _ in range(2)]
+    router = GenerationRouter(replicas=replicas,
+                              config=RouterConfig(probe_interval_ms=10.0,
+                                                  breaker_cooldown_ms=10_000.0))
+    replicas[0].kill()
+    deadline = time.perf_counter() + 10
+    while flight.last_dump() is None and time.perf_counter() < deadline:
+        time.sleep(0.02)
+    path = flight.last_dump()
+    assert path is not None and str(tmp_path) in path
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "breaker_open"
+    assert dump["extra"]["replica"] == 0
+    assert any(n["kind"] == "breaker" for n in dump["notes"])
+    router.stop(drain=False)
+
+
+def test_flight_recorder_disabled_gate(params, tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUMX_FLIGHT_RECORDER", "0")
+    monkeypatch.setenv("TPUMX_FLIGHT_RECORDER_DIR", str(tmp_path))
+    assert flight.dump("unit") is None
+    assert os.listdir(tmp_path) == []
+
+
+# -- satellite: collector-failure isolation ------------------------------------------
+def test_poisoned_collector_is_isolated_and_counted():
+    """One raising pull collector must not break snapshot()/scrape: the
+    rest keep serving and the failure is counted per collector."""
+    reg = obs.metrics.MetricsRegistry()
+    reg.gauge("healthy_gauge").set(7.0)
+    calls = {"good": 0}
+
+    def poisoned():
+        raise RuntimeError("collector went bad")
+
+    def good():
+        calls["good"] += 1
+        reg.gauge("pull_gauge").set(1.0)
+
+    reg.add_collector(poisoned)
+    reg.add_collector(good)
+    snap = reg.snapshot()
+    assert snap["gauges"]["healthy_gauge"] == 7.0
+    assert snap["gauges"]["pull_gauge"] == 1.0 and calls["good"] == 1
+    errs = [(k, v) for k, v in snap["counters"].items()
+            if k.startswith("observability_collector_errors_total")]
+    assert errs and errs[0][1] == 1.0 and "poisoned" in errs[0][0]
+    # exposition also survives and counts again
+    text = reg.to_prometheus()
+    assert "healthy_gauge 7" in text
+    assert "observability_collector_errors_total" in text
+    snap2 = reg.snapshot()
+    errs2 = [v for k, v in snap2["counters"].items()
+             if k.startswith("observability_collector_errors_total")]
+    assert errs2[0] == 3.0  # one per snapshot/scrape since registration
+
+
+# -- satellite: concurrent Prometheus scrape under decode ---------------------------
+def test_concurrent_scrape_while_engine_decodes(params):
+    """Hammer the exposition endpoint from N threads while the engine
+    decodes: no exceptions, no torn exposition output, bounded scrape
+    latency."""
+    svc = GenerationService(params, CFG, _gc(max_new_tokens=16), start=False)
+    rs = np.random.RandomState(6)
+    hs = [svc.submit(rs.randint(0, CFG.vocab, 8), max_new_tokens=16)
+          for _ in range(4)]
+    srv = obs.exposition.start_http_server(port=0)
+    errors, latencies = [], []
+
+    def scraper(tid):
+        try:
+            for _ in range(20):
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/metrics",
+                        timeout=30) as resp:
+                    body = resp.read().decode()
+                latencies.append(time.perf_counter() - t0)
+                assert resp.status == 200
+                # torn output would break the line discipline: every line
+                # is a comment or a "name{labels} value" sample, and the
+                # body terminates cleanly
+                assert body.endswith("\n")
+                for line in body.splitlines():
+                    assert line.startswith("#") or \
+                        len(line.rsplit(" ", 1)) == 2, f"torn line: {line!r}"
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(repr(e))
+
+    svc.start()
+    threads = [threading.Thread(target=scraper, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    for h in hs:
+        h.result(120)
+    srv.close()
+    svc.stop()
+    assert not errors, errors[:3]
+    assert len(latencies) == 8 * 20
+    lat = sorted(latencies)
+    assert lat[int(len(lat) * 0.99)] < 5.0, "scrape latency unbounded"
+
+
+# -- InferenceService micro-batch attribution ---------------------------------------
+def test_inference_service_batch_execute_attributed_per_request():
+    """The micro-batcher's shared execute fans out one participation span
+    per rider's trace, across the queue/worker-thread boundary."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving, sym
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    mod = mx.mod.Module(net, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=[("data", (4, 8))], for_training=False)
+    mod.init_params(mx.init.Uniform(0.05))
+    svc = serving.InferenceService(
+        mod, serving.ServingConfig(max_batch_size=4, batch_timeout_ms=20.0,
+                                   shape_buckets=[(8,)]))
+    svc.warmup([(8,)])
+    tracing.clear()
+    rs = np.random.RandomState(7)
+    futs = [svc.submit(rs.rand(8).astype(np.float32)) for _ in range(4)]
+    for f in futs:
+        f.result(60)
+    parts = obs.recent_spans(name="serving.execute.participate")
+    svc.stop()
+    assert len(parts) == 4
+    assert len({p["trace_id"] for p in parts}) == 4  # one trace per request
+    enq = obs.recent_spans(name="serving.enqueue")
+    assert {p["trace_id"] for p in parts} == {e["trace_id"] for e in enq}, \
+        "participations continue the traces minted at enqueue"
+
+
+# -- stream stats live view ----------------------------------------------------------
+def test_stream_stats_live_then_final(params, monkeypatch):
+    """GenerationStream.stats() serves a live snapshot mid-flight and the
+    wide event once finished — callers no longer wall-clock their own
+    TTFT."""
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    h = svc.submit(np.arange(6), max_new_tokens=4)
+    live = h.stats()
+    assert live["outcome"] == "waiting" and live["ttft_ms"] is None
+    assert live["breakdown_ms"].get("queue", 0) >= 0
+    svc.start()
+    out = h.result(120)
+    final = h.stats()
+    svc.stop()
+    assert len(out) == 4
+    assert final["outcome"] == "finished"
+    assert final["ttft_ms"] is not None and final["ttft_ms"] > 0
+    assert final["ttft_ms"] == pytest.approx(h.ttft_ms, abs=0.01)
+    assert len(final["token_offsets_ms"]) == 4
+    assert final["token_offsets_ms"] == sorted(final["token_offsets_ms"])
+    assert final["requeues"] == 0 and final["retries"] == 0
