@@ -66,7 +66,11 @@ __all__ = ["span", "current_span", "span_stack", "TraceContext",
 _tls = threading.local()
 
 #: bounded rings behind recent_spans()/recent_requests() — also the flight
-#: recorder's raw material (docs/observability.md)
+#: recorder's raw material (docs/observability.md).  _ring_lock is shared
+#: by appenders and snapshot readers: deque appends are atomic, but
+#: list(ring) raises RuntimeError when an engine thread appends
+#: mid-iteration, which would poison stats/debug callers and dump()
+_ring_lock = threading.Lock()
 _SPAN_RING: "deque[dict]" = deque(
     maxlen=int(getenv("TPUMX_TRACE_BUFFER", 4096)))
 _WIDE_RING: "deque[dict]" = deque(
@@ -157,12 +161,15 @@ def current_span() -> Optional[str]:
 
 def _ring_append(name, cat, trace_id, span_id, parent_id, ts, dur, args,
                  thread=None):
-    _SPAN_RING.append({
-        "name": name, "cat": cat, "trace_id": trace_id, "span_id": span_id,
-        "parent_id": parent_id, "ts_us": ts, "dur_us": dur,
-        "thread": thread if thread is not None else threading.get_ident(),
-        "args": args or {},
-    })
+    with _ring_lock:
+        _SPAN_RING.append({
+            "name": name, "cat": cat, "trace_id": trace_id,
+            "span_id": span_id, "parent_id": parent_id, "ts_us": ts,
+            "dur_us": dur,
+            "thread": thread if thread is not None
+            else threading.get_ident(),
+            "args": args or {},
+        })
 
 
 class span:
@@ -264,11 +271,13 @@ def record_event(name: str, cat: str, t0: float, t1: float,
     trace_id = parent_id = None
     if ctx is not None:
         trace_id, parent_id = ctx.trace_id, ctx.span_id
-    _SPAN_RING.append({
-        "name": name, "cat": cat, "trace_id": trace_id, "span_id": sid,
-        "parent_id": parent_id, "ts_us": t0 * 1e6, "dur_us": (t1 - t0) * 1e6,
-        "thread": threading.get_ident(), "args": args or {},
-    })
+    with _ring_lock:
+        _SPAN_RING.append({
+            "name": name, "cat": cat, "trace_id": trace_id, "span_id": sid,
+            "parent_id": parent_id, "ts_us": t0 * 1e6,
+            "dur_us": (t1 - t0) * 1e6,
+            "thread": threading.get_ident(), "args": args or {},
+        })
     if _profiler._state["running"]:  # keep the no-profiler hot path lean
         args = dict(args or ())
         if ctx is not None:
@@ -287,7 +296,8 @@ def record_wide_event(event: dict) -> None:
     docs/observability.md for the generation-request schema)."""
     if not enabled():
         return
-    _WIDE_RING.append(event)
+    with _ring_lock:
+        _WIDE_RING.append(event)
     _profiler._emit("i", "request.complete", "trace",
                     args={"wide_event": event})
     path = os.environ.get("TPUMX_TRACE_LOG")
@@ -306,7 +316,8 @@ def recent_spans(trace_id: Optional[str] = None,
                  limit: Optional[int] = None) -> List[dict]:
     """Recent span records (oldest first), optionally filtered by trace id
     and/or span name."""
-    out: Iterable[dict] = list(_SPAN_RING)
+    with _ring_lock:
+        out: Iterable[dict] = list(_SPAN_RING)
     if trace_id is not None:
         out = [s for s in out if s["trace_id"] == trace_id]
     if name is not None:
@@ -319,7 +330,8 @@ def recent_requests(trace_id: Optional[str] = None,
                     limit: Optional[int] = None) -> List[dict]:
     """Recent wide-event records (oldest first) — one per finished
     request; ``observability.recent_requests()`` re-exports this."""
-    out = list(_WIDE_RING)
+    with _ring_lock:
+        out = list(_WIDE_RING)
     if trace_id is not None:
         out = [e for e in out if e.get("trace_id") == trace_id]
     return out[-limit:] if limit else out
@@ -327,5 +339,6 @@ def recent_requests(trace_id: Optional[str] = None,
 
 def clear() -> None:
     """Drop the span and wide-event rings (tests/bench isolation)."""
-    _SPAN_RING.clear()
-    _WIDE_RING.clear()
+    with _ring_lock:
+        _SPAN_RING.clear()
+        _WIDE_RING.clear()

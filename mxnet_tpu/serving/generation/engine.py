@@ -201,7 +201,8 @@ class _GenRequest:
                  "cancel_requested", "priority", "admit_seq",
                  "n_preempted", "n_requeues", "trace", "seg_state",
                  "seg_t0", "breakdown", "breakdown_first", "rung_s",
-                 "decode_steps", "n_retries", "token_log", "wide_event")
+                 "decode_steps", "n_retries", "token_log", "wide_event",
+                 "lock")
 
     def __init__(self, rid, prompt, bucket, max_new, temperature, top_k,
                  top_p, seed, eos_token, deadline, on_token, priority=0):
@@ -248,13 +249,21 @@ class _GenRequest:
         self.n_retries = 0
         self.token_log: List[float] = []
         self.wide_event: Optional[dict] = None
+        # serializes seg() against GenerationStream.stats()'s live
+        # snapshot: the engine mutates the segment partition OUTSIDE the
+        # service lock (prefill/decode run unlocked), so without this a
+        # caller could read a torn (seg_state, seg_t0) pair or catch
+        # the breakdown dict mid-resize
+        self.lock = threading.Lock()
 
     def seg(self, state: str, now: float) -> None:
         """Close the open lifetime segment at ``now`` and open ``state``."""
-        self.breakdown[self.seg_state] = \
-            self.breakdown.get(self.seg_state, 0.0) + (now - self.seg_t0)
-        self.seg_state = state
-        self.seg_t0 = now
+        with self.lock:
+            self.breakdown[self.seg_state] = \
+                self.breakdown.get(self.seg_state, 0.0) \
+                + (now - self.seg_t0)
+            self.seg_state = state
+            self.seg_t0 = now
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -271,8 +280,10 @@ class GenerationStream:
     """Per-request handle: iterate generated tokens as they stream, or
     block on :meth:`result` for the full list."""
 
-    def __init__(self, req: _GenRequest):
+    def __init__(self, req: _GenRequest,
+                 service: Optional["GenerationService"] = None):
         self._req = req
+        self._service = service
 
     @property
     def request_id(self) -> int:
@@ -333,41 +344,55 @@ class GenerationStream:
         """Per-request observability: the wide-event record once the
         request finished, or a live snapshot of the same shape while it
         runs — TTFT, per-token timestamps, the latency breakdown, and
-        preemption/requeue/retry counts (docs/observability.md)."""
+        preemption/requeue/retry counts (docs/observability.md).  The
+        live path snapshots under the request's segment lock so the
+        breakdown is never torn against a concurrent seg() transition."""
         r = self._req
         ev = r.wide_event
         if ev is not None:
             return dict(ev)
-        now = time.perf_counter()
-        bd = dict(r.breakdown)
-        bd[r.seg_state] = bd.get(r.seg_state, 0.0) + (now - r.seg_t0)
-        first = r.breakdown_first
+        svc = self._service
+        with r.lock:
+            ev = r.wide_event  # may have finished while we acquired
+            if ev is not None:
+                return dict(ev)
+            now = time.perf_counter()
+            bd = dict(r.breakdown)
+            bd[r.seg_state] = bd.get(r.seg_state, 0.0) + (now - r.seg_t0)
+            first = r.breakdown_first
+            rung = dict(r.rung_s)
+            token_log = list(r.token_log)
+            outcome, finish_reason = r.state, r.finish_reason
+            error, t_first = r.error, r.t_first
+            n_generated, decode_steps = r.n_generated, r.decode_steps
+            preemptions, requeues = r.n_preempted, r.n_requeues
+            retries = r.n_retries
         return {
             "type": "generation_request",
             "request_id": r.rid,
             "trace_id": self.trace_id,
-            "replica": None,
+            "replica": None if svc is None else svc._replica_id,
             "priority": r.priority,
             "prompt_tokens": r.prompt_len,
-            "output_tokens": r.n_generated,
-            "outcome": r.state,
-            "finish_reason": r.finish_reason,
-            "error": None if r.error is None else repr(r.error),
+            "output_tokens": n_generated,
+            "outcome": outcome,
+            "finish_reason": finish_reason,
+            "error": None if error is None else repr(error),
             "total_ms": round((now - r.t_submit) * 1e3, 3),
-            "ttft_ms": (None if r.t_first is None
-                        else round((r.t_first - r.t_submit) * 1e3, 3)),
+            "ttft_ms": (None if t_first is None
+                        else round((t_first - r.t_submit) * 1e3, 3)),
             "ttft_breakdown_ms": (
                 None if first is None
                 else {k: round(v * 1e3, 3) for k, v in first.items()}),
             "breakdown_ms": {k: round(v * 1e3, 3) for k, v in bd.items()},
             "prefill_rungs_ms": {str(k): round(v * 1e3, 3)
-                                 for k, v in r.rung_s.items()},
-            "decode_steps": r.decode_steps,
-            "preemptions": r.n_preempted,
-            "requeues": r.n_requeues,
-            "retries": r.n_retries,
+                                 for k, v in rung.items()},
+            "decode_steps": decode_steps,
+            "preemptions": preemptions,
+            "requeues": requeues,
+            "retries": retries,
             "token_offsets_ms": [round((t - r.t_submit) * 1e3, 3)
-                                 for t in r.token_log],
+                                 for t in token_log],
         }
 
 
@@ -604,7 +629,7 @@ class GenerationService:
             self._not_empty.notify_all()
         if self._autostart:
             self._ensure_worker()
-        return GenerationStream(req)
+        return GenerationStream(req, self)
 
     def generate(self, prompt, **kwargs) -> List[int]:
         """Blocking convenience wrapper: ``submit(...).result()``."""

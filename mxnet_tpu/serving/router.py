@@ -439,6 +439,10 @@ class GenerationRouter:
                         self._transition(rep, _OPEN, now)
                         if not h.get("alive"):
                             self._handle_dead_replica(rep)
+                        # dump only AFTER the dead replica's queued work
+                        # is resubmitted: postmortem capture must never
+                        # delay or suppress the failover guarantee
+                        self._dump_breaker_open(rep)
             elif rep.breaker == _OPEN:
                 if now - (rep.opened_at or now) >= \
                         cfg.breaker_cooldown_ms / 1e3:
@@ -450,6 +454,7 @@ class GenerationRouter:
                     healthy += 1
                 else:
                     self._transition(rep, _OPEN, now)
+                    self._dump_breaker_open(rep)
         self._g_healthy.set(healthy)
         with self._lock:
             self._records = [rec for rec in self._records if not rec.done]
@@ -464,10 +469,17 @@ class GenerationRouter:
         self._c_breaker.inc()
         _flight.note("breaker", {"replica": rep.idx, "from": prev,
                                  "to": state})
-        if state == _OPEN:
-            # a breaker opening means a replica just went dark under
-            # traffic — dump the black box while the evidence is fresh
+
+    def _dump_breaker_open(self, rep: _Replica) -> None:
+        # a breaker opening means a replica just went dark under traffic —
+        # dump the black box while the evidence is fresh.  Belt and
+        # suspenders with dump()'s own never-raise contract: an escaping
+        # exception here would be swallowed by _probe_loop and skip the
+        # rest of the probe pass
+        try:
             _flight.dump("breaker_open", extra={"replica": rep.idx})
+        except Exception:
+            pass
 
     def _handle_dead_replica(self, rep: _Replica) -> None:
         """Failure isolation: resubmit every request the dead replica

@@ -410,6 +410,102 @@ def test_flight_recorder_disabled_gate(params, tmp_path, monkeypatch):
     assert os.listdir(tmp_path) == []
 
 
+def test_flight_recorder_dump_never_raises(tmp_path, monkeypatch):
+    """dump() sits on failover paths (breaker-open, quarantine): any
+    failure while BUILDING the payload — not just the file write — must
+    come back as None, never as an exception."""
+    monkeypatch.setenv("TPUMX_FLIGHT_RECORDER_DIR", str(tmp_path))
+
+    def _boom(*a, **kw):
+        raise RuntimeError("deque mutated during iteration")
+
+    monkeypatch.setattr(tracing, "recent_spans", _boom)
+    assert flight.dump("unit") is None
+    assert os.listdir(tmp_path) == []
+
+
+def test_flight_recorder_install_refcounted():
+    """Two owners (router + standalone service) install the crash hooks;
+    the first uninstall must NOT disarm the black box for the second."""
+    orig_hook = sys.excepthook
+    flight.install()
+    flight.install()
+    try:
+        assert sys.excepthook is not orig_hook
+        flight.uninstall()                       # first owner tears down
+        assert sys.excepthook is not orig_hook   # still armed
+    finally:
+        flight.uninstall()                       # last owner tears down
+    assert sys.excepthook is orig_hook
+    flight.uninstall()                           # extra uninstall: harmless
+    assert sys.excepthook is orig_hook
+
+
+def test_breaker_dump_failure_never_blocks_failover(params, tmp_path,
+                                                    monkeypatch):
+    """Regression: a flight-recorder dump blowing up mid-capture while a
+    breaker opens must not swallow dead-replica handling — the dead
+    replica's queued work still moves to the healthy replica."""
+    monkeypatch.setenv("TPUMX_FLIGHT_RECORDER_DIR", str(tmp_path))
+    # kill replica 0 right after its 2nd accepted dispatch, leaving that
+    # request queued on a corpse (same choreography as test_router.py)
+    monkeypatch.setenv("TPUMX_FAULT_GEN_KILL_REPLICA", "0@2")
+    injector().reset()
+
+    def _boom(*a, **kw):
+        raise RuntimeError("deque mutated during iteration")
+
+    monkeypatch.setattr(tracing, "recent_spans", _boom)
+    replicas = [GenerationService(params, CFG, _gc(max_slots=1),
+                                  start=False) for _ in range(2)]
+    router = GenerationRouter(
+        replicas=replicas,
+        config=RouterConfig(probe_interval_ms=10.0,
+                            breaker_cooldown_ms=10_000.0))
+    rs = np.random.RandomState(3)
+    h0 = router.submit(rs.randint(0, CFG.vocab, 8), max_new_tokens=50)
+    deadline = time.perf_counter() + 60
+    while not h0.started and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    assert h0.started
+    handles = [router.submit(rs.randint(0, CFG.vocab, 6), max_new_tokens=4)
+               for _ in range(4)]
+    outs = [h.result(120) for h in handles]   # no client-visible errors
+    assert all(len(o) == 4 for o in outs)
+    assert sum(h.resubmits for h in handles) >= 1
+    assert flight.last_dump() is None         # the dump itself failed...
+    assert os.listdir(tmp_path) == []         # ...and wrote nothing
+    router.stop(drain=False)
+
+
+def test_span_ring_snapshot_safe_under_concurrent_append():
+    """recent_spans()/recent_requests() vs concurrent appenders: a
+    snapshot racing an engine-thread append must never raise ('deque
+    mutated during iteration')."""
+    errs = []
+    stop = threading.Event()
+
+    def _reader():
+        try:
+            while not stop.is_set():
+                tracing.recent_spans()
+                tracing.recent_requests()
+        except Exception as exc:  # noqa: BLE001 — the assertion payload
+            errs.append(exc)
+
+    t = threading.Thread(target=_reader)
+    t.start()
+    try:
+        for i in range(20_000):
+            tracing.record_event("hammer", "test", 0.0, 1.0)
+            if i % 4 == 0:
+                tracing.record_wide_event({"type": "hammer", "i": i})
+    finally:
+        stop.set()
+        t.join()
+    assert not errs
+
+
 # -- satellite: collector-failure isolation ------------------------------------------
 def test_poisoned_collector_is_isolated_and_counted():
     """One raising pull collector must not break snapshot()/scrape: the
@@ -542,3 +638,36 @@ def test_stream_stats_live_then_final(params, monkeypatch):
     assert len(final["token_offsets_ms"]) == 4
     assert final["token_offsets_ms"] == sorted(final["token_offsets_ms"])
     assert final["requeues"] == 0 and final["retries"] == 0
+
+
+def test_stream_stats_live_snapshot_consistent_under_load(params):
+    """Hammer stats() from a foreign thread while the engine decodes: the
+    live snapshot must never raise or show a torn breakdown (a negative
+    segment means seg_state/seg_t0 were read across a transition), and it
+    reports the real replica id instead of None."""
+    svc = GenerationService(params, CFG, _gc(), start=False)
+    h = svc.submit(np.arange(6), max_new_tokens=32)
+    assert h.stats()["replica"] == 0
+    errs = []
+    stop = threading.Event()
+
+    def _poll():
+        try:
+            while not stop.is_set():
+                s = h.stats()
+                assert all(v >= 0 for v in s["breakdown_ms"].values()), s
+        except Exception as exc:  # noqa: BLE001 — the assertion payload
+            errs.append(exc)
+
+    t = threading.Thread(target=_poll)
+    t.start()
+    try:
+        svc.start()
+        out = h.result(120)
+    finally:
+        stop.set()
+        t.join()
+        svc.stop()
+    assert not errs
+    assert len(out) == 32
+    assert h.stats()["replica"] == 0  # the final wide event agrees
