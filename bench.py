@@ -30,6 +30,7 @@ status and the last good on-chip number (docs/last_bench.json).
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -842,16 +843,16 @@ def overload_serving():
     rs = np.random.RandomState(0)
     shared_prefix = rs.randint(0, cfg.vocab, 48)
 
-    def run(preemption):
+    def run(preemption, kv_dtype=None, num_blocks=24):
         # the pool is the binding constraint (4 slots x worst-case ~9
         # blocks >> 23 allocatable): reserve-ahead idles slots on
         # head-of-line worst cases while incremental packs live contexts
         # up to the watermark — the occupancy gap under measurement
         svc = GenerationService(params, cfg, GenerationConfig(
-            max_slots=4, block_size=16, num_blocks=24,
+            max_slots=4, block_size=16, num_blocks=num_blocks,
             seq_buckets=[64, 128], max_new_tokens=new_tokens,
             queue_bound=16, backpressure="shed_oldest",
-            preemption=preemption))
+            preemption=preemption, kv_dtype=kv_dtype))
         svc.warmup()
         # calibrate: one uncontended request gives the per-request service
         # time; the burst then arrives at `rate` x the slot-parallel rate
@@ -915,9 +916,22 @@ def overload_serving():
 
     inc = run(True)
     base = run(False)
+    # the int8 row (docs/quantization.md): the SAME device bytes buy ~2x
+    # the blocks, so the identical burst runs against a doubled pool —
+    # the density win expressed in the occupancy comparison's own units
+    from mxnet_tpu.serving.generation.kv_cache import PagedKVCache
+
+    pool_bytes = 24 * PagedKVCache.bytes_per_block(
+        cfg.n_layers, cfg.n_heads, cfg.d_head, 16)
+    nb_int8 = PagedKVCache.num_blocks_for_bytes(
+        pool_bytes, cfg.n_layers, cfg.n_heads, cfg.d_head, 16,
+        kv_dtype="int8")
+    int8 = run(True, kv_dtype="int8", num_blocks=nb_int8)
+    int8["num_blocks_same_bytes"] = nb_int8
     return {
         "incremental": inc,
         "reserve_ahead": base,
+        "incremental_int8_kv": int8,
         # the acceptance number: context actually served per pool block
         "occupancy_gain": round(inc["steady_live_occupancy"]
                                 - base["steady_live_occupancy"], 4),
@@ -925,6 +939,150 @@ def overload_serving():
         "rate_multiplier": rate,
         "shared_prefix_len": int(shared_prefix.size),
     }
+
+
+def quantized_serving():
+    """Int8 serving density (docs/quantization.md): tokens/sec/chip,
+    blocks/chip at identical pool bytes, and logits/perplexity deltas vs
+    bf16 — int8 WEIGHTS (the ServingConfig.quantize path over a symbolic
+    model) and the int8 KV CACHE (the generation engine's quantized pool)
+    measured independently.  ``BENCH_QUANT=0`` skips;
+    ``BENCH_QUANT_TOKENS`` sizes the decode horizon."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu import quantization as quant
+    from mxnet_tpu.parallel import transformer as tr
+    from mxnet_tpu.serving.generation import (GenerationConfig,
+                                              GenerationService)
+    from mxnet_tpu.serving.generation.kv_cache import PagedKVCache
+
+    new_tokens = int(os.environ.get("BENCH_QUANT_TOKENS", "48"))
+    out = {}
+
+    # -- int8 KV cache: tokens/sec + accuracy vs the bf16 pool ------------
+    cfg = tr.TransformerConfig(vocab=512, d_model=256, n_heads=8,
+                               n_layers=4, d_ff=1024, max_len=512)
+    params = tr.transformer_lm_init(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab, int(n))
+               for n in rng.choice([24, 60, 120], size=12)]
+
+    def drive(kv_dtype):
+        svc = GenerationService(params, cfg, GenerationConfig(
+            max_slots=8, block_size=32, num_blocks=256,
+            seq_buckets=[64, 128], max_new_tokens=new_tokens,
+            amp_dtype="bfloat16", kv_dtype=kv_dtype), start=False)
+        svc.warmup()
+        svc.start()
+        t0 = time.perf_counter()
+        outs = [svc.generate(p, seed=i, timeout=600)
+                for i, p in enumerate(prompts)]
+        wall = time.perf_counter() - t0
+        stats = svc.stats()
+        svc.stop()
+        return outs, stats["counts"]["tokens"] / wall
+
+    bf_out, bf_tps = drive(None)
+    q_out, q_tps = drive("int8")
+    agree = sum(a == b for o1, o2 in zip(bf_out, q_out)
+                for a, b in zip(o1, o2))
+    total = sum(len(o) for o in bf_out)
+
+    # teacher-forced logit/perplexity delta: feed each bf16-generated
+    # sequence through one cache-aware prefill under each pool dtype
+    def nll_of(kv_dtype, seqs):
+        nlls, max_delta = [], 0.0
+        for toks in seqs:
+            toks = np.asarray(toks, np.int32)[None, :64]
+            T = toks.shape[1]
+            bsz, W = 32, 4
+            pool = lambda d: jnp.zeros((cfg.n_layers, 9, bsz, cfg.n_heads,
+                                        cfg.d_head), d)
+            tables = np.arange(1, 1 + W, dtype=np.int32)[None, :]
+            pos = np.arange(T, dtype=np.int32)[None, :]
+            ln = np.array([T], np.int32)
+            if kv_dtype == "int8":
+                sc = jnp.ones((cfg.n_layers, 9, cfg.n_heads))
+                logits, *_ = tr.transformer_lm_decode(
+                    params, toks, pos, ln, pool(jnp.int8), pool(jnp.int8),
+                    tables, cfg, compute_dtype=jnp.bfloat16,
+                    attention_kernel="gather", k_scale=sc, v_scale=sc)
+            else:
+                logits, _, _ = tr.transformer_lm_decode(
+                    params, toks, pos, ln, pool(jnp.bfloat16),
+                    pool(jnp.bfloat16), tables, cfg,
+                    compute_dtype=jnp.bfloat16, attention_kernel="gather")
+            logp = jax.nn.log_softmax(logits[0, :-1].astype(jnp.float32))
+            nll = -jnp.take_along_axis(
+                logp, jnp.asarray(toks[0, 1:])[:, None], axis=1)
+            nlls.append(float(jnp.mean(nll)))
+        return float(np.mean(nlls))
+
+    seqs = [list(np.concatenate([p, np.asarray(o, p.dtype)]))
+            for p, o in zip(prompts, bf_out)]
+    nll_bf = nll_of(None, seqs)
+    nll_q = nll_of("int8", seqs)
+    pool_bytes = 256 * PagedKVCache.bytes_per_block(
+        cfg.n_layers, cfg.n_heads, cfg.d_head, 32, dtype=jnp.bfloat16)
+    blocks_bf16 = 256
+    blocks_int8 = PagedKVCache.num_blocks_for_bytes(
+        pool_bytes, cfg.n_layers, cfg.n_heads, cfg.d_head, 32,
+        kv_dtype="int8")
+    out["kv_int8"] = {
+        "tokens_per_sec_bf16": round(bf_tps, 1),
+        "tokens_per_sec_int8": round(q_tps, 1),
+        "greedy_token_agreement": round(agree / max(total, 1), 4),
+        "perplexity_bf16": round(math.exp(nll_bf), 4),
+        "perplexity_int8": round(math.exp(nll_q), 4),
+        "perplexity_delta": round(math.exp(nll_q) - math.exp(nll_bf), 4),
+        "blocks_per_chip_bf16": blocks_bf16,
+        "blocks_per_chip_int8_same_bytes": blocks_int8,
+        "block_budget_ratio": round(blocks_int8 / blocks_bf16, 4),
+    }
+
+    # -- int8 weights: the ServingConfig.quantize path --------------------
+    data = sym.Variable("data")
+    h = data
+    for i in range(3):
+        h = sym.Activation(sym.FullyConnected(h, num_hidden=256,
+                                              name=f"fc{i}"),
+                           act_type="relu")
+    net = sym.FullyConnected(h, num_hidden=64, name="head")
+    mod = mx.mod.Module(net, label_names=None, context=mx.context.current_context())
+    mod.bind(data_shapes=[("data", (32, 128))], for_training=False)
+    mod.init_params()
+    X = np.random.RandomState(1).rand(256, 128).astype(np.float32)
+    table = quant.calibrate_module(
+        mod, mx.io.NDArrayIter(X, None, batch_size=32))
+
+    from mxnet_tpu.serving.service import _ExecutorAdapter
+
+    def fc_leg(quantize):
+        ad = _ExecutorAdapter(
+            mod._exec, ["data"], quantize=quantize,
+            quantize_calibration=table if quantize else None)
+        feed = {"data": X[:32]}
+        outs = ad.run(feed)  # compile
+        t0 = time.perf_counter()
+        iters = 30
+        for _ in range(iters):
+            outs = ad.run(feed)
+        np.asarray(outs[0])
+        return (32 * iters / (time.perf_counter() - t0),
+                np.asarray(outs[0]))
+
+    f_sps, f_logits = fc_leg(None)
+    q_sps, q_logits = fc_leg("int8")
+    denom = float(np.abs(f_logits).max()) or 1.0
+    out["weights_int8"] = {
+        "samples_per_sec_f32": round(f_sps, 1),
+        "samples_per_sec_int8": round(q_sps, 1),
+        "max_logit_rel_delta": round(
+            float(np.abs(q_logits - f_logits).max()) / denom, 5),
+    }
+    return out
 
 
 def pallas_kernels_bench():
@@ -1470,6 +1628,13 @@ def main():
             sys.stderr.write(f"overload bench failed: "
                              f"{type(e).__name__}: {e}\n")
             result["overload_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_QUANT", "1") == "1":
+        try:
+            result["quantized_serving"] = quantized_serving()
+        except Exception as e:  # optional block: failure is a field, not rc!=0
+            sys.stderr.write(f"quantized bench failed: "
+                             f"{type(e).__name__}: {e}\n")
+            result["quant_error"] = f"{type(e).__name__}: {e}"
     if os.environ.get("BENCH_PALLAS", "1") == "1":
         try:
             result["pallas_kernels"] = pallas_kernels_bench()

@@ -44,6 +44,7 @@ from . import kvstore
 from . import kvstore as kv
 
 from . import amp
+from . import quantization
 
 from . import module
 from . import module as mod

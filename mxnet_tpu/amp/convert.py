@@ -12,10 +12,17 @@ variables (the cast into bf16/fp16 happens in-graph, under autodiff, so
 gradients flow back to f32 master storage for free); the fp16-storage +
 master-weight path is the optimizer's ``multi_precision`` instead
 (docs/amp.md).
+
+The tagged DAG walk itself lives in the shared rewrite engine
+(:mod:`mxnet_tpu.symbol.rewrite`) that int8 quantization drives too
+(docs/quantization.md); this module only supplies AMP's policy — the
+target/f32 op lists, the ``amp_cast`` conversion node, and the loss-head
+``out_grad`` flip.  tests/test_amp_golden.py pins the engine extraction
+byte-identical to the pre-refactor implementation.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+from typing import Optional, Sequence
 
 from ..base import MXNetError, canonical_dtype
 from .lists import FP32_OPS, TARGET_DTYPE_OPS
@@ -49,8 +56,8 @@ def convert_symbol(symbol, target_dtype: str = "bfloat16",
     ``remove_amp_cast`` (or ``save_checkpoint``'s default) recovers the
     original graph for serialization.
     """
-    from ..symbol.graph import Node, SymbolEntry, topo_order
-    from ..symbol.symbol import Symbol
+    from ..symbol.graph import Node
+    from ..symbol.rewrite import PROPAGATE, rewrite_graph
 
     target = canonical_dtype(target_dtype)
     if target not in _LOW_DTYPES:
@@ -62,93 +69,46 @@ def convert_symbol(symbol, target_dtype: str = "bfloat16",
     fset = frozenset(fp32_ops if fp32_ops is not None else FP32_OPS)
     cast_op = _cast_op()
 
-    node_map: Dict[int, Node] = {}
-    # static dtype tag per source node: "f32", target, or None (unknown).
-    # Variables are created f32 by simple_bind unless the user overrides
-    # type_dict — a low-precision-bound variable at worst costs a redundant
-    # (identity) cast, never a wrong result.
-    tag: Dict[int, Optional[str]] = {}
-    cast_cache: Dict[tuple, SymbolEntry] = {}
-    counter = [0]
+    def make_cast(entry, dtype, ordinal):
+        node = Node("op", f"amp_cast{ordinal}", op=cast_op,
+                    attrs={"dtype": dtype}, inputs=[entry])
+        return node, ("f32" if dtype == "float32" else dtype)
 
-    def cast_entry(e: SymbolEntry, dtype: str) -> SymbolEntry:
-        key = (id(e.node), e.index, dtype)
-        ent = cast_cache.get(key)
-        if ent is None:
-            counter[0] += 1
-            n = Node("op", f"amp_cast{counter[0]}", op=cast_op,
-                     attrs={"dtype": dtype}, inputs=[e])
-            tag[id(n)] = "f32" if dtype == "float32" else dtype
-            ent = SymbolEntry(n, 0)
-            cast_cache[key] = ent
-        return ent
-
-    for node in topo_order(symbol._entries):
-        if node.kind == "var":
-            node_map[id(node)] = node  # shared: names/bindings stay stable
-            tag[id(node)] = "f32"
-            continue
-        new_inputs = [SymbolEntry(node_map[id(e.node)], e.index)
-                      for e in node.inputs]
+    def visit(node, inputs, ctx):
         opname = node.op.name
         if opname in tset:
-            new_inputs = [e if tag.get(id(e.node)) == target
-                          else cast_entry(e, target) for e in new_inputs]
-            out_tag: Optional[str] = target
+            inputs = [e if ctx.tag_of(e) == target
+                      else ctx.convert(e, target) for e in inputs]
+            out_tag = target
         elif opname in fset:
             # never touch BatchNorm aux inputs: the executor's functional
             # running-stat commit keys on the aux VARIABLE names
             # (symbol/graph.py eval_node) — and aux vars are f32 anyway
-            new_inputs = [e if (tag.get(id(e.node)) == "f32"
-                                or e.node.attr_dict.get("__is_aux__"))
-                          else cast_entry(e, "float32") for e in new_inputs]
+            inputs = [e if (ctx.tag_of(e) == "f32"
+                            or e.node.attr_dict.get("__is_aux__"))
+                      else ctx.convert(e, "float32") for e in inputs]
             out_tag = "f32"
         else:
-            in_tags = {tag.get(id(e.node)) for e in new_inputs} or {"f32"}
-            out_tag = in_tags.pop() if len(in_tags) == 1 else None
+            out_tag = PROPAGATE
         attrs = dict(node.attrs)
         if opname in _HEAD_OUT_GRAD_OPS and "out_grad" not in attrs:
             attrs["out_grad"] = True
-        new_node = Node("op", node.name, op=node.op, attrs=attrs,
-                        inputs=new_inputs, attr_dict=dict(node.attr_dict))
-        node_map[id(node)] = new_node
-        tag[id(new_node)] = out_tag
+        return inputs, attrs, out_tag
 
-    return Symbol([SymbolEntry(node_map[id(e.node)], e.index)
-                   for e in symbol._entries])
+    # variables tag f32: simple_bind creates them f32 unless the user
+    # overrides type_dict — a low-precision-bound variable at worst costs
+    # a redundant (identity) cast, never a wrong result
+    return rewrite_graph(symbol, visit, make_conversion=make_cast,
+                         default_tag="f32")
 
 
 def remove_amp_cast(symbol):
     """Strip every ``amp_cast`` node, returning the original-precision graph
     (reference: save/export's ``remove_amp_cast=True`` — a converted model's
     checkpoint stays portable to non-AMP consumers)."""
-    from ..symbol.graph import Node, SymbolEntry, topo_order
-    from ..symbol.symbol import Symbol
+    from ..symbol.rewrite import strip_ops
 
-    entry_map: Dict[tuple, SymbolEntry] = {}
-
-    def mapped(e: SymbolEntry) -> SymbolEntry:
-        return entry_map.get((id(e.node), e.index), e)
-
-    changed = False
-    for node in topo_order(symbol._entries):
-        if node.kind == "var":
-            continue
-        if node.op.name == "amp_cast":
-            entry_map[(id(node), 0)] = mapped(node.inputs[0])
-            changed = True
-            continue
-        new_inputs = [mapped(e) for e in node.inputs]
-        if any(a.node is not b.node or a.index != b.index
-               for a, b in zip(new_inputs, node.inputs)):
-            new_node = Node("op", node.name, op=node.op,
-                            attrs=dict(node.attrs), inputs=new_inputs,
-                            attr_dict=dict(node.attr_dict))
-            for i in range(new_node.num_outputs()):
-                entry_map[(id(node), i)] = SymbolEntry(new_node, i)
-    if not changed:
-        return symbol
-    return Symbol([mapped(e) for e in symbol._entries])
+    return strip_ops(symbol, ("amp_cast",))
 
 
 def count_amp_casts(symbol) -> int:

@@ -307,6 +307,17 @@ class Executor:
         (where recompile bugs bite) yet distinct between models."""
         return (kind,) + tuple(self._out_names)
 
+    def _graph_quantized(self) -> bool:
+        """Whether the bound symbol contains int8 serving ops (computed
+        once per executor; quantization.convert_symbol inserts them)."""
+        cached = getattr(self, "_quantized_graph", None)
+        if cached is None:
+            from .quantization.convert import count_quantized_nodes
+
+            cached = count_quantized_nodes(self._symbol) > 0
+            self._quantized_graph = cached
+        return cached
+
     def _signature(self, is_train: bool) -> tuple:
         sig = [is_train]
         # the Pallas kernel layer changes the traced program (fused LN et
@@ -320,6 +331,12 @@ class Executor:
 
         if pallas_enabled():
             sig.append(("pallas", 1))
+        # int8-quantized graphs (docs/quantization.md) key their own
+        # program family — a float and a quantized bind of the same model
+        # never share a cached program.  Unquantized graphs append
+        # NOTHING, so TPUMX_QUANT=0 signatures stay byte-identical.
+        if self._graph_quantized():
+            sig.append(("quant", "int8"))
         for n in self._arg_names:
             a = self.arg_dict[n]
             sig.append((n, a.shape, str(a.dtype)))

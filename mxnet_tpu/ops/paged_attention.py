@@ -118,6 +118,96 @@ def _paged_kernel(tables_ref, maxpos_ref, q_ref, pos_ref, k_ref, v_ref,
         ).astype(o_ref.dtype)
 
 
+def _paged_kernel_q(tables_ref, maxpos_ref, q_ref, pos_ref, k_ref, v_ref,
+                    ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                    bs: int, t: int, scale: float):
+    """The int8-pool variant of :func:`_paged_kernel`
+    (docs/quantization.md): K/V tiles arrive int8 and the per-(block,
+    head) scales ride the same index-mapped VMEM path as the blocks
+    themselves — dequantize is two scalar multiplies per tile, fused into
+    the f32 score/accumulate math the online softmax already does."""
+    b = pl.program_id(0)
+    w = pl.program_id(2)
+    nw = pl.num_programs(2)
+
+    @pl.when(w == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    live = (tables_ref[b, w] != 0) & (w * bs <= maxpos_ref[b])
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale      # (T, D)
+        k = k_ref[0, :, 0, :].astype(jnp.float32) * ks_ref[0, 0]
+        v = v_ref[0, :, 0, :].astype(jnp.float32) * vs_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        ctx = w * bs + jax.lax.broadcasted_iota(jnp.int32, (t, bs), 1)
+        mask = ctx <= pos_ref[0][:, None]   # cache pos <= query pos
+        s = jnp.where(mask, s, _NEG)
+        m_old = m_ref[:, 0]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_old - m_new)
+        l_ref[:, 0] = alpha * l_ref[:, 0] + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, 0] = m_new
+
+    @pl.when(w == nw - 1)
+    def _emit():
+        o_ref[0, :, 0, :] = (
+            acc_ref[:] / jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _paged_call_q(tables, max_pos, q, positions, k_pool, v_pool, k_scale,
+                  v_scale, scale, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    _, bs, _, _ = k_pool.shape
+    W = tables.shape[1]
+
+    def kv_index(b, h, w, tables_ref, maxpos_ref):
+        blk = tables_ref[b, w]
+        return (jnp.where(w * bs > maxpos_ref[b], 0, blk), 0, h, 0)
+
+    def scale_index(b, h, w, tables_ref, maxpos_ref):
+        blk = tables_ref[b, w]
+        return (jnp.where(w * bs > maxpos_ref[b], 0, blk), h)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, H, W),
+        in_specs=[
+            pl.BlockSpec((1, T, 1, D), lambda b, h, w, *_: (b, 0, h, 0)),
+            pl.BlockSpec((1, T), lambda b, h, w, *_: (b, 0)),
+            pl.BlockSpec((1, bs, 1, D), kv_index),
+            pl.BlockSpec((1, bs, 1, D), kv_index),
+            pl.BlockSpec((1, 1), scale_index),
+            pl.BlockSpec((1, 1), scale_index),
+        ],
+        out_specs=pl.BlockSpec((1, T, 1, D),
+                               lambda b, h, w, *_: (b, 0, h, 0)),
+        scratch_shapes=[pltpu.VMEM((T, D), jnp.float32),
+                        pltpu.VMEM((T, 1), jnp.float32),
+                        pltpu.VMEM((T, 1), jnp.float32)],
+    )
+    kernel = functools.partial(_paged_kernel_q, bs=bs, t=T, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, T, H, D), q.dtype),
+        interpret=interpret,
+    )(tables, max_pos, q, positions, k_pool, v_pool, k_scale, v_scale)
+
+
 @functools.partial(jax.jit, static_argnames=("scale", "interpret"))
 def _paged_call(tables, max_pos, q, positions, k_pool, v_pool, scale,
                 interpret):
@@ -158,7 +248,7 @@ def _paged_call(tables, max_pos, q, positions, k_pool, v_pool, scale,
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, positions, max_pos,
-                    scale=None):
+                    scale=None, k_scale=None, v_scale=None):
     """Attention of ``q`` against a paged KV pool, walking the block table
     in-kernel.
 
@@ -174,6 +264,12 @@ def paged_attention(q, k_pool, v_pool, block_tables, positions, max_pos,
         inactive rows: every block is skipped and the output is 0).
     scale : float, optional — softmax scale; default
         :func:`attention_scale` of D.
+    k_scale, v_scale : (num_blocks, H) f32, optional — per-(block, head)
+        dequantization scales for an INT8 pool (docs/quantization.md):
+        the kernel dequantizes each K/V tile in VMEM, with the scales
+        index-mapped through the same scalar-prefetched block table as
+        the blocks themselves.  Omitted = the classic float-pool kernel,
+        byte-identical to the pre-quantization layout.
 
     Returns (B, T, H, D) in q's dtype, matching
     :func:`paged_attention_reference` at rtol 1e-5 (f32) on valid rows.
@@ -183,6 +279,14 @@ def paged_attention(q, k_pool, v_pool, block_tables, positions, max_pos,
     B, T, H, D = q.shape
     if scale is None:
         scale = attention_scale(D)
+    if k_scale is not None:
+        return _paged_call_q(
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(max_pos, jnp.int32), q,
+            jnp.asarray(positions, jnp.int32), k_pool, v_pool,
+            jnp.asarray(k_scale, jnp.float32),
+            jnp.asarray(v_scale, jnp.float32), float(scale),
+            _use_interpret())
     return _paged_call(
         jnp.asarray(block_tables, jnp.int32),
         jnp.asarray(max_pos, jnp.int32), q,
@@ -191,7 +295,8 @@ def paged_attention(q, k_pool, v_pool, block_tables, positions, max_pos,
 
 
 def paged_attention_sharded(q, k_pool, v_pool, block_tables, positions,
-                            max_pos, mesh, axis: str = "mp", scale=None):
+                            max_pos, mesh, axis: str = "mp", scale=None,
+                            k_scale=None, v_scale=None):
     """:func:`paged_attention` partitioned PER HEAD over a model-parallel
     mesh axis (docs/sharding.md, docs/generation.md).
 
@@ -222,6 +327,22 @@ def paged_attention_sharded(q, k_pool, v_pool, block_tables, positions,
     from jax.sharding import PartitionSpec as P
 
     hspec = P(None, None, axis, None)   # heads at dim 2 for q AND the pools
+    if k_scale is not None:
+        # int8 pool: the per-(block, head) scales shard on their head dim
+        # alongside the pools — each rank dequantizes its own head slice
+        fn = shard_map_compat(
+            lambda q, k, v, t, p, m, ks, vs: paged_attention(
+                q, k, v, t, p, m, scale=scale, k_scale=ks, v_scale=vs),
+            mesh=mesh,
+            in_specs=(hspec, hspec, hspec, P(), P(), P(),
+                      P(None, axis), P(None, axis)),
+            out_specs=hspec, check=False)
+        return fn(q, k_pool, v_pool,
+                  jnp.asarray(block_tables, jnp.int32),
+                  jnp.asarray(positions, jnp.int32),
+                  jnp.asarray(max_pos, jnp.int32),
+                  jnp.asarray(k_scale, jnp.float32),
+                  jnp.asarray(v_scale, jnp.float32))
     fn = shard_map_compat(
         lambda q, k, v, t, p, m: paged_attention(q, k, v, t, p, m,
                                                  scale=scale),

@@ -7,6 +7,7 @@ the float ↔ int8 boundary. Symmetric scaling mirrors the reference's
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .registry import register
@@ -151,6 +152,104 @@ def quantized_conv(*args, kernel=(), stride=(), dilate=(), pad=(),
         acc = acc + b.astype(jnp.int32).reshape((1, -1) + (1,) * nd_sp)
     t = out_scale * float(2 ** 31 - 1)
     return acc, (-t).reshape(1), t.reshape(1)
+
+
+# -- TPU-native serving int8 family (docs/quantization.md) -------------------------
+# The ops mxnet_tpu/quantization/convert.py inserts: symmetric int8 with
+# STATIC (calibrated) or dynamic per-tensor activation scales, int8 weights
+# stored ONCE offline with per-output-channel scales, and f32 accumulation
+# on the MXU via ``preferred_element_type`` — unlike the ``_contrib_*``
+# reference ops above, nothing re-quantizes weights per forward and no
+# int32->float range convention rides along: scales are explicit tensors.
+
+@register("_tpumx_quantize_int8", num_outputs=2, differentiable=False)
+def tpumx_quantize_int8(data, scale=0.0):
+    """float -> int8 symmetric: ``q = clip(round(x / s), ±127)``.
+
+    ``scale > 0`` is the calibrated static scale (``threshold / 127`` from a
+    CalibrationTable) — the compiled program carries it as a constant, so
+    outputs are batch-independent.  ``scale <= 0`` falls back to dynamic
+    per-tensor absmax computed in-graph.  Returns ``(q int8, scale (1,))``
+    so consumers dequantize with the same scale either way."""
+    x = data.astype(jnp.float32)
+    if float(scale) > 0:
+        s = jnp.float32(scale)
+    else:
+        s = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8)
+    return q, jnp.reshape(s, (1,))
+
+
+@register("_tpumx_dequantize_int8", differentiable=False)
+def tpumx_dequantize_int8(data, scale, axis=-1):
+    """int8 -> float32: ``x = q * s``.  A scalar/(1,) ``scale`` is
+    per-tensor; a longer ``scale`` is per-channel along ``axis``."""
+    x = data.astype(jnp.float32)
+    s = jnp.asarray(scale, jnp.float32)
+    if s.size > 1:
+        ax = axis % x.ndim
+        s = s.reshape((1,) * ax + (-1,) + (1,) * (x.ndim - ax - 1))
+    else:
+        s = s.reshape(())
+    return x * s
+
+
+@register("_tpumx_quantized_fc_int8", differentiable=False)
+def tpumx_quantized_fc_int8(*args, num_hidden=0, no_bias=False, flatten=True):
+    """int8 FullyConnected with f32 MXU accumulation.
+
+    Inputs: ``(data_q int8, act_scale (1,), weight_q int8 (out, in),
+    w_scale (out,)[, bias f32 (out,)])``.  The int8 matmul accumulates in
+    f32 (``preferred_element_type``), then the per-output-channel
+    dequantize ``acc * act_scale * w_scale`` and the f32 bias land the
+    result back in float — the drop-in body for a converted
+    ``FullyConnected`` node (docs/quantization.md)."""
+    data_q, act_scale, weight_q, w_scale = args[:4]
+    bias = None if (no_bias or len(args) < 5) else args[4]
+    x = data_q
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = jax.lax.dot_general(
+        x, weight_q, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    out = acc * (jnp.reshape(jnp.asarray(act_scale, jnp.float32), ())
+                 * jnp.asarray(w_scale, jnp.float32))
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out
+
+
+@register("_tpumx_quantized_conv_int8", differentiable=False)
+def tpumx_quantized_conv_int8(*args, kernel=(), stride=(), dilate=(),
+                              pad=(), num_filter=0, num_group=1,
+                              no_bias=False, layout=None, cudnn_tune=None,
+                              cudnn_off=False, workspace=1024):
+    """int8 Convolution with f32 accumulation and per-output-channel
+    weight scales; same input convention as ``_tpumx_quantized_fc_int8``
+    (weights in the reference OIHW / O<spatial>I layout)."""
+    from .nn import _conv_dnums, is_channels_last
+
+    data_q, act_scale, weight_q, w_scale = args[:4]
+    bias = None if (no_bias or len(args) < 5) else args[4]
+    nd_sp = data_q.ndim - 2
+    k = len(kernel) if kernel else nd_sp
+    stride = tuple(stride) if stride else (1,) * k
+    dilate = tuple(dilate) if dilate else (1,) * k
+    pad = tuple(pad) if pad else (0,) * k
+    dnums = jax.lax.conv_dimension_numbers(
+        data_q.shape, weight_q.shape, _conv_dnums(data_q.ndim, layout))
+    acc = jax.lax.conv_general_dilated(
+        data_q, weight_q, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dnums, feature_group_count=int(num_group),
+        preferred_element_type=jnp.float32)
+    cshape = ((1,) * (acc.ndim - 1) + (-1,) if is_channels_last(layout)
+              else (1, -1) + (1,) * nd_sp)
+    out = acc * (jnp.reshape(jnp.asarray(act_scale, jnp.float32), ())
+                 * jnp.asarray(w_scale, jnp.float32).reshape(cshape))
+    if bias is not None:
+        out = out + bias.astype(jnp.float32).reshape(cshape)
+    return out
 
 
 @register("_contrib_quantized_pooling", num_outputs=3, differentiable=False)

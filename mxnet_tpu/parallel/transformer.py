@@ -128,11 +128,73 @@ def transformer_lm_apply(params: Params, tokens, positions,
     return x @ params["tok_emb"].T  # tied embeddings
 
 
+def _scatter_kv_quantized(pool, scale, vals, tables, positions, valid,
+                          max_pos, nt: int):
+    """Quantizing scatter into ONE layer's int8 paged pool
+    (docs/quantization.md).
+
+    Only the ``nt`` logical blocks this chunk's contiguous positions can
+    touch are gathered (decode: exactly one block per row), dequantized
+    with their current per-(block, head) scales, updated with the chunk's
+    float K/V, re-scaled from the masked absmax over the WRITTEN prefix,
+    requantized, and scattered back.  Untouched blocks keep their bits;
+    a touched block whose scale is unchanged requantizes to identical
+    int8 (the absmax entry stores as exactly ±127, so ``round(q*s/s)``
+    is the identity) — per-row bits are a pure function of the row's own
+    write history, which is what makes greedy tokens batch-composition-
+    independent under int8.
+
+    pool: (num_blocks, bs, H, D) int8; scale: (num_blocks, H) f32;
+    vals: (B, T, H, D) float; tables: (B, W) int32; positions/valid:
+    (B, T); max_pos: (B,) last valid position AFTER this write (-1 for
+    inactive rows).  Returns (pool, scale).
+    """
+    B, T, H, D = vals.shape
+    bs = pool.shape[1]
+    W = tables.shape[1]
+    # positions are contiguous per row, so the row's first entry names the
+    # first touched logical block (all-invalid rows write to block 0)
+    l0 = positions[:, 0] // bs                                     # (B,)
+    tl = l0[:, None] + jnp.arange(nt, dtype=jnp.int32)[None, :]    # (B, nt)
+    row_live = jnp.any(valid, axis=1)
+    j_ok = (tl < W) & row_live[:, None]
+    tphys = jnp.where(
+        j_ok, jnp.take_along_axis(tables, jnp.minimum(tl, W - 1), axis=1),
+        0)
+    blk = pool[tphys].astype(jnp.float32) \
+        * scale[tphys][:, :, None, :, None]          # (B, nt, bs, H, D)
+    bidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    j = jnp.clip(positions // bs - l0[:, None], 0, nt - 1)
+    o = positions % bs
+    cur = blk[bidx, j, o]
+    blk = blk.at[bidx, j, o].set(
+        jnp.where(valid[..., None, None], vals.astype(jnp.float32), cur))
+    # per-(block, head) scale from the masked absmax over the written
+    # prefix only — unwritten tail garbage (and freshly re-allocated
+    # blocks' stale bits) never pollutes the scale
+    pos_of = tl[:, :, None] * bs \
+        + jnp.arange(bs, dtype=jnp.int32)[None, None, :]   # (B, nt, bs)
+    live = (pos_of <= max_pos[:, None, None]) & j_ok[:, :, None]
+    amax = jnp.max(jnp.abs(blk) * live[..., None, None].astype(jnp.float32),
+                   axis=(2, 4))                            # (B, nt, H)
+    new_s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(blk / new_s[:, :, None, :, None]),
+                 -127, 127).astype(jnp.int8)
+    # duplicate targets only ever alias the reserved null block 0
+    return pool.at[tphys].set(q), scale.at[tphys].set(new_s)
+
+
+def _touched_blocks(T: int, block_size: int) -> int:
+    """Static count of logical blocks ``T`` contiguous positions can span
+    at any alignment (decode T=1 -> 1)."""
+    return (T + block_size - 2) // block_size + 1
+
+
 def transformer_lm_decode(params: Params, tokens, positions, lengths,
                           k_pool, v_pool, block_tables,
                           cfg: TransformerConfig, compute_dtype=None,
                           attention_kernel: Optional[str] = None,
-                          mp_mesh=None):
+                          mp_mesh=None, k_scale=None, v_scale=None):
     """Cache-aware forward: read/write a paged per-layer KV cache.
 
     The generation engine's one model step, serving BOTH phases
@@ -163,6 +225,16 @@ def transformer_lm_decode(params: Params, tokens, positions, lengths,
     k/v written from this very chunk — so a bucketed prefill followed by
     T=1 decode steps reproduces `transformer_lm_apply` logits exactly
     (tests/test_generation.py asserts rtol 1e-5, f32 and bf16).
+
+    ``k_scale``/``v_scale`` (``(n_layers, num_blocks, n_heads)`` f32,
+    docs/quantization.md) switch the pool to INT8 storage: the scatter
+    quantizes the chunk's K/V in-program per (layer, block, head) and
+    both attention paths dequantize at read — the gathered-dense
+    reference path explicitly, the Pallas kernel inside the kernel with
+    the scales riding VMEM next to the block tables.  The return grows to
+    ``(logits, k_pool, v_pool, k_scale, v_scale)``; with scales omitted
+    this function (and its compiled programs) is byte-identical to the
+    pre-quantization layout.
     """
     if compute_dtype is not None:
         params = jax.tree_util.tree_map(
@@ -204,11 +276,15 @@ def transformer_lm_decode(params: Params, tokens, positions, lengths,
         use_paged = _pk.pallas_enabled()
     else:
         use_paged = attention_kernel == "paged"
-    if use_paged:
+    quantized = k_scale is not None
+    if use_paged or quantized:
         # last valid query position per row; -1 (inactive slots) skips
         # every block and the row's output is garbage, same as the oracle
         max_pos = jnp.max(jnp.where(valid, positions, -1), axis=1)
+    if use_paged:
         kernel_scale = _pa.attention_scale(cfg.d_head)
+    if quantized:
+        nt = _touched_blocks(T, block_size)
 
     x = params["tok_emb"][tokens] + jnp.take(params["pos_emb"], positions,
                                              axis=0)
@@ -219,20 +295,50 @@ def transformer_lm_decode(params: Params, tokens, positions, lengths,
         q, k, v = jnp.split(qkv, 3, axis=-1)
         to_heads = lambda t: t.reshape(B, T, cfg.n_heads, cfg.d_head)
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
-        k_pool = k_pool.at[i, phys, offs].set(k.astype(k_pool.dtype))
-        v_pool = v_pool.at[i, phys, offs].set(v.astype(v_pool.dtype))
+        if quantized:
+            kp, ks = _scatter_kv_quantized(k_pool[i], k_scale[i], k,
+                                           block_tables, positions, valid,
+                                           max_pos, nt)
+            vp, vs = _scatter_kv_quantized(v_pool[i], v_scale[i], v,
+                                           block_tables, positions, valid,
+                                           max_pos, nt)
+            k_pool = k_pool.at[i].set(kp)
+            v_pool = v_pool.at[i].set(vp)
+            k_scale = k_scale.at[i].set(ks)
+            v_scale = v_scale.at[i].set(vs)
+        else:
+            k_pool = k_pool.at[i, phys, offs].set(k.astype(k_pool.dtype))
+            v_pool = v_pool.at[i, phys, offs].set(v.astype(v_pool.dtype))
         if use_paged and mp_mesh is not None:
             o = _pa.paged_attention_sharded(
                 q, k_pool[i], v_pool[i], block_tables, positions, max_pos,
-                mesh=mp_mesh, axis="mp", scale=kernel_scale)
+                mesh=mp_mesh, axis="mp", scale=kernel_scale,
+                k_scale=k_scale[i] if quantized else None,
+                v_scale=v_scale[i] if quantized else None)
         elif use_paged:
             o = _pa.paged_attention(q, k_pool[i], v_pool[i], block_tables,
-                                    positions, max_pos, scale=kernel_scale)
+                                    positions, max_pos, scale=kernel_scale,
+                                    k_scale=k_scale[i] if quantized
+                                    else None,
+                                    v_scale=v_scale[i] if quantized
+                                    else None)
         else:
-            k_ctx = k_pool[i][block_tables].reshape(B, W * block_size,
-                                                    cfg.n_heads, cfg.d_head)
-            v_ctx = v_pool[i][block_tables].reshape(B, W * block_size,
-                                                    cfg.n_heads, cfg.d_head)
+            if quantized:
+                # dequantize at read: per-(block, head) scales broadcast
+                # over the gathered context (docs/quantization.md)
+                k_ctx = (k_pool[i][block_tables].astype(jnp.float32)
+                         * k_scale[i][block_tables][:, :, None, :, None]
+                         ).reshape(B, W * block_size, cfg.n_heads,
+                                   cfg.d_head)
+                v_ctx = (v_pool[i][block_tables].astype(jnp.float32)
+                         * v_scale[i][block_tables][:, :, None, :, None]
+                         ).reshape(B, W * block_size, cfg.n_heads,
+                                   cfg.d_head)
+            else:
+                k_ctx = k_pool[i][block_tables].reshape(
+                    B, W * block_size, cfg.n_heads, cfg.d_head)
+                v_ctx = v_pool[i][block_tables].reshape(
+                    B, W * block_size, cfg.n_heads, cfg.d_head)
             # same numerics as ring_attention.local_attention (f32 scores
             # and accumulation), with the causal mask generalized to
             # cache-position <= query-position — padded/unwritten slots
@@ -244,6 +350,8 @@ def transformer_lm_decode(params: Params, tokens, positions, lengths,
         x = x + jax.nn.gelu(h @ g("w1") + g("b1")) @ g("w2") + g("b2")
     x = _ln(x, params["lnf_g"], params["lnf_b"])
     logits = x @ params["tok_emb"].T
+    if quantized:
+        return logits.astype(jnp.float32), k_pool, v_pool, k_scale, v_scale
     return logits.astype(jnp.float32), k_pool, v_pool
 
 

@@ -1,0 +1,64 @@
+"""mxnet_tpu.quantization — int8 serving density (docs/quantization.md).
+
+The last reference capability tpu-mx had not reproduced (PAPER.md layer
+map, op-library row; ROADMAP item 4), rebuilt as a serving-first
+subsystem.  Everything below bf16 is about DENSITY: int8 weights and an
+int8 paged KV cache roughly double the parameters and context a chip
+holds, which multiplies straight through the generation engine's
+admission/preemption machinery into sustained concurrent requests.
+
+Three cooperating pieces:
+
+1. **Calibration** (:mod:`.calibrate`) — run a bound Module over a
+   calibration iterator collecting per-tensor min/max, percentile and
+   (optional) entropy statistics for matmul/conv-family inputs plus
+   per-channel weight absmax, producing a serializable, checksummed
+   :class:`CalibrationTable`.
+2. **Graph conversion** (:mod:`.convert`) — rewrite the symbol over the
+   SHARED rewrite engine (:mod:`mxnet_tpu.symbol.rewrite`, the same core
+   AMP drives): quantize → int8-op sandwiches with static calibrated
+   scales, int8 weights stored once with per-channel scales, f32 MXU
+   accumulation.  Exposed in serving through
+   ``ServingConfig(quantize="int8")`` / ``TPUMX_QUANT`` next to
+   ``amp_dtype``.
+3. **Int8 paged KV cache** — the piece AMP cannot give us: the
+   generation pool stored int8 with per-(layer, block, head) scales,
+   quantized at scatter and dequantized at read inside both attention
+   paths (``GenerationConfig(kv_dtype="int8")`` /
+   ``TPUMX_GEN_KV_DTYPE``; see serving/generation/kv_cache.py and
+   ops/paged_attention.py).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..base import MXNetError
+from .calibrate import (CalibrationTable, calibrate, calibrate_module,
+                        weight_channel_absmax)
+from .convert import (QUANTIZABLE_OPS, convert_symbol, count_quantized_nodes,
+                      quantize_weights)
+
+__all__ = ["CalibrationTable", "calibrate", "calibrate_module",
+           "convert_symbol", "quantize_weights", "count_quantized_nodes",
+           "weight_channel_absmax", "QUANTIZABLE_OPS", "enabled",
+           "active_dtype"]
+
+
+def enabled() -> bool:
+    """Whether env-driven serving quantization is on (``TPUMX_QUANT=int8``;
+    default off — and ``TPUMX_QUANT=0`` is byte-identical to unset,
+    tested)."""
+    return active_dtype() is not None
+
+
+def active_dtype() -> Optional[str]:
+    """The env-selected quantized dtype, or None when off.  Accepted
+    values: ``int8`` (also ``1``); ``0``/``none``/``off``/unset disable."""
+    raw = os.environ.get("TPUMX_QUANT", "").strip().lower()
+    if raw in ("", "0", "none", "off", "false"):
+        return None
+    if raw in ("int8", "1"):
+        return "int8"
+    raise MXNetError(
+        f"TPUMX_QUANT={raw!r}: expected 'int8' (or '0'/'none' to disable)")

@@ -74,7 +74,9 @@ class ServingConfig:
                  batch_buckets: Optional[List[int]] = None,
                  shape_buckets: Optional[List[Tuple[int, ...]]] = None,
                  amp_dtype: Optional[str] = None,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 quantize: Optional[str] = "__env__",
+                 quantize_calibration=None):
         from .bucketing import batch_buckets as _ladder
 
         self.max_batch_size = int(
@@ -115,6 +117,28 @@ class ServingConfig:
         self.amp_dtype: Optional[str] = (
             str(amp_dtype) if amp_dtype is not None
             else (env_amp or None))
+        # int8 weight quantization (docs/quantization.md): executor-backed
+        # models are served through a quantization.convert_symbol'd graph —
+        # int8 weights stored once with per-channel scales, f32 MXU
+        # accumulation — next to amp_dtype.  TPUMX_QUANT=int8 is the fleet
+        # switch; =0/unset leaves every program key and output
+        # byte-identical (bitwise-tested, same standard as TPUMX_AMP).
+        if quantize == "__env__":
+            from .. import quantization as _q
+
+            self.quantize: Optional[str] = _q.active_dtype()
+        else:
+            if quantize not in (None, "int8"):
+                raise ValueError(
+                    f"quantize must be None or 'int8', got {quantize!r}")
+            self.quantize = quantize
+        # a CalibrationTable (or a path to one, TPUMX_QUANT_CALIBRATION)
+        # pins static activation scales; without it activations quantize
+        # dynamically in-graph
+        env_calib = os.environ.get("TPUMX_QUANT_CALIBRATION")
+        self.quantize_calibration = (
+            quantize_calibration if quantize_calibration is not None
+            else (env_calib or None))
         # Prometheus exposition endpoint (docs/observability.md): when set,
         # InferenceService serves the process registry's /metrics on this
         # port (0 = ephemeral) via observability.exposition
@@ -133,7 +157,8 @@ class ServingConfig:
                 f"backpressure={self.backpressure!r}, "
                 f"default_deadline_ms={self.default_deadline_ms}, "
                 f"batch_buckets={self.batch_buckets}, "
-                f"shape_buckets={self.shape_buckets})")
+                f"shape_buckets={self.shape_buckets}, "
+                f"quantize={self.quantize!r})")
 
 
 class Request:

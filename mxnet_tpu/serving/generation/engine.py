@@ -94,7 +94,8 @@ class GenerationConfig:
                  preemption: Optional[bool] = None,
                  watermark_high: Optional[float] = None,
                  watermark_low: Optional[float] = None,
-                 admission_budget: Optional[float] = None):
+                 admission_budget: Optional[float] = None,
+                 kv_dtype: Optional[str] = "__env__"):
         self.max_slots = int(max_slots if max_slots is not None
                              else getenv("TPUMX_GEN_SLOTS", 4))
         if self.max_slots < 1:
@@ -170,6 +171,18 @@ class GenerationConfig:
             raise ValueError(
                 f"watermarks must satisfy 0 < low <= high <= 1, got "
                 f"low={self.watermark_low}, high={self.watermark_high}")
+        # int8 paged KV cache (docs/quantization.md): the pool stores int8
+        # with per-(layer, block, head) scales — ~2x the block budget at
+        # the same bytes — quantized at scatter and dequantized at read in
+        # both attention paths.  None/unset keeps the compute-dtype pool
+        # and every program key byte-identical.
+        if kv_dtype == "__env__":
+            raw = os.environ.get("TPUMX_GEN_KV_DTYPE", "").strip().lower()
+            kv_dtype = None if raw in ("", "0", "none", "off") else raw
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
         # overload control: submissions whose projected worst-case blocks
         # (queued + running) would exceed this multiple of the pool hit the
         # backpressure policy BEFORE the pool thrashes
@@ -187,6 +200,7 @@ class GenerationConfig:
                 f"max_new_tokens={self.max_new_tokens}, "
                 f"backpressure={self.backpressure!r}, "
                 f"amp_dtype={self.amp_dtype!r}, "
+                f"kv_dtype={self.kv_dtype!r}, "
                 f"preemption={self.preemption})")
 
 
@@ -427,13 +441,15 @@ class GenerationService:
         self._cache = PagedKVCache(
             model_cfg.n_layers, model_cfg.n_heads, model_cfg.d_head,
             cfg.num_blocks, cfg.block_size,
-            dtype=compute_dtype or jnp.float32)
+            dtype=compute_dtype or jnp.float32,
+            kv_dtype=cfg.kv_dtype)
         self._cache.allocator.set_watermarks(cfg.watermark_high,
                                              cfg.watermark_low)
         self._programs = GenerationPrograms(params, model_cfg,
                                             compute_dtype=compute_dtype,
                                             mp_devices=cfg.mp_devices,
-                                            shard_rules=cfg.shard_rules)
+                                            shard_rules=cfg.shard_rules,
+                                            kv_dtype=cfg.kv_dtype)
         # mp + paged kernel: the pool lives head-sharded on the mp mesh
         # (1/mp of the cache per chip, docs/generation.md)
         self._programs.place_cache(self._cache)
@@ -1519,6 +1535,7 @@ class GenerationService:
                                "p99": _ms(pct(itl, 99))},
             "compiled_signatures": self._programs.compiled_signatures(),
             "decode_kernel": self._programs.kernel,
+            "kv_dtype": self._config.kv_dtype or str(self._cache.dtype),
             "seq_buckets": list(self._seq_buckets),
             "width_buckets": list(self._width_buckets),
             "closed": self._closed,

@@ -30,7 +30,7 @@ in docs/generation.md.
 from __future__ import annotations
 
 import threading
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 __all__ = ["BlockAllocator", "PagedKVCache", "blocks_for"]
 
@@ -44,6 +44,14 @@ class BlockAllocator:
     """Free-list allocator over physical block ids ``1..num_blocks-1``
     (block 0 is the reserved null block).  Thread-safe; all-or-nothing
     allocation so a request is never half-admitted.
+
+    Every allocated block carries a REFCOUNT (born 1 at :meth:`allocate`):
+    :meth:`incref` marks sharing, :meth:`decref`/:meth:`free` release one
+    reference and the block returns to the free list only at zero.  This
+    is the bookkeeping prefix caching (ROADMAP item 3a, copy-on-write
+    shared prompt blocks) needs, and what the int8 pool's per-block scale
+    lifetime rides on today: a block's scales stay meaningful exactly as
+    long as some owner holds a reference (docs/quantization.md).
 
     ``watermark_high`` / ``watermark_low`` are occupancy fractions the
     preempting engine steers by: crossing above high triggers victim
@@ -65,6 +73,7 @@ class BlockAllocator:
         self._lock = threading.Lock()
         # pop() takes from the tail: hand out low ids first
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._ref: Dict[int, int] = {}  # block id -> live reference count
 
     def set_watermarks(self, high: float, low: float) -> None:
         if not (0.0 < low <= high <= 1.0):
@@ -94,7 +103,8 @@ class BlockAllocator:
         return self.num_free >= int(n)
 
     def allocate(self, n: int) -> Optional[List[int]]:
-        """``n`` blocks, or None (nothing taken) if fewer are free."""
+        """``n`` blocks (refcount 1 each), or None (nothing taken) if
+        fewer are free."""
         n = int(n)
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
@@ -102,17 +112,51 @@ class BlockAllocator:
             if len(self._free) < n:
                 return None
             out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
         return out
 
-    def free(self, blocks: List[int]) -> None:
+    def incref(self, blocks: List[int]) -> None:
+        """Add one reference to each allocated block (a sharer — e.g. a
+        prefix-cache hit — now also holds it)."""
+        with self._lock:
+            for b in blocks:
+                b = int(b)
+                if b not in self._ref:
+                    raise ValueError(
+                        f"incref of unallocated block {b}")
+            for b in blocks:
+                self._ref[int(b)] += 1
+
+    def decref(self, blocks: List[int]) -> List[int]:
+        """Release one reference per block; blocks reaching zero return to
+        the free list.  Returns the block ids actually freed."""
+        freed: List[int] = []
         with self._lock:
             for b in blocks:
                 b = int(b)
                 if b <= 0 or b >= self.num_blocks:
                     raise ValueError(f"block id {b} out of range")
-                if b in self._free:
+                if b not in self._ref:
                     raise ValueError(f"double free of block {b}")
-                self._free.append(b)
+            for b in blocks:
+                b = int(b)
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    del self._ref[b]
+                    self._free.append(b)
+                    freed.append(b)
+        return freed
+
+    def refcount(self, block: int) -> int:
+        """Live reference count of a block (0 = free)."""
+        with self._lock:
+            return self._ref.get(int(block), 0)
+
+    def free(self, blocks: List[int]) -> None:
+        """Release one reference per block (alias of :meth:`decref` —
+        a block truly frees only when its LAST owner lets go)."""
+        self.decref(blocks)
 
     def occupancy(self) -> float:
         """Fraction of allocatable blocks currently owned by requests."""
@@ -128,21 +172,49 @@ class PagedKVCache:
     donated compiled programs and stores the returned (aliased) arrays
     back via :meth:`swap` — the pool is updated in place on device, and
     this object always points at the live copy.
+
+    ``kv_dtype="int8"`` (docs/quantization.md) stores the pool QUANTIZED:
+    K/V become int8 with symmetric per-``(layer, block, head)`` scales in
+    ``k_scale``/``v_scale`` (``(n_layers, num_blocks, n_heads)`` f32,
+    riding through the same donated programs).  The scatter path
+    quantizes each chunk's K/V in-program and both attention paths
+    dequantize at read — the pool then costs ~half the bf16 bytes, which
+    is the ~2x block-budget headline (:meth:`num_blocks_for_bytes`).
     """
 
     def __init__(self, n_layers: int, n_heads: int, d_head: int,
-                 num_blocks: int, block_size: int, dtype=None):
+                 num_blocks: int, block_size: int, dtype=None,
+                 kv_dtype: Optional[str] = None):
         import jax.numpy as jnp
 
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.dtype = jnp.dtype(dtype) if dtype is not None \
             else jnp.dtype(jnp.float32)
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
         shape = (int(n_layers), self.num_blocks, self.block_size,
                  int(n_heads), int(d_head))
-        self.k = jnp.zeros(shape, self.dtype)
-        self.v = jnp.zeros(shape, self.dtype)
+        store = jnp.dtype(jnp.int8) if kv_dtype == "int8" else self.dtype
+        self.k = jnp.zeros(shape, store)
+        self.v = jnp.zeros(shape, store)
+        if kv_dtype == "int8":
+            sshape = (int(n_layers), self.num_blocks, int(n_heads))
+            # unwritten blocks carry scale 1: their (masked-out-of-
+            # attention) garbage dequantizes to bounded values and the
+            # first real write recomputes the scale from scratch
+            self.k_scale = jnp.ones(sshape, jnp.float32)
+            self.v_scale = jnp.ones(sshape, jnp.float32)
+        else:
+            self.k_scale = None
+            self.v_scale = None
         self.allocator = BlockAllocator(self.num_blocks)
+
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype == "int8"
 
     @property
     def shape(self):
@@ -155,10 +227,42 @@ class PagedKVCache:
         """Positions one request could address if it owned every block."""
         return (self.num_blocks - 1) * self.block_size
 
-    def swap(self, k, v) -> None:
+    def swap(self, k, v, k_scale=None, v_scale=None) -> None:
         """Adopt the pool arrays returned by a donated program call."""
         self.k = k
         self.v = v
+        if k_scale is not None:
+            self.k_scale = k_scale
+        if v_scale is not None:
+            self.v_scale = v_scale
 
     def nbytes(self) -> int:
-        return int(self.k.nbytes) + int(self.v.nbytes)
+        n = int(self.k.nbytes) + int(self.v.nbytes)
+        if self.k_scale is not None:
+            n += int(self.k_scale.nbytes) + int(self.v_scale.nbytes)
+        return n
+
+    @staticmethod
+    def bytes_per_block(n_layers: int, n_heads: int, d_head: int,
+                       block_size: int, dtype=None,
+                       kv_dtype: Optional[str] = None) -> int:
+        """Device bytes one pool block costs (K + V + scales)."""
+        import jax.numpy as jnp
+
+        item = 1 if kv_dtype == "int8" else \
+            jnp.dtype(dtype if dtype is not None else jnp.float32).itemsize
+        per = 2 * n_layers * block_size * n_heads * d_head * item
+        if kv_dtype == "int8":
+            per += 2 * n_layers * n_heads * 4  # f32 k/v scales
+        return per
+
+    @classmethod
+    def num_blocks_for_bytes(cls, pool_bytes: int, n_layers: int,
+                             n_heads: int, d_head: int, block_size: int,
+                             dtype=None,
+                             kv_dtype: Optional[str] = None) -> int:
+        """How many blocks a byte budget buys — the density comparison:
+        at identical ``pool_bytes`` the int8 pool's budget is ~2x the
+        bf16 one (scales cost ``8/(block_size*d_head)`` of the win)."""
+        return int(pool_bytes) // cls.bytes_per_block(
+            n_layers, n_heads, d_head, block_size, dtype, kv_dtype)

@@ -59,16 +59,48 @@ def _model_step(params, k_pool, v_pool, tokens, positions, lengths,
     return next_tokens, last, k_pool, v_pool
 
 
+def _model_step_q(params, k_pool, v_pool, k_scale, v_scale, tokens,
+                  positions, lengths, block_tables, seeds, counters,
+                  temperature, top_k, top_p, *, cfg, compute_dtype,
+                  attention_kernel="gather", mp_mesh=None):
+    """The int8-KV variant of :func:`_model_step` (docs/quantization.md):
+    the per-(layer, block, head) scale arrays ride as two extra DONATED
+    pool operands — a separate traced function so the unquantized
+    program layout stays byte-identical when ``kv_dtype`` is off."""
+    import jax.numpy as jnp
+
+    from ...ops.sampling import sample_logits
+    from ...parallel.transformer import transformer_lm_decode
+
+    logits, k_pool, v_pool, k_scale, v_scale = transformer_lm_decode(
+        params, tokens, positions, lengths, k_pool, v_pool, block_tables,
+        cfg, compute_dtype=compute_dtype,
+        attention_kernel=attention_kernel, mp_mesh=mp_mesh,
+        k_scale=k_scale, v_scale=v_scale)
+    last_idx = jnp.clip(jnp.asarray(lengths, jnp.int32) - 1, 0,
+                        tokens.shape[1] - 1)
+    last = jnp.take_along_axis(logits, last_idx[:, None, None],
+                               axis=1)[:, 0, :]
+    next_tokens = sample_logits(last, seeds, counters, temperature,
+                                top_k, top_p)
+    return next_tokens, last, k_pool, v_pool, k_scale, v_scale
+
+
 class GenerationPrograms:
     """Owns the jitted step + per-signature compile accounting."""
 
     def __init__(self, params, cfg, compute_dtype=None, mp_devices: int = 1,
-                 shard_rules=None):
+                 shard_rules=None, kv_dtype=None):
         import jax
         import jax.numpy as jnp
 
         self._cfg = cfg
         self._compute_dtype = compute_dtype
+        # int8 paged KV cache (docs/quantization.md): the jitted step
+        # gains the two donated scale operands and every program key a
+        # ("kv_dtype", "int8") component; None keeps the classic layout
+        # byte-identical
+        self._kv_dtype = kv_dtype
         # model-parallel serving (docs/sharding.md): with mp_devices > 1 the
         # params live sharded per partition rules over a 1-axis ``mp`` mesh
         # — the SAME rule sets training uses — and the jitted global-view
@@ -99,13 +131,22 @@ class GenerationPrograms:
                  or cfg.n_heads % int(self._mp_mesh.shape["mp"]) == 0)
         self._kernel = "paged" if pallas_enabled() and mp_ok else "gather"
         self._params = self._place_params(params)
-        self._jit = jax.jit(
-            functools.partial(
-                _model_step, cfg=cfg, compute_dtype=compute_dtype,
-                attention_kernel=self._kernel,
-                mp_mesh=(self._mp_mesh if self._kernel == "paged"
-                         else None)),
-            donate_argnums=(1, 2))
+        if kv_dtype == "int8":
+            self._jit = jax.jit(
+                functools.partial(
+                    _model_step_q, cfg=cfg, compute_dtype=compute_dtype,
+                    attention_kernel=self._kernel,
+                    mp_mesh=(self._mp_mesh if self._kernel == "paged"
+                             else None)),
+                donate_argnums=(1, 2, 3, 4))
+        else:
+            self._jit = jax.jit(
+                functools.partial(
+                    _model_step, cfg=cfg, compute_dtype=compute_dtype,
+                    attention_kernel=self._kernel,
+                    mp_mesh=(self._mp_mesh if self._kernel == "paged"
+                             else None)),
+                donate_argnums=(1, 2))
         self._lock = threading.Lock()
         self._stats: Dict[tuple, Dict[str, int]] = {}
 
@@ -132,6 +173,14 @@ class GenerationPrograms:
 
         # (n_layers, num_blocks, block_size, n_heads, d_head): heads dim 3
         sh = NamedSharding(self._mp_mesh, P(None, None, None, "mp", None))
+        if cache.quantized:
+            # per-(layer, block, head) scales shard on their head dim 2
+            ssh = NamedSharding(self._mp_mesh, P(None, None, "mp"))
+            cache.swap(jax.device_put(cache.k, sh),
+                       jax.device_put(cache.v, sh),
+                       jax.device_put(cache.k_scale, ssh),
+                       jax.device_put(cache.v_scale, ssh))
+            return
         cache.swap(jax.device_put(cache.k, sh), jax.device_put(cache.v, sh))
 
     def refresh_params(self, params) -> None:
@@ -152,12 +201,16 @@ class GenerationPrograms:
     def _key(self, kind: str, cache, tokens, block_tables) -> tuple:
         sig = (("tokens", tuple(tokens.shape), "int32"),
                ("block_tables", tuple(block_tables.shape), "int32"),
-               ("kv_pool", cache.shape, str(cache.dtype)))
+               ("kv_pool", cache.shape, str(cache.k.dtype)))
         # the paged kernel variant keys its programs separately, while
         # gather (TPUMX_PALLAS=0) keys stay byte-identical to the
         # pre-kernel layout — warm caches and freeze sets carry over
         if self.kernel == "paged":
             sig = sig + (("kernel", "paged"),)
+        # int8 KV pool (docs/quantization.md): its own program family —
+        # kv_dtype off leaves every pre-existing key byte-identical
+        if self._kv_dtype == "int8":
+            sig = sig + (("kv_dtype", "int8"),)
         return (kind, sig)
 
     def run(self, kind: str, cache, tokens, positions, lengths,
@@ -178,11 +231,29 @@ class GenerationPrograms:
             if per is None:
                 per = self._stats[key] = {"hits": 0, "misses": 0}
         # program variants count per-site in compile_cache_stats()["by_site"]
-        # — "gen_decode_paged" next to the classic "gen_decode"
+        # — "gen_decode_paged" next to the classic "gen_decode", with the
+        # int8-pool family as its own "_int8"-suffixed site
         site_kind = kind if kernel == "gather" else f"{kind}_{kernel}"
+        if self._kv_dtype == "int8":
+            site_kind = f"{site_kind}_int8"
         _executor._note_cache(hit=hit, site=(site_kind, ("lm",)), key=key)
         with self._lock:
             per["hits" if hit else "misses"] += 1
+        if self._kv_dtype == "int8":
+            next_tokens, last, k, v, ks, vs = self._jit(
+                self._params, cache.k, cache.v, cache.k_scale,
+                cache.v_scale,
+                _np.asarray(tokens, _np.int32),
+                _np.asarray(positions, _np.int32),
+                _np.asarray(lengths, _np.int32),
+                _np.asarray(block_tables, _np.int32),
+                _np.asarray(seeds, _np.uint32),
+                _np.asarray(counters, _np.uint32),
+                _np.asarray(temperature, _np.float32),
+                _np.asarray(top_k, _np.int32),
+                _np.asarray(top_p, _np.float32))
+            cache.swap(k, v, ks, vs)
+            return _np.asarray(next_tokens), last
         next_tokens, last, k, v = self._jit(
             self._params, cache.k, cache.v,
             _np.asarray(tokens, _np.int32), _np.asarray(positions, _np.int32),

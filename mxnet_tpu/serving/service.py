@@ -87,11 +87,23 @@ class _ExecutorAdapter:
     softmax/norm outputs stay f32, and every bucketed executor in the cache
     compiles the low-precision program.  Parameters are SHARED with the
     original executor (the cast happens in-graph), so ``refresh_params``
-    after a weight update keeps working unchanged (docs/amp.md)."""
+    after a weight update keeps working unchanged (docs/amp.md).
+
+    ``quantize="int8"`` (ServingConfig.quantize / TPUMX_QUANT,
+    docs/quantization.md) additionally rewrites the matmul/conv/FC family
+    through :func:`mxnet_tpu.quantization.convert_symbol`: int8 weights
+    quantized ONCE at adapter construction (per-output-channel scales),
+    activations through static calibrated scales when
+    ``quantize_calibration`` carries a table, f32 MXU accumulation — every
+    bucketed executor in the cache then compiles the int8 program with its
+    own compile keys, and ``refresh_params`` re-quantizes from the float
+    executor so weight updates keep flowing."""
 
     def __init__(self, base_exec, data_names: Sequence[str],
                  label_shapes: Optional[Sequence[Tuple[str, Tuple[int, ...]]]] = None,
-                 amp_dtype: Optional[str] = None):
+                 amp_dtype: Optional[str] = None,
+                 quantize: Optional[str] = None,
+                 quantize_calibration=None):
         if amp_dtype:
             from .. import amp as _amp
 
@@ -99,12 +111,38 @@ class _ExecutorAdapter:
             base_exec = conv.bind(
                 ctx=base_exec._ctx, args=base_exec.arg_dict, args_grad=None,
                 grad_req="null", aux_states=base_exec.aux_dict)
+        self._float_base = base_exec
+        self._quantize = quantize
+        self._quant_table = None
+        if quantize:
+            from .. import quantization as _q
+
+            table = quantize_calibration
+            if isinstance(table, str):
+                table = _q.CalibrationTable.load(table)
+            self._quant_table = table
+            base_exec = self._quantized_bind(base_exec, table)
         self._base = base_exec
         self.input_names = list(data_names)
         self._label_shapes = list(label_shapes or [])
         self._cache: Dict[tuple, object] = {}
         self._lock = threading.Lock()
         self.counter = _CompileCounter()
+
+    def _quantized_bind(self, base_exec, table):
+        from .. import nd as _nd
+        from .. import quantization as _q
+
+        sym = base_exec._symbol
+        shapes = {k: tuple(v.shape) for k, v in base_exec.arg_dict.items()}
+        qsym = _q.convert_symbol(sym, table, param_shapes=shapes)
+        qargs = _q.quantize_weights(sym, dict(base_exec.arg_dict),
+                                    table=table)
+        args = {k: (v if hasattr(v, "asnumpy") else _nd.array(v))
+                for k, v in qargs.items()}
+        return qsym.bind(ctx=base_exec._ctx, args=args, args_grad=None,
+                         grad_req="null",
+                         aux_states=dict(base_exec.aux_dict))
 
     def _executor_for(self, sig: tuple):
         with self._lock:
@@ -133,8 +171,23 @@ class _ExecutorAdapter:
 
     def refresh_params(self) -> None:
         """Re-sync parameters from the base executor into every cached bucket
-        executor (call after updating the served model's weights)."""
+        executor (call after updating the served model's weights).  Under
+        ``quantize`` the float executor stays the source of truth: weights
+        re-quantize (same per-channel absmax recipe) into the int8 base
+        first, so a trained update propagates to the served scales too."""
         inputs = set(self.input_names) | {n for n, _ in self._label_shapes}
+        if self._quantize:
+            from .. import nd as _nd
+            from .. import quantization as _q
+
+            qargs = _q.quantize_weights(
+                self._float_base._symbol, dict(self._float_base.arg_dict),
+                table=self._quant_table)
+            params = {n: (v if hasattr(v, "asnumpy") else _nd.array(v))
+                      for n, v in qargs.items() if n not in inputs}
+            self._base.copy_params_from(params,
+                                        dict(self._float_base.aux_dict),
+                                        allow_extra_params=True)
         params = {n: self._base.arg_dict[n]
                   for n in self._base.arg_dict if n not in inputs}
         with self._lock:
@@ -235,7 +288,8 @@ def _jnp(x):
     return jnp.asarray(x)
 
 
-def _make_adapter(model, data_names, amp_dtype=None):
+def _make_adapter(model, data_names, amp_dtype=None, quantize=None,
+                  quantize_calibration=None):
     # duck-typed: Module-likes carry a bound executor + data_names; raw
     # executors carry arg_dict/forward; Gluon blocks carry collect_params
     if hasattr(model, "_exec") and hasattr(model, "data_names"):
@@ -246,10 +300,13 @@ def _make_adapter(model, data_names, amp_dtype=None):
         label_shapes = [(n, tuple(s)) for n, s in (model.label_shapes or [])]
         return _ExecutorAdapter(model._exec,
                                 data_names or model.data_names,
-                                label_shapes, amp_dtype=amp_dtype)
+                                label_shapes, amp_dtype=amp_dtype,
+                                quantize=quantize,
+                                quantize_calibration=quantize_calibration)
     if hasattr(model, "arg_dict") and hasattr(model, "forward"):
         return _ExecutorAdapter(model, data_names or ["data"],
-                                amp_dtype=amp_dtype)
+                                amp_dtype=amp_dtype, quantize=quantize,
+                                quantize_calibration=quantize_calibration)
     if hasattr(model, "collect_params") and callable(model):
         return _BlockAdapter(model)
     if callable(model):
@@ -279,8 +336,10 @@ class InferenceService:
     def __init__(self, model, config: Optional[ServingConfig] = None,
                  data_names: Optional[Sequence[str]] = None):
         self._config = config or ServingConfig()
-        self._adapter = _make_adapter(model, data_names,
-                                      amp_dtype=self._config.amp_dtype)
+        self._adapter = _make_adapter(
+            model, data_names, amp_dtype=self._config.amp_dtype,
+            quantize=self._config.quantize,
+            quantize_calibration=self._config.quantize_calibration)
         self._metrics = ServingMetrics()
         self._batcher = MicroBatcher(self._config, self._metrics)
         self._worker: Optional[threading.Thread] = None

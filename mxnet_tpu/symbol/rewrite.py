@@ -1,0 +1,186 @@
+"""Shared symbolic DAG-rewrite engine (docs/amp.md, docs/quantization.md).
+
+Both graph-rewriting passes in the stack — AMP's casting policy
+(:func:`mxnet_tpu.amp.convert_symbol`) and int8 quantization
+(:func:`mxnet_tpu.quantization.convert_symbol`) — are the same walk: visit
+the DAG in topo order, keep a static *tag* per producing node (a dtype
+state like ``"f32"``/``"bfloat16"``/``"int8"``), insert the MINIMAL set of
+boundary-conversion nodes (``amp_cast`` for AMP, quantize/dequantize for
+int8) with a conversion cache so a value consumed twice at the same tag
+pays one node, and rebuild the symbol with variables shared (names and
+bindings stay stable).  This module is that walk, extracted from
+``amp/convert.py`` — the AMP goldens in tests/test_amp_golden.py pin the
+extraction byte-identical — so each pass only supplies its policy:
+
+- :func:`rewrite_graph` — the tagged topo walk.  The ``visit`` callback
+  sees each op node with its inputs already remapped into the new graph
+  and decides what happens: return ``None`` for a verbatim clone with tag
+  propagation, a ``(inputs, attrs, tag)`` triple for a clone with
+  converted inputs / amended attrs, or a :class:`Replaced` for a full
+  node-replacement (the quantize → quantized-op → dequantize sandwich).
+- :func:`strip_ops` — the inverse pass: drop single-input passthrough
+  nodes by op name (``remove_amp_cast``'s engine), rebuilding only the
+  nodes whose inputs actually changed.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+__all__ = ["PROPAGATE", "Replaced", "RewriteContext", "rewrite_graph",
+           "strip_ops"]
+
+# sentinel out-tag: derive the node's tag from its (new) input tags —
+# one distinct input tag propagates, mixed tags become unknown (None)
+PROPAGATE = object()
+
+
+class Replaced:
+    """A ``visit`` result that substitutes a whole subgraph for the node:
+    ``entries[i]`` stands in for the original node's output ``i``."""
+
+    __slots__ = ("entries", "tag")
+
+    def __init__(self, entries, tag=None):
+        self.entries = list(entries)
+        self.tag = tag
+
+
+class RewriteContext:
+    """Walk state handed to the ``visit`` policy: per-node tags, the
+    entry remap, and the cached boundary-conversion inserter."""
+
+    def __init__(self, make_conversion: Optional[Callable], default_tag):
+        self._make = make_conversion
+        self.default_tag = default_tag
+        self.entry_map: Dict[tuple, object] = {}
+        self._tag: Dict[int, Optional[str]] = {}
+        self._cache: Dict[tuple, object] = {}
+        self.counter = 0
+
+    def tag_of(self, entry) -> Optional[str]:
+        """The producing node's tag (None = unknown)."""
+        return self._tag.get(id(entry.node))
+
+    def set_tag(self, node, tag) -> None:
+        self._tag[id(node)] = tag
+
+    def convert(self, entry, tag):
+        """Insert (or reuse) a boundary conversion of ``entry`` to ``tag``.
+
+        Cached per ``(producer, output index, tag)`` — a chain of
+        same-policy consumers pays ONE conversion node, the minimal-cast
+        property the AMP tests assert.  The policy's ``make_conversion``
+        builds the node and names it from the running ordinal (the
+        ordinal only advances on cache misses, keeping generated names
+        dense and deterministic)."""
+        from .graph import SymbolEntry
+
+        key = (id(entry.node), entry.index, tag)
+        ent = self._cache.get(key)
+        if ent is None:
+            self.counter += 1
+            node, node_tag = self._make(entry, tag, self.counter)
+            self.set_tag(node, node_tag)
+            ent = SymbolEntry(node, 0)
+            self._cache[key] = ent
+        return ent
+
+
+def rewrite_graph(symbol, visit: Callable, *,
+                  make_conversion: Optional[Callable] = None,
+                  var_tag: Optional[Callable] = None,
+                  default_tag: str = "f32"):
+    """Rebuild ``symbol`` under a tagged-walk rewrite policy.
+
+    Parameters
+    ----------
+    visit : callable(node, inputs, ctx)
+        Called for every op node with ``inputs`` already remapped into
+        the new graph.  Returns ``None`` (verbatim clone, tag
+        propagation), ``(inputs, attrs, tag)`` (clone with those inputs
+        and attrs; ``tag`` may be :data:`PROPAGATE`), or a
+        :class:`Replaced`.
+    make_conversion : callable(entry, tag, ordinal) -> (Node, node_tag)
+        Builds one boundary-conversion node (see
+        :meth:`RewriteContext.convert`).
+    var_tag : callable(node) -> tag
+        Tag for variable nodes (default: ``default_tag`` — simple_bind
+        creates f32 variables unless overridden, and a mis-tagged
+        variable costs at worst a redundant conversion, never a wrong
+        result).
+    default_tag : str
+        The tag assumed for nodes with no inputs.
+
+    Variables are SHARED with the input symbol (names/bindings stay
+    stable); every op node is cloned.  The input symbol is left
+    untouched.
+    """
+    from .graph import Node, SymbolEntry, topo_order
+    from .symbol import Symbol
+
+    ctx = RewriteContext(make_conversion, default_tag)
+
+    def mapped(e: "SymbolEntry") -> "SymbolEntry":
+        return ctx.entry_map[(id(e.node), e.index)]
+
+    for node in topo_order(symbol._entries):
+        if node.kind == "var":
+            ctx.entry_map[(id(node), 0)] = SymbolEntry(node, 0)
+            ctx.set_tag(node, var_tag(node) if var_tag is not None
+                        else default_tag)
+            continue
+        new_inputs = [mapped(e) for e in node.inputs]
+        result = visit(node, new_inputs, ctx)
+        if isinstance(result, Replaced):
+            for i, ent in enumerate(result.entries):
+                ctx.entry_map[(id(node), i)] = ent
+                ctx.set_tag(ent.node, result.tag)
+            continue
+        if result is None:
+            attrs, out_tag = dict(node.attrs), PROPAGATE
+        else:
+            new_inputs, attrs, out_tag = result
+        if out_tag is PROPAGATE:
+            in_tags = {ctx.tag_of(e) for e in new_inputs} or {default_tag}
+            out_tag = in_tags.pop() if len(in_tags) == 1 else None
+        new_node = Node("op", node.name, op=node.op, attrs=attrs,
+                        inputs=new_inputs, attr_dict=dict(node.attr_dict))
+        for i in range(new_node.num_outputs()):
+            ctx.entry_map[(id(node), i)] = SymbolEntry(new_node, i)
+        ctx.set_tag(new_node, out_tag)
+    return Symbol([mapped(e) for e in symbol._entries])
+
+
+def strip_ops(symbol, op_names: Sequence[str]):
+    """Drop every single-input passthrough node whose op name is in
+    ``op_names``, wiring consumers to the stripped node's input —
+    ``remove_amp_cast``'s engine, reusable for any inserted-boundary op
+    family.  Returns the input symbol unchanged when nothing matched."""
+    from .graph import Node, SymbolEntry, topo_order
+    from .symbol import Symbol
+
+    names = frozenset(op_names)
+    entry_map: Dict[tuple, SymbolEntry] = {}
+
+    def mapped(e: SymbolEntry) -> SymbolEntry:
+        return entry_map.get((id(e.node), e.index), e)
+
+    changed = False
+    for node in topo_order(symbol._entries):
+        if node.kind == "var":
+            continue
+        if node.op.name in names:
+            entry_map[(id(node), 0)] = mapped(node.inputs[0])
+            changed = True
+            continue
+        new_inputs = [mapped(e) for e in node.inputs]
+        if any(a.node is not b.node or a.index != b.index
+               for a, b in zip(new_inputs, node.inputs)):
+            new_node = Node("op", node.name, op=node.op,
+                            attrs=dict(node.attrs), inputs=new_inputs,
+                            attr_dict=dict(node.attr_dict))
+            for i in range(new_node.num_outputs()):
+                entry_map[(id(node), i)] = SymbolEntry(new_node, i)
+    if not changed:
+        return symbol
+    return Symbol([mapped(e) for e in symbol._entries])

@@ -21,6 +21,17 @@ def _param_shape_rule(op_name: str, slot: str, attrs: dict,
                       in_shapes: List[Tuple[int, ...]]) -> Tuple[int, ...]:
     """Shape of a learnable/aux input given the data input shapes."""
     data = in_shapes[0]
+    if op_name in ("_tpumx_quantized_fc_int8", "_tpumx_quantized_conv_int8"):
+        # int8 twins (docs/quantization.md): data_q mirrors the float data
+        # shape, weight follows the float op's rule, wscale/bias are
+        # per-output-channel, act_scale is the quantize node's (1,) output
+        if slot == "act_scale":
+            return (1,)
+        base = ("FullyConnected" if op_name == "_tpumx_quantized_fc_int8"
+                else "Convolution")
+        if slot == "weight":
+            return _param_shape_rule(base, "weight", attrs, in_shapes)
+        return _param_shape_rule(base, "bias", attrs, in_shapes)
     if op_name == "FullyConnected":
         nh = int(attrs["num_hidden"])
         flat = 1

@@ -105,6 +105,13 @@ def pytest_configure(config):
         "checkpointing + mxnet_tpu.fault preemption/injection, kvstore "
         "retry/backoff, serving graceful shutdown; "
         "docs/fault_tolerance.md; select with `pytest -m fault`)")
+    config.addinivalue_line(
+        "markers",
+        "quantization: int8 serving density (mxnet_tpu.quantization — "
+        "calibration tables, the shared-rewrite-engine int8 graph "
+        "conversion, ServingConfig.quantize, and the int8 paged KV "
+        "cache; docs/quantization.md; select with "
+        "`pytest -m quantization`)")
 
 
 def pytest_collection_modifyitems(config, items):

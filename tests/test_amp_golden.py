@@ -1,0 +1,129 @@
+"""AMP refactor parity guard (docs/amp.md, docs/quantization.md).
+
+``amp.convert_symbol`` + ``amp.remove_amp_cast`` must produce BYTE-IDENTICAL
+graph JSON for a transformer and a ResNet test symbol against the checked-in
+golden files under tests/golden/.  The casting walk was extracted into the
+shared rewrite engine (mxnet_tpu/symbol/rewrite.py) that quantization drives
+too — these goldens were generated from the pre-refactor implementation, so
+the extraction (and any future engine change) can never silently change AMP
+behavior.
+
+Regenerate (only when an INTENTIONAL policy change lands, with a matching
+changelog entry) with ``REGEN_AMP_GOLDENS=1 pytest tests/test_amp_golden.py``.
+"""
+import os
+
+import pytest
+
+from mxnet_tpu import amp, sym
+
+pytestmark = pytest.mark.amp
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "golden")
+
+
+def _transformer_test_symbol(d_model=32, n_heads=4, d_ff=64, vocab=50):
+    """A one-block decoder transformer, every node explicitly named so the
+    serialized JSON is deterministic across test orderings."""
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    x = sym.Embedding(data, sym.Variable("tok_emb_weight"),
+                      input_dim=vocab, output_dim=d_model, name="tok_emb")
+    h = sym.LayerNorm(x, sym.Variable("ln1_gamma"), sym.Variable("ln1_beta"),
+                      name="ln1")
+    q = sym.FullyConnected(h, num_hidden=d_model, flatten=False, name="wq")
+    k = sym.FullyConnected(h, num_hidden=d_model, flatten=False, name="wk")
+    v = sym.FullyConnected(h, num_hidden=d_model, flatten=False, name="wv")
+    scores = sym.batch_dot(q, k, transpose_b=True, name="attn_scores")
+    p = sym.softmax(scores, axis=-1, name="attn_softmax")
+    o = sym.batch_dot(p, v, name="attn_out")
+    proj = sym.FullyConnected(o, num_hidden=d_model, flatten=False,
+                              name="wo")
+    x = sym.elemwise_add(x, proj, name="res1")
+    h = sym.LayerNorm(x, sym.Variable("ln2_gamma"), sym.Variable("ln2_beta"),
+                      name="ln2")
+    f = sym.Activation(sym.FullyConnected(h, num_hidden=d_ff, flatten=False,
+                                          name="ffn_in"),
+                       act_type="relu", name="ffn_act")
+    f = sym.FullyConnected(f, num_hidden=d_model, flatten=False,
+                           name="ffn_out")
+    x = sym.elemwise_add(x, f, name="res2")
+    logits = sym.FullyConnected(x, num_hidden=vocab, flatten=False,
+                                name="lm_head")
+    return sym.SoftmaxOutput(logits, label, name="softmax")
+
+
+def _resnet_test_symbol(classes=10):
+    """A two-unit residual stack (conv/BN/relu + identity shortcuts) —
+    exercises the aux-input BatchNorm rule, conv chains, and the pooled
+    FC/softmax tail."""
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+
+    def conv_bn_relu(x, name, num_filter, kernel, pad, relu=True):
+        c = sym.Convolution(x, kernel=kernel, num_filter=num_filter,
+                            pad=pad, no_bias=True, name=f"{name}_conv")
+        b = sym.BatchNorm(c, name=f"{name}_bn")
+        return sym.Activation(b, act_type="relu", name=f"{name}_relu") \
+            if relu else b
+
+    x = conv_bn_relu(data, "stem", 8, (3, 3), (1, 1))
+    for i in range(2):
+        body = conv_bn_relu(x, f"u{i}a", 8, (3, 3), (1, 1))
+        body = conv_bn_relu(body, f"u{i}b", 8, (3, 3), (1, 1), relu=False)
+        x = sym.Activation(sym.elemwise_add(x, body, name=f"u{i}_add"),
+                           act_type="relu", name=f"u{i}_relu")
+    x = sym.Pooling(x, global_pool=True, pool_type="avg", kernel=(1, 1),
+                    name="gap")
+    x = sym.Flatten(x, name="flat")
+    logits = sym.FullyConnected(x, num_hidden=classes, name="fc")
+    return sym.SoftmaxOutput(logits, label, name="softmax")
+
+
+_CASES = [
+    ("transformer", _transformer_test_symbol),
+    ("resnet", _resnet_test_symbol),
+]
+
+
+def _check_golden(name: str, json_str: str):
+    path = os.path.join(GOLDEN_DIR, name)
+    if os.environ.get("REGEN_AMP_GOLDENS") == "1":
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(json_str)
+        pytest.skip(f"regenerated {path}")
+    assert os.path.exists(path), (
+        f"golden file {path} missing — generate once with "
+        "REGEN_AMP_GOLDENS=1 from a known-good implementation")
+    with open(path) as f:
+        golden = f.read()
+    assert json_str == golden, (
+        f"amp graph JSON drifted from {name}: the shared rewrite engine "
+        "changed convert_symbol/remove_amp_cast behavior (byte-level "
+        "comparison; regenerate the golden ONLY for an intentional policy "
+        "change)")
+
+
+@pytest.mark.parametrize("name,make", _CASES)
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+def test_convert_symbol_matches_golden(name, make, dtype):
+    conv = amp.convert_symbol(make(), dtype)
+    _check_golden(f"amp_{name}_{dtype}.json", conv.tojson())
+
+
+@pytest.mark.parametrize("name,make", _CASES)
+def test_remove_amp_cast_matches_golden(name, make):
+    stripped = amp.remove_amp_cast(amp.convert_symbol(make(), "bfloat16"))
+    _check_golden(f"amp_{name}_stripped.json", stripped.tojson())
+
+
+@pytest.mark.parametrize("name,make", _CASES)
+def test_strip_is_semantically_lossless(name, make):
+    """Beyond the goldens: stripping a converted graph leaves zero casts and
+    the argument list of the ORIGINAL symbol."""
+    base = make()
+    stripped = amp.remove_amp_cast(amp.convert_symbol(base, "bfloat16"))
+    assert amp.count_amp_casts(stripped) == 0
+    assert stripped.list_arguments() == base.list_arguments()

@@ -303,6 +303,58 @@ def build_cases():
               ("pallas_flash_attention_bwd", pallas_flash_bwd),
               ("pallas_layer_norm_fused", pallas_layer_norm),
               ("pallas_paged_attention", pallas_paged)]
+
+    # int8 quantization family (docs/quantization.md): the serving
+    # quantize/dequantize kernels and the quantized FC/conv twins run as
+    # plain registered ops on both backends...
+    x_q = rng.randn(4, 16).astype(np.float32)
+    w_q = (rng.randn(6, 16) * 0.2).astype(np.float32)
+    ws_q = (np.abs(w_q).max(axis=1) / 127.0).astype(np.float32)
+    wq_q = np.clip(np.round(w_q / ws_q[:, None]), -127, 127).astype(np.int8)
+    img_q = rng.randn(2, 4, 8, 8).astype(np.float32)
+    ck_q = (rng.randn(3, 4, 3, 3) * 0.1).astype(np.float32)
+    cks_q = (np.abs(ck_q).reshape(3, -1).max(axis=1) / 127.0).astype(
+        np.float32)
+    ckq_q = np.clip(np.round(ck_q / cks_q[:, None, None, None]), -127,
+                    127).astype(np.int8)
+
+    cases += [
+        ("quantize_dequantize_int8", case(
+            lambda x: nd._tpumx_dequantize_int8(
+                *nd._tpumx_quantize_int8(x, scale=0.05)), x_q)),
+        ("quantized_fc_int8", case(
+            lambda x, w, s, b: nd._tpumx_quantized_fc_int8(
+                *nd._tpumx_quantize_int8(x), w, s, b, num_hidden=6),
+            x_q, wq_q, ws_q, np.zeros(6, np.float32))),
+        ("quantized_conv_int8", case(
+            lambda x, w, s: nd._tpumx_quantized_conv_int8(
+                *nd._tpumx_quantize_int8(x), w, s, kernel=(3, 3),
+                num_filter=3, pad=(1, 1), no_bias=True),
+            img_q, ckq_q, cks_q)),
+    ]
+
+    # ...and the INT8-POOL paged-attention variant joins the Pallas
+    # two-backend sweep with the same leg-forcing pattern as the PR 9
+    # entries: per-(block, head) scales ride the scalar-prefetch/VMEM
+    # path next to the block tables.
+    kq_paged = rng.randint(-127, 128, kp_paged.shape).astype(np.int8)
+    vq_paged = rng.randint(-127, 128, vp_paged.shape).astype(np.int8)
+    ks_paged = (np.abs(rng.randn(8, 2)) * 0.02 + 0.01).astype(np.float32)
+    vs_paged = (np.abs(rng.randn(8, 2)) * 0.02 + 0.01).astype(np.float32)
+
+    def pallas_paged_int8():
+        from mxnet_tpu.ops import paged_attention as pa
+
+        def body(put):
+            out = pa.paged_attention(
+                put(q_paged), put(kq_paged), put(vq_paged), put(tbl_paged),
+                put(pos_paged), put(maxpos_paged),
+                k_scale=put(ks_paged), v_scale=put(vs_paged))
+            return [np.asarray(out)]
+
+        return _pallas_leg(body)
+
+    cases += [("pallas_paged_attention_int8", pallas_paged_int8)]
     return cases
 
 
