@@ -95,7 +95,9 @@ class GenerationConfig:
                  watermark_high: Optional[float] = None,
                  watermark_low: Optional[float] = None,
                  admission_budget: Optional[float] = None,
-                 kv_dtype: Optional[str] = "__env__"):
+                 kv_dtype: Optional[str] = "__env__",
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_blocks: Optional[int] = None):
         self.max_slots = int(max_slots if max_slots is not None
                              else getenv("TPUMX_GEN_SLOTS", 4))
         if self.max_slots < 1:
@@ -191,6 +193,22 @@ class GenerationConfig:
             else getenv("TPUMX_GEN_ADMISSION_BUDGET", 4.0))
         if self.admission_budget <= 0:
             raise ValueError("admission_budget must be > 0")
+        # prefix caching (docs/generation.md "prefix caching"): hash
+        # prompt tokens per block, share read-only resident KV blocks
+        # across requests with refcounts + copy-on-write, and prefill
+        # only the uncached suffix through the existing chunk rungs.
+        # =0 restores today's behavior byte-for-byte (program keys and
+        # tokens bitwise).
+        self.prefix_cache = bool(
+            prefix_cache if prefix_cache is not None
+            else getenv("TPUMX_GEN_PREFIX_CACHE", True))
+        # reserve cap on blocks the index may keep resident (0 = bounded
+        # only by the pool + watermark eviction)
+        self.prefix_cache_blocks = int(
+            prefix_cache_blocks if prefix_cache_blocks is not None
+            else getenv("TPUMX_GEN_PREFIX_CACHE_BLOCKS", 0))
+        if self.prefix_cache_blocks < 0:
+            raise ValueError("prefix_cache_blocks must be >= 0")
 
     def __repr__(self):
         return (f"GenerationConfig(max_slots={self.max_slots}, "
@@ -201,7 +219,8 @@ class GenerationConfig:
                 f"backpressure={self.backpressure!r}, "
                 f"amp_dtype={self.amp_dtype!r}, "
                 f"kv_dtype={self.kv_dtype!r}, "
-                f"preemption={self.preemption})")
+                f"preemption={self.preemption}, "
+                f"prefix_cache={self.prefix_cache})")
 
 
 class _GenRequest:
@@ -216,7 +235,8 @@ class _GenRequest:
                  "n_preempted", "n_requeues", "trace", "seg_state",
                  "seg_t0", "breakdown", "breakdown_first", "rung_s",
                  "decode_steps", "n_retries", "token_log", "wide_event",
-                 "lock")
+                 "lock", "cached_len", "cached_total", "cow_copies",
+                 "charged_blocks")
 
     def __init__(self, rid, prompt, bucket, max_new, temperature, top_k,
                  top_p, seed, eos_token, deadline, on_token, priority=0):
@@ -248,6 +268,13 @@ class _GenRequest:
         self.admit_seq = -1        # admission recency, keys victim order
         self.n_preempted = 0       # watermark/growth preemptions survived
         self.n_requeues = 0        # error-path requeues consumed
+        # prefix caching (docs/generation.md): tokens served from shared
+        # blocks at the LAST admission / over the request's lifetime, CoW
+        # copies taken, and the overload estimator's projected charge
+        self.cached_len = 0
+        self.cached_total = 0
+        self.cow_copies = 0
+        self.charged_blocks = 0
         # latency attribution (docs/observability.md): the request's
         # lifetime is partitioned into contiguous segments — queue,
         # admission, prefill, decode, preempted — whose transition points
@@ -381,6 +408,7 @@ class GenerationStream:
             n_generated, decode_steps = r.n_generated, r.decode_steps
             preemptions, requeues = r.n_preempted, r.n_requeues
             retries = r.n_retries
+            cached_total, cow_copies = r.cached_total, r.cow_copies
         return {
             "type": "generation_request",
             "request_id": r.rid,
@@ -405,6 +433,8 @@ class GenerationStream:
             "preemptions": preemptions,
             "requeues": requeues,
             "retries": retries,
+            "prefix_cached_tokens": cached_total,
+            "cow_copies": cow_copies,
             "token_offsets_ms": [round((t - r.t_submit) * 1e3, 3)
                                  for t in token_log],
         }
@@ -445,6 +475,16 @@ class GenerationService:
             kv_dtype=cfg.kv_dtype)
         self._cache.allocator.set_watermarks(cfg.watermark_high,
                                              cfg.watermark_low)
+        # prefix caching (docs/generation.md "prefix caching"): the chain-
+        # hash index over resident full blocks.  None with the gate off —
+        # every code path below then stays byte-identical to pre-cache
+        # behavior (program keys, admission accounting, tokens).
+        from .prefix_cache import PrefixCacheIndex
+        self._prefix = (PrefixCacheIndex(
+            self._cache.allocator, cfg.block_size,
+            capacity_blocks=cfg.prefix_cache_blocks)
+            if cfg.prefix_cache else None)
+        self._pc_evictions_seen = 0
         self._programs = GenerationPrograms(params, model_cfg,
                                             compute_dtype=compute_dtype,
                                             mp_devices=cfg.mp_devices,
@@ -489,7 +529,10 @@ class GenerationService:
         self._counts = {"submitted": 0, "finished": 0, "cancelled": 0,
                         "failed": 0, "rejected": 0, "expired": 0,
                         "shed": 0, "tokens": 0, "preempted": 0,
-                        "requeued": 0, "quarantined": 0, "step_failures": 0}
+                        "requeued": 0, "quarantined": 0, "step_failures": 0,
+                        "prefix_hits": 0, "prefix_misses": 0,
+                        "prefix_evictions": 0, "cached_tokens": 0,
+                        "prefill_tokens": 0, "cow_copies": 0}
         self._peak_occupancy = 0.0
         self._ttft: "deque[float]" = deque(maxlen=4096)
         self._itl: "deque[float]" = deque(maxlen=4096)
@@ -526,6 +569,29 @@ class GenerationService:
             help="decode-step program invocations that raised")
         self._h_ttft = reg.histogram("generation_ttft_seconds")
         self._h_itl = reg.histogram("generation_inter_token_seconds")
+        self._c_pc_hits = reg.counter(
+            "generation_prefix_cache_hits_total",
+            help="admissions whose prompt matched >= 1 cached full block "
+                 "(prefill runs only the uncached suffix)")
+        self._c_pc_misses = reg.counter(
+            "generation_prefix_cache_misses_total",
+            help="admissions that matched nothing in the prefix index")
+        self._c_pc_evict = reg.counter(
+            "generation_prefix_cache_evictions_total",
+            help="cache-only blocks dropped from the prefix index "
+                 "(LRU, ahead of victim preemption)")
+        self._c_pc_tokens = reg.counter(
+            "generation_prefix_cached_tokens_total",
+            help="prompt tokens served from shared blocks instead of "
+                 "being re-prefilled")
+        self._g_blocks_shared = reg.gauge(
+            "generation_kv_blocks_shared",
+            help="pool blocks held by more than one owner "
+                 "(BlockAllocator.num_shared) — the shared/exclusive "
+                 "split of the occupancy gauges")
+        self._g_pc_blocks = reg.gauge(
+            "generation_prefix_cache_blocks",
+            help="blocks currently resident in the prefix index")
 
     # -- submission ---------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -580,6 +646,14 @@ class GenerationService:
             raise ValueError(
                 f"request needs {need} cache blocks but the pool only has "
                 f"{cfg.num_blocks - 1} allocatable")
+        # overload accounting with the prefix cache on: blocks the index
+        # would serve are not new demand — charge only the projected
+        # uncached suffix plus one block of copy-on-write slack
+        charge = need
+        if self._prefix is not None:
+            cached_blocks = self._prefix.peek(prompt) // cfg.block_size
+            if cached_blocks:
+                charge = max(1, need - cached_blocks + 1)
         eos = cfg.eos_token if eos_token == "__config__" else (
             None if eos_token is None else int(eos_token))
         ms = deadline_ms if deadline_ms is not None \
@@ -598,7 +672,7 @@ class GenerationService:
                 # BEFORE the pool thrashes, not when the queue fills
                 if len(self._waiting) >= cfg.queue_bound:
                     return f"generation queue bound {cfg.queue_bound} reached"
-                projected = self._projected_blocks_locked() + need
+                projected = self._projected_blocks_locked() + charge
                 if projected > budget:
                     return (f"projected KV demand {projected} blocks exceeds "
                             f"admission budget {budget:.0f} "
@@ -634,6 +708,7 @@ class GenerationService:
                               bucket, max_new, temperature, top_k, top_p,
                               seed, eos, deadline, on_token,
                               priority=priority)
+            req.charged_blocks = charge
             if _trace.enabled():
                 req.trace = (trace_ctx or _trace.current_trace()
                              or _trace.new_trace())
@@ -702,6 +777,11 @@ class GenerationService:
                     zeros_s.astype(_np.uint32), zeros_s.astype(_np.uint32),
                     zeros_s.astype(_np.float32), zeros_s,
                     _np.ones(S, _np.float32))
+            if self._prefix is not None:
+                # the CoW block copy is part of the steady-state set;
+                # copying the reserved null block onto itself warms it
+                # without touching real cache state
+                self._programs.copy_block(self._cache, 0, 0)
         _obs.mark_warm()
         return self._programs.compiled_signatures() - before
 
@@ -728,6 +808,10 @@ class GenerationService:
             self._not_full.notify_all()
         if started:
             self._worker.join(timeout)
+        if self._prefix is not None:
+            # release the cache's own block references (blocks still held
+            # by live requests merely lose their shared status)
+            self._prefix.drop_all()
         self.uninstall_signal_handlers()
 
     drain_and_stop = stop
@@ -925,14 +1009,44 @@ class GenerationService:
                 if r.priority > head.priority:
                     best_i, head = j, r
             need = self._admit_need(head)
+            # prefix cache (docs/generation.md): take shared references on
+            # the longest cached full-block prefix; only the uncached
+            # remainder is new allocation
+            shared: List[int] = []
+            cached = 0
+            if self._prefix is not None:
+                ctx = head.ctx_len if head.ctx_len > 0 else head.prompt_len
+                shared, cached = self._prefix.acquire(head.seq_tokens[:ctx])
+            grow = need - len(shared)
             if cfg.preemption and any(s is not None for s in self._slots) \
-                    and alloc.num_used + need > cfg.watermark_high * total:
-                break  # keep the growth headroom; readmit later
-            blocks = self._cache.allocator.allocate(need)
+                    and alloc.num_used + grow > cfg.watermark_high * total:
+                # cache-only blocks are reclaimable headroom: evict before
+                # concluding the pool is too full to admit
+                over = alloc.num_used + grow - cfg.watermark_high * total
+                if self._prefix is not None and over > 0:
+                    self._prefix.evict_blocks(int(over) + 1)
+                if alloc.num_used + grow > cfg.watermark_high * total:
+                    if shared:
+                        alloc.decref(shared)
+                    break  # keep the growth headroom; readmit later
+            blocks = self._alloc_reclaiming(grow)
             if blocks is None:
+                if shared:
+                    alloc.decref(shared)
                 break
             del self._waiting[best_i]
-            head.blocks = blocks
+            head.blocks = shared + blocks
+            head.cached_len = cached
+            head.cached_total += cached
+            if self._prefix is not None:
+                if cached:
+                    self._counts["prefix_hits"] += 1
+                    self._counts["cached_tokens"] += cached
+                    self._c_pc_hits.inc()
+                    self._c_pc_tokens.inc(cached)
+                else:
+                    self._counts["prefix_misses"] += 1
+                    self._c_pc_misses.inc()
             head.state = _RUNNING
             head.admit_seq = self._admit_seq
             self._admit_seq += 1
@@ -952,6 +1066,50 @@ class GenerationService:
                           "replica": self._replica_id})
             self._not_full.notify_all()
         return admitted
+
+    def _alloc_reclaiming(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` blocks, reclaiming cache-only prefix blocks
+        (LRU) when the free list alone cannot cover it — the cache yields
+        to live demand BEFORE any running request is preempted.  Safe
+        with or without the service lock: allocator and index carry their
+        own locks."""
+        alloc = self._cache.allocator
+        got = alloc.allocate(n)
+        if got is None and self._prefix is not None:
+            self._prefix.evict_blocks(int(n) - alloc.num_free)
+            got = alloc.allocate(n)
+        return got
+
+    def _cow_for_write(self, r: _GenRequest, off: int, take: int) -> None:
+        """Copy-on-write (docs/generation.md "prefix caching"): before a
+        scatter into positions ``[off, off + take)``, any target block
+        with ``refcount > 1`` (shared prompt history) is replaced by a
+        private in-program copy — writers never touch shared blocks, and
+        sharers' logits are bit-identical before and after the append.
+        Runs on the engine thread with no service lock held."""
+        if self._prefix is None or take <= 0:
+            return
+        bs = self._config.block_size
+        alloc = self._cache.allocator
+        for li in range(off // bs, (off + take - 1) // bs + 1):
+            if li >= len(r.blocks):
+                break
+            b = r.blocks[li]
+            if alloc.refcount(b) <= 1:
+                continue
+            fresh = self._alloc_reclaiming(1)
+            if fresh is None:
+                raise ServingError(
+                    f"KV pool exhausted allocating a copy-on-write block "
+                    f"for request {r.rid} (shared block {b})")
+            with _obs.span("serving.cow_copy", cat="serving",
+                           args={"rid": r.rid, "src": int(b),
+                                 "dst": int(fresh[0])}, ctx=r.trace):
+                self._programs.copy_block(self._cache, b, fresh[0])
+            r.blocks[li] = fresh[0]
+            alloc.decref([b])
+            r.cow_copies += 1
+            self._counts["cow_copies"] += 1
 
     def _pick_victim_locked(self) -> Optional[int]:
         """Victim slot for preemption: lowest priority class first, then
@@ -980,6 +1138,15 @@ class GenerationService:
                              "kind": counter}, ctx=r.trace):
             self._slots[i] = None
             if r.blocks:
+                # a preempted request's written context is valid history:
+                # index its full blocks so the decref below leaves them
+                # RESIDENT (cache-held) and the re-prefill on re-admission
+                # re-hits them — resumed TTFT collapses too.  The error-
+                # requeue path ("requeued") skips this: a failing step may
+                # have left the blocks suspect.
+                if self._prefix is not None and counter == "preempted" \
+                        and r.ctx_len > 0:
+                    self._prefix.insert(r.seq_tokens[:r.ctx_len], r.blocks)
                 self._cache.allocator.free(r.blocks)
                 r.blocks = None
             r.state = _WAITING
@@ -1000,6 +1167,15 @@ class GenerationService:
         alloc = self._cache.allocator
         if not alloc.above_high():
             return
+        # cache-only blocks go first (docs/generation.md "prefix
+        # caching"): LRU eviction of index-held blocks ahead of victim
+        # preemption — dropping reusable history is strictly cheaper than
+        # re-prefilling a live request
+        if self._prefix is not None:
+            while alloc.above_low() and self._prefix.evict_blocks(1):
+                pass
+            if not alloc.above_high():
+                return
         while alloc.above_low():
             if sum(1 for r in self._slots
                    if r is not None and r.state == _RUNNING) <= 1:
@@ -1016,7 +1192,6 @@ class GenerationService:
         victim policy's pick; when the grower IS the pick, it preempts
         itself (it is the newest/lowest — latecomers yield)."""
         cfg = self._config
-        alloc = self._cache.allocator
         order = sorted(
             (i for i, r in enumerate(self._slots)
              if r is not None and r.state == _RUNNING),
@@ -1027,7 +1202,7 @@ class GenerationService:
                 continue  # preempted by an earlier grower this pass
             need = blocks_for(r.ctx_len + 1, cfg.block_size)
             while len(r.blocks) < need:
-                got = alloc.allocate(need - len(r.blocks))
+                got = self._alloc_reclaiming(need - len(r.blocks))
                 if got is not None:
                     r.blocks.extend(got)
                     break
@@ -1039,14 +1214,20 @@ class GenerationService:
 
     def _projected_blocks_locked(self) -> int:
         """Worst-case KV demand of everything queued + running — the
-        overload estimator's input (docs/generation.md)."""
+        overload estimator's input (docs/generation.md).  With the prefix
+        cache on, each request carries its submit-time charge: worst case
+        minus the blocks the index projected to serve, plus CoW slack —
+        a shared-prompt burst no longer rejects on demand the pool never
+        actually sees.  Cache off: charge == the full worst case."""
         bs = self._config.block_size
         total = 0
         for r in self._waiting:
-            total += blocks_for(r.prompt_len + r.max_new, bs)
+            total += (r.charged_blocks
+                      or blocks_for(r.prompt_len + r.max_new, bs))
         for r in self._slots:
             if r is not None:
-                total += blocks_for(r.prompt_len + r.max_new, bs)
+                total += (r.charged_blocks
+                          or blocks_for(r.prompt_len + r.max_new, bs))
         return total
 
     def _release_slot_locked(self, i: int, reason: str = _FINISHED,
@@ -1054,6 +1235,12 @@ class GenerationService:
         r = self._slots[i]
         self._slots[i] = None
         if r.blocks:
+            # keep a finished request's full blocks resident for the next
+            # shared-prompt arrival (only clean completions: an errored
+            # request's cache state is suspect)
+            if self._prefix is not None and reason == _FINISHED \
+                    and error is None and r.ctx_len > 0:
+                self._prefix.insert(r.seq_tokens[:r.ctx_len], r.blocks)
             self._cache.allocator.free(r.blocks)
             r.blocks = None
         self._finish_locked(r, reason=reason, error=error)
@@ -1115,12 +1302,15 @@ class GenerationService:
             "preemptions": r.n_preempted,
             "requeues": r.n_requeues,
             "retries": r.n_retries,
+            "prefix_cached_tokens": r.cached_total,
+            "cow_copies": r.cow_copies,
             "token_offsets_ms": [round((t - r.t_submit) * 1e3, 3)
                                  for t in r.token_log],
         }
 
     # -- model steps (engine thread, no lock held) --------------------------------
-    def _chunk_plan(self, prompt_len: int, force_chunked: bool = False):
+    def _chunk_plan(self, prompt_len: int, force_chunked: bool = False,
+                    start: int = 0):
         """Prefill chunking (docs/generation.md): ``[(off, take, T, W)]``.
 
         A single entry is the legacy path — whole prompt padded to its
@@ -1137,9 +1327,28 @@ class GenerationService:
         request's context can exceed the prompt ladder, and must chunk
         even when ``chunked_prefill`` is off): the rung walk is used for
         any length past the smallest rung.
+
+        ``start`` is the prefix-cache spelling (docs/generation.md
+        "prefix caching"): positions ``[0, start)`` are already resident
+        in shared blocks, so the walk covers only the uncached suffix —
+        re-bucketed onto the SAME (T, W) ladder, which is why a cache hit
+        mints no new program shapes.
         """
         cfg = self._config
         rungs = self._seq_buckets
+        if start > 0:
+            chunks = []
+            off = start
+            while off < prompt_len:
+                rem = prompt_len - off
+                fitting = [b for b in rungs if b <= rem]
+                tb = fitting[-1] if fitting else rungs[0]
+                take = min(rem, tb)
+                w = bucket_batch(blocks_for(off + tb, cfg.block_size),
+                                 self._width_buckets)
+                chunks.append((off, take, tb, w))
+                off += take
+            return chunks
         chunked = cfg.chunked_prefill or force_chunked
         if not chunked or prompt_len <= rungs[0]:
             tb = bucket_seq_len(prompt_len, rungs)
@@ -1179,6 +1388,33 @@ class GenerationService:
             for L in range(1, self._model_cfg.max_len):
                 for (_, _, tb, w) in self._chunk_plan(L, force_chunked=True):
                     out.add((tb, w))
+        if cfg.prefix_cache:
+            # cache-hit suffixes (docs/generation.md "prefix caching"):
+            # the rung walk from every block-aligned cached length to
+            # every context length — memoized on (off, remaining) so the
+            # whole enumeration is one pass over reachable walk states
+            bs = cfg.block_size
+            max_ctx = self._model_cfg.max_len - 1
+            seen = set()
+            for start in range(bs, max_ctx, bs):
+                for ctx in range(start + 1, max_ctx + 1):
+                    off, rem = start, ctx - start
+                    while rem > 0 and (off, rem) not in seen:
+                        seen.add((off, rem))
+                        fitting = [b for b in self._seq_buckets if b <= rem]
+                        tb = fitting[-1] if fitting else self._seq_buckets[0]
+                        take = min(rem, tb)
+                        out.add((tb, bucket_batch(
+                            blocks_for(off + tb, bs), self._width_buckets)))
+                        off += take
+                        rem -= take
+            # fully-cached prompts: the single-token logit recompute at
+            # position p-1 (only block-aligned prompt lengths can be
+            # fully cached, and fresh prompts are bounded by the ladder)
+            tb0 = self._seq_buckets[0]
+            for p in range(bs, self._seq_buckets[-1] + 1, bs):
+                out.add((tb0, bucket_batch(blocks_for(p - 1 + tb0, bs),
+                                           self._width_buckets)))
         return sorted(out)
 
     def _prefill(self, r: _GenRequest) -> None:
@@ -1192,18 +1428,49 @@ class GenerationService:
         # token already emitted, so it is simply discarded.
         resumed = r.ctx_len > 0
         ctx = r.ctx_len if resumed else r.prompt_len
-        plan = self._chunk_plan(ctx, force_chunked=resumed)
+        cached = min(r.cached_len, ctx)
+        if cached >= ctx and resumed:
+            # full re-hit: the whole written context (prompt + generated)
+            # is already resident in shared blocks — nothing to compute;
+            # the pending token at index ctx is in seq_tokens and the next
+            # decode picks it up
+            plan = []
+        elif cached >= ctx:
+            # whole prompt cached: recompute ONLY the last position, for
+            # its logits (the near-zero-prefill path).  Its scatter lands
+            # inside the shared tail block, so _cow_for_write below gives
+            # this writer a private copy first; re-quantization of the
+            # copied int8 block is bit-stable (the absmax entry round-
+            # trips exactly, docs/quantization.md), so the recomputed
+            # block — and the sampled token — match the miss path bitwise.
+            start = ctx - 1
+            tb0 = self._seq_buckets[0]
+            plan = [(start, 1, tb0,
+                     bucket_batch(blocks_for(start + tb0, cfg.block_size),
+                                  self._width_buckets))]
+        elif cached > 0:
+            # uncached suffix only, through the SAME (T, W) rung ladder
+            plan = self._chunk_plan(ctx, start=cached)
+        else:
+            plan = self._chunk_plan(ctx, force_chunked=resumed)
         # attribution: the admission segment ran from block allocation to
-        # here; record it on the trace, then open the prefill segment
+        # here; record it on the trace, then open the prefill segment —
+        # with a prefix_reuse segment between them when the cache served
+        # part of the context (the partition stays exact)
         now = time.perf_counter()
         if r.trace is not None:
             _trace.record_event("gen.admit", "serving", r.seg_t0, now,
                                 ctx=r.trace,
                                 args={"rid": r.rid, "resumed": resumed,
                                       "blocks": len(r.blocks or ()),
+                                      "cached": cached,
                                       "replica": self._replica_id})
+        if cached > 0:
+            r.seg("prefix_reuse", now)
+            now = time.perf_counter()
         r.seg("prefill", now)
         for (off, take, tb, wp) in plan:
+            self._cow_for_write(r, off, take)
             table = _np.zeros((1, wp), _np.int32)
             n = min(wp, len(r.blocks))
             table[0, :n] = r.blocks[:n]
@@ -1231,7 +1498,13 @@ class GenerationService:
                     _np.asarray([r.top_p], _np.float32))
             r.rung_s[tb] = r.rung_s.get(tb, 0.0) \
                 + (time.perf_counter() - t_rung0)
+        self._counts["prefill_tokens"] += sum(p[1] for p in plan)
         r.seg("decode", time.perf_counter())
+        # make this context's full blocks available to the NEXT shared-
+        # prompt arrival immediately (not only at finish): concurrent
+        # identical prompts then hit while the first is still decoding
+        if self._prefix is not None and not resumed:
+            self._prefix.insert(r.seq_tokens[:ctx], r.blocks)
         if resumed:
             return
         r.ctx_len = r.prompt_len
@@ -1245,6 +1518,14 @@ class GenerationService:
         (seeded per request), so subsets emit identical values."""
         cfg = self._config
         S = cfg.max_slots
+        # copy-on-write append: a slot about to scatter into a shared
+        # block (refcount > 1) gets a private copy first — shared prompt
+        # history is read-only to every writer (idempotent, so bisection
+        # re-entry is safe)
+        if self._prefix is not None:
+            for r in batch:
+                if r.state == _RUNNING:
+                    self._cow_for_write(r, r.ctx_len, 1)
         rids = {r.rid for r in batch}
         tokens = _np.zeros((S, 1), _np.int32)
         positions = _np.zeros((S, 1), _np.int32)
@@ -1474,6 +1755,14 @@ class GenerationService:
         self._g_blocks_free.set(alloc.num_free)
         self._g_live_occupancy.set(
             self._live_blocks_locked() / total if total else 0.0)
+        if self._prefix is not None:
+            self._g_blocks_shared.set(alloc.num_shared)
+            self._g_pc_blocks.set(self._prefix.num_blocks)
+            ev = self._prefix.evictions
+            if ev > self._pc_evictions_seen:
+                self._c_pc_evict.inc(ev - self._pc_evictions_seen)
+                self._pc_evictions_seen = ev
+            self._counts["prefix_evictions"] = ev
         occ = alloc.occupancy()
         self._peak_occupancy = max(self._peak_occupancy, occ)
         self._g_occupancy.set(occ)
@@ -1526,10 +1815,20 @@ class GenerationService:
                 "total": self._cache.num_blocks - 1,
                 "used": alloc.num_used,
                 "free": alloc.num_free,
+                "shared": alloc.num_shared,
                 "occupancy": round(alloc.occupancy(), 4),
                 "live_occupancy": round(self.live_occupancy(), 4),
                 "peak_occupancy": round(self._peak_occupancy, 4),
             },
+            "prefix_cache": (None if self._prefix is None else {
+                "blocks": self._prefix.num_blocks,
+                "hits": counts["prefix_hits"],
+                "misses": counts["prefix_misses"],
+                "cached_tokens": counts["cached_tokens"],
+                "prefill_tokens": counts["prefill_tokens"],
+                "cow_copies": counts["cow_copies"],
+                "evictions": self._prefix.evictions,
+            }),
             "ttft_ms": {"p50": _ms(pct(ttft, 50)), "p99": _ms(pct(ttft, 99))},
             "inter_token_ms": {"p50": _ms(pct(itl, 50)),
                                "p99": _ms(pct(itl, 99))},

@@ -153,6 +153,15 @@ class BlockAllocator:
         with self._lock:
             return self._ref.get(int(block), 0)
 
+    @property
+    def num_shared(self) -> int:
+        """Blocks currently held by more than one owner — prefix-cache
+        sharing (requests + the index) as opposed to exclusive request
+        blocks; the occupancy gauges split on this (docs/generation.md
+        "prefix caching")."""
+        with self._lock:
+            return sum(1 for c in self._ref.values() if c >= 2)
+
     def free(self, blocks: List[int]) -> None:
         """Release one reference per block (alias of :meth:`decref` —
         a block truly frees only when its LAST owner lets go)."""

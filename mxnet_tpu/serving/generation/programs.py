@@ -32,7 +32,33 @@ from typing import Dict, Optional
 
 import numpy as _np
 
-__all__ = ["GenerationPrograms"]
+__all__ = ["GenerationPrograms", "block_copy_pools"]
+
+
+def block_copy_pools(k_pool, v_pool, src, dst, k_scale=None, v_scale=None):
+    """Copy physical block ``src`` onto ``dst`` across every layer of the
+    paged pool — the copy-on-write primitive of prefix caching
+    (docs/generation.md): a writer whose tail block is shared gets a
+    private copy BEFORE its first scatter, so shared prompt history is
+    never mutated.  ``src``/``dst``: shape-(1,) int32.  For the int8 pool
+    the per-(layer, block, head) scales ride along — a block's bits are
+    only meaningful with its scales, so they copy as one unit.  Returns
+    ``(k_pool, v_pool)`` or ``(k_pool, v_pool, k_scale, v_scale)``;
+    called with donation the copy happens in place on device."""
+    import jax
+    import jax.numpy as jnp
+
+    s = jnp.asarray(src, jnp.int32)[0]
+    d = jnp.asarray(dst, jnp.int32)[0]
+
+    def cp(pool):
+        blk = jax.lax.dynamic_slice_in_dim(pool, s, 1, axis=1)
+        return jax.lax.dynamic_update_slice_in_dim(pool, blk, d, axis=1)
+
+    k_pool, v_pool = cp(k_pool), cp(v_pool)
+    if k_scale is not None:
+        return k_pool, v_pool, cp(k_scale), cp(v_scale)
+    return k_pool, v_pool
 
 
 def _model_step(params, k_pool, v_pool, tokens, positions, lengths,
@@ -147,6 +173,16 @@ class GenerationPrograms:
                     mp_mesh=(self._mp_mesh if self._kernel == "paged"
                              else None)),
                 donate_argnums=(1, 2))
+        # the prefix-cache CoW block copy (docs/generation.md "prefix
+        # caching"): ONE signature per pool family, donated like the
+        # model step so the copy is an in-place device-side move
+        if kv_dtype == "int8":
+            self._jit_copy = jax.jit(block_copy_pools,
+                                     donate_argnums=(0, 1, 4, 5))
+        else:
+            self._jit_copy = jax.jit(
+                lambda k, v, s, d: block_copy_pools(k, v, s, d),
+                donate_argnums=(0, 1))
         self._lock = threading.Lock()
         self._stats: Dict[tuple, Dict[str, int]] = {}
 
@@ -264,6 +300,43 @@ class GenerationPrograms:
             _np.asarray(top_k, _np.int32), _np.asarray(top_p, _np.float32))
         cache.swap(k, v)
         return _np.asarray(next_tokens), last
+
+    def copy_block(self, cache, src: int, dst: int) -> None:
+        """Copy pool block ``src`` onto ``dst`` (scales included for the
+        int8 pool) — the copy-on-write append of prefix caching.  One
+        program signature per pool family, accounted at site
+        ``gen_block_copy`` with the same freeze/explain discipline as the
+        model steps; warmed by ``GenerationService.warmup`` whenever the
+        prefix cache is enabled."""
+        from ... import executor as _executor
+
+        sig = (("kv_pool", cache.shape, str(cache.k.dtype)),)
+        # same key namespacing as _key(): the paged-kernel service and the
+        # int8 pool each keep their whole program family distinct
+        if self.kernel == "paged":
+            sig = sig + (("kernel", "paged"),)
+        if self._kv_dtype == "int8":
+            sig = sig + (("kv_dtype", "int8"),)
+        key = ("gen_block_copy", sig)
+        with self._lock:
+            per = self._stats.get(key)
+            hit = per is not None
+            if per is None:
+                per = self._stats[key] = {"hits": 0, "misses": 0}
+        site = "gen_block_copy_int8" if self._kv_dtype == "int8" \
+            else "gen_block_copy"
+        _executor._note_cache(hit=hit, site=(site, ("lm",)), key=key)
+        with self._lock:
+            per["hits" if hit else "misses"] += 1
+        s = _np.asarray([src], _np.int32)
+        d = _np.asarray([dst], _np.int32)
+        if self._kv_dtype == "int8":
+            k, v, ks, vs = self._jit_copy(cache.k, cache.v, s, d,
+                                          cache.k_scale, cache.v_scale)
+            cache.swap(k, v, ks, vs)
+            return
+        k, v = self._jit_copy(cache.k, cache.v, s, d)
+        cache.swap(k, v)
 
     def compile_stats(self) -> Dict[tuple, Dict[str, int]]:
         """Per-signature ``{"hits", "misses"}`` — every signature compiled

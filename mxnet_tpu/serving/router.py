@@ -33,7 +33,10 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, List, Optional
+
+import numpy as _np
 
 from .. import observability as _obs
 from ..base import getenv
@@ -67,7 +70,9 @@ class RouterConfig:
     def __init__(self, num_replicas: Optional[int] = None,
                  probe_interval_ms: Optional[float] = None,
                  breaker_failures: Optional[int] = None,
-                 breaker_cooldown_ms: Optional[float] = None):
+                 breaker_cooldown_ms: Optional[float] = None,
+                 affinity: Optional[bool] = None,
+                 affinity_blocks: Optional[int] = None):
         self.num_replicas = int(num_replicas if num_replicas is not None
                                 else getenv("TPUMX_ROUTER_REPLICAS", 2))
         if self.num_replicas < 1:
@@ -85,12 +90,25 @@ class RouterConfig:
         self.breaker_cooldown_ms = float(
             breaker_cooldown_ms if breaker_cooldown_ms is not None
             else getenv("TPUMX_ROUTER_BREAKER_COOLDOWN_MS", 500.0))
+        # shared-prefix affinity (docs/generation.md "prefix caching"):
+        # dispatch hashes the leading prompt blocks and prefers the
+        # replica that last served that prefix, turning per-replica
+        # prefix caches into a fleet-wide one.  Breaker/health gating is
+        # unchanged — affinity only picks AMONG eligible replicas.
+        self.affinity = bool(affinity if affinity is not None
+                             else getenv("TPUMX_ROUTER_AFFINITY", True))
+        self.affinity_blocks = int(
+            affinity_blocks if affinity_blocks is not None
+            else getenv("TPUMX_ROUTER_AFFINITY_BLOCKS", 4))
+        if self.affinity_blocks < 1:
+            raise ValueError("affinity_blocks must be >= 1")
 
     def __repr__(self):
         return (f"RouterConfig(num_replicas={self.num_replicas}, "
                 f"probe_interval_ms={self.probe_interval_ms}, "
                 f"breaker_failures={self.breaker_failures}, "
-                f"breaker_cooldown_ms={self.breaker_cooldown_ms})")
+                f"breaker_cooldown_ms={self.breaker_cooldown_ms}, "
+                f"affinity={self.affinity})")
 
 
 class _Replica:
@@ -261,6 +279,11 @@ class GenerationRouter:
             self._replicas.append(_Replica(i, svc))
         self._lock = threading.Lock()
         self._records: List[_Record] = []
+        # shared-prefix affinity: chain hash of the leading prompt blocks
+        # -> the replica that last served that prefix (bounded LRU)
+        self._affinity: "OrderedDict[bytes, int]" = OrderedDict()
+        self._affinity_bs = int(
+            self._replicas[0].service._config.block_size)
         self._closed = False
         self._stop_probe = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
@@ -282,6 +305,11 @@ class GenerationRouter:
         self._g_healthy = reg.gauge(
             "router_healthy_replicas",
             help="replicas currently taking traffic (breaker closed)")
+        self._c_affinity = reg.counter(
+            "router_affinity_dispatches_total",
+            help="dispatches routed to the replica that last served the "
+                 "request's leading prompt blocks (shared-prefix "
+                 "affinity, docs/generation.md)")
         self._g_healthy.set(len(self._replicas))
         if start:
             self.start()
@@ -365,10 +393,56 @@ class GenerationRouter:
             out.append(rep)
         return out
 
+    def _affinity_key(self, prompt) -> Optional[bytes]:
+        """Chain hash of the request's leading prompt blocks (up to
+        ``affinity_blocks`` of them) — the same chained keying the
+        engines' prefix index uses, so requests this maps to one replica
+        are exactly the ones that can share KV blocks there.  None for
+        prompts shorter than one block."""
+        from .generation.prefix_cache import ROOT_KEY, chain_hash
+
+        toks = _np.asarray(prompt).ravel()
+        bs = self._affinity_bs
+        n = min(len(toks) // bs, self._config.affinity_blocks)
+        if n <= 0:
+            return None
+        key = ROOT_KEY
+        for i in range(n):
+            key = chain_hash(key, toks[i * bs:(i + 1) * bs])
+        return key
+
+    def _pick_replica(self, candidates, prompt):
+        """Shared-prefix affinity over least-loaded dispatch: prefer the
+        (eligible) replica that last served this prompt's leading blocks
+        — its prefix cache already holds them — falling back to the
+        least-loaded candidate, which also breaks first-sighting ties."""
+        key = None
+        rep = None
+        if self._config.affinity:
+            key = self._affinity_key(prompt)
+            if key is not None:
+                with self._lock:
+                    idx = self._affinity.get(key)
+                if idx is not None:
+                    rep = next((c for c in candidates if c.idx == idx),
+                               None)
+                    if rep is not None:
+                        self._c_affinity.inc()
+        if rep is None:
+            rep = min(candidates, key=lambda c: c.service.load())
+        if key is not None:
+            with self._lock:
+                self._affinity[key] = rep.idx
+                self._affinity.move_to_end(key)
+                while len(self._affinity) > 4096:
+                    self._affinity.popitem(last=False)
+        return rep
+
     def submit(self, prompt, **kwargs) -> RouterStream:
-        """Dispatch one request to the least-loaded healthy replica;
-        returns a failover-surviving stream handle.  Keyword arguments
-        are :meth:`GenerationService.submit`'s."""
+        """Dispatch one request to the shared-prefix-affine (else
+        least-loaded) healthy replica; returns a failover-surviving
+        stream handle.  Keyword arguments are
+        :meth:`GenerationService.submit`'s."""
         if self._closed:
             raise ServingClosedError("generation router is shut down")
         candidates = self._eligible()
@@ -376,7 +450,7 @@ class GenerationRouter:
             raise NoHealthyReplicaError(
                 f"all {len(self._replicas)} replicas are circuit-broken "
                 "or dead")
-        rep = min(candidates, key=lambda c: c.service.load())
+        rep = self._pick_replica(candidates, prompt)
         # one trace for the whole request lifecycle: reuse the caller's
         # context when one is active (a traced client), else mint a root;
         # the dispatch span narrows it and the engine inherits it through
@@ -512,6 +586,13 @@ class GenerationRouter:
             raise NoHealthyReplicaError(
                 "dead replica's queued work has no healthy target")
         rep = min(candidates, key=lambda c: c.service.load())
+        if self._config.affinity:
+            # future shared-prefix arrivals follow the work, not the corpse
+            key = self._affinity_key(rec.prompt)
+            if key is not None:
+                with self._lock:
+                    self._affinity[key] = rep.idx
+                    self._affinity.move_to_end(key)
         t0 = time.perf_counter()
         from_idx = rec.replica_idx
         # the SAME trace context crosses the replica hop — the new
@@ -542,6 +623,8 @@ class GenerationRouter:
             reps.append({"idx": rep.idx, "breaker": rep.breaker,
                          "dead": rep.dead, "dispatches": rep.dispatches,
                          "health": h})
+        with self._lock:
+            affinity_entries = len(self._affinity)
         return {
             "replicas": reps,
             "healthy": sum(1 for r in reps
@@ -549,5 +632,7 @@ class GenerationRouter:
             "outstanding": outstanding,
             "resubmits_outstanding": resubmits,
             "dispatches": sum(rep.dispatches for rep in self._replicas),
+            "affinity": self._config.affinity,
+            "affinity_entries": affinity_entries,
             "closed": self._closed,
         }

@@ -112,6 +112,13 @@ def pytest_configure(config):
         "conversion, ServingConfig.quantize, and the int8 paged KV "
         "cache; docs/quantization.md; select with "
         "`pytest -m quantization`)")
+    config.addinivalue_line(
+        "markers",
+        "prefix: prefix caching (mxnet_tpu.serving.generation."
+        "prefix_cache — chained-hash block index, copy-on-write shared "
+        "KV blocks, LRU eviction ahead of preemption, router "
+        "shared-prefix affinity; docs/generation.md; select with "
+        "`pytest -m prefix`)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -355,6 +355,56 @@ def build_cases():
         return _pallas_leg(body)
 
     cases += [("pallas_paged_attention_int8", pallas_paged_int8)]
+
+    # the prefix-cache CoW block copy (docs/generation.md "prefix
+    # caching"): the donated in-program pool move that gives a writer a
+    # private tail block before its first scatter — f32 and int8 pool
+    # variants (scales travel with the block) join the two-backend sweep.
+    # Inputs hoisted like the Pallas entries above.
+    kp_cow = rng.randn(2, 6, 4, 2, 8).astype(np.float32)
+    vp_cow = rng.randn(2, 6, 4, 2, 8).astype(np.float32)
+    kq_cow = rng.randint(-127, 128, kp_cow.shape).astype(np.int8)
+    vq_cow = rng.randint(-127, 128, vp_cow.shape).astype(np.int8)
+    ks_cow = (np.abs(rng.randn(2, 6, 2)) * 0.02 + 0.01).astype(np.float32)
+    vs_cow = (np.abs(rng.randn(2, 6, 2)) * 0.02 + 0.01).astype(np.float32)
+    src_cow = np.array([3], np.int32)
+    dst_cow = np.array([5], np.int32)
+
+    def _device_case(fn):
+        def run():
+            import jax
+
+            import mxnet_tpu as mx
+
+            ctx = mx.context.current_context()
+            put = lambda a: jax.device_put(a, ctx.jax_device)  # noqa: E731
+            return fn(put)
+
+        return run
+
+    def kv_block_copy(put):
+        import jax
+
+        from mxnet_tpu.serving.generation.programs import block_copy_pools
+
+        k, v = jax.jit(lambda kp, vp, s, d: block_copy_pools(kp, vp, s, d))(
+            put(kp_cow), put(vp_cow), put(src_cow), put(dst_cow))
+        return [np.asarray(k), np.asarray(v)]
+
+    def kv_block_copy_int8(put):
+        import jax
+
+        from mxnet_tpu.serving.generation.programs import block_copy_pools
+
+        k, v, ks, vs = jax.jit(block_copy_pools)(
+            put(kq_cow), put(vq_cow), put(src_cow), put(dst_cow),
+            put(ks_cow), put(vs_cow))
+        return [np.asarray(k).astype(np.float32),
+                np.asarray(v).astype(np.float32),
+                np.asarray(ks), np.asarray(vs)]
+
+    cases += [("kv_block_copy_cow", _device_case(kv_block_copy)),
+              ("kv_block_copy_cow_int8", _device_case(kv_block_copy_int8))]
     return cases
 
 
