@@ -75,10 +75,16 @@ _bulk_depth = 0
 
 
 def fusion_hint() -> int:
-    """Multi-step fusion hint for ``Executor.fused_step``: the bulk size when
-    inside an explicit ``bulk()`` scope, else 1.  A hint of k fuses k whole
-    train steps into one device program via ``lax.fori_loop`` (the reference's
-    op-bulking knob, threaded_engine.h:469-507, applied at step granularity)."""
+    """Multi-step fusion hint: the bulk size when inside an explicit
+    ``bulk()`` scope, else 1.  A hint of k fuses k whole steps into one
+    device program (the reference's op-bulking knob,
+    threaded_engine.h:469-507, applied at step granularity).  Two
+    consumers: ``Executor.fused_step`` (k train steps via
+    ``lax.fori_loop``) and the generation engine's multi-step decode
+    policy (docs/generation.md "multi-step decoding") — inside a
+    ``bulk(k)`` scope ``GenerationService`` scans up to k decode
+    iterations per device dispatch even under queue pressure, because
+    the caller explicitly asked for dispatch amortization."""
     with _bulk_lock:
         return _bulk_size if _bulk_depth > 0 else 1
 

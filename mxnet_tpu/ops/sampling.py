@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from .registry import register
 
 __all__ = ["temperature_scale", "top_k_mask", "top_p_mask", "sample_logits",
-           "fold_keys", "NEG_INF"]
+           "speculative_verify", "fold_keys", "NEG_INF"]
 
 #: same finite -inf stand-in the attention masks use (exp() underflows to
 #: exactly 0.0 in f32, and finite values keep XLA's max/where paths simple)
@@ -110,6 +110,62 @@ def sample_logits(logits, seeds, counters, temperature, top_k, top_p):
     t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32),
                          greedy.shape)
     return jnp.where(t > 0, sampled, greedy)
+
+
+def speculative_verify(logits, fed_tokens, seeds, counters, temperature,
+                       top_k, top_p, lengths):
+    """Vectorized draft verification for speculative decoding
+    (docs/generation.md "Speculative decoding").
+
+    One multi-query verify step fed row ``b`` the tokens
+    ``[pending, d_1, .., d_s]`` at consecutive positions and produced
+    per-position ``logits`` (B, T, V).  Because :func:`sample_logits` is
+    keyed on ``(seed, position)`` only — Gumbel-max under
+    :func:`fold_keys`, raw argmax for greedy rows — the TARGET model's
+    token at every position is a deterministic function of (logits, seed,
+    position), independent of how many positions are verified per step.
+    Verification therefore reduces to exact match: draft ``d_j`` is
+    accepted iff it equals the target's own sampled token at the position
+    it was proposed for, cumulatively from the left.  Accepted tokens are
+    bitwise the target-only stream for greedy rows and distribution-exact
+    (literally the same draws) for stochastic rows.
+
+    fed_tokens : (B, T) int32 — the chunk fed to the verify step
+        (``fed_tokens[:, 0]`` is the pending token, columns ``1..`` the
+        draft proposals, right-padded).
+    counters : (B,) uint32 — index of the FIRST token being produced
+        (``ctx + 1``, the same keying the single-step decode path uses);
+        position ``j`` of the chunk samples with ``counters + j``.
+    lengths : (B,) int32 — valid fed tokens per row (``s + 1``; 0 for
+        inactive slots).
+
+    Returns ``(target_tokens (B, T) int32, accepted (B,) int32)``:
+    ``target_tokens[b, j]`` is the target's token for produced index
+    ``counters[b] + j``; ``accepted[b]`` counts the leading drafts that
+    matched, so the row may emit ``accepted[b] + 1`` tokens (the matched
+    drafts plus the first non-matching target token — the "bonus" token
+    when every draft matched).  Entries past ``lengths`` are garbage.
+    """
+    logits = jnp.asarray(logits, jnp.float32)
+    B, T, _ = logits.shape
+    rep = lambda a, dt: jnp.broadcast_to(  # noqa: E731
+        jnp.asarray(a, dt)[:, None], (B, T)).reshape(-1)
+    ctr = (jnp.asarray(counters, jnp.uint32)[:, None]
+           + jnp.arange(T, dtype=jnp.uint32)[None, :])
+    target = sample_logits(
+        logits.reshape(B * T, -1), rep(seeds, jnp.uint32),
+        ctr.reshape(-1), rep(temperature, jnp.float32),
+        rep(top_k, jnp.int32), rep(top_p, jnp.float32)).reshape(B, T)
+    if T == 1:
+        return target, jnp.zeros((B,), jnp.int32)
+    # draft j (fed column j) is checked against the target token sampled
+    # at the PREVIOUS column; cumprod keeps only the leading run
+    match = (fed_tokens[:, 1:] == target[:, :-1])
+    valid = (jnp.arange(T - 1, dtype=jnp.int32)[None, :]
+             < (jnp.asarray(lengths, jnp.int32) - 1)[:, None])
+    ok = (match & valid).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(ok, axis=1), axis=1).astype(jnp.int32)
+    return target, accepted
 
 
 # -- registry entries (scalar-attr op forms) ---------------------------------------

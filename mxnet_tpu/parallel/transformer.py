@@ -204,6 +204,18 @@ def transformer_lm_decode(params: Params, tokens, positions, lengths,
     static per (batch, T, table-width) signature, so sequences growing
     inside their block tables never recompile.
 
+    The SAME path is the speculative-decoding *verify* step
+    (docs/generation.md "Speculative decoding"): a (B, s+1) chunk of
+    ``[pending, d_1..d_s]`` mid-sequence tokens per slot, with per-row
+    ``positions`` starting at each slot's context length — because
+    queries see same-chunk writes and the causal mask bounds reads at
+    ``positions``, per-position logits come out exactly as s+1 sequential
+    T=1 decode steps would produce them, in ONE dispatch.  Rejected
+    positions need no device rollback: their entries sit at positions
+    >= the post-verify context length, are never attended (causal mask)
+    before being overwritten by the next chunk fed at those positions,
+    and the engine's copy-on-write keeps them out of shared blocks.
+
     Parameters
     ----------
     tokens : (B, T) int32 — the chunk fed this call (right-padded).

@@ -97,7 +97,13 @@ class GenerationConfig:
                  admission_budget: Optional[float] = None,
                  kv_dtype: Optional[str] = "__env__",
                  prefix_cache: Optional[bool] = None,
-                 prefix_cache_blocks: Optional[int] = None):
+                 prefix_cache_blocks: Optional[int] = None,
+                 speculative: Optional[bool] = None,
+                 draft_mode: Optional[str] = None,
+                 draft_k: Optional[int] = None,
+                 draft_ngram: Optional[int] = None,
+                 draft_window: Optional[int] = None,
+                 multistep_k: Optional[int] = None):
         self.max_slots = int(max_slots if max_slots is not None
                              else getenv("TPUMX_GEN_SLOTS", 4))
         if self.max_slots < 1:
@@ -209,6 +215,46 @@ class GenerationConfig:
             else getenv("TPUMX_GEN_PREFIX_CACHE_BLOCKS", 0))
         if self.prefix_cache_blocks < 0:
             raise ValueError("prefix_cache_blocks must be >= 0")
+        # speculative decoding (docs/generation.md "Speculative
+        # decoding"): a drafter proposes up to draft_k tokens per slot
+        # and ONE multi-query verify step accepts/rejects them — greedy
+        # output stays bitwise target-only, sampled output draws the
+        # literally identical tokens ((seed, position) keying).  =0 (the
+        # default) keeps every code path, program key and token
+        # byte-identical to single-token decode.
+        self.speculative = bool(
+            speculative if speculative is not None
+            else getenv("TPUMX_GEN_SPECULATIVE", 0))
+        # "ngram" = self-speculative prompt lookup against the request's
+        # own history (no second model); "model" = a small draft
+        # transformer passed to GenerationService(draft_params=...)
+        self.draft_mode = str(
+            draft_mode if draft_mode is not None
+            else getenv("TPUMX_GEN_DRAFT_MODE", "ngram")).strip().lower()
+        if self.draft_mode not in ("ngram", "model"):
+            raise ValueError(
+                f"draft_mode must be 'ngram' or 'model', "
+                f"got {self.draft_mode!r}")
+        self.draft_k = int(draft_k if draft_k is not None
+                           else getenv("TPUMX_GEN_DRAFT_K", 4))
+        if self.draft_k < 1:
+            raise ValueError("draft_k must be >= 1")
+        self.draft_ngram = int(draft_ngram if draft_ngram is not None
+                               else getenv("TPUMX_GEN_DRAFT_NGRAM", 3))
+        if self.draft_ngram < 1:
+            raise ValueError("draft_ngram must be >= 1")
+        self.draft_window = int(draft_window if draft_window is not None
+                                else getenv("TPUMX_GEN_DRAFT_WINDOW", 32))
+        if self.draft_window < 1:
+            raise ValueError("draft_window must be >= 1")
+        # multi-step device scheduling: run up to k decode iterations
+        # inside one donated lax.scan program when batch membership is
+        # stable (chosen adaptively from queue depth / engine.fusion_hint
+        # so admission latency doesn't regress); 1 = off, byte-identical.
+        self.multistep_k = int(multistep_k if multistep_k is not None
+                               else getenv("TPUMX_GEN_MULTISTEP_K", 1))
+        if self.multistep_k < 1:
+            raise ValueError("multistep_k must be >= 1")
 
     def __repr__(self):
         return (f"GenerationConfig(max_slots={self.max_slots}, "
@@ -220,7 +266,9 @@ class GenerationConfig:
                 f"amp_dtype={self.amp_dtype!r}, "
                 f"kv_dtype={self.kv_dtype!r}, "
                 f"preemption={self.preemption}, "
-                f"prefix_cache={self.prefix_cache})")
+                f"prefix_cache={self.prefix_cache}, "
+                f"speculative={self.speculative}, "
+                f"multistep_k={self.multistep_k})")
 
 
 class _GenRequest:
@@ -236,7 +284,8 @@ class _GenRequest:
                  "seg_t0", "breakdown", "breakdown_first", "rung_s",
                  "decode_steps", "n_retries", "token_log", "wide_event",
                  "lock", "cached_len", "cached_total", "cow_copies",
-                 "charged_blocks")
+                 "charged_blocks", "draft_proposed", "draft_accepted",
+                 "mode_tokens", "index_safe_len")
 
     def __init__(self, rid, prompt, bucket, max_new, temperature, top_k,
                  top_p, seed, eos_token, deadline, on_token, priority=0):
@@ -275,6 +324,16 @@ class _GenRequest:
         self.cached_total = 0
         self.cow_copies = 0
         self.charged_blocks = 0
+        # speculative decoding (docs/generation.md): drafts proposed for /
+        # accepted by this request, tokens emitted per decode mode, and —
+        # int8 pool only — the longest prefix whose quantized bits are
+        # safe to index into the prefix cache (a partial-rejection verify
+        # can requantize a boundary block under a transiently larger
+        # scale; None = the whole context is safe)
+        self.draft_proposed = 0
+        self.draft_accepted = 0
+        self.mode_tokens: Dict[str, int] = {}
+        self.index_safe_len: Optional[int] = None
         # latency attribution (docs/observability.md): the request's
         # lifetime is partitioned into contiguous segments — queue,
         # admission, prefill, decode, preempted — whose transition points
@@ -409,6 +468,9 @@ class GenerationStream:
             preemptions, requeues = r.n_preempted, r.n_requeues
             retries = r.n_retries
             cached_total, cow_copies = r.cached_total, r.cow_copies
+            draft_proposed = r.draft_proposed
+            draft_accepted = r.draft_accepted
+            mode_tokens = dict(r.mode_tokens)
         return {
             "type": "generation_request",
             "request_id": r.rid,
@@ -435,9 +497,23 @@ class GenerationStream:
             "retries": retries,
             "prefix_cached_tokens": cached_total,
             "cow_copies": cow_copies,
+            "decode_mode": _dominant_mode(mode_tokens),
+            "accepted_ratio": (None if draft_proposed == 0 else
+                               round(draft_accepted / draft_proposed, 4)),
+            "draft_proposed_tokens": draft_proposed,
+            "draft_accepted_tokens": draft_accepted,
             "token_offsets_ms": [round((t - r.t_submit) * 1e3, 3)
                                  for t in token_log],
         }
+
+
+def _dominant_mode(mode_tokens: Dict[str, int]) -> str:
+    """The decode mode that emitted most of a request's tokens —
+    the wide-event ``decode_mode`` field (``single`` when nothing has
+    been emitted yet)."""
+    if not mode_tokens:
+        return "single"
+    return max(mode_tokens.items(), key=lambda kv: (kv[1], kv[0]))[0]
 
 
 class GenerationService:
@@ -458,7 +534,8 @@ class GenerationService:
     _TPS_WINDOW = 5.0  # seconds of token timestamps behind the tokens/sec gauge
 
     def __init__(self, params, model_cfg, config: Optional[GenerationConfig]
-                 = None, start: bool = True):
+                 = None, start: bool = True, draft_params=None,
+                 draft_cfg=None):
         import jax.numpy as jnp
 
         self._model_cfg = model_cfg
@@ -506,6 +583,36 @@ class GenerationService:
         # address max_len positions (the cap itself kept, like batch_buckets)
         self._width_buckets = batch_buckets(
             blocks_for(model_cfg.max_len, cfg.block_size))
+        # multi-token decoding (docs/generation.md "Speculative
+        # decoding"): the verify chunk length Tk = s + 1 (pending token +
+        # s drafts) is pow2-bucketed so warmup enumerates the full
+        # (Tk, W) verify set; the multistep scan length k has its own
+        # ladder.  Both EMPTY with the gates off — the warmup set,
+        # program keys and growth arithmetic then stay byte-identical.
+        self._verify_buckets = ([b for b in batch_buckets(cfg.draft_k + 1)
+                                 if b >= 2] if cfg.speculative else [])
+        self._ms_buckets = ([b for b in batch_buckets(cfg.multistep_k)
+                             if b >= 2] if cfg.multistep_k >= 2 else [])
+        # worst-case positions ONE iteration may write past ctx — block
+        # growth reserves this span ahead (1 = classic single-token)
+        self._iter_span = max(
+            1, (cfg.draft_k + 1) if cfg.speculative else 1,
+            cfg.multistep_k)
+        self._draft = None
+        if cfg.speculative and cfg.draft_mode == "model":
+            if draft_params is None or draft_cfg is None:
+                raise ValueError(
+                    "draft_mode='model' requires draft_params and "
+                    "draft_cfg (a small transformer_lm_init model)")
+            if int(draft_cfg.vocab) != int(model_cfg.vocab):
+                raise ValueError(
+                    f"draft model vocab {draft_cfg.vocab} != target "
+                    f"vocab {model_cfg.vocab}")
+            from .speculative import DraftModel
+            self._draft = DraftModel(
+                draft_params, draft_cfg, cfg.draft_k,
+                min(cfg.draft_window, int(draft_cfg.max_len)),
+                compute_dtype=compute_dtype)
 
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
@@ -532,7 +639,9 @@ class GenerationService:
                         "requeued": 0, "quarantined": 0, "step_failures": 0,
                         "prefix_hits": 0, "prefix_misses": 0,
                         "prefix_evictions": 0, "cached_tokens": 0,
-                        "prefill_tokens": 0, "cow_copies": 0}
+                        "prefill_tokens": 0, "cow_copies": 0,
+                        "draft_proposed": 0, "draft_accepted": 0,
+                        "spec_steps": 0, "multistep_steps": 0}
         self._peak_occupancy = 0.0
         self._ttft: "deque[float]" = deque(maxlen=4096)
         self._itl: "deque[float]" = deque(maxlen=4096)
@@ -592,6 +701,14 @@ class GenerationService:
         self._g_pc_blocks = reg.gauge(
             "generation_prefix_cache_blocks",
             help="blocks currently resident in the prefix index")
+        self._c_draft_proposed = reg.counter(
+            "generation_draft_proposed_tokens_total",
+            help="draft tokens proposed to the speculative verify step "
+                 "(ngram prompt-lookup or the draft model)")
+        self._c_draft_accepted = reg.counter(
+            "generation_draft_accepted_tokens_total",
+            help="proposed draft tokens the target model accepted "
+                 "(emitted bitwise as its own tokens)")
 
     # -- submission ---------------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -777,6 +894,36 @@ class GenerationService:
                     zeros_s.astype(_np.uint32), zeros_s.astype(_np.uint32),
                     zeros_s.astype(_np.float32), zeros_s,
                     _np.ones(S, _np.float32))
+            # speculative verify (docs/generation.md "Speculative
+            # decoding"): every (Tk, W) pair on the ladders — all rows
+            # length 0, so warmup writes only to the null block
+            for tk in self._verify_buckets:
+                for w in self._width_buckets:
+                    self._programs.run_verify(
+                        self._cache,
+                        _np.zeros((S, tk), _np.int32),
+                        _np.zeros((S, tk), _np.int32), zeros_s,
+                        _np.zeros((S, w), _np.int32),
+                        zeros_s.astype(_np.uint32),
+                        zeros_s.astype(_np.uint32),
+                        zeros_s.astype(_np.float32), zeros_s,
+                        _np.ones(S, _np.float32))
+            # multistep scan: one program per (k, W)
+            for k in self._ms_buckets:
+                for w in self._width_buckets:
+                    self._programs.run_multistep(
+                        k, self._cache, zeros_s, zeros_s, zeros_s,
+                        _np.zeros((S, w), _np.int32),
+                        zeros_s.astype(_np.uint32),
+                        zeros_s.astype(_np.uint32),
+                        zeros_s.astype(_np.float32), zeros_s,
+                        _np.ones(S, _np.float32))
+            if self._draft is not None:
+                # the draft proposer is ONE (S, window, k) program
+                self._draft.propose(
+                    _np.zeros((S, self._draft.window), _np.int32),
+                    _np.zeros((S, self._draft.window), _np.int32),
+                    zeros_s)
             if self._prefix is not None:
                 # the CoW block copy is part of the steady-state set;
                 # copying the reserved null block onto itself warms it
@@ -1111,6 +1258,18 @@ class GenerationService:
             r.cow_copies += 1
             self._counts["cow_copies"] += 1
 
+    def _index_safe_ctx(self, r: _GenRequest) -> int:
+        """Longest context prefix whose cache bits are safe to share via
+        the prefix index.  f32/bf16 pools: the whole context (rejected
+        speculative writes only ever land at positions >= ctx_len, never
+        inside an indexed full block).  int8 pools: capped at
+        ``index_safe_len`` once a partial-rejection verify requantized a
+        mixed accepted/rejected boundary block under a transiently larger
+        scale (docs/generation.md "Speculative decoding")."""
+        if r.index_safe_len is None:
+            return r.ctx_len
+        return min(r.ctx_len, r.index_safe_len)
+
     def _pick_victim_locked(self) -> Optional[int]:
         """Victim slot for preemption: lowest priority class first, then
         newest admitted (vLLM's evict-the-latecomer policy — the oldest
@@ -1145,8 +1304,9 @@ class GenerationService:
                 # requeue path ("requeued") skips this: a failing step may
                 # have left the blocks suspect.
                 if self._prefix is not None and counter == "preempted" \
-                        and r.ctx_len > 0:
-                    self._prefix.insert(r.seq_tokens[:r.ctx_len], r.blocks)
+                        and self._index_safe_ctx(r) > 0:
+                    self._prefix.insert(
+                        r.seq_tokens[:self._index_safe_ctx(r)], r.blocks)
                 self._cache.allocator.free(r.blocks)
                 r.blocks = None
             r.state = _WAITING
@@ -1200,7 +1360,14 @@ class GenerationService:
             r = self._slots[i]
             if r is None or r.state != _RUNNING:
                 continue  # preempted by an earlier grower this pass
-            need = blocks_for(r.ctx_len + 1, cfg.block_size)
+            # reserve the whole iteration's worst-case write span (the
+            # verify chunk / multistep scan may append up to _iter_span
+            # positions); span 1 == the classic next-position arithmetic,
+            # and the cap at prompt+max_new means single-token services
+            # are byte-identical
+            need = blocks_for(
+                min(r.ctx_len + self._iter_span,
+                    r.prompt_len + r.max_new), cfg.block_size)
             while len(r.blocks) < need:
                 got = self._alloc_reclaiming(need - len(r.blocks))
                 if got is not None:
@@ -1239,8 +1406,9 @@ class GenerationService:
             # shared-prompt arrival (only clean completions: an errored
             # request's cache state is suspect)
             if self._prefix is not None and reason == _FINISHED \
-                    and error is None and r.ctx_len > 0:
-                self._prefix.insert(r.seq_tokens[:r.ctx_len], r.blocks)
+                    and error is None and self._index_safe_ctx(r) > 0:
+                self._prefix.insert(
+                    r.seq_tokens[:self._index_safe_ctx(r)], r.blocks)
             self._cache.allocator.free(r.blocks)
             r.blocks = None
         self._finish_locked(r, reason=reason, error=error)
@@ -1304,6 +1472,12 @@ class GenerationService:
             "retries": r.n_retries,
             "prefix_cached_tokens": r.cached_total,
             "cow_copies": r.cow_copies,
+            "decode_mode": _dominant_mode(r.mode_tokens),
+            "accepted_ratio": (None if r.draft_proposed == 0 else
+                               round(r.draft_accepted / r.draft_proposed,
+                                     4)),
+            "draft_proposed_tokens": r.draft_proposed,
+            "draft_accepted_tokens": r.draft_accepted,
             "token_offsets_ms": [round((t - r.t_submit) * 1e3, 3)
                                  for t in r.token_log],
         }
@@ -1511,11 +1685,35 @@ class GenerationService:
         self._emit_token(r, int(next_tok[0]))
 
     def _decode_step(self, batch: List[_GenRequest]) -> None:
-        """One decode program over exactly the requests in ``batch``
+        """One decode iteration over exactly the requests in ``batch``
         (slots outside it stay inactive: length 0, null-block table) —
         the full running set normally, a bisection subset when isolating
         a poisoned request.  Tokens are batch-composition-independent
-        (seeded per request), so subsets emit identical values."""
+        (seeded per request), so subsets emit identical values.
+
+        Mode dispatch (docs/generation.md "Speculative decoding"): with
+        speculative decoding on and at least one slot holding draft
+        proposals, the iteration is ONE multi-query verify step (slots
+        without drafts ride along at chunk length 1); otherwise, when
+        multistep is enabled and the adaptive policy allows, k decode
+        iterations run inside one scanned program; otherwise the classic
+        single-token step.  All three paths emit identical token VALUES —
+        they differ only in how many tokens one device dispatch yields."""
+        cfg = self._config
+        if cfg.speculative:
+            drafts = self._propose_drafts(batch)
+            if any(drafts.values()):
+                self._spec_step(batch, drafts)
+                return
+        k = self._choose_multistep_k(batch)
+        if k >= 2:
+            self._multistep_step(batch, k)
+            return
+        self._single_step(batch)
+
+    def _single_step(self, batch: List[_GenRequest]) -> None:
+        """The classic one-token decode program (T=1, one sampled token
+        per running row)."""
         cfg = self._config
         S = cfg.max_slots
         # copy-on-write append: a slot about to scatter into a shared
@@ -1587,7 +1785,274 @@ class GenerationService:
                           "running": len(batch),
                           "replica": self._replica_id})
             r.ctx_len += 1
+            r.mode_tokens["single"] = r.mode_tokens.get("single", 0) + 1
             self._emit_token(r, int(next_tok[i]))
+
+    def _propose_drafts(self, batch: List[_GenRequest]) -> Dict[int, List[int]]:
+        """Draft proposals per request id (possibly empty lists).  Each
+        row's proposal count is capped at ``remaining - 1`` so the verify
+        emit (``accepted + 1`` tokens) can never overshoot ``max_new`` —
+        which also keeps every verify write inside the request's
+        worst-case block reservation."""
+        cfg = self._config
+        out: Dict[int, List[int]] = {}
+        rids = {r.rid for r in batch if r.state == _RUNNING}
+        if self._draft is not None:
+            S = cfg.max_slots
+            w = self._draft.window
+            window = _np.zeros((S, w), _np.int32)
+            positions = _np.zeros((S, w), _np.int32)
+            n_valid = _np.zeros(S, _np.int32)
+            live = []
+            for i, r in enumerate(self._slots):
+                if r is None or r.state != _RUNNING or r.rid not in rids:
+                    continue
+                n = min(r.ctx_len + 1, w)
+                window[i, w - n:] = r.seq_tokens[
+                    r.ctx_len + 1 - n:r.ctx_len + 1]
+                positions[i] = _np.arange(r.ctx_len + 1 - w,
+                                          r.ctx_len + 1, dtype=_np.int32)
+                n_valid[i] = n
+                live.append((i, r))
+            if not live:
+                return out
+            props = self._draft.propose(window, positions, n_valid)
+            for i, r in live:
+                kmax = min(self._draft.k, r.max_new - r.n_generated - 1)
+                out[r.rid] = [int(t) for t in props[i, :max(0, kmax)]]
+            return out
+        from .speculative import propose_ngram
+        for r in batch:
+            if r.state != _RUNNING or r.rid not in rids:
+                continue
+            kmax = min(cfg.draft_k, r.max_new - r.n_generated - 1)
+            out[r.rid] = (propose_ngram(
+                r.seq_tokens[:r.ctx_len + 1], kmax, cfg.draft_ngram)
+                if kmax > 0 else [])
+        return out
+
+    def _emit_many(self, r: _GenRequest, toks: List[int]) -> int:
+        """Emit consecutive tokens for one request; stops the moment a
+        token finishes it (eos / max_new) — surplus verified or scanned
+        tokens are simply discarded, exactly as if they were never
+        computed.  Returns the number emitted."""
+        n = 0
+        for t in toks:
+            if r.state != _RUNNING or self._killed:
+                break
+            r.ctx_len += 1
+            self._emit_token(r, int(t))
+            n += 1
+        return n
+
+    def _spec_step(self, batch: List[_GenRequest],
+                   drafts: Dict[int, List[int]]) -> None:
+        """One speculative iteration: feed ``[pending, d_1..d_s]`` per
+        row through a single cache-aware multi-query verify step and emit
+        the leading run of target-matching tokens (plus the bonus token).
+        Rows with no drafts ride along at chunk length 1 — for them this
+        IS the single-token step."""
+        cfg = self._config
+        S = cfg.max_slots
+        rids = {r.rid for r in batch if r.state == _RUNNING}
+        smax = max((len(drafts.get(r.rid, ())) for r in batch
+                    if r.state == _RUNNING), default=0)
+        tk = bucket_batch(smax + 1, self._verify_buckets)
+        # copy-on-write over the whole verify span: REJECTED writes land
+        # at positions >= ctx_len too, and must never touch a shared
+        # block — this is the rollback guarantee (shared prefix blocks
+        # are physically unreachable from a speculative scatter)
+        if self._prefix is not None:
+            for r in batch:
+                if r.state == _RUNNING:
+                    self._cow_for_write(
+                        r, r.ctx_len, len(drafts.get(r.rid, ())) + 1)
+        tokens = _np.zeros((S, tk), _np.int32)
+        positions = _np.zeros((S, tk), _np.int32)
+        lengths = _np.zeros(S, _np.int32)
+        seeds = _np.zeros(S, _np.uint32)
+        counters = _np.zeros(S, _np.uint32)
+        temperature = _np.zeros(S, _np.float32)
+        top_k = _np.zeros(S, _np.int32)
+        top_p = _np.ones(S, _np.float32)
+        max_w = 1
+        for i, r in enumerate(self._slots):
+            if r is None or r.state != _RUNNING or r.rid not in rids:
+                continue
+            fed = [r.seq_tokens[r.ctx_len]] + drafts.get(r.rid, [])
+            tokens[i, :len(fed)] = fed
+            positions[i] = r.ctx_len + _np.arange(tk, dtype=_np.int32)
+            lengths[i] = len(fed)
+            seeds[i] = r.seed
+            counters[i] = r.ctx_len + 1  # first produced-token index
+            temperature[i] = r.temperature
+            top_k[i] = r.top_k
+            top_p[i] = r.top_p
+            max_w = max(max_w, blocks_for(r.ctx_len + len(fed),
+                                          cfg.block_size))
+        w = bucket_batch(max_w, self._width_buckets)
+        tables = _np.zeros((S, w), _np.int32)
+        for i, r in enumerate(self._slots):
+            if r is None or r.state != _RUNNING or r.rid not in rids:
+                continue
+            n = min(w, len(r.blocks))
+            tables[i, :n] = r.blocks[:n]
+        if _fault_injector().gen_step_fail(rids):
+            from ...fault.inject import FaultInjectedError
+            raise FaultInjectedError(
+                f"injected decode-step failure "
+                f"(TPUMX_FAULT_GEN_STEP_FAIL) at iteration "
+                f"{self._iteration}, batch rids {sorted(rids)}")
+        t_step0 = time.perf_counter()
+        with _obs.span("serving.spec_verify", cat="serving",
+                       args={"running": len(batch), "width": int(w),
+                             "chunk": int(tk),
+                             "iteration": self._iteration}):
+            target, accepted = self._programs.run_verify(
+                self._cache, tokens, positions, lengths, tables, seeds,
+                counters, temperature, top_k, top_p)
+        t_step1 = time.perf_counter()
+        traced = _trace.enabled()
+        bs = cfg.block_size
+        quantized = self._cache.quantized
+        for i, r in enumerate(self._slots):
+            if r is None or r.state != _RUNNING or r.rid not in rids:
+                continue
+            s_i = int(lengths[i]) - 1  # drafts fed for this row
+            n_emit = int(accepted[i]) + 1
+            r.decode_steps += 1
+            emitted = self._emit_many(
+                r, [int(t) for t in target[i, :n_emit]])
+            acc = max(0, emitted - 1)
+            r.draft_proposed += s_i
+            r.draft_accepted += acc
+            r.mode_tokens["spec"] = r.mode_tokens.get("spec", 0) + emitted
+            self._counts["draft_proposed"] += s_i
+            self._counts["draft_accepted"] += acc
+            if s_i:
+                self._c_draft_proposed.inc(s_i)
+            if acc:
+                self._c_draft_accepted.inc(acc)
+            # int8 pool + partial rejection: the boundary block now holds
+            # accepted entries requantized under a scale that saw the
+            # rejected garbage — never index it for sharing (f32 pools
+            # need no such cap: every write is position-exact)
+            if quantized and s_i > acc and r.ctx_len % bs != 0:
+                safe = (r.ctx_len // bs) * bs
+                r.index_safe_len = (safe if r.index_safe_len is None
+                                    else min(r.index_safe_len, safe))
+            if traced and r.trace is not None:
+                _trace.record_event(
+                    "serving.decode.participate", "serving", t_step0,
+                    t_step1, ctx=r.trace,
+                    args={"rid": r.rid, "iteration": self._iteration,
+                          "running": len(batch), "mode": "spec",
+                          "proposed": s_i, "accepted": acc,
+                          "replica": self._replica_id})
+        self._counts["spec_steps"] += 1
+
+    def _choose_multistep_k(self, batch: List[_GenRequest]) -> int:
+        """Adaptive scan length (docs/generation.md "multi-step
+        decoding"): inside an ``engine.bulk`` scope the PR 3
+        ``fusion_hint`` drives k (the caller explicitly asked for
+        dispatch amortization); otherwise a non-empty waiting queue
+        forces k=1 so admission latency never regresses — a queued
+        request joins the batch at the very next token, exactly as
+        before.  The result is floored onto the pow2 ladder and bounded
+        by every row's remaining budget (a scanned token past max_new
+        would be computed only to be discarded)."""
+        cfg = self._config
+        if cfg.multistep_k < 2 or not self._ms_buckets:
+            return 1
+        rows = [r for r in batch if r.state == _RUNNING]
+        if not rows:
+            return 1
+        from ...engine import fusion_hint
+        hint = fusion_hint()
+        if hint > 1:
+            want = min(cfg.multistep_k, hint)
+        elif len(self._waiting) > 0:
+            return 1
+        else:
+            want = cfg.multistep_k
+        want = min(want, min(r.max_new - r.n_generated for r in rows))
+        k = 1
+        for b in self._ms_buckets:
+            if b <= want:
+                k = b
+        return k
+
+    def _multistep_step(self, batch: List[_GenRequest], k: int) -> None:
+        """k decode iterations inside one donated scanned program — the
+        same per-iteration math as :meth:`_single_step` (tokens and int8
+        write pattern bit-identical), with k-1 host↔device round trips
+        amortized away."""
+        cfg = self._config
+        S = cfg.max_slots
+        rids = {r.rid for r in batch if r.state == _RUNNING}
+        if self._prefix is not None:
+            for r in batch:
+                if r.state == _RUNNING:
+                    self._cow_for_write(r, r.ctx_len, k)
+        tokens = _np.zeros(S, _np.int32)
+        positions = _np.zeros(S, _np.int32)
+        lengths = _np.zeros(S, _np.int32)
+        seeds = _np.zeros(S, _np.uint32)
+        counters = _np.zeros(S, _np.uint32)
+        temperature = _np.zeros(S, _np.float32)
+        top_k = _np.zeros(S, _np.int32)
+        top_p = _np.ones(S, _np.float32)
+        max_w = 1
+        for i, r in enumerate(self._slots):
+            if r is None or r.state != _RUNNING or r.rid not in rids:
+                continue
+            tokens[i] = r.seq_tokens[r.ctx_len]
+            positions[i] = r.ctx_len
+            lengths[i] = 1
+            seeds[i] = r.seed
+            counters[i] = r.ctx_len + 1
+            temperature[i] = r.temperature
+            top_k[i] = r.top_k
+            top_p[i] = r.top_p
+            max_w = max(max_w, blocks_for(r.ctx_len + k, cfg.block_size))
+        w = bucket_batch(max_w, self._width_buckets)
+        tables = _np.zeros((S, w), _np.int32)
+        for i, r in enumerate(self._slots):
+            if r is None or r.state != _RUNNING or r.rid not in rids:
+                continue
+            n = min(w, len(r.blocks))
+            tables[i, :n] = r.blocks[:n]
+        if _fault_injector().gen_step_fail(rids):
+            from ...fault.inject import FaultInjectedError
+            raise FaultInjectedError(
+                f"injected decode-step failure "
+                f"(TPUMX_FAULT_GEN_STEP_FAIL) at iteration "
+                f"{self._iteration}, batch rids {sorted(rids)}")
+        t_step0 = time.perf_counter()
+        with _obs.span("serving.multistep", cat="serving",
+                       args={"running": len(batch), "width": int(w),
+                             "k": int(k),
+                             "iteration": self._iteration}):
+            toks = self._programs.run_multistep(
+                k, self._cache, tokens, positions, lengths, tables,
+                seeds, counters, temperature, top_k, top_p)
+        t_step1 = time.perf_counter()
+        traced = _trace.enabled()
+        for i, r in enumerate(self._slots):
+            if r is None or r.state != _RUNNING or r.rid not in rids:
+                continue
+            r.decode_steps += 1
+            emitted = self._emit_many(r, [int(t) for t in toks[i]])
+            r.mode_tokens["multistep"] = \
+                r.mode_tokens.get("multistep", 0) + emitted
+            if traced and r.trace is not None:
+                _trace.record_event(
+                    "serving.decode.participate", "serving", t_step0,
+                    t_step1, ctx=r.trace,
+                    args={"rid": r.rid, "iteration": self._iteration,
+                          "running": len(batch), "mode": "multistep",
+                          "k": int(k), "replica": self._replica_id})
+        self._counts["multistep_steps"] += 1
 
     # -- failure isolation (docs/fault_tolerance.md serving rows) -----------------
     def _note_step_failure(self, exc: BaseException) -> None:
@@ -1832,6 +2297,26 @@ class GenerationService:
             "ttft_ms": {"p50": _ms(pct(ttft, 50)), "p99": _ms(pct(ttft, 99))},
             "inter_token_ms": {"p50": _ms(pct(itl, 50)),
                                "p99": _ms(pct(itl, 99))},
+            "decode_mode": ("spec" if self._config.speculative else
+                            "multistep" if self._config.multistep_k >= 2
+                            else "single"),
+            "speculative": (None if not self._config.speculative else {
+                "draft_mode": self._config.draft_mode,
+                "draft_k": self._config.draft_k,
+                "proposed_tokens": counts["draft_proposed"],
+                "accepted_tokens": counts["draft_accepted"],
+                "accepted_ratio": (
+                    None if counts["draft_proposed"] == 0 else
+                    round(counts["draft_accepted"]
+                          / counts["draft_proposed"], 4)),
+                "mean_accepted_len": (
+                    None if counts["spec_steps"] == 0 else
+                    round(counts["draft_accepted"]
+                          / counts["spec_steps"], 4)),
+                "spec_steps": counts["spec_steps"],
+            }),
+            "multistep": {"k": self._config.multistep_k,
+                          "steps": counts["multistep_steps"]},
             "compiled_signatures": self._programs.compiled_signatures(),
             "decode_kernel": self._programs.kernel,
             "kv_dtype": self._config.kv_dtype or str(self._cache.dtype),

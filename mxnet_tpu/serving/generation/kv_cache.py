@@ -26,6 +26,21 @@ byte-for-byte (allocate ``ceil((prompt + max_new) / block_size)`` blocks
 at admission, never preempt — an admitted request can never hit cache OOM
 mid-decode, at the cost of pool headroom); both policies are documented
 in docs/generation.md.
+
+Speculative decoding (docs/generation.md "Speculative decoding") writes
+ahead of the accepted context: a verify step scatters K/V for all s+1
+fed positions, then the engine advances ``ctx_len`` only past the
+accepted prefix.  Rejected entries need no device-side rollback in this
+model — they live at positions >= the new context length, the causal
+mask keeps them unread, and the next chunk fed at those positions
+overwrites them.  What protects SHARED state is the same copy-on-write
+machinery prefix caching uses: the engine CoWs the whole verify span
+before dispatch, so a rejected write can never land in a block with
+``refcount > 1`` (:meth:`PagedKVCache.snapshot_blocks` lets tests pin
+this at the bit level).  The int8 pool has one extra wrinkle — a
+partial rejection can requantize a mixed boundary block under a
+transiently larger scale — handled engine-side by capping what the
+prefix index may share (``_GenRequest.index_safe_len``).
 """
 from __future__ import annotations
 
@@ -244,6 +259,24 @@ class PagedKVCache:
             self.k_scale = k_scale
         if v_scale is not None:
             self.v_scale = v_scale
+
+    def snapshot_blocks(self, blocks: List[int]) -> Dict[str, "object"]:
+        """Device-bit snapshot of the given physical blocks (K, V and —
+        int8 pool — their scales) as host numpy arrays.  Test/debug
+        helper for the speculative-decoding rollback guarantee
+        (docs/generation.md "Speculative decoding"): shared prefix
+        blocks must be bit-identical before and after a verify step
+        that rejected drafts, because rejected writes only ever land in
+        the writer's PRIVATE (copy-on-write) tail blocks."""
+        import numpy as np
+
+        idx = np.asarray([int(b) for b in blocks], np.int32)
+        out = {"k": np.asarray(self.k[:, idx]),
+               "v": np.asarray(self.v[:, idx])}
+        if self.quantized:
+            out["k_scale"] = np.asarray(self.k_scale[:, idx])
+            out["v_scale"] = np.asarray(self.v_scale[:, idx])
+        return out
 
     def nbytes(self) -> int:
         n = int(self.k.nbytes) + int(self.v.nbytes)

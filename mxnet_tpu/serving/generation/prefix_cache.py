@@ -29,6 +29,17 @@ Sharing discipline (docs/generation.md "prefix caching"):
 The index never touches the device: matching, insertion, and eviction are
 pure host arithmetic + refcount bookkeeping, and a cache hit reuses the
 EXISTING chunked-prefill program ladder (no new program shapes).
+
+Speculative decoding (docs/generation.md "Speculative decoding")
+composes safely with all of the above: :meth:`PrefixCacheIndex.insert`
+only ever indexes FULL blocks of the ACCEPTED context the engine hands
+it, and rejected speculative writes land exclusively at positions past
+that context in the writer's private (copy-on-write) tail blocks — so a
+shared or indexed block can never hold a rejected draft's K/V.  For the
+int8 pool the engine additionally caps the insert length at the
+request's ``index_safe_len`` (a partial-rejection verify can requantize
+a mixed boundary block under a transiently larger scale, and such a
+block must not be shared).
 """
 from __future__ import annotations
 

@@ -112,6 +112,121 @@ def _model_step_q(params, k_pool, v_pool, k_scale, v_scale, tokens,
     return next_tokens, last, k_pool, v_pool, k_scale, v_scale
 
 
+def _verify_step(params, k_pool, v_pool, tokens, positions, lengths,
+                 block_tables, seeds, counters, temperature, top_k, top_p,
+                 *, cfg, compute_dtype, attention_kernel="gather",
+                 mp_mesh=None):
+    """Speculative verify (docs/generation.md "Speculative decoding"):
+    ONE cache-aware multi-query step over ``[pending, d_1..d_s]`` per row
+    — the same chunked-prefill path as :func:`_model_step`, but ALL valid
+    positions feed the sampler (via ``speculative_verify``) instead of
+    just the last one.  Returns per-position target tokens plus the
+    leading accepted-draft count per row."""
+    from ...ops.sampling import speculative_verify
+    from ...parallel.transformer import transformer_lm_decode
+
+    logits, k_pool, v_pool = transformer_lm_decode(
+        params, tokens, positions, lengths, k_pool, v_pool, block_tables,
+        cfg, compute_dtype=compute_dtype,
+        attention_kernel=attention_kernel, mp_mesh=mp_mesh)
+    target, accepted = speculative_verify(
+        logits, tokens, seeds, counters, temperature, top_k, top_p,
+        lengths)
+    return target, accepted, k_pool, v_pool
+
+
+def _verify_step_q(params, k_pool, v_pool, k_scale, v_scale, tokens,
+                   positions, lengths, block_tables, seeds, counters,
+                   temperature, top_k, top_p, *, cfg, compute_dtype,
+                   attention_kernel="gather", mp_mesh=None):
+    """int8-KV variant of :func:`_verify_step` (scales donated along)."""
+    from ...ops.sampling import speculative_verify
+    from ...parallel.transformer import transformer_lm_decode
+
+    logits, k_pool, v_pool, k_scale, v_scale = transformer_lm_decode(
+        params, tokens, positions, lengths, k_pool, v_pool, block_tables,
+        cfg, compute_dtype=compute_dtype,
+        attention_kernel=attention_kernel, mp_mesh=mp_mesh,
+        k_scale=k_scale, v_scale=v_scale)
+    target, accepted = speculative_verify(
+        logits, tokens, seeds, counters, temperature, top_k, top_p,
+        lengths)
+    return target, accepted, k_pool, v_pool, k_scale, v_scale
+
+
+def _multistep(params, k_pool, v_pool, tokens, positions, lengths,
+               block_tables, seeds, counters, temperature, top_k, top_p,
+               *, k, cfg, compute_dtype, attention_kernel="gather",
+               mp_mesh=None):
+    """``k`` decode iterations inside ONE donated program via
+    ``lax.scan`` (docs/generation.md "multi-step decoding") — each scan
+    iteration is exactly the single-step decode math (same (S, 1) model
+    call, same ``(seed, position)`` sampler keying, same one-position
+    scatter), so tokens match the step-at-a-time path and the int8 pool's
+    write pattern is bit-identical; only the host↔device round-trips in
+    between are amortized away.  ``tokens``/``positions``/``counters``
+    are the FIRST iteration's (S,) values; rows with ``lengths == 0`` are
+    inactive throughout (null-block writes).  Returns (S, k) tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.sampling import sample_logits
+    from ...parallel.transformer import transformer_lm_decode
+
+    def body(carry, _):
+        k_pool, v_pool, tok, pos, ctr = carry
+        logits, k_pool, v_pool = transformer_lm_decode(
+            params, tok[:, None], pos[:, None], lengths, k_pool, v_pool,
+            block_tables, cfg, compute_dtype=compute_dtype,
+            attention_kernel=attention_kernel, mp_mesh=mp_mesh)
+        nxt = sample_logits(logits[:, 0, :], seeds, ctr, temperature,
+                            top_k, top_p)
+        return (k_pool, v_pool, nxt, pos + 1, ctr + 1), nxt
+
+    init = (k_pool, v_pool,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(counters, jnp.uint32))
+    (k_pool, v_pool, _, _, _), toks = jax.lax.scan(
+        body, init, None, length=k)
+    return jnp.transpose(toks), k_pool, v_pool  # (S, k)
+
+
+def _multistep_q(params, k_pool, v_pool, k_scale, v_scale, tokens,
+                 positions, lengths, block_tables, seeds, counters,
+                 temperature, top_k, top_p, *, k, cfg, compute_dtype,
+                 attention_kernel="gather", mp_mesh=None):
+    """int8-KV variant of :func:`_multistep`: the scale arrays join the
+    scan carry, and because each iteration scatters exactly one position
+    per row (the single-step pattern), the masked-absmax requantization
+    touches blocks in the same order single-step decode would."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.sampling import sample_logits
+    from ...parallel.transformer import transformer_lm_decode
+
+    def body(carry, _):
+        k_pool, v_pool, k_scale, v_scale, tok, pos, ctr = carry
+        logits, k_pool, v_pool, k_scale, v_scale = transformer_lm_decode(
+            params, tok[:, None], pos[:, None], lengths, k_pool, v_pool,
+            block_tables, cfg, compute_dtype=compute_dtype,
+            attention_kernel=attention_kernel, mp_mesh=mp_mesh,
+            k_scale=k_scale, v_scale=v_scale)
+        nxt = sample_logits(logits[:, 0, :], seeds, ctr, temperature,
+                            top_k, top_p)
+        return (k_pool, v_pool, k_scale, v_scale, nxt, pos + 1,
+                ctr + 1), nxt
+
+    init = (k_pool, v_pool, k_scale, v_scale,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(counters, jnp.uint32))
+    (k_pool, v_pool, k_scale, v_scale, _, _, _), toks = jax.lax.scan(
+        body, init, None, length=k)
+    return jnp.transpose(toks), k_pool, v_pool, k_scale, v_scale
+
+
 class GenerationPrograms:
     """Owns the jitted step + per-signature compile accounting."""
 
@@ -173,6 +288,24 @@ class GenerationPrograms:
                     mp_mesh=(self._mp_mesh if self._kernel == "paged"
                              else None)),
                 donate_argnums=(1, 2))
+        # multi-token decoding (docs/generation.md "Speculative
+        # decoding"): the verify step shares the model step's operand
+        # layout but returns per-position targets + accept counts; the
+        # multistep scan needs one jitted partial per static k (built
+        # lazily — creating a jit wrapper traces nothing)
+        self._step_kw = dict(
+            cfg=cfg, compute_dtype=compute_dtype,
+            attention_kernel=self._kernel,
+            mp_mesh=(self._mp_mesh if self._kernel == "paged" else None))
+        if kv_dtype == "int8":
+            self._jit_verify = jax.jit(
+                functools.partial(_verify_step_q, **self._step_kw),
+                donate_argnums=(1, 2, 3, 4))
+        else:
+            self._jit_verify = jax.jit(
+                functools.partial(_verify_step, **self._step_kw),
+                donate_argnums=(1, 2))
+        self._jit_ms: Dict[int, object] = {}
         # the prefix-cache CoW block copy (docs/generation.md "prefix
         # caching"): ONE signature per pool family, donated like the
         # model step so the copy is an in-place device-side move
@@ -300,6 +433,107 @@ class GenerationPrograms:
             _np.asarray(top_k, _np.int32), _np.asarray(top_p, _np.float32))
         cache.swap(k, v)
         return _np.asarray(next_tokens), last
+
+    def _note(self, kind: str, key: tuple) -> None:
+        """Compile-cache bookkeeping shared by every program family:
+        per-signature hit/miss counts plus the ``_note_cache`` call that
+        feeds freeze/explain — BEFORE dispatch, like :meth:`run`."""
+        from ... import executor as _executor
+
+        with self._lock:
+            per = self._stats.get(key)
+            hit = per is not None
+            if per is None:
+                per = self._stats[key] = {"hits": 0, "misses": 0}
+        site_kind = kind if self.kernel == "gather" \
+            else f"{kind}_{self.kernel}"
+        if self._kv_dtype == "int8":
+            site_kind = f"{site_kind}_int8"
+        _executor._note_cache(hit=hit, site=(site_kind, ("lm",)), key=key)
+        with self._lock:
+            per["hits" if hit else "misses"] += 1
+
+    def run_verify(self, cache, tokens, positions, lengths, block_tables,
+                   seeds, counters, temperature, top_k, top_p):
+        """One speculative verify step: ``tokens`` (S, Tk) holds
+        ``[pending, d_1..d_s]`` per row (right-padded; ``lengths`` counts
+        the valid columns).  Returns ``(target np(S, Tk), accepted
+        np(S,))`` — see :func:`~mxnet_tpu.ops.sampling.speculative_verify`
+        for the emit contract.  Site ``gen_verify``; keys share the
+        :meth:`run` namespace so warmup enumerates the (Tk, W) ladder."""
+        key = self._key("gen_verify", cache, tokens, block_tables)
+        self._note("gen_verify", key)
+        args = (_np.asarray(tokens, _np.int32),
+                _np.asarray(positions, _np.int32),
+                _np.asarray(lengths, _np.int32),
+                _np.asarray(block_tables, _np.int32),
+                _np.asarray(seeds, _np.uint32),
+                _np.asarray(counters, _np.uint32),
+                _np.asarray(temperature, _np.float32),
+                _np.asarray(top_k, _np.int32),
+                _np.asarray(top_p, _np.float32))
+        if self._kv_dtype == "int8":
+            target, accepted, k, v, ks, vs = self._jit_verify(
+                self._params, cache.k, cache.v, cache.k_scale,
+                cache.v_scale, *args)
+            cache.swap(k, v, ks, vs)
+        else:
+            target, accepted, k, v = self._jit_verify(
+                self._params, cache.k, cache.v, *args)
+            cache.swap(k, v)
+        return _np.asarray(target), _np.asarray(accepted)
+
+    def _ms_jit(self, k: int):
+        import jax
+
+        with self._lock:
+            fn = self._jit_ms.get(k)
+            if fn is None:
+                if self._kv_dtype == "int8":
+                    fn = jax.jit(
+                        functools.partial(_multistep_q, k=k,
+                                          **self._step_kw),
+                        donate_argnums=(1, 2, 3, 4))
+                else:
+                    fn = jax.jit(
+                        functools.partial(_multistep, k=k,
+                                          **self._step_kw),
+                        donate_argnums=(1, 2))
+                self._jit_ms[k] = fn
+        return fn
+
+    def run_multistep(self, k: int, cache, tokens, positions, lengths,
+                      block_tables, seeds, counters, temperature, top_k,
+                      top_p):
+        """``k`` decode iterations in one donated program (``lax.scan``).
+
+        ``tokens``/``positions``/``counters`` are the first iteration's
+        (S,) values; returns np (S, k) tokens per row.  Each k is its own
+        program signature (``("k", k)`` key component, site
+        ``gen_multistep``) — the engine's pow2 k-ladder keeps the family
+        finite for warmup."""
+        tokens = _np.asarray(tokens, _np.int32)
+        key = self._key("gen_multistep", cache, tokens, block_tables)
+        key = (key[0], key[1] + (("k", int(k)),))
+        self._note("gen_multistep", key)
+        fn = self._ms_jit(int(k))
+        args = (tokens,
+                _np.asarray(positions, _np.int32),
+                _np.asarray(lengths, _np.int32),
+                _np.asarray(block_tables, _np.int32),
+                _np.asarray(seeds, _np.uint32),
+                _np.asarray(counters, _np.uint32),
+                _np.asarray(temperature, _np.float32),
+                _np.asarray(top_k, _np.int32),
+                _np.asarray(top_p, _np.float32))
+        if self._kv_dtype == "int8":
+            toks, kk, vv, ks, vs = fn(self._params, cache.k, cache.v,
+                                      cache.k_scale, cache.v_scale, *args)
+            cache.swap(kk, vv, ks, vs)
+        else:
+            toks, kk, vv = fn(self._params, cache.k, cache.v, *args)
+            cache.swap(kk, vv)
+        return _np.asarray(toks)
 
     def copy_block(self, cache, src: int, dst: int) -> None:
         """Copy pool block ``src`` onto ``dst`` (scales included for the

@@ -1,0 +1,191 @@
+"""Draft-token proposers for speculative decoding (docs/generation.md
+"Speculative decoding").
+
+Two proposal sources feed the engine's multi-query verify step
+(``GenerationPrograms.run_verify``):
+
+- :func:`propose_ngram` — self-speculative prompt-lookup drafting
+  (Saxena 2023 prompt-lookup decoding / LLMA): match the tail of the
+  request's OWN token history (prompt + generated) against an earlier
+  occurrence and propose the tokens that followed it.  Pure host numpy,
+  no second model, no device work — near-free, and strongest exactly
+  when prompts are repetitive (the prefix-cache-hot regime of PR 15);
+- :class:`DraftModel` — a small draft transformer (``transformer_lm_init``
+  layout) proposing ``k`` greedy continuations per slot in ONE compiled
+  program: the k autoregressive draft steps run inside ``lax.scan`` over a
+  fixed right-aligned context window, so the whole proposer is a single
+  ``(max_slots, window, k)`` signature — warmup-enumerable and clean under
+  ``TPUMX_FREEZE_COMPILES=1`` (site ``gen_draft``).
+
+Draft proposals NEVER affect output correctness — only the acceptance
+rate.  Verification (:func:`mxnet_tpu.ops.sampling.speculative_verify`)
+emits exactly the target model's own ``(seed, position)``-keyed tokens,
+so drafts are always proposed greedily here, even for stochastic
+requests.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, List
+
+import numpy as _np
+
+__all__ = ["propose_ngram", "DraftModel"]
+
+
+def propose_ngram(tokens, k: int, ngram_max: int,
+                  ngram_min: int = 1) -> List[int]:
+    """Prompt-lookup drafting: find the most recent earlier occurrence of
+    the history's trailing n-gram (longest ``n`` first, ``ngram_max`` down
+    to ``ngram_min``) and propose up to ``k`` tokens that followed it.
+
+    ``tokens`` is the request's full known history (prompt + generated,
+    including the pending token).  Returns ``[]`` when no n-gram repeats
+    — the engine then falls back to plain decoding for that slot, so a
+    non-repetitive request costs nothing extra.
+    """
+    toks = _np.asarray(tokens, dtype=_np.int32)
+    L = int(toks.size)
+    if k <= 0 or L < ngram_min + 1:
+        return []
+    for n in range(min(ngram_max, L - 1), ngram_min - 1, -1):
+        tail = toks[L - n:]
+        # candidate start offsets of earlier occurrences (exclude the
+        # trailing match itself); windows compared vectorized
+        starts = L - n - 1
+        if starts <= 0:
+            continue
+        windows = _np.lib.stride_tricks.sliding_window_view(
+            toks[:L - 1], n)
+        hits = _np.flatnonzero((windows == tail).all(axis=1))
+        if hits.size == 0:
+            continue
+        i = int(hits[-1])  # most recent prior occurrence
+        cont = toks[i + n:i + n + k]
+        if cont.size:
+            return [int(t) for t in cont]
+    return []
+
+
+def _draft_propose(params, window, positions, n_valid, *, k, cfg,
+                   compute_dtype=None):
+    """k greedy draft tokens per row from a fixed right-aligned context
+    window — the whole autoregressive proposal loop traced as ONE
+    ``lax.scan`` program.
+
+    window : (S, w) int32 — the last ``min(ctx+1, w)`` known tokens of
+        each slot, RIGHT-aligned (left entries are padding).
+    positions : (S, w) int32 — global positions of those columns (padding
+        columns may be negative; they are clipped and masked).
+    n_valid : (S,) int32 — real tokens per row (0 = inactive slot).
+
+    Returns (S, k) int32 proposals.  The draft attends causally within
+    the window only — a deliberate truncation: proposals are cheap hints,
+    the target's verify step is the sole source of truth.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.sampling import NEG_INF
+    from ...parallel.transformer import _ln
+
+    if compute_dtype is not None:
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(compute_dtype), params)
+    w = window.shape[1]
+    col = jnp.arange(w, dtype=jnp.int32)
+    causal = col[None, :, None] >= col[None, None, :]       # (1, q, kc)
+    scale = 1.0 / jnp.sqrt(cfg.d_head).astype(jnp.float32)
+
+    def fwd(window, positions, n_valid):
+        B = window.shape[0]
+        pos = jnp.clip(positions, 0, cfg.max_len - 1)
+        key_ok = col[None, :] >= (w - n_valid)[:, None]     # (B, kc)
+        mask = causal & key_ok[:, None, :]                  # (B, q, kc)
+        bias = jnp.where(mask, 0.0, NEG_INF)
+        x = params["tok_emb"][window] + params["pos_emb"][pos]
+        for i in range(cfg.n_layers):
+            g = lambda n: params[f"l{i}_{n}"]  # noqa: B023 — read now
+            h = _ln(x, g("ln1_g"), g("ln1_b"))
+            qkv = h @ g("wqkv")
+            q, kk, v = jnp.split(qkv, 3, axis=-1)
+            to_heads = lambda t: t.reshape(B, w, cfg.n_heads, cfg.d_head)
+            q, kk, v = to_heads(q), to_heads(kk), to_heads(v)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           kk.astype(jnp.float32)) * scale
+            s = s + bias[:, None, :, :]
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+            x = x + o.astype(x.dtype).reshape(B, w, cfg.d_model) @ g("wo")
+            h = _ln(x, g("ln2_g"), g("ln2_b"))
+            x = x + jax.nn.gelu(h @ g("w1") + g("b1")) @ g("w2") + g("b2")
+        x = _ln(x, params["lnf_g"], params["lnf_b"])
+        return (x[:, -1, :] @ params["tok_emb"].T).astype(jnp.float32)
+
+    def body(carry, _):
+        window, positions, n_valid = carry
+        logits = fwd(window, positions, n_valid)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        window = jnp.concatenate([window[:, 1:], nxt[:, None]], axis=1)
+        positions = jnp.concatenate(
+            [positions[:, 1:], (positions[:, -1] + 1)[:, None]], axis=1)
+        n_valid = jnp.minimum(n_valid + 1, w)
+        return (window, positions, n_valid), nxt
+
+    _, toks = jax.lax.scan(body, (window, positions, n_valid), None,
+                           length=k)
+    return jnp.transpose(toks)  # (S, k)
+
+
+class DraftModel:
+    """The compiled draft proposer: one jitted ``(S, window, k)`` program
+    with the same compile-cache accounting (site ``gen_draft``) and
+    freeze discipline as the engine's model steps."""
+
+    def __init__(self, params, cfg, k: int, window: int,
+                 compute_dtype=None):
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.k = int(k)
+        self.window = int(window)
+        if self.window < 1:
+            raise ValueError("draft_window must be >= 1")
+        if self.window > cfg.max_len:
+            raise ValueError(
+                f"draft_window {self.window} exceeds the draft model's "
+                f"max_len {cfg.max_len}")
+        self._params = {n: jnp.asarray(v) for n, v in params.items()}
+        self._jit = jax.jit(functools.partial(
+            _draft_propose, k=self.k, cfg=cfg,
+            compute_dtype=compute_dtype))
+        self._lock = threading.Lock()
+        self._stats: Dict[tuple, Dict[str, int]] = {}
+
+    def propose(self, window, positions, n_valid) -> _np.ndarray:
+        """(S, k) greedy draft proposals; inactive rows (n_valid 0)
+        return garbage the engine ignores."""
+        from ... import executor as _executor
+
+        window = _np.asarray(window, _np.int32)
+        key = ("gen_draft",
+               (("window", tuple(window.shape), "int32"),
+                ("k", self.k)))
+        with self._lock:
+            per = self._stats.get(key)
+            hit = per is not None
+            if per is None:
+                per = self._stats[key] = {"hits": 0, "misses": 0}
+        _executor._note_cache(hit=hit, site=("gen_draft", ("lm",)), key=key)
+        with self._lock:
+            per["hits" if hit else "misses"] += 1
+        out = self._jit(self._params, window,
+                        _np.asarray(positions, _np.int32),
+                        _np.asarray(n_valid, _np.int32))
+        return _np.asarray(out)
+
+    def compile_stats(self) -> Dict[tuple, Dict[str, int]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._stats.items()}

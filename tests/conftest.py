@@ -119,6 +119,13 @@ def pytest_configure(config):
         "KV blocks, LRU eviction ahead of preemption, router "
         "shared-prefix affinity; docs/generation.md; select with "
         "`pytest -m prefix`)")
+    config.addinivalue_line(
+        "markers",
+        "speculative: speculative + multi-token decoding "
+        "(mxnet_tpu.serving.generation.speculative — n-gram/draft-model "
+        "proposers, the multi-query verify step, multistep lax.scan "
+        "decode, exact-match rejection sampling; docs/generation.md "
+        "\"Speculative decoding\"; select with `pytest -m speculative`)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -199,6 +199,48 @@ def test_ttft_breakdown_sums_to_ttft_and_total(params):
     assert preempted and preempted[0]["breakdown_ms"].get("preempted", 0) > 0
 
 
+@pytest.mark.speculative
+def test_ttft_breakdown_partition_with_speculation(params):
+    """Speculative decoding adds NO lifetime segments (verify steps run
+    inside "decode"), so the exact TTFT/total partition survives with the
+    gate on — and the wide event carries the new decode_mode /
+    accepted_ratio / draft-token fields."""
+    svc = GenerationService(params, CFG, _gc(speculative=True),
+                            start=False)
+    # repetitive prompts: the n-gram drafter fires and drafts get accepted
+    hs = [svc.submit([1, 2, 3, 4, 1, 2, 3, 4, 1, 2, 3, 4],
+                     max_new_tokens=10),
+          svc.submit([7, 8, 9, 7, 8, 9, 7, 8, 9], max_new_tokens=10)]
+    svc.start()
+    for h in hs:
+        h.result(120)
+    st = svc.stats()
+    svc.stop()
+    assert st["counts"]["spec_steps"] >= 1
+    assert st["speculative"]["proposed_tokens"] >= 1
+    for h in hs:
+        ev = h.stats()
+        assert ev["outcome"] == "finished"
+        comp = set(ev["ttft_breakdown_ms"]) | set(ev["breakdown_ms"])
+        assert comp <= {"queue", "admission", "prefill", "decode",
+                        "preempted", "prefix_reuse"}
+        assert sum(ev["ttft_breakdown_ms"].values()) == \
+            pytest.approx(ev["ttft_ms"], abs=0.05)
+        assert sum(ev["breakdown_ms"].values()) == \
+            pytest.approx(ev["total_ms"], abs=0.05)
+        assert ev["decode_mode"] in ("single", "spec")
+        assert ev["draft_proposed_tokens"] >= 0
+        assert ev["draft_accepted_tokens"] <= ev["draft_proposed_tokens"]
+        if ev["draft_proposed_tokens"]:
+            assert ev["accepted_ratio"] == pytest.approx(
+                ev["draft_accepted_tokens"] / ev["draft_proposed_tokens"],
+                abs=1e-3)
+        else:
+            assert ev["accepted_ratio"] is None
+    assert any(ev["decode_mode"] == "spec" for ev in map(
+        lambda h: h.stats(), hs))
+
+
 def test_retried_then_quarantined_wide_event(params, tmp_path, monkeypatch):
     """A persistently poisoned request is retried, bisected, quarantined —
     its wide event records the retries and a breakdown that still sums to

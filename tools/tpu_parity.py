@@ -405,6 +405,67 @@ def build_cases():
 
     cases += [("kv_block_copy_cow", _device_case(kv_block_copy)),
               ("kv_block_copy_cow_int8", _device_case(kv_block_copy_int8))]
+
+    # the speculative-decoding pair (docs/generation.md "Speculative
+    # decoding"): the exact-match rejection sampler and the multi-query
+    # verify step — a mid-sequence (B, s+1) chunk through the cache-aware
+    # decode path followed by speculative_verify on its logits, exactly
+    # the engine's one-dispatch verify iteration.  Inputs hoisted like
+    # the entries above.
+    logits_sv = rng.randn(2, 4, 19).astype(np.float32)
+    fed_sv = rng.randint(0, 19, (2, 4)).astype(np.int32)
+    seeds_sv = np.array([7, 9], np.uint32)
+    ctr_sv = np.array([11, 4], np.uint32)
+    temp_sv = np.array([0.0, 0.8], np.float32)
+    topk_sv = np.array([0, 5], np.int32)
+    topp_sv = np.array([1.0, 0.9], np.float32)
+    len_sv = np.array([4, 3], np.int32)
+    prompt_sv = rng.randint(0, 19, (1, 8)).astype(np.int32)
+    verify_sv = rng.randint(0, 19, (1, 4)).astype(np.int32)
+
+    def spec_rejection_sampler(put):
+        import jax
+
+        from mxnet_tpu.ops import sampling as smp
+
+        tgt, acc = jax.jit(smp.speculative_verify)(
+            put(logits_sv), put(fed_sv), put(seeds_sv), put(ctr_sv),
+            put(temp_sv), put(topk_sv), put(topp_sv), put(len_sv))
+        return [np.asarray(tgt), np.asarray(acc)]
+
+    def spec_verify_step(put):
+        import functools
+
+        import jax
+
+        from mxnet_tpu.ops import sampling as smp
+        from mxnet_tpu.parallel import transformer as tr
+
+        cfg = tr.TransformerConfig(vocab=19, d_model=16, n_heads=2,
+                                   n_layers=2, d_ff=32, max_len=32)
+        params = put(tr.transformer_lm_init(cfg, jax.random.PRNGKey(2)))
+        kp = put(np.zeros((2, 4, 8, 2, 8), np.float32))
+        vp = put(np.zeros((2, 4, 8, 2, 8), np.float32))
+        tbl = put(np.array([[1, 2]], np.int32))
+        step = jax.jit(functools.partial(tr.transformer_lm_decode, cfg=cfg))
+        # prefill the 8-token context...
+        _, kp, vp = step(params, put(prompt_sv),
+                         put(np.arange(8, dtype=np.int32)[None]),
+                         put(np.array([8], np.int32)), kp, vp, tbl)
+        # ...then ONE (1, 4) verify chunk at positions 8..11 and the
+        # rejection sampler over its per-position logits
+        logits, kp, vp = step(params, put(verify_sv),
+                              put(np.arange(8, 12, dtype=np.int32)[None]),
+                              put(np.array([4], np.int32)), kp, vp, tbl)
+        tgt, acc = jax.jit(smp.speculative_verify)(
+            logits, put(verify_sv), put(seeds_sv[:1]), put(ctr_sv[:1]),
+            put(temp_sv[:1]), put(topk_sv[:1]), put(topp_sv[:1]),
+            put(len_sv[:1]))
+        return [np.asarray(logits), np.asarray(kp), np.asarray(vp),
+                np.asarray(tgt), np.asarray(acc)]
+
+    cases += [("spec_rejection_sampler", _device_case(spec_rejection_sampler)),
+              ("spec_verify_step", _device_case(spec_verify_step))]
     return cases
 
 
